@@ -1,0 +1,31 @@
+"""ceph_tpu — a TPU-native distributed storage-compute framework.
+
+A from-scratch reimplementation of the capabilities of Ceph (reference:
+javacruft/ceph, Octopus 15.1.0) designed TPU-first: the erasure-code data
+plane runs as bit-sliced GF(2^8) matmuls on the MXU (JAX/Pallas), stripes
+are batched into tensors, shardings over a `jax.sharding.Mesh` replace
+NCCL-style collectives, and the host-side control plane (plugin registry,
+OSD pipeline, CRUSH placement, messenger, monitor) keeps Ceph's contracts
+without porting its C++.
+
+Layer map (mirrors reference SURVEY.md section 1):
+  common/   foundations: bufferlist, crc32c, config, logging, perf counters
+  ec/       erasure-code subsystem (interface, registry, plugins)
+  ops/      JAX/Pallas kernels: GF(2^8) bit-sliced matmul, crc32c, bitpack
+  osd/      EC write/read/recovery pipeline (ECUtil, ECBackend, PGLog)
+  crush/    deterministic placement (straw2, rjenkins hash)
+  msg/      async messenger (framed, crc-protected protocol)
+  mon/      monitor: cluster-map authority
+  osdc/     objecter (client-side op engine)
+  rados/    librados-like public client API
+  store/    ObjectStore contract + MemStore / FileStore-lite
+  parallel/ device-mesh sharding of the stripe-batch data plane
+  tools/    benchmark + CLI tools
+"""
+
+__version__ = "0.1.0"
+
+# Mirrors CEPH_RELEASE / ceph_release ("15 octopus rc") versioning role:
+# plugins embed this and the registry refuses mismatches (reference:
+# src/erasure-code/ErasureCodePlugin.cc:142).
+PLUGIN_ABI_VERSION = "ceph-tpu-plugin-1"

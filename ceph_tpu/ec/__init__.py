@@ -1,0 +1,12 @@
+"""Erasure-code subsystem (reference src/erasure-code/)."""
+
+from .interface import ErasureCodeError, ErasureCodeInterface, Profile
+from .registry import ErasureCodePlugin, ErasureCodePluginRegistry
+
+__all__ = [
+    "ErasureCodeError",
+    "ErasureCodeInterface",
+    "Profile",
+    "ErasureCodePlugin",
+    "ErasureCodePluginRegistry",
+]

@@ -1,0 +1,281 @@
+"""GF(2^8) arithmetic core for Reed-Solomon erasure codes.
+
+The reference gets its Galois-field kernels from vendored jerasure/
+gf-complete and ISA-L assembler submodules (reference .gitmodules;
+src/erasure-code/jerasure/, src/erasure-code/isa/).  Here the field lives
+in numpy tables on the host and — the point of this framework — as GF(2)
+bit-matrices so that multiply-accumulate over the field becomes an XOR/AND
+matmul the TPU MXU can run (see ceph_tpu/ops/gf_matmul.py).
+
+Field: GF(2^8) with the primitive polynomial x^8+x^4+x^3+x^2+1 (0x11d),
+the same polynomial gf-complete and ISA-L use for w=8.
+
+Key identity used for bit-slicing: multiplication by a constant c is
+GF(2)-linear on the 8 bits of the operand, so there is an 8x8 bit-matrix
+M_c with  bits(c*x) = M_c @ bits(x)  (mod 2).  A full (k+m, k) generator
+matrix over GF(2^8) therefore expands to an (8(k+m), 8k) 0/1 matrix, and
+encode of a whole chunk is one {0,1}-matmul mod 2 — MXU food.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+GF_POLY = 0x11D
+GF_SIZE = 256
+
+
+def _build_tables() -> tuple[np.ndarray, np.ndarray]:
+    """Log/antilog tables for the generator alpha=2 of GF(2^8)/0x11d."""
+    exp = np.zeros(512, dtype=np.uint8)
+    log = np.zeros(256, dtype=np.int32)
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x <<= 1
+        if x & 0x100:
+            x ^= GF_POLY
+    exp[255:510] = exp[0:255]  # wraparound so exp[a+b] works without mod
+    return exp, log
+
+
+GF_EXP, GF_LOG = _build_tables()
+
+
+def gf_mul(a: int, b: int) -> int:
+    """Scalar multiply in GF(2^8)."""
+    if a == 0 or b == 0:
+        return 0
+    return int(GF_EXP[int(GF_LOG[a]) + int(GF_LOG[b])])
+
+
+def gf_div(a: int, b: int) -> int:
+    """Scalar divide in GF(2^8); b must be nonzero."""
+    if b == 0:
+        raise ZeroDivisionError("GF(2^8) division by zero")
+    if a == 0:
+        return 0
+    return int(GF_EXP[(int(GF_LOG[a]) - int(GF_LOG[b])) % 255])
+
+
+def gf_inv(a: int) -> int:
+    if a == 0:
+        raise ZeroDivisionError("GF(2^8) inverse of zero")
+    return int(GF_EXP[(255 - int(GF_LOG[a])) % 255])
+
+
+def gf_pow(a: int, n: int) -> int:
+    if n == 0:
+        return 1
+    if a == 0:
+        return 0
+    return int(GF_EXP[(int(GF_LOG[a]) * n) % 255])
+
+
+@functools.lru_cache(maxsize=1)
+def mul_table() -> np.ndarray:
+    """(256, 256) uint8 full multiplication table; MUL[a][b] = a*b.
+
+    Row c is the byte-LUT for multiply-by-c, applied to whole numpy chunks
+    with a single fancy-index (the host-side analog of gf-complete's
+    region multiply kernels).
+    """
+    a = np.arange(256)
+    la = GF_LOG[a][:, None]
+    lb = GF_LOG[a][None, :]
+    out = GF_EXP[(la + lb) % 255].astype(np.uint8)
+    out[0, :] = 0
+    out[:, 0] = 0
+    return out
+
+
+def gf_mul_region(c: int, data: np.ndarray) -> np.ndarray:
+    """Multiply every byte of `data` (uint8 ndarray) by constant c."""
+    if c == 0:
+        return np.zeros_like(data)
+    if c == 1:
+        return data.copy()
+    return mul_table()[c][data]
+
+
+def gf_matvec(mat: np.ndarray, chunks: np.ndarray) -> np.ndarray:
+    """GF(2^8) matrix x "vector of chunks" product.
+
+    mat: (r, k) uint8 coefficient matrix.
+    chunks: (k, n) uint8 — k chunks of n bytes.
+    Returns (r, n) uint8: out[i] = XOR_j mat[i,j] * chunks[j].
+
+    This is the CPU reference for what ops/gf_matmul.py computes on TPU;
+    bit-identical by construction of the bit-matrices.
+    """
+    r, k = mat.shape
+    assert chunks.shape[0] == k, (mat.shape, chunks.shape)
+    out = np.zeros((r, chunks.shape[1]), dtype=np.uint8)
+    lut = mul_table()
+    for i in range(r):
+        acc = out[i]
+        for j in range(k):
+            c = int(mat[i, j])
+            if c == 0:
+                continue
+            if c == 1:
+                acc ^= chunks[j]
+            else:
+                acc ^= lut[c][chunks[j]]
+        out[i] = acc
+    return out
+
+
+def gf_matmul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """GF(2^8) matrix product of small coefficient matrices (uint8)."""
+    r, k = a.shape
+    k2, c = b.shape
+    assert k == k2
+    out = np.zeros((r, c), dtype=np.uint8)
+    for i in range(r):
+        for j in range(c):
+            acc = 0
+            for t in range(k):
+                acc ^= gf_mul(int(a[i, t]), int(b[t, j]))
+            out[i, j] = acc
+    return out
+
+
+def gf_invert_matrix(mat: np.ndarray) -> np.ndarray:
+    """Invert a square GF(2^8) matrix by Gauss-Jordan elimination.
+
+    Raises ValueError if singular.  Used on the host to build decode
+    matrices from the surviving rows of the generator matrix (reference
+    behavior: jerasure_matrix_decode / ISA-L gf_gen_decode_matrix).
+    """
+    n = mat.shape[0]
+    assert mat.shape == (n, n)
+    a = mat.astype(np.uint8).copy()
+    inv = np.eye(n, dtype=np.uint8)
+    for col in range(n):
+        pivot = -1
+        for row in range(col, n):
+            if a[row, col]:
+                pivot = row
+                break
+        if pivot < 0:
+            raise ValueError("singular GF(2^8) matrix")
+        if pivot != col:
+            a[[col, pivot]] = a[[pivot, col]]
+            inv[[col, pivot]] = inv[[pivot, col]]
+        pv = gf_inv(int(a[col, col]))
+        if pv != 1:
+            lut = mul_table()[pv]
+            a[col] = lut[a[col]]
+            inv[col] = lut[inv[col]]
+        for row in range(n):
+            if row != col and a[row, col]:
+                c = int(a[row, col])
+                lut = mul_table()[c]
+                a[row] ^= lut[a[col]]
+                inv[row] ^= lut[inv[col]]
+    return inv
+
+
+# ----------------------------------------------------------------------------
+# Bit-matrix expansion (the TPU-native representation)
+# ----------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=1)
+def _bitmatrix_table() -> np.ndarray:
+    """(256, 8, 8) uint8: BITMAT[c] is M_c with bits(c*x) = M_c @ bits(x).
+
+    Bit order is LSB-first: bit i of a byte is (byte >> i) & 1.
+    Column j of M_c holds bits(c * 2^j).
+    """
+    out = np.zeros((256, 8, 8), dtype=np.uint8)
+    for c in range(256):
+        for j in range(8):
+            prod = gf_mul(c, 1 << j)
+            for i in range(8):
+                out[c, i, j] = (prod >> i) & 1
+    return out
+
+
+def expand_to_bitmatrix(mat: np.ndarray) -> np.ndarray:
+    """Expand an (r, k) GF(2^8) matrix to an (8r, 8k) GF(2) 0/1 matrix.
+
+    Block (i, j) of the result is the 8x8 bit-matrix of coefficient
+    mat[i, j].  Multiplying bit-sliced data by this matrix mod 2 computes
+    the same chunks gf_matvec does — this is the Cauchy-bitmatrix idea
+    (reference: jerasure cauchy_orig/cauchy_good schedules,
+    src/erasure-code/jerasure/ErasureCodeJerasure.cc:265,353) recast as a
+    dense matmul for the MXU instead of an XOR schedule for the CPU.
+    """
+    r, k = mat.shape
+    bm = _bitmatrix_table()
+    out = np.zeros((8 * r, 8 * k), dtype=np.uint8)
+    for i in range(r):
+        for j in range(k):
+            out[8 * i:8 * i + 8, 8 * j:8 * j + 8] = bm[mat[i, j]]
+    return out
+
+
+def bitmatrix_matvec(bitmat: np.ndarray, chunks: np.ndarray) -> np.ndarray:
+    """CPU check-model of the TPU path: bit-unpack, 0/1 matmul mod 2, pack.
+
+    chunks: (k, n) uint8 -> returns (r, n) uint8 where bitmat is (8r, 8k).
+    """
+    k8 = bitmat.shape[1]
+    k = k8 // 8
+    assert chunks.shape[0] == k
+    bits = np.unpackbits(chunks, axis=0, bitorder="little")  # (8k, n)? no:
+    # unpackbits on axis 0 expands each row into 8 rows (bit planes of that
+    # row, LSB-first with bitorder="little"), giving (8k, n) with row
+    # j*8+i = bit i of chunk j — matches the bitmatrix column layout.
+    prod = (bitmat.astype(np.uint32) @ bits.astype(np.uint32)) & 1
+    return np.packbits(prod.astype(np.uint8), axis=0, bitorder="little")
+
+
+# ----------------------------------------------------------------------------
+# Generator matrix constructions
+# ----------------------------------------------------------------------------
+
+def vandermonde_rs_matrix(k: int, m: int) -> np.ndarray:
+    """Systematic (k+m, k) RS generator matrix from a Vandermonde matrix.
+
+    Construction: build the (k+m, k) Vandermonde V[i,j] = i^j (distinct
+    evaluation points 0..k+m-1), then column-reduce so the top k rows are
+    the identity.  Any k rows of the result are invertible, which is the
+    property reed_sol_van relies on (reference:
+    src/erasure-code/jerasure/ErasureCodeJerasure.cc:162 via
+    jerasure's reed_sol_vandermonde_coding_matrix).
+    """
+    n = k + m
+    if n > GF_SIZE:
+        raise ValueError(f"k+m={n} exceeds GF(2^8) point count")
+    v = np.zeros((n, k), dtype=np.uint8)
+    for i in range(n):
+        for j in range(k):
+            v[i, j] = gf_pow(i, j) if i else (1 if j == 0 else 0)
+    # Column-reduce so rows 0..k-1 become identity; elementary column ops
+    # preserve the any-k-rows-invertible property.
+    top_inv = gf_invert_matrix(v[:k, :])
+    return gf_matmul(v, top_inv)
+
+
+def cauchy_rs_matrix(k: int, m: int) -> np.ndarray:
+    """Systematic (k+m, k) generator: identity on top of a Cauchy block.
+
+    Parity block C[i,j] = 1/(x_i + y_j) with x_i = k+i, y_j = j — distinct
+    points so every square submatrix of the Cauchy block is invertible and
+    the whole matrix is MDS (reference technique cauchy_orig/cauchy_good,
+    src/erasure-code/jerasure/ErasureCodeJerasure.h:138-187; ISA-L kCauchy,
+    src/erasure-code/isa/ErasureCodeIsa.h:37).
+    """
+    if k + m > GF_SIZE:
+        raise ValueError(f"k+m={k + m} exceeds GF(2^8) point count")
+    g = np.zeros((k + m, k), dtype=np.uint8)
+    g[:k, :] = np.eye(k, dtype=np.uint8)
+    for i in range(m):
+        for j in range(k):
+            g[k + i, j] = gf_inv((k + i) ^ j)
+    return g

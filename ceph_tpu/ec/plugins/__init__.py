@@ -1,0 +1,16 @@
+"""Built-in erasure-code plugins.
+
+Naming convention mirrors the reference's shared objects: plugin `name`
+lives in module `ec_<name>` (reference loads `libec_<name>.so`,
+src/erasure-code/ErasureCodePlugin.cc:110).
+
+Built-ins:
+  ec_example  - trivial k=2 m=1 XOR codec (test reference, like
+                src/test/erasure-code/ErasureCodeExample.h)
+  ec_jerasure - CPU Reed-Solomon (reed_sol_van, cauchy_orig, cauchy_good)
+  ec_isa      - CPU RS with cached decode tables (ISA-L role)
+  ec_jax      - TPU bit-sliced GF(2^8) matmul codec (the north star)
+  ec_lrc      - locally repairable layered code
+  ec_shec     - shingled EC
+  ec_clay     - coupled-layer MSR regenerating code
+"""

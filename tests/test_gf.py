@@ -1,0 +1,107 @@
+"""GF(2^8) field + matrix math unit tests.
+
+Models the reference's codec-math tier (SURVEY.md section 4 tier 1, e.g.
+src/test/erasure-code/TestErasureCodeJerasure.cc) at the field level.
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import gf
+
+
+def test_field_axioms_sampled():
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        a, b, c = (int(x) for x in rng.integers(0, 256, 3))
+        assert gf.gf_mul(a, b) == gf.gf_mul(b, a)
+        assert gf.gf_mul(a, gf.gf_mul(b, c)) == gf.gf_mul(gf.gf_mul(a, b), c)
+        # distributivity over XOR (field addition)
+        assert gf.gf_mul(a, b ^ c) == gf.gf_mul(a, b) ^ gf.gf_mul(a, c)
+    assert gf.gf_mul(1, 77) == 77
+    assert gf.gf_mul(0, 77) == 0
+
+
+def test_inverse():
+    for a in range(1, 256):
+        assert gf.gf_mul(a, gf.gf_inv(a)) == 1
+        assert gf.gf_div(a, a) == 1
+    with pytest.raises(ZeroDivisionError):
+        gf.gf_inv(0)
+
+
+def test_mul_table_matches_scalar():
+    t = gf.mul_table()
+    rng = np.random.default_rng(1)
+    for _ in range(100):
+        a, b = (int(x) for x in rng.integers(0, 256, 2))
+        assert t[a, b] == gf.gf_mul(a, b)
+
+
+def test_region_mul():
+    rng = np.random.default_rng(2)
+    data = rng.integers(0, 256, 1024, dtype=np.uint8)
+    for c in (0, 1, 2, 87, 255):
+        ref = np.array([gf.gf_mul(c, int(x)) for x in data], dtype=np.uint8)
+        np.testing.assert_array_equal(gf.gf_mul_region(c, data), ref)
+
+
+def test_matrix_inversion():
+    rng = np.random.default_rng(3)
+    for n in (1, 2, 4, 8):
+        for _ in range(5):
+            while True:
+                m = rng.integers(0, 256, (n, n)).astype(np.uint8)
+                try:
+                    inv = gf.gf_invert_matrix(m)
+                    break
+                except ValueError:
+                    continue
+            prod = gf.gf_matmul(m, inv)
+            np.testing.assert_array_equal(prod, np.eye(n, dtype=np.uint8))
+
+
+def test_singular_matrix_raises():
+    m = np.array([[1, 2], [1, 2]], dtype=np.uint8)
+    with pytest.raises(ValueError):
+        gf.gf_invert_matrix(m)
+
+
+@pytest.mark.parametrize("k,m", [(2, 1), (3, 2), (8, 3), (8, 4), (12, 4)])
+@pytest.mark.parametrize("builder", [gf.vandermonde_rs_matrix,
+                                     gf.cauchy_rs_matrix])
+def test_generator_matrices_mds(k, m, builder):
+    """Every k-row subset must be invertible (MDS property)."""
+    import itertools
+    g = builder(k, m)
+    np.testing.assert_array_equal(g[:k], np.eye(k, dtype=np.uint8))
+    n = k + m
+    combos = list(itertools.combinations(range(n), k))
+    if len(combos) > 60:
+        rng = np.random.default_rng(4)
+        combos = [combos[i] for i in
+                  rng.choice(len(combos), 60, replace=False)]
+    for rows in combos:
+        gf.gf_invert_matrix(g[list(rows), :])  # raises if singular
+
+
+def test_bitmatrix_equals_field_mul():
+    """bits(c*x) == M_c @ bits(x) for all c, sampled x."""
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 256, (1, 256), dtype=np.uint8)
+    for c in list(range(8)) + [13, 142, 255]:
+        mat = np.array([[c]], dtype=np.uint8)
+        bm = gf.expand_to_bitmatrix(mat)
+        got = gf.bitmatrix_matvec(bm, data)
+        ref = gf.gf_mul_region(c, data)
+        np.testing.assert_array_equal(got, ref)
+
+
+@pytest.mark.parametrize("k,m", [(2, 1), (8, 3), (5, 4)])
+def test_bitmatrix_matvec_equals_gf_matvec(k, m):
+    rng = np.random.default_rng(6)
+    g = gf.cauchy_rs_matrix(k, m)[k:]
+    chunks = rng.integers(0, 256, (k, 512), dtype=np.uint8)
+    ref = gf.gf_matvec(g, chunks)
+    got = gf.bitmatrix_matvec(gf.expand_to_bitmatrix(g), chunks)
+    np.testing.assert_array_equal(got, ref)

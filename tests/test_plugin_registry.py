@@ -1,0 +1,139 @@
+"""Plugin registry loading / fault-model tests.
+
+Models reference src/test/erasure-code/TestErasureCodePlugin.cc:77-106 and
+its broken-plugin .so fixtures (FailToInitialize/FailToRegister/
+MissingEntryPoint/MissingVersion): the registry's error contract is
+ENOENT / EXDEV / ENOEXEC / EBADF / EEXIST, and concurrent factory() calls
+must serialize on the registry lock.
+"""
+
+import errno
+import textwrap
+import threading
+
+import pytest
+
+from ceph_tpu.ec import ErasureCodeError, ErasureCodePluginRegistry
+
+REG = ErasureCodePluginRegistry.instance()
+
+
+def write_plugin(tmp_path, name, body):
+    (tmp_path / f"ec_{name}.py").write_text(textwrap.dedent(body))
+    return str(tmp_path)
+
+
+def test_load_missing_plugin():
+    with pytest.raises(ErasureCodeError) as ei:
+        REG.factory("no_such_plugin_xyz", {})
+    assert ei.value.errno == errno.ENOENT
+
+
+def test_missing_version(tmp_path):
+    d = write_plugin(tmp_path, "noversion", """
+        def __erasure_code_init__(name, directory):
+            pass
+    """)
+    with pytest.raises(ErasureCodeError) as ei:
+        REG.factory("noversion", {}, directory=d)
+    assert ei.value.errno == errno.EXDEV
+
+
+def test_version_mismatch(tmp_path):
+    d = write_plugin(tmp_path, "badversion", """
+        __erasure_code_version__ = "something-old"
+        def __erasure_code_init__(name, directory):
+            pass
+    """)
+    with pytest.raises(ErasureCodeError) as ei:
+        REG.factory("badversion", {}, directory=d)
+    assert ei.value.errno == errno.EXDEV
+
+
+def test_missing_entry_point(tmp_path):
+    d = write_plugin(tmp_path, "noentry", """
+        from ceph_tpu import PLUGIN_ABI_VERSION
+        __erasure_code_version__ = PLUGIN_ABI_VERSION
+    """)
+    with pytest.raises(ErasureCodeError) as ei:
+        REG.factory("noentry", {}, directory=d)
+    assert ei.value.errno == errno.ENOENT
+
+
+def test_fail_to_initialize(tmp_path):
+    d = write_plugin(tmp_path, "failinit", """
+        from ceph_tpu import PLUGIN_ABI_VERSION
+        __erasure_code_version__ = PLUGIN_ABI_VERSION
+        def __erasure_code_init__(name, directory):
+            raise RuntimeError("boom")
+    """)
+    with pytest.raises(ErasureCodeError) as ei:
+        REG.factory("failinit", {}, directory=d)
+    assert ei.value.errno == errno.ENOEXEC
+
+
+def test_fail_to_register(tmp_path):
+    d = write_plugin(tmp_path, "noregister", """
+        from ceph_tpu import PLUGIN_ABI_VERSION
+        __erasure_code_version__ = PLUGIN_ABI_VERSION
+        def __erasure_code_init__(name, directory):
+            pass  # "forgets" to call registry.add
+    """)
+    with pytest.raises(ErasureCodeError) as ei:
+        REG.factory("noregister", {}, directory=d)
+    assert ei.value.errno == errno.EBADF
+
+
+def test_double_add_is_eexist(tmp_path):
+    from ceph_tpu.ec.registry import ErasureCodePlugin
+    if REG.get("example") is None:
+        REG.load("example")
+    with pytest.raises(ErasureCodeError) as ei:
+        REG.add("example", ErasureCodePlugin())
+    assert ei.value.errno == errno.EEXIST
+
+
+def test_external_plugin_dir_loads(tmp_path):
+    """A valid out-of-tree plugin loads from erasure_code_dir, like
+    libec_*.so from the plugin directory (options.cc:564)."""
+    d = write_plugin(tmp_path, "extxor", """
+        import numpy as np
+        from ceph_tpu import PLUGIN_ABI_VERSION
+        from ceph_tpu.ec.plugins.ec_example import ErasureCodeExample
+        from ceph_tpu.ec.registry import (ErasureCodePlugin,
+                                          ErasureCodePluginRegistry)
+        __erasure_code_version__ = PLUGIN_ABI_VERSION
+        class P(ErasureCodePlugin):
+            def factory(self, profile):
+                return ErasureCodeExample()
+        def __erasure_code_init__(name, directory):
+            ErasureCodePluginRegistry.instance().add(name, P())
+    """)
+    codec = REG.factory("extxor", {}, directory=d)
+    enc = codec.encode({0, 1, 2}, b"x" * 100)
+    assert len(enc) == 3
+
+
+def test_concurrent_factory_threadsafe():
+    """Registry must survive concurrent lazy loads (reference deadlock
+    test TestErasureCodePlugin.cc:30-72 with the Hangs fixture)."""
+    errs = []
+
+    def run():
+        try:
+            REG.factory("jerasure", {"k": "2", "m": "1"})
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=run) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errs
+
+
+def test_preload():
+    REG.preload(["jerasure", "isa", "example"])
+    assert REG.get("jerasure") is not None
+    assert REG.get("isa") is not None
