@@ -1,0 +1,1 @@
+"""TPU kernels: bit-sliced GF(2^8) matmul, crc32c, packing utilities."""

@@ -1,0 +1,156 @@
+"""Bit-sliced GF(2^8) linear algebra on TPU.
+
+The hot loop of the whole framework.  The reference computes erasure-code
+parity with per-coefficient Galois region ops (jerasure schedules /
+ISA-L `ec_encode_data`, reference src/erasure-code/isa/ErasureCodeIsa.cc:129)
+— a CPU-SIMD formulation.  TPU-first, the same math is one matmul:
+
+  * multiply-by-constant in GF(2^8) is GF(2)-linear on the 8 bits, so a
+    (r, k) coefficient matrix over GF(2^8) expands to an (8r, 8k) 0/1
+    matrix (ceph_tpu/ec/gf.py expand_to_bitmatrix);
+  * a chunk of N bytes unpacks to 8 bit-planes; stacking k chunks gives
+    a (8k, N) 0/1 operand;
+  * parity bits = bitmatrix @ bits mod 2 — an int8 matmul on the MXU
+    with int32 accumulation (inner dim 8k <= 256 so sums stay tiny),
+    followed by `& 1` and a pack on the VPU.
+
+Layout: *bit-major interleaved*.  Row index bit*n + chunk (not
+chunk*8+bit) so the in-kernel unpack `(block >> i) & 1` needs no
+transpose: shifting a (k, T) byte tile by i in [0, 8) and stacking gives
+exactly rows [i*k + j].  `interleave_bitmatrix` converts the math-layout
+matrix from gf.expand_to_bitmatrix into this kernel layout.
+
+Everything here is shape-static and jit-compatible; the Pallas kernel
+tiles the byte axis and keeps unpack -> matmul -> pack fused in VMEM so
+HBM traffic is just bytes-in + parity-out (the reason this beats an XLA
+fallback, which materializes the 8x unpacked bit-planes in HBM).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # pltpu imports fail off-TPU for some symbols; guard for CPU tests
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+from ..ec import gf
+
+LANE = 128           # TPU lane width: byte-axis tiles must be multiples
+DEFAULT_TILE = 8192  # bytes of each chunk processed per grid step
+
+
+def interleave_bitmatrix(mat: np.ndarray) -> np.ndarray:
+    """(r, k) GF(2^8) matrix -> (8r, 8k) 0/1 matrix in bit-major layout.
+
+    out[i*r + ri, j*k + cj] = bit (i, j) of the 8x8 bit-matrix of
+    mat[ri, cj]; i.e. rows grouped by output bit, columns by input bit.
+    """
+    r, k = mat.shape
+    math_layout = gf.expand_to_bitmatrix(mat)          # (8r, 8k) chunk-major
+    out = np.zeros_like(math_layout)
+    for ri in range(r):
+        for i in range(8):
+            for cj in range(k):
+                for j in range(8):
+                    out[i * r + ri, j * k + cj] = \
+                        math_layout[ri * 8 + i, cj * 8 + j]
+    return out
+
+
+def _unpack_bits(block: jnp.ndarray) -> jnp.ndarray:
+    """(k, T) uint8 -> (8k, T) int8 bit-planes, bit-major rows."""
+    k, t = block.shape
+    shifts = jnp.arange(8, dtype=jnp.uint8)[:, None, None]
+    bits = (block[None, :, :] >> shifts) & jnp.uint8(1)   # (8, k, T)
+    return bits.reshape(8 * k, t).astype(jnp.int8)
+
+
+def _pack_bits(bits: jnp.ndarray, r: int) -> jnp.ndarray:
+    """(8r, T) int32 0/1 bit-major rows -> (r, T) uint8 bytes."""
+    t = bits.shape[1]
+    weights = (jnp.int32(1) << jnp.arange(8, dtype=jnp.int32))[:, None, None]
+    return jnp.sum(bits.reshape(8, r, t) * weights, axis=0).astype(jnp.uint8)
+
+
+# ----------------------------------------------------------------------------
+# XLA (non-Pallas) path: correct everywhere, used on CPU and as the oracle
+# ----------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("r",))
+def gf_bitmatmul_xla(bitmat: jnp.ndarray, chunks: jnp.ndarray, r: int
+                     ) -> jnp.ndarray:
+    """Apply an interleaved (8r, 8k) bitmatrix to (k, N) uint8 chunks."""
+    bits = _unpack_bits(chunks)
+    prod = jax.lax.dot_general(
+        bitmat.astype(jnp.int8), bits,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    ) & 1
+    return _pack_bits(prod, r)
+
+
+# ----------------------------------------------------------------------------
+# Pallas kernel: fused unpack -> MXU matmul -> mod2 -> pack
+# ----------------------------------------------------------------------------
+
+def _gf_kernel(bitmat_ref, in_ref, out_ref):
+    r8 = bitmat_ref.shape[0]
+    bits = _unpack_bits(in_ref[:])
+    prod = jax.lax.dot_general(
+        bitmat_ref[:], bits,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    ) & 1
+    out_ref[:] = _pack_bits(prod, r8 // 8)
+
+
+@functools.partial(jax.jit, static_argnames=("r", "tile"))
+def gf_bitmatmul_pallas(bitmat: jnp.ndarray, chunks: jnp.ndarray, r: int,
+                        tile: int = DEFAULT_TILE) -> jnp.ndarray:
+    """Pallas path of gf_bitmatmul.  chunks (k, N) with N % tile == 0."""
+    k, n = chunks.shape
+    assert n % tile == 0, (n, tile)
+    grid = (n // tile,)
+    return pl.pallas_call(
+        _gf_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((8 * r, 8 * k), lambda t: (0, 0)),
+            pl.BlockSpec((k, tile), lambda t: (0, t)),
+        ],
+        out_specs=pl.BlockSpec((r, tile), lambda t: (0, t)),
+        out_shape=jax.ShapeDtypeStruct((r, n), jnp.uint8),
+    )(bitmat.astype(jnp.int8), chunks)
+
+
+def _pick_tile(n: int) -> int:
+    tile = min(DEFAULT_TILE, n)
+    while n % tile:
+        tile //= 2
+    return max(tile, LANE)
+
+
+def gf_bitmatmul(bitmat: jnp.ndarray, chunks: jnp.ndarray, r: int,
+                 force_xla: bool | None = None) -> jnp.ndarray:
+    """Dispatch: Pallas on TPU, XLA elsewhere.  Pads N up to a lane/tile
+    multiple and strips the pad (zero bytes encode to zero parity, so
+    padding is benign for linear codes)."""
+    k, n = chunks.shape
+    use_xla = force_xla if force_xla is not None \
+        else jax.default_backend() == "cpu"
+    npad = -n % LANE
+    if npad:
+        chunks = jnp.pad(chunks, ((0, 0), (0, npad)))
+    if use_xla:
+        out = gf_bitmatmul_xla(bitmat, chunks, r)
+    else:
+        out = gf_bitmatmul_pallas(bitmat, chunks, r,
+                                  tile=_pick_tile(n + npad))
+    return out[:, :n] if npad else out

@@ -2,16 +2,24 @@
 
 Tests run on a virtual 8-device CPU mesh so multi-chip sharding logic is
 exercised without TPU hardware (and without touching the TPU tunnel).
-Must set flags before jax is imported anywhere.
+
+Note: this environment's sitecustomize registers an `axon` TPU platform
+and calls jax.config.update("jax_platforms", "axon,cpu") at interpreter
+start, which overrides JAX_PLATFORMS from the environment — so we must
+override the *config* again here, before any backend is initialized.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
