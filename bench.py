@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Headline benchmark: EC encode GB/s, k=8 m=3, 1 MiB stripes (vs CPU).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N}
+
+value       = jax-plugin (TPU when available) encode throughput, input
+              GB/s over 1 MiB objects split k=8 + m=3 parity, batched.
+vs_baseline = value / best-CPU-plugin throughput measured on this host —
+              the stand-in for the reference's ISA-L single-socket number
+              (the reference publishes no absolute numbers; BASELINE.md).
+
+Mirrors the canonical invocation of the reference benchmark
+(src/erasure-code/isa/README: `-p isa -P k=8 -P m=3 -S 1048576 -i 1000`).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+K, M, SIZE = 8, 3, 1 << 20
+
+
+def time_encode_cpu(codec, chunks, min_iters=5, min_time=2.0):
+    codec.encode_chunks(chunks)
+    t0 = time.perf_counter()
+    iters = 0
+    while iters < min_iters or time.perf_counter() - t0 < min_time:
+        codec.encode_chunks(chunks)
+        iters += 1
+    return iters * SIZE / (time.perf_counter() - t0)
+
+
+def time_encode_jax(codec, chunks, batch=32, min_time=3.0):
+    import jax
+    import jax.numpy as jnp
+    stripes = jnp.asarray(np.stack([chunks] * batch))
+    out = codec.encode_stripes(stripes)
+    jax.block_until_ready(out)  # compile + warm
+    t0 = time.perf_counter()
+    iters = 0
+    while time.perf_counter() - t0 < min_time:
+        out = codec.encode_stripes(stripes)
+        iters += 1
+    jax.block_until_ready(out)
+    elapsed = time.perf_counter() - t0
+    return iters * batch * SIZE / elapsed
+
+
+def main():
+    sys.path.insert(0, ".")
+    from ceph_tpu.ec import ErasureCodePluginRegistry
+
+    reg = ErasureCodePluginRegistry.instance()
+    prof = {"k": str(K), "m": str(M), "technique": "cauchy"}
+    rng = np.random.default_rng(0)
+    payload = rng.integers(0, 256, SIZE, dtype=np.uint8).tobytes()
+
+    jax_codec = reg.factory("jax", dict(prof))
+    chunks = jax_codec.encode_prepare(payload)
+
+    # CPU denominator: best available CPU plugin (native C if built).
+    cpu_best = 0.0
+    for plugin, p in (("isa", {"k": str(K), "m": str(M)}),
+                      ("jerasure", {"k": str(K), "m": str(M),
+                                    "technique": "cauchy_good"})):
+        try:
+            c = reg.factory(plugin, p)
+            cpu_best = max(cpu_best, time_encode_cpu(c, chunks))
+        except Exception as e:  # noqa: BLE001
+            print(f"# cpu plugin {plugin} failed: {e}", file=sys.stderr)
+
+    value = time_encode_jax(jax_codec, chunks)
+
+    out = {
+        "metric": "ec_encode_k8_m3_1MiB",
+        "value": round(value / 1e9, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(value / cpu_best, 3) if cpu_best else None,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,5 @@
+"""Device-mesh parallelism for the EC data plane."""
+
+from .mesh import DistributedStripeCodec, make_mesh
+
+__all__ = ["DistributedStripeCodec", "make_mesh"]

@@ -1,0 +1,1 @@
+"""CLI tools (reference src/tools/, src/test/erasure-code/ benchmark)."""

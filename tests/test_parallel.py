@@ -1,0 +1,47 @@
+"""Multi-device sharded EC tests on the virtual 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+
+
+def test_eight_virtual_devices():
+    assert len(jax.devices()) == 8
+
+
+@pytest.mark.parametrize("n_shard,n_data", [(1, 1), (2, 2), (4, 2), (8, 1),
+                                            (2, 4)])
+def test_distributed_encode_matches_reference(n_shard, n_data):
+    from ceph_tpu.parallel import DistributedStripeCodec, make_mesh
+    k, m = 8, 3
+    mesh = make_mesh(n_shard, n_data)
+    codec = DistributedStripeCodec(k, m, mesh)
+    rng = np.random.default_rng(42)
+    stripes = rng.integers(0, 256, (2 * n_data, k, 256), dtype=np.uint8)
+    parity = np.asarray(codec.encode(stripes))
+    ref = codec.encode_reference(stripes)
+    np.testing.assert_array_equal(parity, ref)
+
+
+def test_distributed_matches_jax_plugin_bytes():
+    """Collective-fan-in parity == single-chip plugin parity, bit for bit."""
+    from ceph_tpu.ec import ErasureCodePluginRegistry
+    from ceph_tpu.parallel import DistributedStripeCodec, make_mesh
+    codec1 = ErasureCodePluginRegistry.instance().factory(
+        "jax", {"k": "4", "m": "2", "technique": "cauchy"})
+    mesh = make_mesh(2, 2)
+    dcodec = DistributedStripeCodec(4, 2, mesh)
+    rng = np.random.default_rng(43)
+    stripes = rng.integers(0, 256, (4, 4, 128), dtype=np.uint8)
+    a = np.asarray(dcodec.encode(stripes))
+    b = np.asarray(codec1.encode_stripes(stripes))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_graft_entry_contract():
+    import __graft_entry__ as ge
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (3, args[0].shape[1])
+    ge.dryrun_multichip(8)
