@@ -15,6 +15,7 @@ Mirrors the canonical invocation of the reference benchmark
 """
 
 import json
+import os
 import sys
 import time
 
@@ -50,8 +51,12 @@ def time_encode_jax(codec, chunks, batch=32, min_time=3.0):
 
 
 def main():
-    sys.path.insert(0, ".")
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from ceph_tpu.ec import ErasureCodePluginRegistry
+    from ceph_tpu.utils.platform import ensure_usable_backend
+
+    backend = ensure_usable_backend()
+    print(f"# backend: {backend}", file=sys.stderr)
 
     reg = ErasureCodePluginRegistry.instance()
     prof = {"k": str(K), "m": str(M), "technique": "cauchy"}

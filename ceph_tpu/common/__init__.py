@@ -1,0 +1,4 @@
+"""Foundations: buffers, checksums, config, logging, perf counters.
+
+Reference layer 0 (src/common/, src/include/, src/log/, src/global/).
+"""

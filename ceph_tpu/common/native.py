@@ -1,0 +1,85 @@
+"""ctypes loader for the native data-plane library (native/).
+
+Builds on demand with `make -C native` the first time, caches the .so.
+Every entry point has a pure-python fallback, so the framework works
+without a C toolchain — but the native path is what makes the CPU
+baseline honest (reference analog: crc32c_intel_fast + ISA-L/gf-complete
+SIMD kernels vs their table fallbacks).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+_LIB_PATH = _NATIVE_DIR / "libceph_tpu_native.so"
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def load() -> ctypes.CDLL | None:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            if not _LIB_PATH.exists():
+                subprocess.run(["make", "-C", str(_NATIVE_DIR), "-s"],
+                               check=True, capture_output=True, timeout=120)
+            lib = ctypes.CDLL(str(_LIB_PATH))
+        except Exception:  # noqa: BLE001 - fall back to pure python
+            return None
+        lib.ceph_tpu_crc32c.restype = ctypes.c_uint32
+        lib.ceph_tpu_crc32c.argtypes = [
+            ctypes.c_uint32, ctypes.c_char_p, ctypes.c_size_t]
+        lib.ceph_tpu_crc32c_zeros.restype = ctypes.c_uint32
+        lib.ceph_tpu_crc32c_zeros.argtypes = [ctypes.c_uint32, ctypes.c_uint64]
+        lib.ceph_tpu_crc32c_combine.restype = ctypes.c_uint32
+        lib.ceph_tpu_crc32c_combine.argtypes = [
+            ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint64]
+        lib.gf8_init.restype = None
+        lib.gf8_mul_region_xor.restype = None
+        lib.gf8_mul_region_xor.argtypes = [
+            ctypes.c_uint8, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t]
+        lib.gf8_encode.restype = None
+        lib.gf8_encode.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
+            ctypes.c_size_t]
+        lib.gf8_init()
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def gf8_matvec(mat, chunks):
+    """Native GF(2^8) matrix x chunks product: (r, k) x (k, n) -> (r, n).
+
+    Returns None when the native library is unavailable (caller falls
+    back to the numpy LUT path).
+    """
+    import numpy as np
+    lib = load()
+    if lib is None:
+        return None
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)
+    chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
+    r, k = mat.shape
+    n = chunks.shape[1]
+    out = np.empty((r, n), dtype=np.uint8)
+    data_ptrs = (ctypes.c_void_p * k)(
+        *[chunks[j].ctypes.data for j in range(k)])
+    par_ptrs = (ctypes.c_void_p * r)(
+        *[out[i].ctypes.data for i in range(r)])
+    lib.gf8_encode(k, r, mat.ctypes.data, data_ptrs, par_ptrs, n)
+    return out

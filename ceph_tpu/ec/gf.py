@@ -113,6 +113,11 @@ def gf_matvec(mat: np.ndarray, chunks: np.ndarray) -> np.ndarray:
     """
     r, k = mat.shape
     assert chunks.shape[0] == k, (mat.shape, chunks.shape)
+    if chunks.shape[1] >= 1024:  # native SIMD path when worth the ctypes hop
+        from ..common import native
+        got = native.gf8_matvec(mat, chunks)
+        if got is not None:
+            return got
     out = np.zeros((r, chunks.shape[1]), dtype=np.uint8)
     lut = mul_table()
     for i in range(r):

@@ -91,10 +91,10 @@ class ErasureCodeJerasure(ErasureCode):
     # -- encode / decode ----------------------------------------------------
 
     def encode_chunks(self, chunks: np.ndarray) -> np.ndarray:
-        coding = self.matrix[self.k:]
-        if self.bitmatrix is not None:
-            return gf.bitmatrix_matvec(self.bitmatrix, chunks)
-        return gf.gf_matvec(coding, chunks)
+        # The bitmatrix (kept for oracle tests of the TPU layout) computes
+        # identical bytes; the LUT/native-SIMD path is the fast CPU route
+        # even for the bitmatrix techniques.
+        return gf.gf_matvec(self.matrix[self.k:], chunks)
 
     def decode_chunks(self, dense: np.ndarray, erasures) -> np.ndarray:
         """Reconstruct erased rows: invert the surviving generator rows.

@@ -20,8 +20,6 @@ program per (k, m, batch-geometry), reused across the write pipeline.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -103,6 +101,11 @@ class DistributedStripeCodec:
         the relayout.
         """
         stripes = jnp.asarray(stripes, dtype=jnp.uint8)
+        n_data = self.mesh.shape["data"]
+        if stripes.shape[0] % n_data:
+            raise ValueError(
+                f"stripe batch {stripes.shape[0]} not divisible by 'data' "
+                f"mesh axis {n_data}")
         chunks_first = jnp.transpose(stripes, (1, 0, 2))
         chunks_first = jax.device_put(
             chunks_first,

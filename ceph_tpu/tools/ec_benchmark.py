@@ -88,8 +88,6 @@ def _device_encode_loop(codec, chunks_np, iterations, batch):
 
 
 def run_encode(codec, args) -> tuple[float, int]:
-    n = codec.get_chunk_count()
-    want = set(range(n))
     rng = np.random.default_rng(55)
     payload = rng.integers(0, 256, args.size, dtype=np.uint8).tobytes()
     chunks = codec.encode_prepare(payload)
@@ -135,7 +133,19 @@ def run_decode(codec, args) -> tuple[float, int]:
 
 def main(argv=None) -> int:
     args = parse_args(argv)
-    codec = make_codec(args.plugin, args.parameter)
+    from ..ec import ErasureCodeError
+    if args.plugin == "jax":
+        # Pin a working backend first: the codec's init touches the device,
+        # and this image's TPU tunnel may stall (see utils/platform.py).
+        from ..utils.platform import ensure_usable_backend
+        backend = ensure_usable_backend()
+        if args.verbose:
+            print(f"backend={backend}", file=sys.stderr)
+    try:
+        codec = make_codec(args.plugin, args.parameter)
+    except ErasureCodeError as e:
+        print(f"ec_benchmark: {e}", file=sys.stderr)
+        return 1
     if args.verbose:
         print(f"plugin={args.plugin} k={codec.get_data_chunk_count()} "
               f"m={codec.get_coding_chunk_count()} size={args.size} "

@@ -1,0 +1,60 @@
+"""JAX platform selection that survives this image's axon sitecustomize.
+
+The container's sitecustomize force-selects an experimental `axon` TPU
+platform via jax.config.update("jax_platforms", "axon,cpu"), which
+overrides the JAX_PLATFORMS env var.  First contact with the TPU tunnel
+can take minutes and may fail with UNAVAILABLE — and backend init is
+blocking and uninterruptible in-process.  So tools that must always make
+progress (bench.py, the benchmark CLI) probe the accelerator in a
+*subprocess* with a timeout, then pin this process to the best backend
+that actually works.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_PROBE_RESULT: str | None = None
+
+PROBE_CODE = (
+    "import jax\n"
+    "d = jax.devices()\n"
+    "print(d[0].platform)\n"
+)
+
+
+def probe_accelerator(timeout: float | None = None) -> bool:
+    """True if the default (TPU) backend initializes within `timeout`s."""
+    timeout = timeout or float(os.environ.get("CEPH_TPU_PROBE_TIMEOUT", "120"))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", PROBE_CODE],
+            capture_output=True, timeout=timeout, text=True)
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def ensure_usable_backend(prefer_cpu: bool = False) -> str:
+    """Pin jax to a working backend; returns its name ('axon'/'tpu'/'cpu').
+
+    Must run before any jax backend initialization in this process.
+    """
+    global _PROBE_RESULT
+    import jax
+
+    if prefer_cpu:
+        jax.config.update("jax_platforms", "cpu")
+        return "cpu"
+    if _PROBE_RESULT is None:
+        platforms = os.environ.get("JAX_PLATFORMS", "")
+        if "axon" in platforms or platforms in ("", "tpu"):
+            _PROBE_RESULT = "accel" if probe_accelerator() else "cpu"
+        else:
+            _PROBE_RESULT = "accel"
+    if _PROBE_RESULT == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        return "cpu"
+    return jax.default_backend()

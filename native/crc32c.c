@@ -1,0 +1,163 @@
+/* crc32c (Castagnoli) — hardware + software paths + combine.
+ *
+ * Native equivalent of the reference's checksum stack
+ * (src/common/crc32c.cc dispatching to crc32c_intel_fast /
+ * crc32c_aarch64 / sctp_crc32 software fallback, plus
+ * ceph_crc32c_zeros-style combine helpers): same polynomial 0x1EDC6F41
+ * (reflected 0x82F63B78), same init/xor conventions as
+ * bufferlist::crc32c (src/include/buffer.h:1199).
+ *
+ * Build: cc -O3 -fPIC -shared (see Makefile); SSE4.2 path compiled in
+ * when available and selected at runtime via cpuid.
+ */
+
+#include <stdint.h>
+#include <stddef.h>
+
+#if defined(__x86_64__) || defined(__i386__)
+#include <cpuid.h>
+#endif
+
+#define POLY_REFLECTED 0x82F63B78u
+
+/* ---------------- software: slice-by-8 ---------------- */
+
+static uint32_t table[8][256];
+static int table_ready = 0;
+
+static void init_tables(void) {
+    if (table_ready) return;
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = i;
+        for (int j = 0; j < 8; j++)
+            c = (c & 1) ? (c >> 1) ^ POLY_REFLECTED : c >> 1;
+        table[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = table[0][i];
+        for (int s = 1; s < 8; s++) {
+            c = (c >> 8) ^ table[0][c & 0xff];
+            table[s][i] = c;
+        }
+    }
+    table_ready = 1;
+}
+
+static uint32_t crc32c_sw(uint32_t crc, const uint8_t *buf, size_t len) {
+    init_tables();
+    while (len && ((uintptr_t)buf & 7)) {
+        crc = (crc >> 8) ^ table[0][(crc ^ *buf++) & 0xff];
+        len--;
+    }
+    while (len >= 8) {
+        uint64_t v;
+        __builtin_memcpy(&v, buf, 8);
+        v ^= crc;
+        crc = table[7][v & 0xff] ^ table[6][(v >> 8) & 0xff] ^
+              table[5][(v >> 16) & 0xff] ^ table[4][(v >> 24) & 0xff] ^
+              table[3][(v >> 32) & 0xff] ^ table[2][(v >> 40) & 0xff] ^
+              table[1][(v >> 48) & 0xff] ^ table[0][(v >> 56) & 0xff];
+        buf += 8;
+        len -= 8;
+    }
+    while (len--)
+        crc = (crc >> 8) ^ table[0][(crc ^ *buf++) & 0xff];
+    return crc;
+}
+
+/* ---------------- hardware: SSE4.2 crc32 instruction ---------------- */
+
+#if defined(__x86_64__)
+__attribute__((target("sse4.2")))
+static uint32_t crc32c_hw(uint32_t crc, const uint8_t *buf, size_t len) {
+    while (len && ((uintptr_t)buf & 7)) {
+        crc = __builtin_ia32_crc32qi(crc, *buf++);
+        len--;
+    }
+    uint64_t c = crc;
+    while (len >= 8) {
+        uint64_t v;
+        __builtin_memcpy(&v, buf, 8);
+        c = __builtin_ia32_crc32di(c, v);
+        buf += 8;
+        len -= 8;
+    }
+    crc = (uint32_t)c;
+    while (len--)
+        crc = __builtin_ia32_crc32qi(crc, *buf++);
+    return crc;
+}
+
+static int have_sse42(void) {
+    static int cached = -1;
+    if (cached < 0) {
+        unsigned eax, ebx, ecx, edx;
+        cached = __get_cpuid(1, &eax, &ebx, &ecx, &edx) && (ecx & bit_SSE4_2);
+    }
+    return cached;
+}
+#endif
+
+uint32_t ceph_tpu_crc32c(uint32_t crc, const uint8_t *buf, size_t len) {
+#if defined(__x86_64__)
+    if (have_sse42())
+        return crc32c_hw(crc, buf, len);
+#endif
+    return crc32c_sw(crc, buf, len);
+}
+
+/* ---------------- combine: crc(A||B) from crc(A), crc(B), len(B) -----
+ *
+ * GF(2) matrix method (zlib-style): advancing a CRC over n zero bytes is
+ * multiplication of the crc (as a GF(2) 32-vector) by M_zero^n; combine =
+ * shift crc(A) over len(B) zeros then xor crc(B).  This is also exactly
+ * what the reference's ceph_crc32c_zeros enables (extending a crc across
+ * zero padding without touching memory).
+ */
+
+static uint32_t gf2_times(const uint32_t *mat, uint32_t vec) {
+    uint32_t sum = 0;
+    int i = 0;
+    while (vec) {
+        if (vec & 1) sum ^= mat[i];
+        vec >>= 1;
+        i++;
+    }
+    return sum;
+}
+
+static void gf2_square(uint32_t *sq, const uint32_t *mat) {
+    for (int i = 0; i < 32; i++)
+        sq[i] = gf2_times(mat, mat[i]);
+}
+
+uint32_t ceph_tpu_crc32c_zeros(uint32_t crc, uint64_t len) {
+    if (len == 0) return crc;
+    uint32_t even[32], odd[32];
+    /* odd = matrix for one zero *bit*: shift right, feed poly */
+    odd[0] = POLY_REFLECTED;
+    for (int i = 1; i < 32; i++)
+        odd[i] = 1u << (i - 1);
+    gf2_square(even, odd);   /* 2 bits */
+    gf2_square(odd, even);   /* 4 bits */
+    /* now loop: apply for each set bit of byte-length, matrices advance
+     * 8*2^k bits = 2^(k+3) */
+    uint64_t n = len;
+    /* start with matrix for 1 byte (8 bits): square 4-bit matrix once */
+    gf2_square(even, odd);   /* 8 bits = 1 byte */
+    uint32_t (*cur)[32] = &even, (*next)[32] = &odd;
+    do {
+        if (n & 1)
+            crc = gf2_times(*cur, crc);
+        n >>= 1;
+        if (!n) break;
+        gf2_square(*next, *cur);
+        uint32_t (*t)[32] = cur; cur = next; next = t;
+    } while (1);
+    return crc;
+}
+
+uint32_t ceph_tpu_crc32c_combine(uint32_t crc_a, uint32_t crc_b,
+                                 uint64_t len_b) {
+    return ceph_tpu_crc32c_zeros(crc_a, len_b) ^ crc_b;
+}
