@@ -1,0 +1,1 @@
+"""OSD: the distributed object-store core (reference src/osd/)."""

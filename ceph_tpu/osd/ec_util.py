@@ -1,0 +1,183 @@
+"""EC stripe geometry + per-shard checksums + stripe-batch codec glue.
+
+Re-expresses reference src/osd/ECUtil.{h,cc}:
+
+* `StripeInfo` — stripe_width/chunk_size arithmetic and logical<->chunk
+  offset mapping (reference stripe_info_t, ECUtil.h:27-80).
+* `HashInfo` — cumulative per-shard crc32c, persisted as a shard xattr,
+  with projected sizes for in-flight ops (reference ECUtil.h:101-160;
+  updated by append at ECUtil.cc:172, verified on reads by
+  ECBackend::handle_sub_read, checked by deep scrub).
+* `encode` / `decode` — slice a logical buffer into stripes and run the
+  codec.  TPU-first difference from the reference: where ECUtil::encode
+  loops stripes serially calling ec_impl->encode per stripe
+  (ECUtil.cc:120-150), here the whole extent (all stripes) goes to the
+  codec as ONE batched call — the kernel tiles the byte axis, so more
+  stripes just means a longer axis, and cross-transaction batching in
+  the backend concatenates further.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..common import crc32c as _crc
+from ..ec.interface import ErasureCodeInterface
+
+
+@dataclass(frozen=True)
+class StripeInfo:
+    """Geometry of an EC pool's stripes (reference stripe_info_t)."""
+
+    stripe_width: int   # bytes of logical data per stripe (k * chunk_size)
+    chunk_size: int     # bytes per shard per stripe
+
+    def __post_init__(self):
+        assert self.stripe_width % self.chunk_size == 0, \
+            (self.stripe_width, self.chunk_size)
+
+    @property
+    def k(self) -> int:
+        return self.stripe_width // self.chunk_size
+
+    def logical_to_prev_stripe_offset(self, off: int) -> int:
+        return off - off % self.stripe_width
+
+    def logical_to_next_stripe_offset(self, off: int) -> int:
+        return -(-off // self.stripe_width) * self.stripe_width
+
+    def logical_to_prev_chunk_offset(self, off: int) -> int:
+        """Byte offset within each shard object for a logical offset."""
+        return (off // self.stripe_width) * self.chunk_size
+
+    def logical_to_next_chunk_offset(self, off: int) -> int:
+        return -(-off // self.stripe_width) * self.chunk_size
+
+    def aligned_logical_offset_to_chunk_offset(self, off: int) -> int:
+        assert off % self.stripe_width == 0, off
+        return (off // self.stripe_width) * self.chunk_size
+
+    def aligned_chunk_offset_to_logical_offset(self, off: int) -> int:
+        assert off % self.chunk_size == 0, off
+        return (off // self.chunk_size) * self.stripe_width
+
+    def offset_len_to_stripe_bounds(self, off: int,
+                                    length: int) -> tuple[int, int]:
+        """Round an extent out to stripe bounds (reference
+        stripe_info_t::offset_len_to_stripe_bounds)."""
+        start = self.logical_to_prev_stripe_offset(off)
+        end = self.logical_to_next_stripe_offset(off + length)
+        return start, end - start
+
+
+HINFO_KEY = "hinfo_key"  # shard xattr name (reference ECUtil.cc get_hinfo_key)
+
+
+@dataclass
+class HashInfo:
+    """Cumulative per-shard crc32c + total logical shard size.
+
+    Invariant: cumulative_shard_hashes[s] is the crc32c (seed -1) of all
+    bytes ever appended to shard s, and total_chunk_size their length.
+    Append-only, like the reference (EC overwrites bump object
+    generations rather than rewriting ranges in place).
+    """
+
+    total_chunk_size: int = 0
+    cumulative_shard_hashes: list[int] = field(default_factory=list)
+
+    @classmethod
+    def make(cls, n_shards: int) -> "HashInfo":
+        return cls(0, [0xFFFFFFFF] * n_shards)
+
+    def append(self, old_size: int, shard_chunks: np.ndarray) -> None:
+        """Fold one stripe-aligned append into every shard's crc
+        (reference HashInfo::append, ECUtil.cc:172).  shard_chunks is
+        (n_shards, added_len)."""
+        assert old_size == self.total_chunk_size, \
+            f"append at {old_size} != current {self.total_chunk_size}"
+        n, added = shard_chunks.shape
+        assert n == len(self.cumulative_shard_hashes)
+        for s in range(n):
+            self.cumulative_shard_hashes[s] = _crc.crc32c(
+                shard_chunks[s].tobytes(), self.cumulative_shard_hashes[s])
+        self.total_chunk_size += added
+
+    def truncate(self, new_size: int) -> None:
+        """EC can only roll back appends; a truncate to a smaller size
+        invalidates incremental crcs, so reset (reference keeps old
+        generations instead — same observable contract for scrub)."""
+        if new_size != self.total_chunk_size:
+            self.total_chunk_size = new_size
+            self.cumulative_shard_hashes = [
+                0xFFFFFFFF] * len(self.cumulative_shard_hashes)
+            self.invalidated = True
+
+    def get_chunk_hash(self, shard: int) -> int:
+        return self.cumulative_shard_hashes[shard]
+
+    # -- persistence (shard xattr) -----------------------------------------
+
+    def encode(self) -> bytes:
+        import struct
+        return struct.pack(
+            "<QI", self.total_chunk_size,
+            len(self.cumulative_shard_hashes)) + b"".join(
+            int(h).to_bytes(4, "little")
+            for h in self.cumulative_shard_hashes)
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "HashInfo":
+        import struct
+        size, n = struct.unpack_from("<QI", raw)
+        hashes = [int.from_bytes(raw[12 + 4 * i:16 + 4 * i], "little")
+                  for i in range(n)]
+        return cls(size, hashes)
+
+
+def encode(sinfo: StripeInfo, ec_impl: ErasureCodeInterface,
+           data: np.ndarray) -> np.ndarray:
+    """Encode a stripe-aligned logical extent into all shard chunks.
+
+    data: (L,) uint8 with L % stripe_width == 0.
+    Returns (k+m, L/k): shard s's contiguous bytes for this extent.
+
+    One batched codec call for all stripes: logical layout is
+    [stripe0[chunk0..chunkk-1], stripe1[...], ...]; reshaping to
+    (nstripes, k, chunk_size) and transposing gives each shard's rows,
+    which ride the codec's byte axis in one launch.
+    """
+    data = np.asarray(data, dtype=np.uint8).ravel()
+    assert data.size % sinfo.stripe_width == 0, \
+        (data.size, sinfo.stripe_width)
+    k = sinfo.k
+    m = ec_impl.get_chunk_count() - ec_impl.get_data_chunk_count()
+    assert k == ec_impl.get_data_chunk_count()
+    nstripes = data.size // sinfo.stripe_width
+    # (k, nstripes*chunk_size): row j = shard j's bytes across stripes
+    chunks = data.reshape(nstripes, k, sinfo.chunk_size) \
+                 .transpose(1, 0, 2).reshape(k, -1)
+    parity = np.asarray(ec_impl.encode_chunks(chunks))
+    return np.concatenate([chunks, parity], axis=0)
+
+
+def decode(sinfo: StripeInfo, ec_impl: ErasureCodeInterface,
+           shard_data: dict[int, np.ndarray], want_len: int) -> np.ndarray:
+    """Rebuild a logical extent from per-shard contiguous buffers
+    (reference ECUtil::decode).  shard_data maps shard id -> (chunk-run)
+    bytes, all the same length and stripe-aligned."""
+    lens = {v.size for v in shard_data.values()}
+    assert len(lens) == 1, "mixed shard lengths"
+    run = lens.pop()
+    assert run % sinfo.chunk_size == 0
+    k = sinfo.k
+    decoded = ec_impl.decode(set(range(k)),
+                             {s: d for s, d in shard_data.items()}, run)
+    nstripes = run // sinfo.chunk_size
+    stacked = np.stack([np.asarray(decoded[j], dtype=np.uint8)
+                        for j in range(k)])        # (k, run)
+    logical = stacked.reshape(k, nstripes, sinfo.chunk_size) \
+                     .transpose(1, 0, 2).reshape(-1)
+    return logical[:want_len]

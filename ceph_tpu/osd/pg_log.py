@@ -1,0 +1,83 @@
+"""PG log: per-PG op journal for recovery and EC rollback.
+
+Re-expresses reference src/osd/PGLog.{h,cc} at the fidelity the EC
+pipeline needs: an ordered list of entries keyed by eversion, each
+carrying enough rollback state to locally undo it (the reference's
+design constraint that EC ops be locally rollbackable —
+doc/dev/osd_internals/erasure_coding/ecbackend.rst:9-27: append records
+the old size, delete keeps the old generation, setattr keeps prior
+values), plus the can_rollback_to / rollforward bounds ECBackend
+advances in try_finish_rmw (reference ECBackend.cc:2115-2134).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+
+from .types import eversion_t, hobject_t
+
+
+class LogOp(Enum):
+    MODIFY = "modify"
+    DELETE = "delete"
+    ERROR = "error"
+
+
+@dataclass
+class RollbackInfo:
+    """What a shard must remember to undo this entry locally."""
+    append_old_size: int | None = None          # size before an append
+    old_attrs: dict[str, bytes | None] | None = None  # prior xattr values
+    kept_generation: int | None = None          # delete renamed to this gen
+    hinfo_old: bytes | None = None              # prior hinfo xattr
+
+
+@dataclass
+class LogEntry:
+    version: eversion_t
+    oid: hobject_t
+    op: LogOp = LogOp.MODIFY
+    rollback: RollbackInfo = field(default_factory=RollbackInfo)
+
+
+class PGLog:
+    def __init__(self) -> None:
+        self.entries: list[LogEntry] = []
+        self.head = eversion_t()            # newest logged
+        self.tail = eversion_t()            # oldest kept
+        self.can_rollback_to = eversion_t() # entries after this are undoable
+        self.rollforward_to = eversion_t()  # entries before this are durable
+
+    def add(self, entry: LogEntry) -> None:
+        assert entry.version > self.head, (entry.version, self.head)
+        self.entries.append(entry)
+        self.head = entry.version
+
+    def roll_forward_to(self, v: eversion_t) -> list[LogEntry]:
+        """Mark entries <= v irrevocable; returns the newly-stable ones
+        (whose rollback state may be discarded / old gens trimmed)."""
+        newly = [e for e in self.entries
+                 if self.rollforward_to < e.version <= v]
+        if v > self.rollforward_to:
+            self.rollforward_to = v
+        if v > self.can_rollback_to:
+            self.can_rollback_to = v
+        return newly
+
+    def rollback_to(self, v: eversion_t) -> list[LogEntry]:
+        """Drop entries newer than v; returns them newest-first so the
+        caller can undo their store effects.  Only legal if v >=
+        rollforward_to (can't undo what was rolled forward)."""
+        assert v >= self.rollforward_to, (v, self.rollforward_to)
+        undone = sorted((e for e in self.entries if e.version > v),
+                        key=lambda e: e.version, reverse=True)
+        self.entries = [e for e in self.entries if e.version <= v]
+        self.head = v
+        return undone
+
+    def trim(self, to: eversion_t) -> None:
+        """Discard entries <= to (reference log trimming)."""
+        self.entries = [e for e in self.entries if e.version > to]
+        if to > self.tail:
+            self.tail = to
