@@ -1,0 +1,164 @@
+"""OSDMap: the epoch-versioned cluster map.
+
+Re-expresses reference src/osd/OSDMap.{h,cc}: which OSDs exist/are
+up/in, their addresses and weights, the pools (`pg_pool_t` with type,
+size, pg_num, EC profile, stripe_width), pg_temp overrides, and the
+placement queries everything uses — object -> PG -> OSDs
+(`pg_to_up_acting_osds`, reference OSDMap.cc:2627, which runs CRUSH and
+then applies up/down filtering and overrides).
+
+Incremental maps: `Incremental` records deltas; `apply_incremental`
+advances the epoch.  (The mon is the sole author; everyone else applies.)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+from ..crush import CrushWrapper
+from ..crush.hash import crush_hash32
+from ..crush.map import CRUSH_ITEM_NONE
+from .types import PoolType, pg_t, spg_t
+
+
+@dataclass
+class PGPool:
+    """pg_pool_t (reference osd_types.h)."""
+    id: int
+    name: str
+    type: PoolType
+    size: int                     # replicas or k+m
+    min_size: int
+    pg_num: int
+    crush_rule: int
+    erasure_code_profile: str = ""
+    stripe_width: int = 0
+
+    def is_erasure(self) -> bool:
+        return self.type == PoolType.ERASURE
+
+
+@dataclass
+class OSDInfo:
+    id: int
+    up: bool = False
+    in_: bool = True
+    weight: float = 1.0           # reweight in [0,1]
+    addr: tuple[str, int] | None = None
+
+
+class OSDMap:
+    def __init__(self) -> None:
+        self.epoch = 0
+        self.osds: dict[int, OSDInfo] = {}
+        self.pools: dict[int, PGPool] = {}
+        self.pool_ids_by_name: dict[str, int] = {}
+        self.crush = CrushWrapper()
+        self.pg_temp: dict[pg_t, list[int]] = {}
+        self.ec_profiles: dict[str, dict[str, str]] = {}
+
+    # -- queries ------------------------------------------------------------
+
+    def get_pool(self, pool_id: int) -> PGPool | None:
+        return self.pools.get(pool_id)
+
+    def lookup_pool(self, name: str) -> PGPool | None:
+        pid = self.pool_ids_by_name.get(name)
+        return self.pools.get(pid) if pid is not None else None
+
+    def is_up(self, osd: int) -> bool:
+        o = self.osds.get(osd)
+        return bool(o and o.up)
+
+    def object_to_pg(self, pool_id: int, name: str, key: str = "") -> pg_t:
+        """object name -> pg seed (reference object_locator_to_pg via
+        ceph_str_hash + ceph_stable_mod)."""
+        pool = self.pools[pool_id]
+        h = crush_hash32(key or name)
+        return pg_t(pool_id, h % pool.pg_num)
+
+    def _weight_of(self):
+        osds = self.osds
+
+        def weight(item: int) -> float:
+            if item < 0:
+                return 1.0
+            o = osds.get(item)
+            if o is None or not o.in_:
+                return 0.0
+            return o.weight
+        return weight
+
+    def pg_to_raw_osds(self, pgid: pg_t) -> list[int]:
+        pool = self.pools[pgid.pool]
+        x = crush_hash32(pgid.pool, pgid.seed)
+        return self.crush.do_rule(pool.crush_rule, x, pool.size,
+                                  weight_of=self._weight_of())
+
+    def pg_to_up_acting_osds(self, pgid: pg_t
+                             ) -> tuple[list[int], list[int], int, int]:
+        """(up, acting, up_primary, acting_primary) — reference
+        OSDMap.cc:2627.  EC pools keep positional NONE holes; replicated
+        pools compact them out."""
+        pool = self.pools[pgid.pool]
+        raw = self.pg_to_raw_osds(pgid)
+        if pool.is_erasure():
+            up = [d if d != CRUSH_ITEM_NONE and self.is_up(d)
+                  else CRUSH_ITEM_NONE for d in raw]
+        else:
+            up = [d for d in raw if d != CRUSH_ITEM_NONE and self.is_up(d)]
+        acting = self.pg_temp.get(pgid, up)
+        up_primary = next((d for d in up if d != CRUSH_ITEM_NONE), -1)
+        acting_primary = next(
+            (d for d in acting if d != CRUSH_ITEM_NONE), -1)
+        return up, acting, up_primary, acting_primary
+
+    def primary_shard(self, pgid: pg_t) -> spg_t | None:
+        pool = self.pools[pgid.pool]
+        up, acting, _, primary = self.pg_to_up_acting_osds(pgid)
+        if primary < 0:
+            return None
+        if pool.is_erasure():
+            return spg_t(pgid, acting.index(primary))
+        return spg_t(pgid)
+
+    # -- mutation (mon-side) ------------------------------------------------
+
+    def add_osd(self, osd_id: int, host: str, weight: float = 1.0,
+                addr: tuple[str, int] | None = None) -> None:
+        self.osds[osd_id] = OSDInfo(osd_id, up=False, in_=True,
+                                    weight=1.0, addr=addr)
+        self.crush.add_osd(osd_id, weight, host)
+
+    def set_osd_up(self, osd_id: int, addr: tuple[str, int] | None = None
+                   ) -> None:
+        o = self.osds[osd_id]
+        o.up = True
+        if addr:
+            o.addr = addr
+
+    def set_osd_down(self, osd_id: int) -> None:
+        if osd_id in self.osds:
+            self.osds[osd_id].up = False
+
+    def set_osd_out(self, osd_id: int) -> None:
+        if osd_id in self.osds:
+            self.osds[osd_id].in_ = False
+
+    def create_pool(self, name: str, type_: PoolType, size: int,
+                    pg_num: int, crush_rule: int,
+                    erasure_code_profile: str = "",
+                    stripe_width: int = 0,
+                    min_size: int | None = None) -> PGPool:
+        pid = max(self.pools, default=0) + 1
+        if min_size is None:
+            min_size = size - 1 if type_ == PoolType.REPLICATED else size
+        pool = PGPool(pid, name, type_, size, min_size, pg_num, crush_rule,
+                      erasure_code_profile, stripe_width)
+        self.pools[pid] = pool
+        self.pool_ids_by_name[name] = pid
+        return pool
+
+    def bump_epoch(self) -> int:
+        self.epoch += 1
+        return self.epoch

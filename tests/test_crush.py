@@ -1,0 +1,182 @@
+"""CRUSH + OSDMap placement tests.
+
+Reference analogs: src/test/crush/crush.cc, src/test/osd/TestOSDMap.cc —
+determinism, weight proportionality, failure-domain separation, indep
+positional stability, up/acting filtering.
+"""
+
+import collections
+
+import pytest
+
+from ceph_tpu.crush import CrushWrapper
+from ceph_tpu.crush.map import CRUSH_ITEM_NONE
+from ceph_tpu.osd.osd_map import OSDMap
+from ceph_tpu.osd.types import PoolType, pg_t
+
+
+def build_cluster(n_hosts=4, osds_per_host=3, weight=1.0):
+    c = CrushWrapper()
+    osd = 0
+    for h in range(n_hosts):
+        for _ in range(osds_per_host):
+            c.add_osd(osd, weight, f"host{h}")
+            osd += 1
+    return c
+
+
+def test_deterministic():
+    c = build_cluster()
+    rid = c.add_simple_rule("data", "default", "host", 3)
+    a = [c.do_rule(rid, x, 3) for x in range(100)]
+    b = [c.do_rule(rid, x, 3) for x in range(100)]
+    assert a == b
+
+
+def test_failure_domain_separation():
+    c = build_cluster(n_hosts=4, osds_per_host=3)
+    rid = c.add_simple_rule("data", "default", "host", 3)
+    for x in range(200):
+        out = c.do_rule(rid, x, 3)
+        assert len(out) == 3
+        hosts = {o // 3 for o in out}
+        assert len(hosts) == 3, f"two replicas share a host: {out}"
+
+
+def test_weight_proportionality():
+    c = CrushWrapper()
+    # host0's osds have double weight
+    for o in range(4):
+        c.add_osd(o, 2.0 if o < 2 else 1.0, f"host{o}")
+    rid = c.add_simple_rule("data", "default", "host", 1)
+    counts = collections.Counter()
+    for x in range(6000):
+        counts[c.do_rule(rid, x, 1)[0]] += 1
+    heavy = counts[0] + counts[1]
+    light = counts[2] + counts[3]
+    assert 1.6 < heavy / light < 2.5, counts
+
+
+def test_indep_positional_stability():
+    """EC: when an OSD drops out, surviving positions keep their devices
+    (reference crush_choose_indep semantics)."""
+    c = build_cluster(n_hosts=6, osds_per_host=2)
+    rid = c.add_simple_rule("ecrule", "default", "host", 5,
+                            rule_mode="indep")
+    base = {x: c.do_rule(rid, x, 5) for x in range(100)}
+    # knock out osd 4 via zero weight
+    wf = lambda item: 0.0 if item == 4 else (1.0 if item >= 0 else 1.0)
+    moved = same = 0
+    for x in range(100):
+        out = c.do_rule(rid, x, 5, weight_of=wf)
+        for pos in range(5):
+            if base[x][pos] == 4:
+                continue  # this slot had to move
+            if out[pos] == base[x][pos]:
+                same += 1
+            else:
+                moved += 1
+    assert same > moved * 10, (same, moved)
+
+
+def test_indep_returns_positional_holes_when_scarce():
+    c = build_cluster(n_hosts=3, osds_per_host=1)
+    rid = c.add_simple_rule("ecrule", "default", "host", 5,
+                            rule_mode="indep")
+    out = c.do_rule(rid, 7, 5)
+    assert len(out) == 5
+    assert out.count(CRUSH_ITEM_NONE) == 2  # only 3 hosts exist
+    assert len({o for o in out if o != CRUSH_ITEM_NONE}) == 3
+
+
+def test_stability_under_weight_change():
+    """Adding capacity moves only ~proportional data (straw2 property)."""
+    c = build_cluster(n_hosts=5, osds_per_host=2)
+    rid = c.add_simple_rule("data", "default", "host", 1)
+    base = {x: c.do_rule(rid, x, 1)[0] for x in range(2000)}
+    # add one more host via second map
+    c2 = build_cluster(n_hosts=6, osds_per_host=2)
+    rid2 = c2.add_simple_rule("data", "default", "host", 1)
+    moved = sum(1 for x in range(2000)
+                if c2.do_rule(rid2, x, 1)[0] != base[x])
+    # ideal movement fraction = 1/6 ~ 0.17; allow slack
+    assert moved / 2000 < 0.35, moved
+
+
+# -- OSDMap -----------------------------------------------------------------
+
+def make_osdmap(n_hosts=4, per_host=2):
+    m = OSDMap()
+    osd = 0
+    for h in range(n_hosts):
+        for _ in range(per_host):
+            m.add_osd(osd, f"host{h}", addr=("127.0.0.1", 7000 + osd))
+            m.set_osd_up(osd)
+            osd += 1
+    return m
+
+
+def test_osdmap_ec_pool_mapping():
+    m = make_osdmap(n_hosts=6, per_host=2)
+    rid = m.crush.add_simple_rule("ecpool_rule", "default", "host", 5,
+                                  rule_mode="indep")
+    pool = m.create_pool("ecpool", PoolType.ERASURE, size=5, pg_num=32,
+                         crush_rule=rid, stripe_width=4 * 4096)
+    for seed in range(32):
+        pgid = pg_t(pool.id, seed)
+        up, acting, upp, actp = m.pg_to_up_acting_osds(pgid)
+        assert len(up) == 5
+        assert upp >= 0
+        assert actp == upp
+    # down an osd: its positions become holes, others stay
+    pgs_using_3 = [s for s in range(32)
+                   if 3 in m.pg_to_up_acting_osds(pg_t(pool.id, s))[0]]
+    assert pgs_using_3
+    before = {s: m.pg_to_up_acting_osds(pg_t(pool.id, s))[0]
+              for s in range(32)}
+    m.set_osd_down(3)
+    for s in pgs_using_3:
+        up, _, _, _ = m.pg_to_up_acting_osds(pg_t(pool.id, s))
+        pos = before[s].index(3)
+        assert up[pos] == CRUSH_ITEM_NONE
+        for p in range(5):
+            if p != pos:
+                assert up[p] == before[s][p]
+
+
+def test_osdmap_replicated_pool_compacts():
+    m = make_osdmap()
+    rid = m.crush.add_simple_rule("rep", "default", "host", 3)
+    pool = m.create_pool("rbd", PoolType.REPLICATED, size=3, pg_num=16,
+                         crush_rule=rid)
+    m.set_osd_down(0)
+    for seed in range(16):
+        up, acting, _, _ = m.pg_to_up_acting_osds(pg_t(pool.id, seed))
+        assert 0 not in up
+        assert CRUSH_ITEM_NONE not in up
+
+
+def test_object_to_pg_stable():
+    m = make_osdmap()
+    rid = m.crush.add_simple_rule("rep", "default", "host", 3)
+    pool = m.create_pool("rbd", PoolType.REPLICATED, size=3, pg_num=16,
+                         crush_rule=rid)
+    a = m.object_to_pg(pool.id, "myobject")
+    assert a == m.object_to_pg(pool.id, "myobject")
+    assert 0 <= a.seed < 16
+    seeds = {m.object_to_pg(pool.id, f"obj{i}").seed for i in range(200)}
+    assert len(seeds) > 10  # spread
+
+
+def test_pg_temp_override():
+    m = make_osdmap()
+    rid = m.crush.add_simple_rule("rep", "default", "host", 3)
+    pool = m.create_pool("rbd", PoolType.REPLICATED, size=3, pg_num=8,
+                         crush_rule=rid)
+    pgid = pg_t(pool.id, 3)
+    up, acting, _, _ = m.pg_to_up_acting_osds(pgid)
+    m.pg_temp[pgid] = [7, 6, 5]
+    up2, acting2, _, ap = m.pg_to_up_acting_osds(pgid)
+    assert up2 == up
+    assert acting2 == [7, 6, 5]
+    assert ap == 7
