@@ -1,0 +1,436 @@
+"""The typed message set for the storage protocol.
+
+Re-expresses the slice of the reference's 163 message types
+(src/messages/) this framework's daemons speak:
+
+client <-> OSD:   MOSDOp / MOSDOpReply (reference MOSDOp.h)
+OSD <-> OSD (EC): MOSDECSubOpWrite / ...WriteReply / ...Read /
+                  ...ReadReply (reference MOSDECSubOpWrite.h etc.,
+                  carrying ECSubWrite/ECSubRead from ECMsgTypes.h)
+OSD <-> OSD:      MOSDPing (heartbeat, reference MOSDPing.h)
+daemon <-> mon:   MMonGetMap/MMonMap, MOSDBoot, MOSDFailure,
+                  MMonCommand/MMonCommandAck (pool + profile admin)
+
+Wire layout follows message.py: JSON meta for control fields, one raw
+data segment for payload bytes (write data / read replies / serialized
+shard transactions).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..osd.types import eversion_t, hobject_t, pg_t, spg_t
+from ..store.object_store import Transaction
+from ..store import object_store as os_
+from .message import Message, register_message
+
+
+# -- id plumbing -------------------------------------------------------------
+
+def hobj_to_json(o: hobject_t) -> list:
+    return [o.pool, o.name, o.key, o.snap, o.hash]
+
+
+def hobj_from_json(j) -> hobject_t:
+    return hobject_t(*j)
+
+
+def spg_to_json(s: spg_t) -> list:
+    return [s.pgid.pool, s.pgid.seed, s.shard]
+
+
+def spg_from_json(j) -> spg_t:
+    return spg_t(pg_t(j[0], j[1]), j[2])
+
+
+# -- transaction wire form ---------------------------------------------------
+
+def txn_to_wire(txn: Transaction) -> tuple[list, bytes]:
+    """Serialize a store Transaction: op records in JSON + one data blob
+    (write payloads, xattr/omap values) addressed by (offset, length)."""
+    ops = []
+    blob = bytearray()
+
+    def put(b: bytes) -> list[int]:
+        off = len(blob)
+        blob.extend(b)
+        return [off, len(b)]
+
+    def g2j(g):
+        return [hobj_to_json(g.hobj), g.generation, g.shard]
+
+    for op in txn.ops:
+        if isinstance(op, os_.OpTouch):
+            ops.append(["touch", g2j(op.oid)])
+        elif isinstance(op, os_.OpWrite):
+            ops.append(["write", g2j(op.oid), op.offset,
+                        put(op.data.tobytes())])
+        elif isinstance(op, os_.OpZero):
+            ops.append(["zero", g2j(op.oid), op.offset, op.length])
+        elif isinstance(op, os_.OpTruncate):
+            ops.append(["truncate", g2j(op.oid), op.size])
+        elif isinstance(op, os_.OpRemove):
+            ops.append(["remove", g2j(op.oid)])
+        elif isinstance(op, os_.OpSetAttrs):
+            ops.append(["setattrs", g2j(op.oid),
+                        {k: put(v) for k, v in op.attrs.items()}])
+        elif isinstance(op, os_.OpRmAttr):
+            ops.append(["rmattr", g2j(op.oid), op.name])
+        elif isinstance(op, os_.OpClone):
+            ops.append(["clone", g2j(op.src), g2j(op.dst)])
+        elif isinstance(op, os_.OpRename):
+            ops.append(["rename", g2j(op.src), g2j(op.dst)])
+        elif isinstance(op, os_.OpOmapSet):
+            ops.append(["omapset", g2j(op.oid),
+                        [[put(k), put(v)] for k, v in op.kv.items()]])
+        elif isinstance(op, os_.OpOmapRmKeys):
+            ops.append(["omaprm", g2j(op.oid), [put(k) for k in op.keys]])
+        elif isinstance(op, os_.OpOmapClear):
+            ops.append(["omapclear", g2j(op.oid)])
+        else:
+            raise TypeError(f"cannot serialize {op!r}")
+    return ops, bytes(blob)
+
+
+def txn_from_wire(ops: list, blob: bytes) -> Transaction:
+    from ..osd.types import ghobject_t
+
+    def get(ref) -> bytes:
+        off, ln = ref
+        return blob[off:off + ln]
+
+    def j2g(j):
+        return ghobject_t(hobj_from_json(j[0]), j[1], j[2])
+
+    t = Transaction()
+    for rec in ops:
+        kind = rec[0]
+        if kind == "touch":
+            t.touch(j2g(rec[1]))
+        elif kind == "write":
+            t.write(j2g(rec[1]), rec[2],
+                    np.frombuffer(get(rec[3]), dtype=np.uint8))
+        elif kind == "zero":
+            t.zero(j2g(rec[1]), rec[2], rec[3])
+        elif kind == "truncate":
+            t.truncate(j2g(rec[1]), rec[2])
+        elif kind == "remove":
+            t.remove(j2g(rec[1]))
+        elif kind == "setattrs":
+            t.setattrs(j2g(rec[1]), {k: get(v) for k, v in rec[2].items()})
+        elif kind == "rmattr":
+            t.rmattr(j2g(rec[1]), rec[2])
+        elif kind == "clone":
+            t.clone(j2g(rec[1]), j2g(rec[2]))
+        elif kind == "rename":
+            t.rename(j2g(rec[1]), j2g(rec[2]))
+        elif kind == "omapset":
+            t.omap_setkeys(j2g(rec[1]),
+                           {get(k): get(v) for k, v in rec[2]})
+        elif kind == "omaprm":
+            t.omap_rmkeys(j2g(rec[1]), [get(k) for k in rec[2]])
+        elif kind == "omapclear":
+            t.omap_clear(j2g(rec[1]))
+        else:
+            raise ValueError(f"unknown wire op {kind}")
+    return t
+
+
+# -- client ops --------------------------------------------------------------
+
+@register_message
+class MOSDOp(Message):
+    """Client -> primary OSD op (reference src/messages/MOSDOp.h).
+    ops: list of [opname, offset, length] with write payloads
+    concatenated in the data segment in op order."""
+
+    type_id = 42
+
+    def __init__(self, pgid: spg_t, oid: hobject_t, ops: list,
+                 data: bytes = b"", tid: int = 0, epoch: int = 0):
+        super().__init__()
+        self.pgid, self.oid, self.ops = pgid, oid, ops
+        self.data, self.tid, self.epoch = data, tid, epoch
+
+    def to_meta(self):
+        return {"pgid": spg_to_json(self.pgid),
+                "oid": hobj_to_json(self.oid),
+                "ops": self.ops, "tid": self.tid, "epoch": self.epoch}
+
+    def data_segment(self):
+        return self.data
+
+    def decode_wire(self, meta, data):
+        self.pgid = spg_from_json(meta["pgid"])
+        self.oid = hobj_from_json(meta["oid"])
+        self.ops, self.tid = meta["ops"], meta["tid"]
+        self.epoch = meta["epoch"]
+        self.data = data
+
+
+@register_message
+class MOSDOpReply(Message):
+    """reference MOSDOpReply.h."""
+
+    type_id = 43
+
+    def __init__(self, tid: int, result: int, data: bytes = b"",
+                 epoch: int = 0):
+        super().__init__()
+        self.tid, self.result, self.data, self.epoch = \
+            tid, result, data, epoch
+
+    def to_meta(self):
+        return {"tid": self.tid, "result": self.result, "epoch": self.epoch}
+
+    def data_segment(self):
+        return self.data
+
+    def decode_wire(self, meta, data):
+        self.tid, self.result = meta["tid"], meta["result"]
+        self.epoch = meta["epoch"]
+        self.data = data
+
+
+# -- EC sub-ops --------------------------------------------------------------
+
+@register_message
+class MOSDECSubOpWrite(Message):
+    """Primary -> shard write (reference MOSDECSubOpWrite.h carrying
+    ECSubWrite: shard transaction + version, ECMsgTypes.h)."""
+
+    type_id = 108
+
+    def __init__(self, pgid: spg_t, tid: int, at_version: eversion_t,
+                 txn: Transaction):
+        super().__init__()
+        self.pgid, self.tid, self.at_version, self.txn = \
+            pgid, tid, at_version, txn
+
+    def to_meta(self):
+        ops, blob = txn_to_wire(self.txn)
+        self._blob = blob
+        return {"pgid": spg_to_json(self.pgid), "tid": self.tid,
+                "v": [self.at_version.epoch, self.at_version.version],
+                "ops": ops}
+
+    def data_segment(self):
+        return self._blob
+
+    def decode_wire(self, meta, data):
+        self.pgid = spg_from_json(meta["pgid"])
+        self.tid = meta["tid"]
+        self.at_version = eversion_t(*meta["v"])
+        self.txn = txn_from_wire(meta["ops"], data)
+
+
+@register_message
+class MOSDECSubOpWriteReply(Message):
+    type_id = 109
+
+    def __init__(self, pgid: spg_t, tid: int, shard: int, result: int = 0):
+        super().__init__()
+        self.pgid, self.tid, self.shard, self.result = \
+            pgid, tid, shard, result
+
+    def to_meta(self):
+        return {"pgid": spg_to_json(self.pgid), "tid": self.tid,
+                "shard": self.shard, "result": self.result}
+
+    def decode_wire(self, meta, data):
+        self.pgid = spg_from_json(meta["pgid"])
+        self.tid, self.shard = meta["tid"], meta["shard"]
+        self.result = meta["result"]
+
+
+@register_message
+class MOSDECSubOpRead(Message):
+    """Primary -> shard read (reference MOSDECSubOpRead.h / ECSubRead:
+    per-shard extent list + attr wants)."""
+
+    type_id = 110
+
+    def __init__(self, pgid: spg_t, tid: int, oid: hobject_t,
+                 off: int, length: int, want_attrs: bool = False):
+        super().__init__()
+        self.pgid, self.tid, self.oid = pgid, tid, oid
+        self.off, self.length, self.want_attrs = off, length, want_attrs
+
+    def to_meta(self):
+        return {"pgid": spg_to_json(self.pgid), "tid": self.tid,
+                "oid": hobj_to_json(self.oid), "off": self.off,
+                "len": self.length, "attrs": self.want_attrs}
+
+    def decode_wire(self, meta, data):
+        self.pgid = spg_from_json(meta["pgid"])
+        self.tid = meta["tid"]
+        self.oid = hobj_from_json(meta["oid"])
+        self.off, self.length = meta["off"], meta["len"]
+        self.want_attrs = meta["attrs"]
+
+
+@register_message
+class MOSDECSubOpReadReply(Message):
+    type_id = 111
+
+    def __init__(self, pgid: spg_t, tid: int, shard: int, result: int,
+                 data: bytes = b"", attrs: dict[str, bytes] | None = None):
+        super().__init__()
+        self.pgid, self.tid, self.shard, self.result = \
+            pgid, tid, shard, result
+        self.data = data
+        self.attrs = attrs or {}
+
+    def to_meta(self):
+        # attrs ride the data segment after the read payload
+        self._attr_blob = json.dumps(
+            {k: v.hex() for k, v in self.attrs.items()}).encode()
+        return {"pgid": spg_to_json(self.pgid), "tid": self.tid,
+                "shard": self.shard, "result": self.result,
+                "dlen": len(self.data)}
+
+    def data_segment(self):
+        return self.data + self._attr_blob
+
+    def decode_wire(self, meta, data):
+        self.pgid = spg_from_json(meta["pgid"])
+        self.tid, self.shard = meta["tid"], meta["shard"]
+        self.result = meta["result"]
+        dlen = meta["dlen"]
+        self.data = data[:dlen]
+        self.attrs = {k: bytes.fromhex(v)
+                      for k, v in json.loads(data[dlen:].decode()).items()}
+
+
+# -- heartbeat / mon ---------------------------------------------------------
+
+@register_message
+class MOSDPing(Message):
+    """reference MOSDPing.h (PING / PING_REPLY)."""
+
+    type_id = 70
+
+    def __init__(self, from_osd: int, epoch: int = 0, is_reply: bool = False,
+                 stamp: float = 0.0):
+        super().__init__()
+        self.from_osd, self.epoch, self.is_reply, self.stamp = \
+            from_osd, epoch, is_reply, stamp
+
+    def to_meta(self):
+        return {"from": self.from_osd, "epoch": self.epoch,
+                "reply": self.is_reply, "stamp": self.stamp}
+
+    def decode_wire(self, meta, data):
+        self.from_osd, self.epoch = meta["from"], meta["epoch"]
+        self.is_reply, self.stamp = meta["reply"], meta["stamp"]
+
+
+@register_message
+class MMonGetMap(Message):
+    type_id = 4
+
+    def __init__(self, what: str = "osdmap"):
+        super().__init__()
+        self.what = what
+
+    def to_meta(self):
+        return {"what": self.what}
+
+    def decode_wire(self, meta, data):
+        self.what = meta["what"]
+
+
+@register_message
+class MMonMap(Message):
+    """OSDMap payload (reference MOSDMap.h); JSON-serialized map."""
+
+    type_id = 5
+
+    def __init__(self, map_json: dict | None = None):
+        super().__init__()
+        self.map_json = map_json or {}
+
+    def to_meta(self):
+        return {}
+
+    def data_segment(self):
+        return json.dumps(self.map_json).encode()
+
+    def decode_wire(self, meta, data):
+        self.map_json = json.loads(data.decode()) if data else {}
+
+
+@register_message
+class MOSDBoot(Message):
+    """OSD announces itself up (reference MOSDBoot.h)."""
+
+    type_id = 71
+
+    def __init__(self, osd_id: int = -1, addr: tuple[str, int] | None = None):
+        super().__init__()
+        self.osd_id, self.addr = osd_id, addr
+
+    def to_meta(self):
+        return {"osd": self.osd_id, "addr": list(self.addr or ())}
+
+    def decode_wire(self, meta, data):
+        self.osd_id = meta["osd"]
+        a = meta["addr"]
+        self.addr = (a[0], a[1]) if a else None
+
+
+@register_message
+class MOSDFailure(Message):
+    """Failure report to the mon (reference MOSDFailure.h)."""
+
+    type_id = 72
+
+    def __init__(self, reporter: int = -1, failed: int = -1,
+                 epoch: int = 0):
+        super().__init__()
+        self.reporter, self.failed, self.epoch = reporter, failed, epoch
+
+    def to_meta(self):
+        return {"reporter": self.reporter, "failed": self.failed,
+                "epoch": self.epoch}
+
+    def decode_wire(self, meta, data):
+        self.reporter, self.failed = meta["reporter"], meta["failed"]
+        self.epoch = meta["epoch"]
+
+
+@register_message
+class MMonCommand(Message):
+    """Admin command (reference MMonCommand.h; `ceph` CLI JSON dispatch)."""
+
+    type_id = 50
+
+    def __init__(self, cmd: dict | None = None, tid: int = 0):
+        super().__init__()
+        self.cmd = cmd or {}
+        self.tid = tid
+
+    def to_meta(self):
+        return {"cmd": self.cmd, "tid": self.tid}
+
+    def decode_wire(self, meta, data):
+        self.cmd, self.tid = meta["cmd"], meta["tid"]
+
+
+@register_message
+class MMonCommandAck(Message):
+    type_id = 51
+
+    def __init__(self, tid: int = 0, result: int = 0, out: dict | None = None):
+        super().__init__()
+        self.tid, self.result, self.out = tid, result, out or {}
+
+    def to_meta(self):
+        return {"tid": self.tid, "result": self.result, "out": self.out}
+
+    def decode_wire(self, meta, data):
+        self.tid, self.result = meta["tid"], meta["result"]
+        self.out = meta["out"]
