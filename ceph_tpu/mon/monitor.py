@@ -1,0 +1,216 @@
+"""Monitor: the cluster control plane.
+
+Re-expresses the slice of reference src/mon/ the storage path needs —
+the OSDMonitor role (src/mon/OSDMonitor.cc): sole author of the OSDMap,
+consumer of boot/failure reports with a quorum-of-reporters rule
+(prepare_failure, reference OSDMonitor.cc:3226 / can_mark_down :3019),
+EC profile management with plugin validation (normalize_profile :7190 +
+stripe_unit validation :7211-7229), pool creation, and map distribution
+to every subscriber on each epoch.
+
+Single-instance: the reference replicates this state machine over Paxos
+across 3+ mons; here the map authority is one process and the Paxos
+quorum is future work recorded in docs/ROADMAP (the OSD/client contract
+— "mon is where maps come from" — is identical either way).
+"""
+
+from __future__ import annotations
+
+import errno
+import threading
+
+from ..ec import ErasureCodeError, ErasureCodePluginRegistry, Profile
+from ..msg import Messenger
+from ..msg import messages as M
+from ..osd.osd_map import OSDMap
+from ..osd.types import PoolType
+
+DEFAULT_EC_PROFILE = {"plugin": "jax", "k": "2", "m": "1",
+                      "technique": "cauchy",
+                      "crush-failure-domain": "host"}
+
+
+class Monitor:
+    def __init__(self, addr: tuple[str, int] = ("127.0.0.1", 0),
+                 failure_quorum: int = 2):
+        self.osdmap = OSDMap()
+        self.osdmap.ec_profiles["default"] = dict(DEFAULT_EC_PROFILE)
+        self.lock = threading.RLock()
+        self.failure_quorum = failure_quorum
+        self._failure_reports: dict[int, set[int]] = {}
+        self._subscribers: list = []
+        self.messenger = Messenger("mon")
+        self.messenger.add_dispatcher(self._dispatch)
+        self.addr = self.messenger.bind(addr)
+
+    def shutdown(self) -> None:
+        self.messenger.shutdown()
+
+    # -- dispatch -----------------------------------------------------------
+
+    def _dispatch(self, conn, msg) -> None:
+        if isinstance(msg, M.MMonGetMap):
+            with self.lock:
+                if conn not in self._subscribers:
+                    self._subscribers.append(conn)
+                conn.send_message(M.MMonMap(self.osdmap.to_json()))
+        elif isinstance(msg, M.MOSDBoot):
+            self._handle_boot(msg)
+        elif isinstance(msg, M.MOSDFailure):
+            self._handle_failure(msg)
+        elif isinstance(msg, M.MMonCommand):
+            result, out = self.handle_command(msg.cmd)
+            conn.send_message(M.MMonCommandAck(msg.tid, result, out))
+
+    def _publish(self) -> None:
+        """Push the new map to every subscriber (reference OSDMap epoch
+        share; subscribers are daemons and clients)."""
+        j = self.osdmap.to_json()
+        for conn in list(self._subscribers):
+            try:
+                conn.send_message(M.MMonMap(j))
+            except Exception:  # noqa: BLE001
+                self._subscribers.remove(conn)
+
+    # -- osd lifecycle ------------------------------------------------------
+
+    def _handle_boot(self, msg: M.MOSDBoot) -> None:
+        with self.lock:
+            if msg.osd_id not in self.osdmap.osds:
+                # auto-create with one host per osd unless pre-declared
+                self.osdmap.add_osd(msg.osd_id, f"host{msg.osd_id}",
+                                    addr=msg.addr)
+            self.osdmap.set_osd_up(msg.osd_id, msg.addr)
+            self._failure_reports.pop(msg.osd_id, None)
+            self.osdmap.bump_epoch()
+            self._publish()
+
+    def _handle_failure(self, msg: M.MOSDFailure) -> None:
+        with self.lock:
+            if not self.osdmap.is_up(msg.failed):
+                return
+            reports = self._failure_reports.setdefault(msg.failed, set())
+            reports.add(msg.reporter)
+            up = sum(1 for o in self.osdmap.osds.values() if o.up)
+            need = min(self.failure_quorum, max(1, up - 1))
+            if len(reports) >= need:
+                self.osdmap.set_osd_down(msg.failed)
+                self._failure_reports.pop(msg.failed, None)
+                self.osdmap.bump_epoch()
+                self._publish()
+
+    # -- admin commands (reference OSDMonitor command surface) --------------
+
+    def handle_command(self, cmd: dict) -> tuple[int, dict]:
+        prefix = cmd.get("prefix", "")
+        try:
+            if prefix == "osd erasure-code-profile set":
+                return self._cmd_profile_set(cmd)
+            if prefix == "osd erasure-code-profile get":
+                name = cmd["name"]
+                prof = self.osdmap.ec_profiles.get(name)
+                return (0, {"profile": prof}) if prof is not None else \
+                    (-errno.ENOENT, {"error": f"no profile {name}"})
+            if prefix == "osd erasure-code-profile ls":
+                return 0, {"profiles": sorted(self.osdmap.ec_profiles)}
+            if prefix == "osd pool create":
+                return self._cmd_pool_create(cmd)
+            if prefix == "osd pool ls":
+                return 0, {"pools": [p.name
+                                     for p in self.osdmap.pools.values()]}
+            if prefix == "status":
+                return self._cmd_status()
+            if prefix == "osd tree":
+                return self._cmd_tree()
+            return -errno.EINVAL, {"error": f"unknown command {prefix!r}"}
+        except ErasureCodeError as e:
+            return -e.errno, {"error": str(e)}
+        except KeyError as e:
+            return -errno.EINVAL, {"error": f"missing arg {e}"}
+
+    def _cmd_profile_set(self, cmd: dict) -> tuple[int, dict]:
+        """Validate + normalize via the plugin itself (reference
+        normalize_profile, OSDMonitor.cc:7190)."""
+        name = cmd["name"]
+        prof = dict(cmd.get("profile", {}))
+        prof.setdefault("plugin", "jax")
+        profile = Profile(dict(prof))
+        codec = ErasureCodePluginRegistry.instance().factory(
+            prof["plugin"], profile)
+        # normalized: plugin filled defaults (k/m/technique) into profile
+        normalized = dict(profile.data)
+        with self.lock:
+            self.osdmap.ec_profiles[name] = normalized
+            self.osdmap.bump_epoch()
+            self._publish()
+        return 0, {"profile": normalized,
+                   "chunk_count": codec.get_chunk_count()}
+
+    def _cmd_pool_create(self, cmd: dict) -> tuple[int, dict]:
+        name = cmd["name"]
+        pg_num = int(cmd.get("pg_num", 8))
+        kind = cmd.get("type", "replicated")
+        with self.lock:
+            if self.osdmap.lookup_pool(name) is not None:
+                return -errno.EEXIST, {"error": f"pool {name} exists"}
+            if kind == "erasure":
+                prof_name = cmd.get("erasure_code_profile", "default")
+                prof = self.osdmap.ec_profiles.get(prof_name)
+                if prof is None:
+                    return -errno.ENOENT, \
+                        {"error": f"no profile {prof_name}"}
+                profile = Profile(dict(prof))
+                codec = ErasureCodePluginRegistry.instance().factory(
+                    prof["plugin"], profile)
+                k = codec.get_data_chunk_count()
+                n = codec.get_chunk_count()
+                # stripe_width from profile stripe_unit (validated against
+                # chunk size, reference OSDMonitor.cc:7211-7229)
+                stripe_unit = int(profile.get("stripe_unit", "4096"))
+                chunk = codec.get_chunk_size(stripe_unit * k)
+                stripe_width = chunk * k
+                rule_name = cmd.get("crush_rule", f"{name}_rule")
+                rid = self.osdmap.crush.rule_id_by_name(rule_name)
+                if rid is None:
+                    rid = codec.create_rule(rule_name, self.osdmap.crush)
+                pool = self.osdmap.create_pool(
+                    name, PoolType.ERASURE, size=n, pg_num=pg_num,
+                    crush_rule=rid, erasure_code_profile=prof_name,
+                    stripe_width=stripe_width)
+            else:
+                size = int(cmd.get("size", 3))
+                rule_name = cmd.get("crush_rule", "replicated_rule")
+                rid = self.osdmap.crush.rule_id_by_name(rule_name)
+                if rid is None:
+                    rid = self.osdmap.crush.add_simple_rule(
+                        rule_name, "default", "host", size)
+                pool = self.osdmap.create_pool(
+                    name, PoolType.REPLICATED, size=size, pg_num=pg_num,
+                    crush_rule=rid)
+            self.osdmap.bump_epoch()
+            self._publish()
+        return 0, {"pool_id": pool.id, "stripe_width": pool.stripe_width}
+
+    def _cmd_status(self) -> tuple[int, dict]:
+        with self.lock:
+            osds = self.osdmap.osds.values()
+            return 0, {
+                "epoch": self.osdmap.epoch,
+                "num_osds": len(self.osdmap.osds),
+                "num_up_osds": sum(1 for o in osds if o.up),
+                "num_in_osds": sum(1 for o in self.osdmap.osds.values()
+                                   if o.in_),
+                "pools": len(self.osdmap.pools),
+            }
+
+    def _cmd_tree(self) -> tuple[int, dict]:
+        with self.lock:
+            cm = self.osdmap.crush.map
+            return 0, {
+                "buckets": [[b.name, b.type_name,
+                             [(i, w) for i, w in zip(b.items, b.weights)]]
+                            for b in cm.buckets.values()],
+                "osds": [[o.id, "up" if o.up else "down",
+                          "in" if o.in_ else "out"]
+                         for o in self.osdmap.osds.values()],
+            }

@@ -277,12 +277,14 @@ class MOSDECSubOpReadReply(Message):
     type_id = 111
 
     def __init__(self, pgid: spg_t, tid: int, shard: int, result: int,
-                 data: bytes = b"", attrs: dict[str, bytes] | None = None):
+                 data: bytes = b"", attrs: dict[str, bytes] | None = None,
+                 size: int = -1):
         super().__init__()
         self.pgid, self.tid, self.shard, self.result = \
             pgid, tid, shard, result
         self.data = data
         self.attrs = attrs or {}
+        self.size = size  # shard object size; -1 = absent
 
     def to_meta(self):
         # attrs ride the data segment after the read payload
@@ -290,7 +292,7 @@ class MOSDECSubOpReadReply(Message):
             {k: v.hex() for k, v in self.attrs.items()}).encode()
         return {"pgid": spg_to_json(self.pgid), "tid": self.tid,
                 "shard": self.shard, "result": self.result,
-                "dlen": len(self.data)}
+                "dlen": len(self.data), "size": self.size}
 
     def data_segment(self):
         return self.data + self._attr_blob
@@ -299,6 +301,7 @@ class MOSDECSubOpReadReply(Message):
         self.pgid = spg_from_json(meta["pgid"])
         self.tid, self.shard = meta["tid"], meta["shard"]
         self.result = meta["result"]
+        self.size = meta["size"]
         dlen = meta["dlen"]
         self.data = data[:dlen]
         self.attrs = {k: bytes.fromhex(v)

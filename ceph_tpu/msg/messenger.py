@@ -132,6 +132,15 @@ class Messenger:
         with cls._loop_lock:
             if cls._loop is None or not cls._loop_thread.is_alive():
                 loop = asyncio.new_event_loop()
+                # Wide dispatcher pool: handlers may block on nested RPC
+                # round-trips (shard stat/attr fetches inside a client-op
+                # handler), so the pool must exceed the plausible nesting
+                # across all in-process daemons (single-host test clusters
+                # share this reactor).
+                from concurrent.futures import ThreadPoolExecutor
+                loop.set_default_executor(
+                    ThreadPoolExecutor(max_workers=64,
+                                       thread_name_prefix="msgr-dispatch"))
 
                 def run():
                     asyncio.set_event_loop(loop)

@@ -173,15 +173,22 @@ class ECBackend:
     # -- object metadata helpers -------------------------------------------
 
     def _get_hinfo(self, oid: hobject_t) -> HashInfo:
-        h = self.shards.get_hinfo(0, oid)
-        return h if h is not None else HashInfo.make(self.n)
+        """hinfo is replicated on every shard; take the first live one."""
+        for s in range(self.n):
+            h = self.shards.get_hinfo(s, oid)
+            if h is not None:
+                return h
+        return HashInfo.make(self.n)
 
     def _get_size(self, oid: hobject_t) -> int:
-        """Logical size = shard-0 chunk size scaled up (objects are padded
-        to stripe bounds on write)."""
-        chunk = self.shards.stat(0, oid)
-        return 0 if chunk is None else \
-            self.sinfo.aligned_chunk_offset_to_logical_offset(chunk)
+        """Logical size = shard chunk size scaled up (objects are padded
+        to stripe bounds on write; all shards share the size)."""
+        for s in range(self.n):
+            chunk = self.shards.stat(s, oid)
+            if chunk is not None:
+                return self.sinfo.aligned_chunk_offset_to_logical_offset(
+                    chunk)
+        return 0
 
     # -- entry (reference submit_transaction :1483 / start_rmw :1839) ------
 
@@ -387,20 +394,28 @@ class ECBackend:
         chunk_len = span // self.k
         got: dict[int, np.ndarray] = {}
         failed: set[int] = set()
+        ready = threading.Event()
+        issued = [0]
 
         def on_done(shard, data):
             if data is None:
                 failed.add(shard)
             else:
                 got[shard] = data
+            if len(got) >= self.k or len(got) + len(failed) >= issued[0]:
+                ready.set()
 
+        issued[0] = self.k
         for s in range(self.k):
             self.shards.sub_read(s, oid, chunk_off, chunk_len, on_done)
-        if failed:
+        if not ready.wait(timeout=30) or (failed and len(got) < self.k):
+            # degraded: fan out to parity shards until k gathered
+            # (reference get_remaining_shards :1633 / fast_read)
+            ready.clear()
+            issued[0] = self.n
             for s in range(self.k, self.n):
-                if len(got) >= self.k:
-                    break
                 self.shards.sub_read(s, oid, chunk_off, chunk_len, on_done)
+            ready.wait(timeout=30)
         if len(got) < self.k:
             raise ErasureCodeError(5, f"unrecoverable read {oid}")
         use = dict(list(sorted(got.items()))[: self.k])

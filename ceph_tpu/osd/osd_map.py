@@ -162,3 +162,66 @@ class OSDMap:
     def bump_epoch(self) -> int:
         self.epoch += 1
         return self.epoch
+
+    # -- wire form (mon -> everyone; reference OSDMap::encode) --------------
+
+    def to_json(self) -> dict:
+        from ..crush.map import Rule, Step
+        crush = self.crush.map
+        return {
+            "epoch": self.epoch,
+            "osds": [[o.id, o.up, o.in_, o.weight, list(o.addr or ())]
+                     for o in self.osds.values()],
+            "pools": [[p.id, p.name, int(p.type), p.size, p.min_size,
+                       p.pg_num, p.crush_rule, p.erasure_code_profile,
+                       p.stripe_width]
+                      for p in self.pools.values()],
+            "pg_temp": [[pg.pool, pg.seed, osds]
+                        for pg, osds in self.pg_temp.items()],
+            "ec_profiles": self.ec_profiles,
+            "crush": {
+                "devices": [[d.id, d.weight, d.device_class]
+                            for d in crush.devices.values()],
+                "buckets": [[b.id, b.name, b.type_name, b.items, b.weights]
+                            for b in crush.buckets.values()],
+                "rules": [[r.id, r.name, r.mode,
+                           [[s.op, s.num, s.type_name, s.mode, s.item]
+                            for s in r.steps]]
+                          for r in crush.rules.values()],
+                "next_bucket_id": self.crush._next_bucket_id,
+                "next_rule_id": self.crush._next_rule_id,
+            },
+        }
+
+    @classmethod
+    def from_json(cls, j: dict) -> "OSDMap":
+        from ..crush.map import Bucket, Rule, Step
+        m = cls()
+        m.epoch = j["epoch"]
+        for oid_, up, in_, w, addr in j["osds"]:
+            m.osds[oid_] = OSDInfo(oid_, up, in_, w,
+                                   tuple(addr) if addr else None)
+        for pid, name, t, size, msize, pgn, rule, prof, sw in j["pools"]:
+            m.pools[pid] = PGPool(pid, name, PoolType(t), size, msize,
+                                  pgn, rule, prof, sw)
+            m.pool_ids_by_name[name] = pid
+        for pool, seed, osds in j.get("pg_temp", []):
+            m.pg_temp[pg_t(pool, seed)] = osds
+        m.ec_profiles = dict(j.get("ec_profiles", {}))
+        cj = j["crush"]
+        cm = m.crush.map
+        for did, w, dc in cj["devices"]:
+            cm.devices[did] = __import__(
+                "ceph_tpu.crush.map", fromlist=["Device"]).Device(did, w, dc)
+        for bid, name, tname, items, weights in cj["buckets"]:
+            b = Bucket(bid, name, tname, list(items), list(weights))
+            cm.buckets[bid] = b
+            cm.buckets_by_name[name] = b
+        for rid, name, mode, steps in cj["rules"]:
+            cm.rules[rid] = Rule(rid, name,
+                                 [Step(op=s[0], num=s[1], type_name=s[2],
+                                       mode=s[3], item=s[4]) for s in steps],
+                                 mode=mode)
+        m.crush._next_bucket_id = cj["next_bucket_id"]
+        m.crush._next_rule_id = cj["next_rule_id"]
+        return m

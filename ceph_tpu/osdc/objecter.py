@@ -1,0 +1,146 @@
+"""Objecter: the client-side RADOS op state machine.
+
+Re-expresses reference src/osdc/Objecter.{h,cc}: ops target a PG's
+acting primary computed from the OSDMap via CRUSH *on the client*
+(_calc_target, reference Objecter.cc:2759 -> OSDMap::pg_to_up_acting_osds),
+are sent as MOSDOp and matched to MOSDOpReply by tid (op_submit :2256 /
+_send_op :3216); every new map retargets and resends what's pending
+(:1293).  Mon interaction (map subscription, admin commands) rides the
+same engine, standing in for MonClient.
+"""
+
+from __future__ import annotations
+
+import errno
+import threading
+import time
+
+from ..msg import Messenger
+from ..msg import messages as M
+from ..osd.osd_map import OSDMap
+from ..osd.types import hobject_t, spg_t
+
+
+class TimedOut(Exception):
+    pass
+
+
+class Objecter:
+    def __init__(self, mon_addr: tuple[str, int], name: str = "client"):
+        self.messenger = Messenger(name)
+        self.messenger.add_dispatcher(self._dispatch)
+        self.mon_addr = mon_addr
+        self.mon_conn = self.messenger.connect(mon_addr)
+        self.osdmap = OSDMap()
+        self.map_event = threading.Event()
+        self._tid = 0
+        self._lock = threading.Lock()
+        self._waiters: dict[int, dict] = {}
+        self._mon_waiters: dict[int, dict] = {}
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self, timeout: float = 10.0) -> None:
+        self.mon_conn.send_message(M.MMonGetMap())
+        deadline = time.time() + timeout
+        while self.osdmap.epoch == 0 and time.time() < deadline:
+            self.map_event.wait(0.05)
+            self.map_event.clear()
+        if self.osdmap.epoch == 0:
+            raise TimedOut("no osdmap from mon")
+
+    def shutdown(self) -> None:
+        self.messenger.shutdown()
+
+    # -- dispatch -----------------------------------------------------------
+
+    def _dispatch(self, conn, msg) -> None:
+        if isinstance(msg, M.MMonMap):
+            self.osdmap = OSDMap.from_json(msg.map_json)
+            self.map_event.set()
+        elif isinstance(msg, M.MOSDOpReply):
+            with self._lock:
+                w = self._waiters.pop(msg.tid, None)
+            if w is not None:
+                w["reply"] = msg
+                w["event"].set()
+        elif isinstance(msg, M.MMonCommandAck):
+            with self._lock:
+                w = self._mon_waiters.pop(msg.tid, None)
+            if w is not None:
+                w["reply"] = msg
+                w["event"].set()
+
+    # -- map plumbing -------------------------------------------------------
+
+    def refresh_map(self, timeout: float = 5.0) -> None:
+        self.map_event.clear()
+        self.mon_conn.send_message(M.MMonGetMap())
+        self.map_event.wait(timeout)
+
+    def _calc_target(self, pool_id: int, name: str
+                     ) -> tuple[spg_t, int] | None:
+        """reference _calc_target: object -> pg -> acting primary."""
+        pgid = self.osdmap.object_to_pg(pool_id, name)
+        spg = self.osdmap.primary_shard(pgid)
+        if spg is None:
+            return None
+        _, _, _, primary = self.osdmap.pg_to_up_acting_osds(pgid)
+        return spg, primary
+
+    # -- op submission ------------------------------------------------------
+
+    def op_submit(self, pool_id: int, name: str, ops: list,
+                  data: bytes = b"", timeout: float = 30.0,
+                  attempts: int = 3) -> M.MOSDOpReply:
+        oid = hobject_t(pool=pool_id, name=name)
+        last_err = None
+        for attempt in range(attempts):
+            tgt = self._calc_target(pool_id, name)
+            if tgt is None:
+                self.refresh_map()
+                last_err = -errno.EHOSTUNREACH
+                continue
+            spg, primary = tgt
+            info = self.osdmap.osds.get(primary)
+            if info is None or info.addr is None:
+                self.refresh_map()
+                last_err = -errno.EHOSTUNREACH
+                continue
+            with self._lock:
+                self._tid += 1
+                tid = self._tid
+                w = {"event": threading.Event(), "reply": None}
+                self._waiters[tid] = w
+            conn = self.messenger.connect(tuple(info.addr))
+            conn.send_message(M.MOSDOp(spg, oid, ops, data, tid,
+                                       self.osdmap.epoch))
+            if w["event"].wait(timeout):
+                reply = w["reply"]
+                if reply.result == -errno.EAGAIN:
+                    # primary moved (reference retarget on map change)
+                    self.refresh_map()
+                    last_err = reply.result
+                    continue
+                return reply
+            with self._lock:
+                self._waiters.pop(tid, None)
+            self.refresh_map()
+            last_err = -errno.ETIMEDOUT
+        raise TimedOut(f"op {name} failed after {attempts} attempts "
+                       f"(last {last_err})")
+
+    # -- mon commands -------------------------------------------------------
+
+    def mon_command(self, cmd: dict, timeout: float = 15.0
+                    ) -> tuple[int, dict]:
+        with self._lock:
+            self._tid += 1
+            tid = self._tid
+            w = {"event": threading.Event(), "reply": None}
+            self._mon_waiters[tid] = w
+        self.mon_conn.send_message(M.MMonCommand(cmd, tid))
+        if not w["event"].wait(timeout):
+            raise TimedOut(f"mon command {cmd.get('prefix')}")
+        ack = w["reply"]
+        return ack.result, ack.out

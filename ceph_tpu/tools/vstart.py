@@ -1,0 +1,93 @@
+"""Dev cluster launcher (reference src/vstart.sh + qa/standalone/
+ceph-helpers.sh run_mon/run_osd): start a mon and N OSDs on localhost
+loopback — in-process threads by default (standalone-test style: many
+daemons, one host, real messenger over loopback).
+
+Library use:
+    with Cluster(n_osds=6) as c:
+        client = c.client()
+        ...
+
+CLI use:
+    python -m ceph_tpu.tools.vstart --osds 6     # runs until Ctrl-C
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from ..mon import Monitor
+from ..osd.daemon import OSDDaemon
+from ..rados import RadosClient
+
+
+class Cluster:
+    def __init__(self, n_osds: int = 6, heartbeat_interval: float = 0.0,
+                 failure_quorum: int = 2):
+        self.mon = Monitor(failure_quorum=failure_quorum)
+        self.osds: list[OSDDaemon] = []
+        self.n_osds = n_osds
+        self.heartbeat_interval = heartbeat_interval
+        self._clients: list[RadosClient] = []
+
+    def start(self) -> "Cluster":
+        for i in range(self.n_osds):
+            osd = OSDDaemon(i, self.mon.addr,
+                            heartbeat_interval=self.heartbeat_interval)
+            self.osds.append(osd)
+        for osd in self.osds:
+            osd.boot()
+        return self
+
+    def client(self) -> RadosClient:
+        c = RadosClient(self.mon.addr).connect()
+        self._clients.append(c)
+        return c
+
+    def kill_osd(self, osd_id: int) -> None:
+        """Hard-kill an OSD (thrasher-style, reference
+        qa/tasks/ceph_manager.py kill_osd)."""
+        osd = self.osds[osd_id]
+        osd.shutdown()
+
+    def mark_osd_down(self, osd_id: int) -> None:
+        """Administratively mark down (what failure detection would do)."""
+        with self.mon.lock:
+            self.mon.osdmap.set_osd_down(osd_id)
+            self.mon.osdmap.bump_epoch()
+            self.mon._publish()
+
+    def stop(self) -> None:
+        for c in self._clients:
+            c.shutdown()
+        for osd in self.osds:
+            osd.shutdown()
+        self.mon.shutdown()
+
+    def __enter__(self) -> "Cluster":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="vstart")
+    ap.add_argument("--osds", type=int, default=6)
+    ap.add_argument("--heartbeat", type=float, default=1.0)
+    args = ap.parse_args(argv)
+    cluster = Cluster(args.osds, heartbeat_interval=args.heartbeat).start()
+    print(f"mon at {cluster.mon.addr}; {args.osds} osds up; Ctrl-C to stop",
+          flush=True)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        cluster.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
