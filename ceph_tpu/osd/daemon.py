@@ -451,6 +451,9 @@ class OSDDaemon:
                 data_off += ln
             elif name == "read":
                 _, off, ln = op
+                if not self._object_exists(state, msg.oid):
+                    result = -errno.ENOENT
+                    break
                 data = be.read(msg.oid, off, ln if ln > 0 else None)
                 read_payload += data.tobytes() if data is not None else b""
             elif name == "stat":
@@ -469,6 +472,12 @@ class OSDDaemon:
                 result = -errno.ETIMEDOUT
         conn.send_message(M.MOSDOpReply(msg.tid, result, read_payload,
                                         self.osdmap.epoch))
+
+    def _object_exists(self, state: PGState, oid: hobject_t) -> bool:
+        be = state.backend
+        if state.kind == "ec":
+            return be.exists(oid)
+        return be.stat(oid) is not None
 
     def _stat_logical(self, state: PGState, oid: hobject_t) -> int | None:
         be = state.backend

@@ -172,23 +172,36 @@ class ECBackend:
 
     # -- object metadata helpers -------------------------------------------
 
-    def _get_hinfo(self, oid: hobject_t) -> HashInfo:
-        """hinfo is replicated on every shard; take the first live one."""
+    def _fetch_hinfo(self, oid: hobject_t) -> HashInfo | None:
+        """hinfo is replicated on every shard; first live one, None if
+        the object doesn't exist anywhere."""
         for s in range(self.n):
             h = self.shards.get_hinfo(s, oid)
             if h is not None:
                 return h
-        return HashInfo.make(self.n)
+        return None
+
+    def _get_hinfo(self, oid: hobject_t) -> HashInfo:
+        return self._fetch_hinfo(oid) or HashInfo.make(self.n)
 
     def _get_size(self, oid: hobject_t) -> int:
-        """Logical size = shard chunk size scaled up (objects are padded
-        to stripe bounds on write; all shards share the size)."""
+        """True (unpadded) object size from the hinfo xattr; falls back
+        to the stripe-derived size for objects without one."""
+        h = self._fetch_hinfo(oid)
+        if h is not None:
+            return h.logical_size
         for s in range(self.n):
             chunk = self.shards.stat(s, oid)
             if chunk is not None:
                 return self.sinfo.aligned_chunk_offset_to_logical_offset(
                     chunk)
         return 0
+
+    def exists(self, oid: hobject_t) -> bool:
+        if self._fetch_hinfo(oid) is not None:
+            return True
+        return any(self.shards.stat(s, oid) is not None
+                   for s in range(self.n))
 
     # -- entry (reference submit_transaction :1483 / start_rmw :1839) ------
 
@@ -212,8 +225,34 @@ class ECBackend:
     def _try_state_to_reads(self) -> None:
         while self.waiting_state:
             op = self.waiting_state[0]
+            # One hinfo fetch sweep per object: the plan needs both the
+            # hinfo and the size, and size is derived from hinfo when it
+            # exists (over the messenger each shard fetch is a blocking
+            # RPC, so the sweep count matters).
+            cache: dict = {}
+
+            def fetch(oid):
+                if oid not in cache:
+                    cache[oid] = self._fetch_hinfo(oid)
+                return cache[oid]
+
+            def get_hinfo(oid):
+                return fetch(oid) or HashInfo.make(self.n)
+
+            def get_size(oid):
+                h = fetch(oid)
+                if h is not None:
+                    return h.logical_size
+                for s in range(self.n):
+                    chunk = self.shards.stat(s, oid)
+                    if chunk is not None:
+                        return (self.sinfo
+                                .aligned_chunk_offset_to_logical_offset(
+                                    chunk))
+                return 0
+
             op.plan = ect.get_write_plan(
-                self.sinfo, op.txn, self._get_hinfo, self._get_size)
+                self.sinfo, op.txn, get_hinfo, get_size)
             self.waiting_state.pop(0)
             op.state = "reading"
             self.waiting_reads.append(op)

@@ -195,6 +195,14 @@ def generate_transactions(
             hinfo.truncate(chunk_size)
             for s in range(n_shards):
                 txns[s].truncate(shard_oid(oid, s), chunk_size)
+        # logical (unpadded) object size, kept in the hinfo xattr
+        # (reference: object_info_t.size)
+        new_logical = hinfo.logical_size
+        for w in op.writes:
+            new_logical = max(new_logical, w.end)
+        if op.truncate_to is not None:
+            new_logical = op.truncate_to
+        hinfo.logical_size = new_logical
         if op.attrs:
             sets = {k: v for k, v in op.attrs.items() if v is not None}
             dels = [k for k, v in op.attrs.items() if v is None]

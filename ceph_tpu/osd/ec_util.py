@@ -77,20 +77,26 @@ HINFO_KEY = "hinfo_key"  # shard xattr name (reference ECUtil.cc get_hinfo_key)
 
 @dataclass
 class HashInfo:
-    """Cumulative per-shard crc32c + total logical shard size.
+    """Cumulative per-shard crc32c + shard/logical sizes.
 
     Invariant: cumulative_shard_hashes[s] is the crc32c (seed -1) of all
     bytes ever appended to shard s, and total_chunk_size their length.
     Append-only, like the reference (EC overwrites bump object
     generations rather than rewriting ranges in place).
+
+    logical_size carries the object's true byte length (the reference
+    keeps this in object_info_t; here it rides the hinfo xattr, which is
+    already replicated on every shard) — without it, reads would return
+    the stripe-padded size.
     """
 
     total_chunk_size: int = 0
     cumulative_shard_hashes: list[int] = field(default_factory=list)
+    logical_size: int = 0
 
     @classmethod
     def make(cls, n_shards: int) -> "HashInfo":
-        return cls(0, [0xFFFFFFFF] * n_shards)
+        return cls(0, [0xFFFFFFFF] * n_shards, 0)
 
     def append(self, old_size: int, shard_chunks: np.ndarray) -> None:
         """Fold one stripe-aligned append into every shard's crc
@@ -123,7 +129,7 @@ class HashInfo:
     def encode(self) -> bytes:
         import struct
         return struct.pack(
-            "<QI", self.total_chunk_size,
+            "<QQI", self.total_chunk_size, self.logical_size,
             len(self.cumulative_shard_hashes)) + b"".join(
             int(h).to_bytes(4, "little")
             for h in self.cumulative_shard_hashes)
@@ -131,10 +137,10 @@ class HashInfo:
     @classmethod
     def decode(cls, raw: bytes) -> "HashInfo":
         import struct
-        size, n = struct.unpack_from("<QI", raw)
-        hashes = [int.from_bytes(raw[12 + 4 * i:16 + 4 * i], "little")
+        size, logical, n = struct.unpack_from("<QQI", raw)
+        hashes = [int.from_bytes(raw[20 + 4 * i:24 + 4 * i], "little")
                   for i in range(n)]
-        return cls(size, hashes)
+        return cls(size, hashes, logical)
 
 
 def encode(sinfo: StripeInfo, ec_impl: ErasureCodeInterface,

@@ -1,0 +1,81 @@
+"""`ceph` CLI: JSON admin-command dispatch to the mon.
+
+Re-expresses the reference's src/ceph.in command surface for the
+commands this build's mon implements:
+
+  python -m ceph_tpu.tools.ceph_cli -m HOST:PORT status
+  python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd tree
+  python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd pool ls
+  python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd pool create NAME \
+      [--type erasure --profile NAME --pg-num N --size N]
+  python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd erasure-code-profile \
+      set NAME k=4 m=2 plugin=jax
+  python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd erasure-code-profile \
+      {get NAME | ls}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def parse_addr(s: str) -> tuple[str, int]:
+    host, port = s.rsplit(":", 1)
+    return host, int(port)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ceph")
+    ap.add_argument("-m", "--mon", required=True)
+    ap.add_argument("--type", default="replicated")
+    ap.add_argument("--profile", default="default")
+    ap.add_argument("--pg-num", type=int, default=8)
+    ap.add_argument("--size", type=int, default=3)
+    ap.add_argument("words", nargs="+")
+    args = ap.parse_args(argv)
+    words = args.words
+
+    from ..osdc import Objecter
+
+    obj = Objecter(parse_addr(args.mon), "ceph-cli")
+    try:
+        obj.start()
+        cmd = None
+        if words == ["status"]:
+            cmd = {"prefix": "status"}
+        elif words == ["osd", "tree"]:
+            cmd = {"prefix": "osd tree"}
+        elif words == ["osd", "pool", "ls"]:
+            cmd = {"prefix": "osd pool ls"}
+        elif words[:3] == ["osd", "pool", "create"] and len(words) == 4:
+            cmd = {"prefix": "osd pool create", "name": words[3],
+                   "type": args.type, "pg_num": args.pg_num,
+                   "size": args.size,
+                   "erasure_code_profile": args.profile}
+        elif words[:3] == ["osd", "erasure-code-profile", "set"] \
+                and len(words) >= 4:
+            name = words[3]
+            prof = dict(w.split("=", 1) for w in words[4:] if "=" in w)
+            cmd = {"prefix": "osd erasure-code-profile set", "name": name,
+                   "profile": prof}
+        elif words[:3] == ["osd", "erasure-code-profile", "get"] \
+                and len(words) >= 4:
+            cmd = {"prefix": "osd erasure-code-profile get",
+                   "name": words[3]}
+        elif words[:3] == ["osd", "erasure-code-profile", "ls"]:
+            cmd = {"prefix": "osd erasure-code-profile ls"}
+        if cmd is None:
+            print(f"ceph: unknown command {' '.join(words)!r}",
+                  file=sys.stderr)
+            return 22
+        result, out = obj.mon_command(cmd)
+        print(json.dumps(out, indent=2, sort_keys=True))
+        return 0 if result == 0 else 1
+    finally:
+        obj.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,77 @@
+"""`rados` CLI: object-level operations + bench.
+
+Re-expresses the reference's src/tools/rados/rados.cc surface (put/get/
+ls-free subset + `rados bench` style throughput run) over the client
+API.  Usage:
+
+  python -m ceph_tpu.tools.rados_cli -m HOST:PORT -p POOL put NAME FILE
+  python -m ceph_tpu.tools.rados_cli -m HOST:PORT -p POOL get NAME FILE
+  python -m ceph_tpu.tools.rados_cli -m HOST:PORT -p POOL rm NAME
+  python -m ceph_tpu.tools.rados_cli -m HOST:PORT -p POOL bench SECONDS write
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+
+def parse_addr(s: str) -> tuple[str, int]:
+    host, port = s.rsplit(":", 1)
+    return host, int(port)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="rados")
+    ap.add_argument("-m", "--mon", required=True, help="mon HOST:PORT")
+    ap.add_argument("-p", "--pool", required=True)
+    ap.add_argument("command", choices=("put", "get", "rm", "bench"))
+    ap.add_argument("args", nargs="*")
+    ap.add_argument("-b", "--block-size", type=int, default=1 << 20)
+    args = ap.parse_args(argv)
+
+    from ..rados import RadosClient
+
+    client = RadosClient(parse_addr(args.mon)).connect()
+    try:
+        io = client.open_ioctx(args.pool)
+        if args.command == "put":
+            name, path = args.args
+            data = sys.stdin.buffer.read() if path == "-" else \
+                open(path, "rb").read()
+            io.write_full(name, data)
+            print(f"wrote {len(data)} bytes to {name}")
+        elif args.command == "get":
+            name, path = args.args
+            data = io.read(name, 0)
+            if path == "-":
+                sys.stdout.buffer.write(data)
+            else:
+                open(path, "wb").write(data)
+                print(f"read {len(data)} bytes from {name}")
+        elif args.command == "rm":
+            io.remove(args.args[0])
+            print(f"removed {args.args[0]}")
+        elif args.command == "bench":
+            seconds = float(args.args[0]) if args.args else 5.0
+            payload = np.random.default_rng(0).integers(
+                0, 256, args.block_size, dtype=np.uint8).tobytes()
+            t0 = time.time()
+            n = 0
+            while time.time() - t0 < seconds:
+                io.write_full(f"bench_{n}", payload)
+                n += 1
+            dt = time.time() - t0
+            mb = n * args.block_size / 1e6
+            print(f"wrote {n} x {args.block_size}B in {dt:.2f}s = "
+                  f"{mb / dt:.1f} MB/s")
+        return 0
+    finally:
+        client.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
