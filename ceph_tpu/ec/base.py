@@ -26,6 +26,9 @@ SIMD_ALIGN = 64  # reference uses 32 (ErasureCode.cc:42); 64 also serves cacheli
 class ErasureCode(ErasureCodeInterface):
     k: int = 0
     m: int = 0
+    # Locality codes (LRC/SHEC) can decode from fewer than k chunks when
+    # the right ones are present; they relax the availability precheck.
+    ALLOW_PARTIAL_DECODE = False
 
     def __init__(self) -> None:
         self.chunk_mapping: list[int] = []
@@ -138,7 +141,8 @@ class ErasureCode(ErasureCodeInterface):
         dense, erasures = self._decode_prepare(chunks, chunk_size)
         if not erasures or not (set(want_to_read) - set(chunks)):
             return {i: dense[i] for i in want_to_read}
-        if self.get_chunk_count() - len(erasures) < self.k:
+        if not self.ALLOW_PARTIAL_DECODE and \
+                self.get_chunk_count() - len(erasures) < self.k:
             raise ErasureCodeError(
                 errno.EIO, f"cannot decode: {len(erasures)} erasures > m={self.m}")
         decoded = self.decode_chunks(dense, erasures)

@@ -1,0 +1,178 @@
+"""LRC plugin: Locally Repairable Code.
+
+Fills the role of reference src/erasure-code/lrc/ErasureCodeLrc.{h,cc}:
+cheap single-failure repair by adding local parities over groups.
+
+Profile (the reference's "low-level" k/m/l form, doc/rados/operations/
+erasure-code-lrc.rst): k data chunks, m global RS parities, and one
+local XOR parity per group of l chunks taken over the ordered sequence
+[data..., global parities...] — so k=8 m=4 l=4 yields 3 groups and 15
+chunks total, and a single lost chunk rebuilds from its group's l
+surviving members instead of k.
+
+The layered-grammar form of the reference (layers= / mapping= JSON with
+recursive plugin composition) is intentionally not replicated; the k/m/l
+form covers the placement/repair capability the grammar exists to
+describe.  minimum_to_decode prefers the local group for single
+erasures — the property LRC exists for.
+"""
+
+from __future__ import annotations
+
+import errno
+
+import numpy as np
+
+from .. import gf
+from ..base import ErasureCode
+from ..interface import ErasureCodeError, Profile
+from ..registry import ErasureCodePlugin, ErasureCodePluginRegistry
+
+__erasure_code_version__ = ErasureCodePlugin.abi_version
+
+
+class ErasureCodeLrc(ErasureCode):
+    ALLOW_PARTIAL_DECODE = True
+
+    def __init__(self):
+        super().__init__()
+        self.l = 0
+        self.n_local = 0
+        self.global_matrix: np.ndarray | None = None
+        self.groups: list[list[int]] = []  # member chunk ids per group
+
+    def init(self, profile: Profile) -> None:
+        self.k = profile.to_int("k", 4)
+        m = profile.to_int("m", 2)
+        self.l = profile.to_int("l", 3)
+        if self.k < 1 or m < 1 or self.l < 2:
+            raise ErasureCodeError(errno.EINVAL,
+                                   f"bad k={self.k} m={m} l={self.l}")
+        if (self.k + m) % self.l:
+            raise ErasureCodeError(
+                errno.EINVAL,
+                f"k+m={self.k + m} must be divisible by l={self.l}")
+        self._m_global = m
+        self.n_local = (self.k + m) // self.l
+        self.m = m + self.n_local  # interface m = all parity chunks
+        self.global_matrix = gf.cauchy_rs_matrix(self.k, m)
+        # groups over the ordered [data, global parity] sequence; the
+        # local parity chunk of group g sits at index k + m + g
+        self.groups = []
+        for g in range(self.n_local):
+            members = list(range(g * self.l, (g + 1) * self.l))
+            self.groups.append(members)
+        super().init(profile)
+
+    # -- geometry -----------------------------------------------------------
+
+    def group_of(self, chunk: int) -> list[int] | None:
+        """Group members + local parity for a data/global chunk id."""
+        km = self.k + self._m_global
+        if chunk < km:
+            g = chunk // self.l
+            return self.groups[g] + [km + g]
+        if chunk < self.get_chunk_count():
+            g = chunk - km
+            return self.groups[g] + [km + g]
+        return None
+
+    # -- codec --------------------------------------------------------------
+
+    def encode_chunks(self, chunks: np.ndarray) -> np.ndarray:
+        glob = gf.gf_matvec(self.global_matrix[self.k:], chunks)
+        seq = np.concatenate([chunks, glob], axis=0)
+        locals_ = np.stack([
+            np.bitwise_xor.reduce(seq[members], axis=0)
+            for members in self.groups])
+        return np.concatenate([glob, locals_], axis=0)
+
+    def minimum_to_decode(self, want_to_read, available):
+        want = set(want_to_read)
+        avail = set(available)
+        missing = want - avail
+        if not missing:
+            return {i: [(0, 1)] for i in want}
+        if len(missing) == 1:
+            # local repair: the group of the missing chunk
+            mchunk = next(iter(missing))
+            grp = self.group_of(mchunk)
+            if grp is not None:
+                helpers = [c for c in grp if c != mchunk]
+                if all(h in avail for h in helpers):
+                    out = {h: [(0, 1)] for h in helpers}
+                    for w in want & avail:
+                        out[w] = [(0, 1)]
+                    return out
+        # global: any k of the data+global chunks
+        km = self.k + self._m_global
+        usable = sorted(a for a in avail if a < km)
+        if len(usable) < self.k:
+            raise ErasureCodeError(
+                errno.EIO, f"LRC cannot decode: {sorted(avail)}")
+        out = {c: [(0, 1)] for c in usable[: self.k]}
+        for w in want & avail:
+            out[w] = [(0, 1)]
+        return out
+
+    def decode_chunks(self, dense: np.ndarray, erasures) -> np.ndarray:
+        out = dense.copy()
+        erased = set(erasures)
+        km = self.k + self._m_global
+        # pass 1: local XOR repairs while possible
+        progress = True
+        while progress and erased:
+            progress = False
+            for e in sorted(erased):
+                grp = self.group_of(e)
+                if grp is None:
+                    continue
+                helpers = [c for c in grp if c != e]
+                if all(h not in erased for h in helpers):
+                    out[e] = np.bitwise_xor.reduce(out[helpers], axis=0)
+                    erased.discard(e)
+                    progress = True
+        self._unsolved = set()
+        if not erased:
+            return out
+        # pass 2: global RS over data+global parities
+        survivors = [i for i in range(km) if i not in erased][: self.k]
+        if len(survivors) < self.k:
+            # partial helper set: whatever pass 1 recovered is all we
+            # can do; decode() errors if a wanted chunk is still missing
+            self._unsolved = set(erased)
+            return out
+        inv = gf.gf_invert_matrix(self.global_matrix[survivors, :])
+        need_data = [e for e in erased if e < self.k]
+        if need_data:
+            rows = np.stack([inv[e] for e in need_data])
+            rec = gf.gf_matvec(rows, out[survivors])
+            for idx, e in enumerate(need_data):
+                out[e] = rec[idx]
+            erased -= set(need_data)
+        # re-derive any remaining parity chunks from complete data
+        if erased:
+            glob = gf.gf_matvec(self.global_matrix[self.k:], out[: self.k])
+            out[self.k:km] = glob
+            seq = out[:km]
+            for g, members in enumerate(self.groups):
+                out[km + g] = np.bitwise_xor.reduce(seq[members], axis=0)
+        return out
+
+    def decode(self, want_to_read, chunks, chunk_size):
+        out = super().decode(want_to_read, chunks, chunk_size)
+        bad = set(want_to_read) & getattr(self, "_unsolved", set())
+        if bad:
+            raise ErasureCodeError(
+                errno.EIO,
+                f"LRC: chunks {sorted(bad)} unrecoverable from provided set")
+        return out
+
+
+class ErasureCodePluginLrc(ErasureCodePlugin):
+    def factory(self, profile: Profile):
+        return ErasureCodeLrc()
+
+
+def __erasure_code_init__(name: str, directory: str | None) -> None:
+    ErasureCodePluginRegistry.instance().add(name, ErasureCodePluginLrc())

@@ -1,0 +1,135 @@
+"""LRC + SHEC plugin tests (reference TestErasureCodeLrc.cc /
+TestErasureCodeShec_all.cc roles)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import ErasureCodeError, ErasureCodePluginRegistry
+
+REG = ErasureCodePluginRegistry.instance()
+
+
+def make(plugin, **profile):
+    return REG.factory(plugin, {k: str(v) for k, v in profile.items()})
+
+
+# -- LRC ---------------------------------------------------------------------
+
+def test_lrc_chunk_count():
+    codec = make("lrc", k=8, m=4, l=4)
+    # 8 data + 4 global + 3 local = 15 (doc erasure-code-lrc.rst example)
+    assert codec.get_chunk_count() == 15
+    assert codec.get_data_chunk_count() == 8
+
+
+def test_lrc_single_failure_uses_local_group():
+    codec = make("lrc", k=8, m=4, l=4)
+    n = codec.get_chunk_count()
+    # lose data chunk 1: group 0 = chunks 0..3 + local parity 12
+    got = codec.minimum_to_decode({1}, set(range(n)) - {1})
+    assert set(got) == {0, 2, 3, 12}
+    assert len(got) < 8  # cheaper than k
+
+
+def test_lrc_roundtrip_all_single_and_double():
+    codec = make("lrc", k=4, m=2, l=3)
+    n = codec.get_chunk_count()   # 4 + 2 + 2 = 8
+    rng = np.random.default_rng(0)
+    payload = rng.integers(0, 256, 4 * 300, dtype=np.uint8).tobytes()
+    enc = codec.encode(set(range(n)), payload)
+    cs = len(enc[0])
+    for nerase in (1, 2):
+        for erased in itertools.combinations(range(n), nerase):
+            avail = {i: enc[i] for i in range(n) if i not in erased}
+            try:
+                dec = codec.decode(set(range(n)), avail, cs)
+            except ErasureCodeError:
+                continue  # some double patterns exceed LRC tolerance
+            for i in range(n):
+                np.testing.assert_array_equal(
+                    dec[i], enc[i], err_msg=f"chunk {i} erased={erased}")
+
+
+def test_lrc_bad_profile():
+    with pytest.raises(ErasureCodeError):
+        make("lrc", k=5, m=2, l=3)  # 7 % 3 != 0
+
+
+# -- SHEC --------------------------------------------------------------------
+
+def test_shec_all_patterns_up_to_c():
+    codec = make("shec", k=4, m=3, c=2)
+    n = codec.get_chunk_count()
+    rng = np.random.default_rng(1)
+    payload = rng.integers(0, 256, 4 * 257, dtype=np.uint8).tobytes()
+    enc = codec.encode(set(range(n)), payload)
+    cs = len(enc[0])
+    for r in (1, 2):
+        for erased in itertools.combinations(range(n), r):
+            avail = {i: enc[i] for i in range(n) if i not in erased}
+            dec = codec.decode(set(range(n)), avail, cs)
+            for i in range(n):
+                np.testing.assert_array_equal(
+                    dec[i], enc[i], err_msg=f"erased={erased}")
+
+
+def test_shec_recovery_efficiency():
+    """Single-failure repair must read fewer chunks than k when windows
+    allow (the property SHEC exists for)."""
+    codec = make("shec", k=8, m=4, c=3)
+    n = codec.get_chunk_count()
+    smaller = 0
+    for e in range(codec.k):
+        got = codec.minimum_to_decode({e}, set(range(n)) - {e})
+        if len(got) < codec.k:
+            smaller += 1
+    assert smaller >= codec.k // 2, f"only {smaller} local repairs"
+
+
+def test_shec_k8_m4_c3_roundtrip_sampled():
+    codec = make("shec", k=8, m=4, c=3)
+    n = codec.get_chunk_count()
+    rng = np.random.default_rng(2)
+    payload = rng.integers(0, 256, 8 * 128, dtype=np.uint8).tobytes()
+    enc = codec.encode(set(range(n)), payload)
+    cs = len(enc[0])
+    combos = list(itertools.combinations(range(n), 3))
+    idx = rng.choice(len(combos), 40, replace=False)
+    for i in idx:
+        erased = combos[i]
+        avail = {j: enc[j] for j in range(n) if j not in erased}
+        dec = codec.decode(set(range(n)), avail, cs)
+        for j in range(n):
+            np.testing.assert_array_equal(dec[j], enc[j],
+                                          err_msg=f"erased={erased}")
+
+
+def test_shec_minimum_to_decode_is_sufficient():
+    """Whatever minimum_to_decode returns must actually decode."""
+    codec = make("shec", k=6, m=3, c=2)
+    n = codec.get_chunk_count()
+    rng = np.random.default_rng(3)
+    payload = rng.integers(0, 256, 6 * 100, dtype=np.uint8).tobytes()
+    enc = codec.encode(set(range(n)), payload)
+    cs = len(enc[0])
+    for e in range(n):
+        need = codec.minimum_to_decode({e}, set(range(n)) - {e})
+        avail = {i: enc[i] for i in need}
+        dec = codec.decode({e}, avail, cs)
+        np.testing.assert_array_equal(dec[e], enc[e])
+
+
+def test_lrc_minimum_to_decode_is_sufficient():
+    codec = make("lrc", k=8, m=4, l=4)
+    n = codec.get_chunk_count()
+    rng = np.random.default_rng(4)
+    payload = rng.integers(0, 256, 8 * 64, dtype=np.uint8).tobytes()
+    enc = codec.encode(set(range(n)), payload)
+    cs = len(enc[0])
+    for e in range(n):
+        need = codec.minimum_to_decode({e}, set(range(n)) - {e})
+        avail = {i: enc[i] for i in need}
+        dec = codec.decode({e}, avail, cs)
+        np.testing.assert_array_equal(dec[e], enc[e])
