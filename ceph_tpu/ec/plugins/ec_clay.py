@@ -1,0 +1,345 @@
+"""CLAY plugin: Coupled-LAYer MSR regenerating code.
+
+Fills the role of reference src/erasure-code/clay/ErasureCodeClay.{h,cc}
+(profile k, m, d): an MDS code with *sub-chunked* chunks whose
+single-failure repair reads only a fraction 1/q of each helper chunk —
+the reason ErasureCodeInterface carries sub-chunk (offset, count) lists
+in minimum_to_decode (reference ErasureCodeInterface.h:297,
+ErasureCodeClay.h:57 get_sub_chunk_count).
+
+Construction (Clay codes, FAST'18 — the same family the reference
+implements): nodes are points (x, y) on a q x t grid (q = d-k+1,
+t = (k+m)/q, chunk i -> x=i%q, y=i//q); every chunk splits into q^t
+sub-chunks indexed by planes z = (z_0..z_{t-1}), z_y in [0,q).  An
+uncoupled symbol U(x,y;z) per node per plane forms, within each plane,
+a codeword of a scalar (n,k) MDS code; the stored (coupled) symbols C
+relate to U by a pairwise invertible transform: vertex (x,y) in plane z
+with x != z_y pairs with vertex (z_y, y) in plane z(y->x), and
+
+    [ C_A@z ; C_B@z' ] = [[1, g], [g, 1]] [ U_A@z ; U_B@z' ]   (g^2 != 1)
+
+while hole-aligned vertices (x == z_y) have C = U.
+
+decode_layered processes planes in increasing order of "intersection
+score" (count of erased hole-aligned vertices): by induction every
+intact vertex can be decoupled using symbols from lower-score planes,
+each plane's <= m unknown U's solve via the MDS parity-check system, and
+the erased C's re-couple.  Encode IS decode with the parity chunks as
+the erasures (exactly the reference's approach).
+
+Repair: losing one chunk (x0,y0) with d = n-1 helpers reads only the
+q^{t-1} "repair planes" {z : z_{y0} = x0} from each helper; per plane
+the q unknowns (failed U + the y0-column helpers' U) solve in one m x m
+system, and the coupling relation reproduces the failed chunk's
+sub-chunks on the remaining planes.  Scope: d = k+m-1 (the reference's
+recommended/default d, e.g. k=8 m=4 d=11); smaller d falls back to
+full-chunk reads.
+"""
+
+from __future__ import annotations
+
+import errno
+import itertools
+
+import numpy as np
+
+from .. import gf
+from ..base import ErasureCode
+from ..interface import ErasureCodeError, Profile
+from ..registry import ErasureCodePlugin, ErasureCodePluginRegistry
+
+__erasure_code_version__ = ErasureCodePlugin.abi_version
+
+GAMMA = 2  # coupling constant; needs gamma^2 != 1 in GF(2^8)
+
+
+class ErasureCodeClay(ErasureCode):
+    def __init__(self):
+        super().__init__()
+        self.d = 0
+        self.q = 0
+        self.t = 0
+        self.sub_chunks = 0
+        self.H: np.ndarray | None = None  # (m, n) parity check of base MDS
+
+    # -- setup --------------------------------------------------------------
+
+    def init(self, profile: Profile) -> None:
+        self.k = profile.to_int("k", 4)
+        self.m = profile.to_int("m", 2)
+        self.d = profile.to_int("d", self.k + self.m - 1)
+        n = self.k + self.m
+        if self.d != n - 1:
+            raise ErasureCodeError(
+                errno.EINVAL,
+                f"clay: only d=k+m-1 supported (got d={self.d}, k+m-1={n - 1})")
+        self.q = self.d - self.k + 1
+        if n % self.q:
+            raise ErasureCodeError(
+                errno.EINVAL, f"clay: q={self.q} must divide k+m={n}")
+        self.t = n // self.q
+        self.sub_chunks = self.q ** self.t
+        base = gf.cauchy_rs_matrix(self.k, self.m)
+        p = base[self.k:]                      # (m, k)
+        self.H = np.concatenate([p, np.eye(self.m, dtype=np.uint8)], axis=1)
+        det = 1 ^ gf.gf_mul(GAMMA, GAMMA)
+        self._cinv = gf.gf_inv(det)
+        super().init(profile)
+
+    def get_sub_chunk_count(self) -> int:
+        return self.sub_chunks
+
+    def get_alignment(self) -> int:
+        # chunk must split into q^t sub-chunks
+        return 64 * self.sub_chunks // np.gcd(64, self.sub_chunks) \
+            if self.sub_chunks % 64 else self.sub_chunks
+
+    def get_chunk_size(self, stripe_width: int) -> int:
+        per = (stripe_width + self.k - 1) // self.k
+        align = self.sub_chunks
+        return -(-per // align) * align
+
+    # -- geometry -----------------------------------------------------------
+
+    def _node(self, chunk: int) -> tuple[int, int]:
+        return chunk % self.q, chunk // self.q
+
+    def _chunk(self, x: int, y: int) -> int:
+        return y * self.q + x
+
+    def _planes(self):
+        return itertools.product(range(self.q), repeat=self.t)
+
+    def _z_index(self, z: tuple[int, ...]) -> int:
+        idx = 0
+        for zy in z:
+            idx = idx * self.q + zy
+        return idx
+
+    def _score(self, z: tuple[int, ...], erased_nodes: set) -> int:
+        return sum(1 for (x, y) in erased_nodes if z[y] == x)
+
+    # -- pair transform -----------------------------------------------------
+
+    def _decouple(self, c_a, c_b):
+        """U_A = cinv * (C_A + g*C_B) for a pair (A@z, B@z')."""
+        lut = gf.mul_table()
+        return lut[self._cinv][c_a ^ lut[GAMMA][c_b]]
+
+    # -- the layered decoder ------------------------------------------------
+
+    def _solve_plane(self, u_known: dict, unknown_nodes: list,
+                     shape) -> dict:
+        """Solve H u = 0 for the unknown nodes of one plane."""
+        n = self.k + self.m
+        cols = [self._chunk(x, y) for (x, y) in unknown_nodes]
+        a = self.H[:, cols]                          # (m, u)
+        rhs = np.zeros((self.m, *shape), dtype=np.uint8)
+        lut = gf.mul_table()
+        for r in range(self.m):
+            for j in range(n):
+                if j in cols:
+                    continue
+                h = int(self.H[r, j])
+                if h:
+                    rhs[r] ^= lut[h][u_known[j]]
+        from .ec_shec import ErasureCodeShec
+        sol = ErasureCodeShec._gf_solve(
+            a.astype(np.uint8), rhs.reshape(self.m, -1))
+        if sol is None:
+            raise ErasureCodeError(errno.EIO, "clay: plane unsolvable")
+        sol = sol.reshape(len(cols), *shape)
+        return {cols[i]: sol[i] for i in range(len(cols))}
+
+    def decode_layered(self, C: np.ndarray, erased: list[int]) -> np.ndarray:
+        """C: (n, sub_chunks, S); rows in `erased` are garbage on input,
+        reconstructed on output."""
+        n = self.k + self.m
+        S = C.shape[2]
+        erased_nodes = {self._node(e) for e in erased}
+        if len(erased) > self.m:
+            raise ErasureCodeError(errno.EIO, "clay: too many erasures")
+        out = C.copy()
+        U = np.zeros_like(out)
+        lut = gf.mul_table()
+        erased_set = set(erased)
+        planes = sorted(self._planes(),
+                        key=lambda z: (self._score(z, erased_nodes), z))
+        # pass A: compute U everywhere, planes in score order.  Intact
+        # vertex with erased partner: partner plane has score-1 (the
+        # erased partner is hole-aligned here but not there), so its U is
+        # already solved — use C_A = U_A + g U_B directly and skip the
+        # partner's C entirely.
+        for z in planes:
+            zi = self._z_index(z)
+            u_known: dict[int, np.ndarray] = {}
+            for ch in range(n):
+                x, y = self._node(ch)
+                if ch in erased_set:
+                    continue
+                if z[y] == x:
+                    U[ch, zi] = out[ch, zi]
+                else:
+                    bch = self._chunk(z[y], y)
+                    z2 = list(z)
+                    z2[y] = x
+                    z2i = self._z_index(tuple(z2))
+                    if bch in erased_set:
+                        U[ch, zi] = out[ch, zi] ^ lut[GAMMA][U[bch, z2i]]
+                    else:
+                        U[ch, zi] = self._decouple(out[ch, zi],
+                                                   out[bch, z2i])
+                u_known[ch] = U[ch, zi]
+            if erased:
+                sol = self._solve_plane(u_known,
+                                        [self._node(e) for e in erased],
+                                        (S,))
+                for ch, val in sol.items():
+                    U[ch, zi] = val
+        # pass B: re-couple every erased vertex from the complete U field
+        for z in self._planes():
+            zi = self._z_index(z)
+            for e in erased:
+                x, y = self._node(e)
+                if z[y] == x:
+                    out[e, zi] = U[e, zi]
+                else:
+                    bch = self._chunk(z[y], y)
+                    z2 = list(z)
+                    z2[y] = x
+                    z2i = self._z_index(tuple(z2))
+                    out[e, zi] = U[e, zi] ^ lut[GAMMA][U[bch, z2i]]
+        return out
+
+    # -- codec interface ----------------------------------------------------
+
+    def _to_planes(self, chunks: np.ndarray) -> np.ndarray:
+        n_rows, cs = chunks.shape
+        assert cs % self.sub_chunks == 0, (cs, self.sub_chunks)
+        return chunks.reshape(n_rows, self.sub_chunks, cs // self.sub_chunks)
+
+    def encode_chunks(self, chunks: np.ndarray) -> np.ndarray:
+        n = self.k + self.m
+        cs = chunks.shape[1]
+        C = np.zeros((n, self.sub_chunks, cs // self.sub_chunks),
+                     dtype=np.uint8)
+        C[: self.k] = self._to_planes(chunks)
+        C = self.decode_layered(C, list(range(self.k, n)))
+        return C[self.k:].reshape(self.m, cs)
+
+    def decode_chunks(self, dense: np.ndarray, erasures) -> np.ndarray:
+        cs = dense.shape[1]
+        C = self._to_planes(dense).copy()
+        C = self.decode_layered(C, sorted(set(erasures)))
+        return C.reshape(dense.shape[0], cs)
+
+    # -- repair-optimal reads ----------------------------------------------
+
+    def repair_planes(self, lost_chunk: int) -> list[int]:
+        x0, y0 = self._node(lost_chunk)
+        return sorted(self._z_index(z) for z in self._planes()
+                      if z[y0] == x0)
+
+    def minimum_to_decode(self, want_to_read, available):
+        """Single lost chunk with every other chunk available -> repair
+        planes only (the sub-chunk (offset,count) contract,
+        reference ErasureCodeClay minimum_to_repair)."""
+        want = set(want_to_read)
+        avail = set(available)
+        missing = want - avail
+        n = self.k + self.m
+        if len(missing) == 1 and len(avail) >= n - 1:
+            planes = self.repair_planes(next(iter(missing)))
+            runs = self._runs(planes)
+            return {h: list(runs) for h in sorted(avail)[: self.d]}
+        return super().minimum_to_decode(want, avail)
+
+    @staticmethod
+    def _runs(idxs: list[int]) -> list[tuple[int, int]]:
+        runs = []
+        for i in idxs:
+            if runs and runs[-1][0] + runs[-1][1] == i:
+                runs[-1] = (runs[-1][0], runs[-1][1] + 1)
+            else:
+                runs.append((i, 1))
+        return [tuple(r) for r in runs]
+
+    def repair(self, lost_chunk: int,
+               helper_planes: dict[int, np.ndarray],
+               sub_size: int) -> np.ndarray:
+        """Rebuild `lost_chunk` from d helpers' repair-plane sub-chunks.
+
+        helper_planes: chunk_id -> (len(repair_planes), sub_size) array,
+        rows ordered like repair_planes(lost_chunk).
+        Returns the full (sub_chunks * sub_size,) chunk.
+        """
+        n = self.k + self.m
+        x0, y0 = self._node(lost_chunk)
+        rp = self.repair_planes(lost_chunk)
+        rp_pos = {zi: i for i, zi in enumerate(rp)}
+        if len(helper_planes) < self.d:
+            raise ErasureCodeError(errno.EIO, "clay: need d helpers")
+        lut = gf.mul_table()
+        out = np.zeros((self.sub_chunks, sub_size), dtype=np.uint8)
+        # U values on repair planes, per node
+        planes = [z for z in self._planes() if z[y0] == x0]
+        ua_col_y0: dict[tuple[int, int], np.ndarray] = {}  # (x, zi) -> U_A
+        for z in planes:
+            zi = self._z_index(z)
+            u_known: dict[int, np.ndarray] = {}
+            unknown_nodes = [(x0, y0)]
+            for ch in range(n):
+                x, y = self._node(ch)
+                if ch == lost_chunk:
+                    continue
+                cv = helper_planes[ch][rp_pos[zi]]
+                if y == y0:
+                    # pairs with the lost node at a non-repair plane:
+                    # U unknown, solved below
+                    unknown_nodes.append((x, y))
+                    continue
+                if z[y] == x:
+                    u_known[ch] = cv
+                else:
+                    bx = z[y]
+                    bch = self._chunk(bx, y)
+                    z2 = list(z)
+                    z2[y] = x
+                    z2i = self._z_index(tuple(z2))
+                    c_b = helper_planes[bch][rp_pos[z2i]]
+                    u_known[ch] = self._decouple(cv, c_b)
+            sol = self._solve_plane(u_known, unknown_nodes, (sub_size,))
+            out[zi] = sol[lost_chunk]               # hole-aligned: C = U
+            for x in range(self.q):
+                if x == x0:
+                    continue
+                ch = self._chunk(x, y0)
+                ua_col_y0[(x, zi)] = sol[ch]
+        # non-repair planes of the lost chunk via the coupling relation:
+        # lost node B at z' pairs with A=(x,y0) at z = z'(y0->x0), z in rp
+        ginv = gf.gf_inv(GAMMA)
+        for z in planes:
+            zi = self._z_index(z)
+            for x in range(self.q):
+                if x == x0:
+                    continue
+                ch = self._chunk(x, y0)
+                zprime = list(z)
+                zprime[y0] = x
+                zpi = self._z_index(tuple(zprime))
+                u_a = ua_col_y0[(x, zi)]
+                c_a = helper_planes[ch][rp_pos[zi]]
+                # C_A@z = U_A + g U_B  ->  U_B = (C_A + U_A)/g
+                u_b = lut[ginv][c_a ^ u_a]
+                # C_B@z' = g U_A + U_B
+                out[zpi] = lut[GAMMA][u_a] ^ u_b
+        return out.reshape(-1)
+
+
+class ErasureCodePluginClay(ErasureCodePlugin):
+    def factory(self, profile: Profile):
+        return ErasureCodeClay()
+
+
+def __erasure_code_init__(name: str, directory: str | None) -> None:
+    ErasureCodePluginRegistry.instance().add(name, ErasureCodePluginClay())
