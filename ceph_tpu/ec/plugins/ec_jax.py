@@ -109,6 +109,30 @@ class ErasureCodeJax(ErasureCode):
         par = bs.gf_bitmatmul(self._enc_bitmat, flat, self.m)
         return jnp.transpose(par.reshape(self.m, b, c), (1, 0, 2))
 
+    def encode_chunks_with_crc(self, chunks: np.ndarray,
+                               seeds: list[int] | None = None
+                               ) -> tuple[np.ndarray, list[int]]:
+        """The fused north-star launch: parity AND per-shard crc32c from
+        one kernel call (BASELINE.json; reference analog computes them
+        separately: plugin encode_chunks + HashInfo::append crc loop,
+        src/osd/ECUtil.cc:172).
+
+        Returns (parity (m, N), crcs for all k+m shards seeded `seeds`
+        (default 0xFFFFFFFF each, the HashInfo convention)).
+        """
+        from ...ops import bitsliced as bs
+        from ...ops import crc32c_linear as cl
+        chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
+        parity, tile_ls, tail_bytes, tile = bs.gf_encode_with_crc(
+            self._enc_bitmat, chunks, self.m)
+        n_sh = self.k + self.m
+        if seeds is None:
+            seeds = [0xFFFFFFFF] * n_sh
+        crcs = [cl.fold_tile_crcs(tile_ls[s], tile, seeds[s],
+                                  tail_bytes[s].tobytes())
+                for s in range(n_sh)]
+        return np.asarray(parity), crcs
+
     # -- decode -------------------------------------------------------------
 
     def _decode_plan(self, survivors: tuple[int, ...],

@@ -130,6 +130,114 @@ def gf_bitmatmul_pallas(bitmat: jnp.ndarray, chunks: jnp.ndarray, r: int,
     )(bitmat.astype(jnp.int8), chunks)
 
 
+FUSED_TILE = 2048  # fused parity+crc kernel tile (cmat VMEM footprint)
+
+
+def _gf_crc_kernel(bitmat_ref, cmat_ref, in_ref, par_ref, crc_ref):
+    """Fused: parity tile + per-tile crc32c L-bits for every shard, one
+    launch (the north-star fusion: checksum and parity from the same
+    VMEM-resident bit-planes)."""
+    from . import crc32c_linear as cl
+    r8 = bitmat_ref.shape[0]
+    m = r8 // 8
+    bits = _unpack_bits(in_ref[:])                    # (8k, T)
+    prod = jax.lax.dot_general(
+        bitmat_ref[:], bits,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    ) & 1
+    par_ref[:] = _pack_bits(prod, m)
+    data_crc = cl.tile_crc_bits(bits, cmat_ref[:])            # (k, 32)
+    par_crc = cl.tile_crc_bits(prod.astype(jnp.int8),
+                               cmat_ref[:])                   # (m, 32)
+    crc_ref[:] = jnp.concatenate([data_crc, par_crc],
+                                 axis=0)[None, :, :]
+
+
+@functools.partial(jax.jit, static_argnames=("m", "tile"))
+def gf_encode_with_crc_pallas(bitmat, cmat, chunks, m: int,
+                              tile: int = FUSED_TILE):
+    k, n = chunks.shape
+    assert n % tile == 0, (n, tile)
+    grid = (n // tile,)
+    return pl.pallas_call(
+        _gf_crc_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((8 * m, 8 * k), lambda t: (0, 0)),
+            pl.BlockSpec((8, tile, 32), lambda t: (0, 0, 0)),
+            pl.BlockSpec((k, tile), lambda t: (0, t)),
+        ],
+        out_specs=[
+            pl.BlockSpec((m, tile), lambda t: (0, t)),
+            pl.BlockSpec((1, k + m, 32), lambda t: (t, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, n), jnp.uint8),
+            jax.ShapeDtypeStruct((n // tile, k + m, 32), jnp.int32),
+        ],
+    )(bitmat.astype(jnp.int8), cmat, chunks)
+
+
+@functools.partial(jax.jit, static_argnames=("m", "tile"))
+def gf_encode_with_crc_xla(bitmat, cmat, chunks, m: int,
+                           tile: int = FUSED_TILE):
+    """XLA twin of the fused kernel (CPU tests / fallback)."""
+    from . import crc32c_linear as cl
+    k, n = chunks.shape
+    ntiles = n // tile
+    bits = _unpack_bits(chunks)                       # (8k, N)
+    prod = jax.lax.dot_general(
+        bitmat.astype(jnp.int8), bits,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    ) & 1
+    parity = _pack_bits(prod, m)
+    crcs = []
+    for t in range(ntiles):
+        sl = slice(t * tile, (t + 1) * tile)
+        d = cl.tile_crc_bits(bits[:, sl], cmat)
+        p = cl.tile_crc_bits(prod[:, sl].astype(jnp.int8), cmat)
+        crcs.append(jnp.concatenate([d, p], axis=0))
+    return parity, jnp.stack(crcs)
+
+
+def gf_encode_with_crc(bitmat, chunks, m: int,
+                       force_xla: bool | None = None):
+    """Encode + per-shard crc32c L-values in one fused launch.
+
+    chunks (k, N) uint8.  Returns (parity (m, N) uint8,
+    tile_ls (n_shards, ntiles) uint32, tail bytes per shard start) —
+    callers fold with crc32c_linear.fold_tile_crcs.  N's remainder
+    beyond the tile grid is returned as `tail` for host folding.
+    """
+    from . import crc32c_linear as cl
+    k, n = chunks.shape
+    tile = FUSED_TILE
+    use_xla = force_xla if force_xla is not None \
+        else jax.default_backend() == "cpu"
+    body = (n // tile) * tile
+    cmat = jnp.asarray(cl.crc_tile_matrix(tile))
+    if body:
+        fn = gf_encode_with_crc_xla if use_xla else gf_encode_with_crc_pallas
+        parity_body, crc_bits = fn(bitmat, cmat, chunks[:, :body], m)
+        crc_bits = np.asarray(crc_bits)               # (ntiles, n_sh, 32)
+        tile_ls = cl.bits_to_u32(crc_bits).T          # (n_sh, ntiles)
+    else:
+        parity_body = jnp.zeros((m, 0), dtype=jnp.uint8)
+        tile_ls = np.zeros((k + m, 0), dtype=np.uint32)
+    tail = chunks[:, body:]
+    if tail.shape[1]:
+        parity_tail = gf_bitmatmul(bitmat, tail, m, force_xla=force_xla)
+        parity = jnp.concatenate([parity_body, parity_tail], axis=1)
+        tail_bytes = np.concatenate(
+            [np.asarray(tail), np.asarray(parity_tail)], axis=0)
+    else:
+        parity = parity_body
+        tail_bytes = np.zeros((k + m, 0), dtype=np.uint8)
+    return parity, tile_ls, tail_bytes, tile
+
+
 def _pick_tile(n: int) -> int:
     tile = min(DEFAULT_TILE, n)
     while n % tile:

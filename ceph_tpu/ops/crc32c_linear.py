@@ -1,0 +1,97 @@
+"""crc32c as GF(2) linear algebra — the fused-checksum half of the
+north star (BASELINE.json: "crc32c for the same shards is fused into the
+stripe kernel so checksum and parity come out of one launch").
+
+Why this works: the crc32c byte-update  crc' = (crc >> 8) ^ T[(crc ^ b)
+& 0xff]  is GF(2)-linear in (crc, b).  Hence for an N-byte block B,
+
+    crc(B, seed) = A_N . seed  (+)  L(B)
+
+where A_N is the 32x32 zero-advance matrix (ceph_tpu.common.crc32c
+crc32c_zeros computes A_N . s) and the *linear part* L(B) = crc(B, 0) is
+a GF(2)-linear map of B's bits: L(B) = C_T @ bits(B) mod 2 for a fixed
+(32, 8T) 0/1 matrix per tile size T.  So the same bit-planes the GF(2^8)
+encode kernel already holds in VMEM feed a second small matmul that
+yields each shard's per-tile L-vector; tiles then fold on the host in
+O(ntiles) 32-bit combines:  L(B1||B2) = A_{|B2|} L(B1) + L(B2).
+
+Matches `bufferlist::crc32c` exactly (Castagnoli, caller seed, no final
+xor) — verified against ceph_tpu.common.crc32c in tests.
+
+Layout note: the encode kernel's bit rows are bit-major interleaved
+(row i*r + s = bit i of shard s), so the tile matrix is exposed as
+C_i^T slices, shape (8, T, 32): L_shard = sum_i bits_i(shard) @ C_i^T.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..common import crc32c as _crc
+
+
+@functools.lru_cache(maxsize=8)
+def crc_tile_matrix(tile: int) -> np.ndarray:
+    """(8, tile, 32) int8: slice [i, t, :] = bits of L(block with only
+    bit i of byte t set)."""
+    out = np.zeros((8, tile, 32), dtype=np.int8)
+    # contribution of byte v at position t in a T-byte block:
+    # A_{T-1-t} . L1(v), with L1(v) = crc of the single byte from state 0
+    l1 = np.zeros((8, 32), dtype=np.int8)
+    for i in range(8):
+        v = _crc.crc32c(bytes([1 << i]), 0)
+        l1[i] = [(v >> j) & 1 for j in range(32)]
+    # walk positions from the last byte backwards, advancing by one byte
+    cur = l1.copy()           # A_0 . L1
+    for t in range(tile - 1, -1, -1):
+        out[:, t, :] = cur
+        if t > 0:
+            for i in range(8):
+                val = sum(int(cur[i, j]) << j for j in range(32))
+                adv = _crc.crc32c_zeros(val, 1)
+                cur[i] = [(adv >> j) & 1 for j in range(32)]
+    return out
+
+
+def bits_to_u32(bits: np.ndarray) -> np.ndarray:
+    """(..., 32) 0/1 -> (...,) uint32, bit j = lsb weight 2^j."""
+    weights = (1 << np.arange(32, dtype=np.uint64))
+    return (bits.astype(np.uint64) @ weights).astype(np.uint32)
+
+
+def fold_tile_crcs(tile_ls: np.ndarray, tile: int, seed: int,
+                   tail: bytes = b"") -> int:
+    """Fold per-tile L-vectors (ntiles, uint32) + optional tail bytes
+    into the final crc with `seed`."""
+    acc = 0
+    for lv in tile_ls:
+        acc = _crc.crc32c_zeros(acc, tile) ^ int(lv)
+    n_bytes = len(tile_ls) * tile
+    if tail:
+        acc = _crc.crc32c_zeros(acc, len(tail)) ^ _crc.crc32c(tail, 0)
+        n_bytes += len(tail)
+    return _crc.crc32c_zeros(seed & 0xFFFFFFFF, n_bytes) ^ acc
+
+
+# ----------------------------------------------------------------------------
+# device-side tile CRC (jnp; callable inside the Pallas kernel too)
+# ----------------------------------------------------------------------------
+
+def tile_crc_bits(bits, cmat):
+    """bits: (8r, T) int8 bit-major rows; cmat: (8, T, 32) -> (r, 32)
+    int32 0/1 L-bit matrix for each of the r shards of this tile."""
+    import jax
+    import jax.numpy as jnp
+    r8, t = bits.shape
+    r = r8 // 8
+    b = bits.reshape(8, r, t).astype(jnp.float32)
+    # sum_i (r, T) @ (T, 32); f32 keeps 0/1 sums exact up to 2^24
+    acc = jnp.zeros((r, 32), dtype=jnp.float32)
+    for i in range(8):
+        acc = acc + jax.lax.dot_general(
+            b[i], cmat[i].astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    return acc.astype(jnp.int32) & 1

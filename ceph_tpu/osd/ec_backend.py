@@ -360,6 +360,7 @@ class ECBackend:
                 for e in extents:
                     work.append((op, oid, e, self._assemble_extent(op, oid, e)))
         encoded_by_op: dict[int, dict] = {id(op): {} for op in ready}
+        crcs_by_op: dict[int, dict] = {id(op): {} for op in ready}
         if work:
             k = self.k
             runs = []
@@ -368,8 +369,30 @@ class ECBackend:
                 runs.append(logical.reshape(
                     nstripes, k, self.sinfo.chunk_size)
                     .transpose(1, 0, 2).reshape(k, -1))
-            big = np.concatenate(runs, axis=1) if len(runs) > 1 else runs[0]
-            parity = np.asarray(self.ec_impl.encode_chunks(big))
+            # North-star fused path: a single appending extent gets
+            # parity + cumulative shard crcs from ONE kernel launch,
+            # seeded with the current hinfo state.
+            fused = None
+            if len(work) == 1 and hasattr(self.ec_impl,
+                                          "encode_chunks_with_crc"):
+                op, oid, e, _ = work[0]
+                hinfo = op.plan.hash_infos[oid]
+                chunk_off = self.sinfo.aligned_logical_offset_to_chunk_offset(
+                    e.off)
+                if chunk_off == hinfo.total_chunk_size:
+                    seeds = list(hinfo.cumulative_shard_hashes)
+                    parity, crcs = self.ec_impl.encode_chunks_with_crc(
+                        runs[0], seeds=seeds)
+                    fused = (np.asarray(parity), crcs)
+            if fused is not None:
+                parity, crcs = fused
+                big = runs[0]
+                op, oid, e, _ = work[0]
+                crcs_by_op[id(op)][(oid, e.off)] = crcs
+            else:
+                big = np.concatenate(runs, axis=1) if len(runs) > 1 \
+                    else runs[0]
+                parity = np.asarray(self.ec_impl.encode_chunks(big))
             allshards = np.concatenate([big, parity], axis=0)
             self.batched_launches += 1
             self.batched_extents += len(work)
@@ -381,11 +404,13 @@ class ECBackend:
                 col += width
 
         for op in ready:
-            self._commit_op(op, encoded_by_op[id(op)])
+            self._commit_op(op, encoded_by_op[id(op)],
+                            crcs_by_op[id(op)])
 
-    def _commit_op(self, op: ECOp, encoded: dict) -> None:
+    def _commit_op(self, op: ECOp, encoded: dict,
+                   crcs: dict | None = None) -> None:
         txns, _ = ect.generate_transactions(
-            self.sinfo, self.n, op.plan, op.txn, encoded)
+            self.sinfo, self.n, op.plan, op.txn, encoded, crcs)
         # PG log entries with rollback info (reference log_operation :958)
         for oid, objop in op.txn.ops.items():
             rb = RollbackInfo()

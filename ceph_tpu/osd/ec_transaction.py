@@ -160,14 +160,20 @@ def generate_transactions(
     plan: WritePlan,
     txn: PGTransaction,
     encoded: dict[tuple[hobject_t, int], np.ndarray],
+    encoded_crcs: dict[tuple[hobject_t, int], list[int]] | None = None,
 ) -> tuple[dict[int, Transaction], dict[hobject_t, HashInfo]]:
     """Turn encoded extents + metadata ops into per-shard Transactions.
 
     `encoded` maps (oid, extent.off) -> (k+m, chunk_run) shard bytes —
-    produced by the backend's batched codec launch.  Returns per-shard
-    transactions and the updated HashInfos (written as hinfo xattrs on
-    every shard, reference ECTransaction.cc:25-60 encode_and_write).
+    produced by the backend's batched codec launch.  `encoded_crcs`
+    optionally carries cumulative shard crcs the fused TPU kernel
+    already produced for an extent (seeded with the prior hinfo state);
+    when present for an appending extent the host crc pass is skipped
+    entirely.  Returns per-shard transactions and the updated HashInfos
+    (written as hinfo xattrs on every shard, reference
+    ECTransaction.cc:25-60 encode_and_write).
     """
+    encoded_crcs = encoded_crcs or {}
     txns = {s: Transaction() for s in range(n_shards)}
     new_hinfos: dict[hobject_t, HashInfo] = {}
     for oid, op in txn.ops.items():
@@ -181,7 +187,10 @@ def generate_transactions(
             chunk_off = sinfo.aligned_logical_offset_to_chunk_offset(ext.off)
             chunk_run = shards.shape[1]
             appending = chunk_off == hinfo.total_chunk_size
-            if appending:
+            if appending and (oid, ext.off) in encoded_crcs:
+                hinfo.append_precomputed(chunk_off, chunk_run,
+                                         encoded_crcs[(oid, ext.off)])
+            elif appending:
                 hinfo.append(chunk_off, shards)
             else:
                 # overwrite inside the object: incremental crc no longer

@@ -111,6 +111,17 @@ class HashInfo:
                 shard_chunks[s].tobytes(), self.cumulative_shard_hashes[s])
         self.total_chunk_size += added
 
+    def append_precomputed(self, old_size: int, added: int,
+                           new_hashes: list[int]) -> None:
+        """Fold an append whose cumulative crcs were already produced —
+        by the fused TPU kernel seeded with the current hashes (the
+        north-star single-launch path)."""
+        assert old_size == self.total_chunk_size
+        assert len(new_hashes) == len(self.cumulative_shard_hashes)
+        self.cumulative_shard_hashes = [int(h) & 0xFFFFFFFF
+                                        for h in new_hashes]
+        self.total_chunk_size += added
+
     def truncate(self, new_size: int) -> None:
         """EC can only roll back appends; a truncate to a smaller size
         invalidates incremental crcs, so reset (reference keeps old

@@ -290,3 +290,28 @@ def test_pg_log_rollback_bounds():
     assert log.head == eversion_t(1, 3)
     with pytest.raises(AssertionError):
         log.rollback_to(eversion_t(1, 2))
+
+
+def test_fused_crc_pipeline_matches_host_crc():
+    """jax-codec pipeline uses the fused parity+crc launch for appends;
+    resulting hinfo must equal the host-computed crc convention."""
+    from ceph_tpu.common import crc32c as C
+    backend, store = make_backend(plugin="jax")
+    o = oid("objfused")
+    rng = np.random.default_rng(7)
+    p1 = rng.integers(0, 256, 512, dtype=np.uint8)
+    txn = PGTransaction()
+    txn.write(o, 0, p1)
+    commit(backend, txn, 1)
+    # second append continues the cumulative crc with fused seeds
+    p2 = rng.integers(0, 256, 256, dtype=np.uint8)
+    t2 = PGTransaction()
+    t2.write(o, 512, p2)
+    commit(backend, t2, 2)
+    hinfo = backend.shards.get_hinfo(0, o)
+    whole = np.concatenate([p1, p2])
+    shards = ec_util.encode(backend.sinfo, backend.ec_impl, whole)
+    for s in range(6):
+        want = C.crc32c(shards[s].tobytes(), 0xFFFFFFFF)
+        assert hinfo.get_chunk_hash(s) == want, f"shard {s}"
+    np.testing.assert_array_equal(backend.read(o, 0, 768), whole)
