@@ -1,0 +1,160 @@
+"""Typed option schema + layered runtime config.
+
+Re-expresses the reference's config system (src/common/options.cc —
+1,602 Option() entries with type/default/min/max/enum/level/flags/
+see_also — and md_config_t, src/common/config.h:55): a single typed
+schema, values layered  compiled defaults < conf file < mon central
+config < env < cli < injectargs,  and observer callbacks fired on
+runtime change.
+
+Only the options this framework actually reads are declared (new ones
+register at import time from the subsystem that owns them — same
+discipline as the reference's per-component option blocks).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import Any, Callable
+
+
+class Level(IntEnum):
+    BASIC = 0
+    ADVANCED = 1
+    DEV = 2
+
+
+@dataclass
+class Option:
+    name: str
+    type: type                   # int, float, str, bool
+    default: Any
+    desc: str = ""
+    level: Level = Level.ADVANCED
+    min: float | None = None
+    max: float | None = None
+    enum_values: tuple | None = None
+    see_also: tuple = ()
+    flags: tuple = ()            # e.g. ("startup",)
+
+    def validate(self, value: Any) -> Any:
+        if self.type is bool and isinstance(value, str):
+            value = value.lower() in ("true", "1", "yes", "on")
+        value = self.type(value)
+        if self.min is not None and value < self.min:
+            raise ValueError(f"{self.name}={value} < min {self.min}")
+        if self.max is not None and value > self.max:
+            raise ValueError(f"{self.name}={value} > max {self.max}")
+        if self.enum_values and value not in self.enum_values:
+            raise ValueError(
+                f"{self.name}={value!r} not in {self.enum_values}")
+        return value
+
+
+SCHEMA: dict[str, Option] = {}
+
+
+def register_options(opts: list[Option]) -> None:
+    for o in opts:
+        SCHEMA[o.name] = o
+
+
+register_options([
+    # EC (reference options.cc:564, :2610-2613)
+    Option("erasure_code_dir", str, "",
+           "directory for out-of-tree EC plugins", Level.ADVANCED,
+           flags=("startup",)),
+    Option("osd_erasure_code_plugins", str, "jerasure isa jax",
+           "EC plugins to preload at daemon start", Level.ADVANCED,
+           flags=("startup",)),
+    Option("osd_pool_default_erasure_code_profile", str,
+           "plugin=jax technique=cauchy k=8 m=3",
+           "default EC profile for new pools"),
+    # messenger
+    Option("ms_dispatch_workers", int, 64,
+           "dispatcher thread pool width", Level.ADVANCED, min=1),
+    Option("ms_crc_data", bool, True, "crc-protect message payloads"),
+    # osd
+    Option("osd_heartbeat_interval", float, 1.0,
+           "seconds between peer pings", min=0.05),
+    Option("osd_heartbeat_grace", float, 4.0,
+           "missed-ping multiplier before reporting failure", min=1.0),
+    Option("osd_pool_default_pg_num", int, 8, "default pg count", min=1),
+    Option("osd_op_queue", str, "wpq", "op scheduler",
+           enum_values=("wpq", "mclock")),
+    Option("osd_max_backfills", int, 1,
+           "concurrent recovery ops per OSD", min=1),
+    Option("osd_scrub_auto", bool, False, "run background scrub"),
+    # tpu data plane
+    Option("tpu_encode_tile", int, 8192,
+           "byte-axis tile of the GF matmul kernel", Level.DEV, min=128),
+    Option("tpu_fused_crc", bool, True,
+           "emit shard crc32c from the encode launch", Level.DEV),
+    Option("tpu_batch_window_ms", float, 0.0,
+           "max time to hold EC ops for cross-transaction batching",
+           Level.DEV, min=0.0),
+])
+
+
+class Config:
+    """Layered md_config_t equivalent with change observers."""
+
+    LAYERS = ("default", "file", "mon", "env", "cli", "override")
+
+    def __init__(self) -> None:
+        self._layers: dict[str, dict[str, Any]] = {
+            layer: {} for layer in self.LAYERS}
+        self._observers: dict[str, list[Callable[[str, Any], None]]] = {}
+        self._lock = threading.RLock()
+        for name, opt in SCHEMA.items():
+            self._layers["default"][name] = opt.default
+        # CEPH_TPU_<OPTION> env overrides (reference env layer)
+        for name in SCHEMA:
+            env = os.environ.get(f"CEPH_TPU_{name.upper()}")
+            if env is not None:
+                self._layers["env"][name] = SCHEMA[name].validate(env)
+
+    def get(self, name: str) -> Any:
+        with self._lock:
+            for layer in reversed(self.LAYERS):
+                if name in self._layers[layer]:
+                    return self._layers[layer][name]
+        raise KeyError(f"unknown option {name}")
+
+    def set(self, name: str, value: Any, layer: str = "override") -> None:
+        opt = SCHEMA.get(name)
+        if opt is None:
+            raise KeyError(f"unknown option {name}")
+        value = opt.validate(value)
+        with self._lock:
+            old = self.get(name)
+            self._layers[layer][name] = value
+            observers = list(self._observers.get(name, []))
+        if value != old:
+            for cb in observers:
+                cb(name, value)
+
+    def add_observer(self, name: str,
+                     cb: Callable[[str, Any], None]) -> None:
+        with self._lock:
+            self._observers.setdefault(name, []).append(cb)
+
+    def show(self) -> dict[str, Any]:
+        with self._lock:
+            return {name: self.get(name) for name in sorted(SCHEMA)}
+
+    def inject_args(self, args: str) -> None:
+        """`injectargs`-style "--opt value --flag" runtime updates."""
+        toks = args.split()
+        i = 0
+        while i < len(toks):
+            name = toks[i].lstrip("-").replace("-", "_")
+            if i + 1 < len(toks) and not toks[i + 1].startswith("--"):
+                self.set(name, toks[i + 1])
+                i += 2
+            else:
+                self.set(name, True)
+                i += 1

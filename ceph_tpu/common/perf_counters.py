@@ -1,0 +1,113 @@
+"""Per-daemon performance counters.
+
+Re-expresses the reference's PerfCounters (src/common/perf_counters.h):
+typed counters built once per component (counter / gauge / time /
+long-running-average), updated lock-free on the hot path (here: plain
+int/float updates under the GIL, with a lock only for dump), dumped via
+the admin socket (`perf dump`) and shipped to the mgr role.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from enum import Enum
+
+
+class CounterType(Enum):
+    U64 = "u64"              # monotonically increasing counter
+    GAUGE = "gauge"          # settable level
+    TIME = "time"            # accumulated seconds
+    AVG = "avg"              # (sum, count) long-running average
+
+
+@dataclass
+class _Counter:
+    name: str
+    type: CounterType
+    desc: str = ""
+    value: float = 0
+    sum: float = 0
+    count: int = 0
+
+
+class PerfCountersBuilder:
+    def __init__(self, name: str):
+        self.name = name
+        self._counters: dict[str, _Counter] = {}
+
+    def add_u64_counter(self, key: str, desc: str = ""):
+        self._counters[key] = _Counter(key, CounterType.U64, desc)
+        return self
+
+    def add_gauge(self, key: str, desc: str = ""):
+        self._counters[key] = _Counter(key, CounterType.GAUGE, desc)
+        return self
+
+    def add_time_avg(self, key: str, desc: str = ""):
+        self._counters[key] = _Counter(key, CounterType.AVG, desc)
+        return self
+
+    def create_perf_counters(self) -> "PerfCounters":
+        return PerfCounters(self.name, self._counters)
+
+
+class PerfCounters:
+    def __init__(self, name: str, counters: dict[str, _Counter]):
+        self.name = name
+        self._c = counters
+        self._lock = threading.Lock()
+
+    def inc(self, key: str, by: float = 1) -> None:
+        self._c[key].value += by
+
+    def set(self, key: str, value: float) -> None:
+        self._c[key].value = value
+
+    def tinc(self, key: str, seconds: float) -> None:
+        c = self._c[key]
+        c.sum += seconds
+        c.count += 1
+
+    def time(self, key: str):
+        """Context manager timing a block into a time-avg counter."""
+        pc = self
+
+        class _T:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                pc.tinc(key, time.perf_counter() - self.t0)
+        return _T()
+
+    def dump(self) -> dict:
+        with self._lock:
+            out = {}
+            for key, c in self._c.items():
+                if c.type == CounterType.AVG:
+                    out[key] = {"avgcount": c.count, "sum": c.sum,
+                                "avgtime": c.sum / c.count if c.count else 0}
+                else:
+                    out[key] = c.value
+            return out
+
+
+class PerfCountersCollection:
+    """All counter sets of one daemon (reference PerfCountersCollection),
+    the object `perf dump` walks."""
+
+    def __init__(self) -> None:
+        self._sets: dict[str, PerfCounters] = {}
+        self._lock = threading.Lock()
+
+    def add(self, pc: PerfCounters) -> PerfCounters:
+        with self._lock:
+            self._sets[pc.name] = pc
+        return pc
+
+    def dump(self) -> dict:
+        with self._lock:
+            return {name: pc.dump() for name, pc in self._sets.items()}

@@ -232,8 +232,38 @@ class OSDDaemon:
     def __init__(self, osd_id: int, mon_addr: tuple[str, int],
                  store: ObjectStore | None = None,
                  addr: tuple[str, int] = ("127.0.0.1", 0),
-                 heartbeat_interval: float = 0.0):
+                 heartbeat_interval: float = 0.0,
+                 asok_path: str | None = None):
+        from ..common.context import CephContext
+        from ..common.perf_counters import PerfCountersBuilder
         self.osd_id = osd_id
+        self.cct = CephContext(f"osd.{osd_id}", asok_path)
+        self.cct.preload_erasure_code()
+        self.perf = self.cct.perf.add(
+            PerfCountersBuilder(f"osd.{osd_id}")
+            .add_u64_counter("op", "client ops received")
+            .add_u64_counter("op_w", "mutating ops")
+            .add_u64_counter("op_r", "read ops")
+            .add_u64_counter("subop_w", "shard sub-writes applied")
+            .add_u64_counter("subop_r", "shard sub-reads served")
+            .add_time_avg("op_latency", "client op latency")
+            .create_perf_counters())
+        if self.cct.asok is not None:
+            self.cct.asok.register_command(
+                "status", lambda cmd: {
+                    "osd": self.osd_id,
+                    "epoch": self.osdmap.epoch,
+                    "num_pgs": len(self.pgs)})
+            self.cct.asok.register_command(
+                "dump_ops_in_flight", lambda cmd: {
+                    "ops": [
+                        {"pg": str(pgid), "state": o.state,
+                         "version": str(o.version)}
+                        for pgid, st in self.pgs.items()
+                        if st.kind == "ec"
+                        for o in (st.backend.waiting_state +
+                                  st.backend.waiting_reads +
+                                  st.backend.waiting_commit)]})
         self.store = store or MemStore()
         self.store.mount()
         self.osdmap = OSDMap()
@@ -274,6 +304,7 @@ class OSDDaemon:
         self._hb_stop.set()
         self.messenger.shutdown()
         self.store.umount()
+        self.cct.shutdown()
 
     def conn_to_osd(self, osd: int):
         info = self.osdmap.osds.get(osd)
@@ -290,10 +321,12 @@ class OSDDaemon:
             elif isinstance(msg, M.MOSDOp):
                 self._handle_client_op(conn, msg)
             elif isinstance(msg, M.MOSDECSubOpWrite):
+                self.perf.inc("subop_w")
                 self.apply_shard_txn(msg.pgid, msg.txn)
                 conn.send_message(M.MOSDECSubOpWriteReply(
                     msg.pgid, msg.tid, msg.pgid.shard))
             elif isinstance(msg, M.MOSDECSubOpRead):
+                self.perf.inc("subop_r")
                 reply = self.stat_shard(msg.pgid, msg.oid, msg.want_attrs) \
                     if msg.length == 0 else \
                     self._read_reply(msg.pgid, msg.oid, msg.off, msg.length)
@@ -418,6 +451,8 @@ class OSDDaemon:
     def _handle_client_op(self, conn, msg: M.MOSDOp) -> None:
         """reference PrimaryLogPG::do_op/do_osd_ops: decode the op
         vector, build a PGTransaction for mutations, execute reads."""
+        self.perf.inc("op")
+        _t0 = time.perf_counter()
         state = self._get_pg(msg.pgid.pgid)
         be = state.backend
         txn = PGTransaction()
@@ -465,11 +500,15 @@ class OSDDaemon:
             else:
                 result = -errno.EOPNOTSUPP
         if result == 0 and txn.ops:
+            self.perf.inc("op_w")
             done = threading.Event()
             version = state.next_version(self.osdmap.epoch)
             be.submit_transaction(txn, version, done.set)
             if not done.wait(30):
                 result = -errno.ETIMEDOUT
+        elif result == 0:
+            self.perf.inc("op_r")
+        self.perf.tinc("op_latency", time.perf_counter() - _t0)
         conn.send_message(M.MOSDOpReply(msg.tid, result, read_payload,
                                         self.osdmap.epoch))
 

@@ -25,17 +25,21 @@ from ..rados import RadosClient
 
 class Cluster:
     def __init__(self, n_osds: int = 6, heartbeat_interval: float = 0.0,
-                 failure_quorum: int = 2):
+                 failure_quorum: int = 2, asok_dir: str | None = None):
         self.mon = Monitor(failure_quorum=failure_quorum)
         self.osds: list[OSDDaemon] = []
         self.n_osds = n_osds
         self.heartbeat_interval = heartbeat_interval
+        self.asok_dir = asok_dir
         self._clients: list[RadosClient] = []
 
     def start(self) -> "Cluster":
         for i in range(self.n_osds):
+            asok = (f"{self.asok_dir}/osd.{i}.asok"
+                    if self.asok_dir else None)
             osd = OSDDaemon(i, self.mon.addr,
-                            heartbeat_interval=self.heartbeat_interval)
+                            heartbeat_interval=self.heartbeat_interval,
+                            asok_path=asok)
             self.osds.append(osd)
         for osd in self.osds:
             osd.boot()
