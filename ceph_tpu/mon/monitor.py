@@ -118,6 +118,21 @@ class Monitor:
             if prefix == "osd pool ls":
                 return 0, {"pools": [p.name
                                      for p in self.osdmap.pools.values()]}
+            if prefix == "osd out":
+                osd_id = int(cmd["id"])
+                with self.lock:
+                    self.osdmap.set_osd_out(osd_id)
+                    self.osdmap.bump_epoch()
+                    self._publish()
+                return 0, {"out": osd_id}
+            if prefix == "osd in":
+                osd_id = int(cmd["id"])
+                with self.lock:
+                    if osd_id in self.osdmap.osds:
+                        self.osdmap.osds[osd_id].in_ = True
+                    self.osdmap.bump_epoch()
+                    self._publish()
+                return 0, {"in": osd_id}
             if prefix == "status":
                 return self._cmd_status()
             if prefix == "osd tree":

@@ -437,3 +437,44 @@ class MMonCommandAck(Message):
     def decode_wire(self, meta, data):
         self.tid, self.result = meta["tid"], meta["result"]
         self.out = meta["out"]
+
+
+# -- PG scan / recovery push (reference MOSDPGScan / MOSDPGPush) -------------
+
+@register_message
+class MPGList(Message):
+    """List objects of a PG shard collection (reference MOSDPGScan role,
+    used by backfill and scrub)."""
+
+    type_id = 112
+
+    def __init__(self, pgid: spg_t = None, tid: int = 0):
+        super().__init__()
+        self.pgid, self.tid = pgid, tid
+
+    def to_meta(self):
+        return {"pgid": spg_to_json(self.pgid), "tid": self.tid}
+
+    def decode_wire(self, meta, data):
+        self.pgid = spg_from_json(meta["pgid"])
+        self.tid = meta["tid"]
+
+
+@register_message
+class MPGListReply(Message):
+    type_id = 113
+
+    def __init__(self, pgid: spg_t = None, tid: int = 0,
+                 oids: list | None = None):
+        super().__init__()
+        self.pgid, self.tid = pgid, tid
+        self.oids = oids or []   # list of hobject json lists
+
+    def to_meta(self):
+        return {"pgid": spg_to_json(self.pgid), "tid": self.tid,
+                "oids": self.oids}
+
+    def decode_wire(self, meta, data):
+        self.pgid = spg_from_json(meta["pgid"])
+        self.tid = meta["tid"]
+        self.oids = meta["oids"]

@@ -254,6 +254,7 @@ class OSDDaemon:
                     "osd": self.osd_id,
                     "epoch": self.osdmap.epoch,
                     "num_pgs": len(self.pgs)})
+            self.cct.asok.register_command("scrub", self._asok_scrub)
             self.cct.asok.register_command(
                 "dump_ops_in_flight", lambda cmd: {
                     "ops": [
@@ -266,6 +267,12 @@ class OSDDaemon:
                                   st.backend.waiting_commit)]})
         self.store = store or MemStore()
         self.store.mount()
+        self._raw_tid = 1 << 32   # raw-RPC tids, disjoint from backends'
+        self.raw_write_waiters: dict = {}
+        self.raw_list_waiters: dict = {}
+        self._recovered_epochs: set[int] = set()
+        self.recovery_enabled = True
+        self.prev_osdmap: OSDMap | None = None
         self.osdmap = OSDMap()
         self.map_event = threading.Event()
         self.pgs: dict[pg_t, PGState] = {}
@@ -336,6 +343,17 @@ class OSDDaemon:
                 self._route_write_reply(msg)
             elif isinstance(msg, M.MOSDECSubOpReadReply):
                 self._route_read_reply(msg)
+            elif isinstance(msg, M.MPGList):
+                try:
+                    oids = [M.hobj_to_json(g.hobj) for g in
+                            self.store.list_objects(self._cid(msg.pgid))]
+                except KeyError:
+                    oids = []
+                conn.send_message(M.MPGListReply(msg.pgid, msg.tid, oids))
+            elif isinstance(msg, M.MPGListReply):
+                waiter = self.raw_list_waiters.pop((msg.pgid, msg.tid), None)
+                if waiter is not None:
+                    waiter(msg)
             elif isinstance(msg, M.MOSDPing):
                 self._handle_ping(conn, msg)
         except Exception as e:  # noqa: BLE001 - daemon must not die
@@ -347,6 +365,7 @@ class OSDDaemon:
 
     def _handle_map(self, msg: M.MMonMap) -> None:
         newmap = OSDMap.from_json(msg.map_json)
+        self.prev_osdmap = self.osdmap if self.osdmap.epoch else None
         self.osdmap = newmap
         # refresh acting sets of cached backends (mini re-peering)
         with self.pg_lock:
@@ -359,6 +378,248 @@ class OSDDaemon:
                 if primary != self.osd_id:
                     self.pgs.pop(pgid, None)  # primary moved away
         self.map_event.set()
+        if self.recovery_enabled and newmap.pools and \
+                newmap.epoch not in self._recovered_epochs:
+            self._recovered_epochs.add(newmap.epoch)
+            threading.Thread(target=self._recover_epoch,
+                             args=(newmap.epoch,), daemon=True,
+                             name=f"osd.{self.osd_id}.recovery").start()
+
+    # -- recovery / backfill (reference PeeringState -> Recovering /
+    #    Backfilling; ECBackend::continue_recovery_op :570) ----------------
+
+    def _recover_epoch(self, epoch: int) -> None:
+        """After a map change, rebuild any shard the new acting set is
+        missing, for every PG this OSD leads.  This is the elastic part
+        of the system: mark an OSD out -> CRUSH picks replacements ->
+        primaries reconstruct the lost shards onto them."""
+        import numpy as np
+        from ..store.object_store import Transaction
+        for pool in list(self.osdmap.pools.values()):
+            for seed in range(pool.pg_num):
+                pgid = pg_t(pool.id, seed)
+                try:
+                    up, acting, _, primary = \
+                        self.osdmap.pg_to_up_acting_osds(pgid)
+                except Exception:  # noqa: BLE001
+                    continue
+                if primary != self.osd_id:
+                    continue
+                if pool.is_erasure():
+                    self._recover_ec_pg(pgid, acting)
+                else:
+                    self._recover_replicated_pg(pgid, acting)
+
+    def _pg_object_names(self, pgid: pg_t, acting, shard_ids) -> set:
+        names: set = set()
+        for s in shard_ids:
+            osd = acting[s] if s < len(acting) else None
+            if osd is None:
+                continue
+            from ..crush.map import CRUSH_ITEM_NONE
+            if osd == CRUSH_ITEM_NONE or not self.osdmap.is_up(osd):
+                continue
+            spg = spg_t(pgid, s if len(shard_ids) > 1 else NO_SHARD)
+            for oj in self._remote_list(osd, spg):
+                names.add(M.hobj_from_json(oj))
+        return names
+
+    def _remote_list(self, osd: int, spg: spg_t,
+                     timeout: float = 10.0) -> list:
+        if osd == self.osd_id:
+            try:
+                return [M.hobj_to_json(g.hobj)
+                        for g in self.store.list_objects(self._cid(spg))]
+            except KeyError:
+                return []
+        with self.pg_lock:
+            self._raw_tid += 1
+            tid = self._raw_tid
+        box: dict = {}
+        ev = threading.Event()
+        self.raw_list_waiters[(spg, tid)] = \
+            lambda m: (box.update(oids=m.oids), ev.set())
+        try:
+            self.conn_to_osd(osd).send_message(M.MPGList(spg, tid))
+        except Exception:  # noqa: BLE001
+            return []
+        ev.wait(timeout)
+        return box.get("oids", [])
+
+    def _push_shard_txn(self, osd: int, spg: spg_t, txn,
+                        timeout: float = 20.0) -> bool:
+        if osd == self.osd_id:
+            self.apply_shard_txn(spg, txn)
+            return True
+        with self.pg_lock:
+            self._raw_tid += 1
+            tid = self._raw_tid
+        ev = threading.Event()
+        self.raw_write_waiters[(spg, tid)] = lambda m: ev.set()
+        self.conn_to_osd(osd).send_message(
+            M.MOSDECSubOpWrite(spg, tid, eversion_t(), txn))
+        return ev.wait(timeout)
+
+    def _remote_read_full(self, osd: int, spg: spg_t, oid: hobject_t,
+                          timeout: float = 3.0):
+        """(data, attrs) of a shard object on a specific OSD, or None.
+        The backfill copy path: a moved shard is fetched from its old
+        holder verbatim instead of being re-decoded."""
+        if osd == self.osd_id:
+            goid = ghobject_t(oid, shard=spg.shard)
+            try:
+                data = self.store.read(self._cid(spg), goid)
+                attrs = self.store.getattrs(self._cid(spg), goid)
+            except KeyError:
+                return None
+            return np.asarray(data), attrs
+        with self.pg_lock:
+            self._raw_tid += 1
+            tid = self._raw_tid
+        box: dict = {}
+        ev = threading.Event()
+        self.raw_read_waiters[(spg, tid)] = \
+            lambda m: (box.update(msg=m), ev.set())
+        try:
+            self.conn_to_osd(osd).send_message(
+                M.MOSDECSubOpRead(spg, tid, oid, 0, 0, want_attrs=True))
+        except Exception:  # noqa: BLE001
+            return None
+        if not ev.wait(timeout):
+            return None
+        stat = box["msg"]
+        if stat.result != 0 or stat.size < 0:
+            return None
+        with self.pg_lock:
+            self._raw_tid += 1
+            tid = self._raw_tid
+        box2: dict = {}
+        ev2 = threading.Event()
+        self.raw_read_waiters[(spg, tid)] = \
+            lambda m: (box2.update(msg=m), ev2.set())
+        self.conn_to_osd(osd).send_message(
+            M.MOSDECSubOpRead(spg, tid, oid, 0, stat.size))
+        if not ev2.wait(timeout) or box2["msg"].result != 0:
+            return None
+        return (np.frombuffer(box2["msg"].data, dtype=np.uint8),
+                stat.attrs)
+
+    def _recover_ec_pg(self, pgid: pg_t, acting: list[int]) -> None:
+        from ..crush.map import CRUSH_ITEM_NONE
+        from ..store.object_store import Transaction
+        state = self._get_pg(pgid)
+        if state.kind != "ec":
+            return
+        be = state.backend
+        prev_acting = None
+        if self.prev_osdmap is not None and \
+                pgid.pool in self.prev_osdmap.pools:
+            try:
+                _, prev_acting, _, _ = \
+                    self.prev_osdmap.pg_to_up_acting_osds(pgid)
+            except Exception:  # noqa: BLE001
+                prev_acting = None
+        # objects may live on old holders only: list those too
+        names = self._pg_object_names(pgid, acting, range(be.n))
+        if prev_acting:
+            for s, osd in enumerate(prev_acting):
+                if osd != CRUSH_ITEM_NONE and self.osdmap.is_up(osd):
+                    for oj in self._remote_list(osd, spg_t(pgid, s)):
+                        names.add(M.hobj_from_json(oj))
+        for oid in names:
+            missing = []
+            for s, osd in enumerate(acting):
+                if osd == CRUSH_ITEM_NONE or not self.osdmap.is_up(osd):
+                    continue
+                if be.shards.stat(s, oid) is None:
+                    missing.append(s)
+            if not missing:
+                continue
+            # 1: backfill-by-copy from the previous holder of each shard
+            still_missing = []
+            for s in missing:
+                copied = False
+                if prev_acting and s < len(prev_acting):
+                    old = prev_acting[s]
+                    if old != CRUSH_ITEM_NONE and old != acting[s] and \
+                            self.osdmap.is_up(old):
+                        got = self._remote_read_full(
+                            old, spg_t(pgid, s), oid)
+                        if got is not None:
+                            data, attrs = got
+                            txn = Transaction()
+                            goid = shard_oid(oid, s)
+                            txn.write(goid, 0, data)
+                            if attrs:
+                                txn.setattrs(goid, attrs)
+                            self._push_shard_txn(
+                                acting[s], spg_t(pgid, s), txn)
+                            copied = True
+                if not copied:
+                    still_missing.append(s)
+            if not still_missing:
+                self.cct.dout("osd", 5,
+                              f"backfilled {oid.name} shards {missing} "
+                              f"of pg {pgid} by copy")
+                continue
+            if len(still_missing) > be.m:
+                self.cct.dout("osd", 1,
+                              f"{oid.name}: {len(still_missing)} shards "
+                              f"unrecoverable in pg {pgid}")
+                continue
+            # 2: reconstruct-from-k via the EC decode path
+            try:
+                hinfo = be._get_hinfo(oid)
+
+                def push(s, data, hinfo=hinfo, oid=oid):
+                    txn = Transaction()
+                    goid = shard_oid(oid, s)
+                    txn.write(goid, 0, data)
+                    from .ec_util import HINFO_KEY
+                    txn.setattr(goid, HINFO_KEY, hinfo.encode())
+                    self._push_shard_txn(acting[s], spg_t(pgid, s), txn)
+
+                be.recover_shard(oid, still_missing, push)
+                self.cct.dout("osd", 5,
+                              f"recovered {oid.name} shards "
+                              f"{still_missing} of pg {pgid} by decode")
+            except Exception as e:  # noqa: BLE001
+                self.cct.dout("osd", 1,
+                              f"recovery of {oid.name} failed: {e!r}")
+
+    def _recover_replicated_pg(self, pgid: pg_t,
+                               acting: list[int]) -> None:
+        from ..store.object_store import Transaction
+        spg = spg_t(pgid, NO_SHARD)
+        names = self._pg_object_names(pgid, acting, [0])
+        # union over all replicas so a primary that lost data also heals
+        for r, osd in enumerate(acting):
+            if osd != self.osd_id and self.osdmap.is_up(osd):
+                for oj in self._remote_list(osd, spg):
+                    names.add(M.hobj_from_json(oj))
+        for oid in names:
+            goid = ghobject_t(oid, shard=NO_SHARD)
+            src = None
+            for osd in acting:
+                if osd == self.osd_id:
+                    try:
+                        self.store.stat(self._cid(spg), goid)
+                        src = self.osd_id
+                        break
+                    except KeyError:
+                        continue
+            if src is None:
+                continue  # remote-source replication is via EC path
+            data = self.store.read(self._cid(spg), goid)
+            attrs = self.store.getattrs(self._cid(spg), goid)
+            for osd in acting:
+                if osd == self.osd_id or not self.osdmap.is_up(osd):
+                    continue
+                txn = Transaction()
+                txn.write(goid, 0, data)
+                if attrs:
+                    txn.setattrs(goid, attrs)
+                self._push_shard_txn(osd, spg, txn)
 
     # -- shard-side ops (any OSD) ------------------------------------------
 
@@ -402,6 +663,10 @@ class OSDDaemon:
                                       attrs, size)
 
     def _route_write_reply(self, msg) -> None:
+        waiter = self.raw_write_waiters.pop((msg.pgid, msg.tid), None)
+        if waiter is not None:
+            waiter(msg)
+            return
         with self.pg_lock:
             state = self.pgs.get(msg.pgid.pgid)
         if state is None:
@@ -525,6 +790,36 @@ class OSDDaemon:
             return size if size > 0 else (
                 None if be.shards.stat(0, oid) is None else size)
         return be.stat(oid)
+
+    # -- scrub (asok-driven; reference `ceph pg scrub`) ---------------------
+
+    def _asok_scrub(self, cmd: dict) -> dict:
+        from . import scrub as scrub_mod
+        deep = bool(cmd.get("deep", True))
+        repair = bool(cmd.get("repair", False))
+        out = {}
+        for pool in list(self.osdmap.pools.values()):
+            if not pool.is_erasure():
+                continue
+            for seed in range(pool.pg_num):
+                pgid = pg_t(pool.id, seed)
+                _, acting, _, primary = \
+                    self.osdmap.pg_to_up_acting_osds(pgid)
+                if primary != self.osd_id:
+                    continue
+                state = self._get_pg(pgid)
+                names = sorted(self._pg_object_names(
+                    pgid, acting, range(state.backend.n)),
+                    key=lambda o: o.name)
+                res = scrub_mod.scrub_pg(state.backend, names, deep=deep,
+                                         repair=repair)
+                out[str(pgid)] = {
+                    "objects": res.objects,
+                    "errors": [[e.oid.name, e.shard, e.kind, e.detail]
+                               for e in res.errors],
+                    "repaired": len(res.repaired),
+                }
+        return out
 
     # -- heartbeats (reference OSD::handle_osd_ping / failure_queue) --------
 

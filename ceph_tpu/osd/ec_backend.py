@@ -495,15 +495,30 @@ class ECBackend:
         each to `push(shard, data, hinfo)` (the caller writes it to the
         new home — locally or over the wire)."""
         hinfo = self._get_hinfo(oid)
-        chunk_len = self.shards.stat(
-            next(s for s in range(self.n) if s not in missing), oid)
-        got: dict[int, np.ndarray] = {}
+        chunk_len = None
         for s in range(self.n):
-            if s in missing or len(got) >= self.k:
+            if s in missing:
                 continue
-            self.shards.sub_read(s, oid, 0, chunk_len,
-                                 lambda sh, d: got.__setitem__(sh, d)
-                                 if d is not None else None)
+            chunk_len = self.shards.stat(s, oid)
+            if chunk_len is not None:
+                break
+        if chunk_len is None:
+            raise ErasureCodeError(5, f"cannot recover {oid}: no survivor")
+        got: dict[int, np.ndarray] = {}
+        done = {"n": 0}
+        ready = threading.Event()
+        targets = [s for s in range(self.n) if s not in missing]
+
+        def on_done(sh, d):
+            if d is not None:
+                got[sh] = d
+            done["n"] += 1
+            if len(got) >= self.k or done["n"] >= len(targets):
+                ready.set()
+
+        for s in targets:
+            self.shards.sub_read(s, oid, 0, chunk_len, on_done)
+        ready.wait(timeout=30)
         if len(got) < self.k:
             raise ErasureCodeError(5, f"cannot recover {oid}: "
                                    f"{len(got)} < k={self.k}")

@@ -1,0 +1,206 @@
+"""Op scheduler: QoS between client / recovery / scrub work.
+
+Re-expresses reference src/osd/scheduler/ (OpScheduler.cc:24
+make_scheduler): a pluggable queue the OSD's worker shards pull from,
+either weighted-priority (WPQ) or an mClock-style
+reservation/weight/limit dequeuer (src/osd/scheduler/mClockScheduler.h,
+src/dmclock submodule).  The mClock here implements the core dmclock
+idea — per-class virtual tags from (reservation, weight, limit) — not
+the full distributed protocol.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+
+@dataclass(order=True)
+class _WPQItem:
+    sort_key: tuple
+    item: Any = field(compare=False)
+
+
+class WeightedPriorityQueue:
+    """Strict-then-weighted priorities (reference WeightedPriorityQueue):
+    strict items first; others dequeued proportionally to priority."""
+
+    def __init__(self):
+        self._strict: list = []
+        self._heap: list[_WPQItem] = []
+        self._counter = itertools.count()
+        self._vclock = 0.0
+
+    def enqueue(self, item, priority: int = 63, strict: bool = False):
+        if strict:
+            self._strict.append((priority, next(self._counter), item))
+            self._strict.sort(key=lambda t: (-t[0], t[1]))
+        else:
+            # virtual finish time ~ 1/priority spacing
+            self._vclock += 1.0
+            key = (self._vclock / max(priority, 1), next(self._counter))
+            heapq.heappush(self._heap, _WPQItem(key, item))
+
+    def dequeue(self):
+        if self._strict:
+            return self._strict.pop(0)[2]
+        if self._heap:
+            return heapq.heappop(self._heap).item
+        return None
+
+    def empty(self) -> bool:
+        return not self._strict and not self._heap
+
+    def __len__(self):
+        return len(self._strict) + len(self._heap)
+
+
+@dataclass
+class ClientProfile:
+    """dmclock (reservation, weight, limit) triple per op class."""
+    reservation: float = 0.0   # ops/sec guaranteed
+    weight: float = 1.0        # proportional share
+    limit: float = 0.0         # ops/sec cap (0 = none)
+
+
+class MClockScheduler:
+    """Single-node dmclock: tag ops with reservation/proportional virtual
+    times, serve reservation-eligible first, then by weight, respecting
+    limits (reference mClockScheduler defaults: client/recovery/scrub
+    classes)."""
+
+    DEFAULT_PROFILES = {
+        "client": ClientProfile(reservation=100.0, weight=2.0),
+        "recovery": ClientProfile(reservation=10.0, weight=1.0,
+                                  limit=500.0),
+        "scrub": ClientProfile(reservation=5.0, weight=0.5, limit=200.0),
+    }
+
+    def __init__(self, profiles: dict[str, ClientProfile] | None = None):
+        self.profiles = dict(profiles or self.DEFAULT_PROFILES)
+        self._queues: dict[str, list] = {c: [] for c in self.profiles}
+        self._r_tags: dict[str, float] = {c: 0.0 for c in self.profiles}
+        self._p_tags: dict[str, float] = {c: 0.0 for c in self.profiles}
+        self._counter = itertools.count()
+
+    def enqueue(self, item, op_class: str = "client", **_):
+        if op_class not in self._queues:
+            self._queues[op_class] = []
+            self.profiles[op_class] = ClientProfile()
+            self._r_tags[op_class] = 0.0
+            self._p_tags[op_class] = 0.0
+        self._queues[op_class].append((next(self._counter), item))
+
+    def dequeue(self):
+        now = time.monotonic()
+        # 1: reservation phase — any class behind its reservation tag
+        best = None
+        for c, q in self._queues.items():
+            if not q:
+                continue
+            prof = self.profiles[c]
+            if prof.reservation > 0 and self._r_tags[c] <= now:
+                if best is None or self._r_tags[c] < self._r_tags[best]:
+                    best = c
+        if best is None:
+            # 2: proportional phase by weight tags (limit-respecting)
+            for c, q in self._queues.items():
+                if not q:
+                    continue
+                prof = self.profiles[c]
+                if prof.limit > 0 and self._p_tags[c] > now:
+                    continue
+                if best is None or \
+                        self._p_tags[c] / max(self.profiles[c].weight, 1e-9) < \
+                        self._p_tags[best] / max(self.profiles[best].weight,
+                                                 1e-9):
+                    best = c
+        if best is None:
+            # 3: work-conserving fallback — nothing reservation-eligible
+            # and every limited class is ahead of its cap; serve the
+            # lowest weighted tag anyway (limits only bind under
+            # contention, as in dmclock)
+            for c, q in self._queues.items():
+                if not q:
+                    continue
+                if best is None or \
+                        self._p_tags[c] / max(self.profiles[c].weight, 1e-9) < \
+                        self._p_tags[best] / max(self.profiles[best].weight,
+                                                 1e-9):
+                    best = c
+        if best is None:
+            return None
+        prof = self.profiles[best]
+        if prof.reservation > 0:
+            self._r_tags[best] = max(self._r_tags[best], now) + \
+                1.0 / prof.reservation
+        rate = prof.limit if prof.limit > 0 else 1000.0
+        self._p_tags[best] = max(self._p_tags[best], now) + 1.0 / rate
+        return self._queues[best].pop(0)[1]
+
+    def empty(self) -> bool:
+        return all(not q for q in self._queues.values())
+
+    def __len__(self):
+        return sum(len(q) for q in self._queues.values())
+
+
+def make_scheduler(kind: str):
+    """reference OpScheduler.cc:24 make_scheduler."""
+    if kind == "mclock":
+        return MClockScheduler()
+    return WeightedPriorityQueue()
+
+
+class ShardedOpWQ:
+    """N worker threads draining a scheduler (reference OSD.h:1568
+    ShardedOpWQ: the thread pool between dispatch and PG work).  Items
+    are thunks; op classes map to scheduler classes."""
+
+    def __init__(self, n_threads: int = 2, kind: str = "wpq"):
+        self.scheduler = make_scheduler(kind)
+        self._cv = threading.Condition()
+        self._stop = False
+        self.threads = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"osd-op-wq-{i}")
+            for i in range(n_threads)]
+        for t in self.threads:
+            t.start()
+
+    def queue(self, fn: Callable[[], None], op_class: str = "client",
+              priority: int = 63) -> None:
+        with self._cv:
+            if isinstance(self.scheduler, MClockScheduler):
+                self.scheduler.enqueue(fn, op_class=op_class)
+            else:
+                self.scheduler.enqueue(
+                    fn, priority=priority,
+                    strict=(op_class == "client" and priority >= 196))
+            self._cv.notify()
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                while self.scheduler.empty() and not self._stop:
+                    self._cv.wait(0.5)
+                if self._stop:
+                    return
+                fn = self.scheduler.dequeue()
+            if fn is not None:
+                try:
+                    fn()
+                except Exception:  # noqa: BLE001
+                    import traceback
+                    traceback.print_exc()
+
+    def drain_and_stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        for t in self.threads:
+            t.join(timeout=2)

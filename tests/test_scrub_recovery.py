@@ -1,0 +1,200 @@
+"""Scrub + elastic recovery + op scheduler tests.
+
+Reference analogs: scrub design (ecbackend.rst "Scrub" + ScrubStore),
+thrash-style recovery (qa/tasks/thrashosds.py kill/out/in during load),
+scheduler (src/osd/scheduler/).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import ErasureCodePluginRegistry
+from ceph_tpu.osd import ec_transaction as ect
+from ceph_tpu.osd import scrub as scrub_mod
+from ceph_tpu.osd.ec_backend import ECBackend, LocalShardBackend
+from ceph_tpu.osd.ec_transaction import PGTransaction
+from ceph_tpu.osd.ec_util import StripeInfo
+from ceph_tpu.osd.types import eversion_t, hobject_t, pg_t
+from ceph_tpu.store import MemStore
+from ceph_tpu.store.object_store import Transaction
+
+REG = ErasureCodePluginRegistry.instance()
+
+
+def make_backend(k=4, m=2, chunk=64):
+    codec = REG.factory("jerasure", {"k": str(k), "m": str(m)})
+    store = MemStore()
+    store.mount()
+    shards = LocalShardBackend(store, pg_t(1, 0), k + m)
+    return ECBackend(codec, StripeInfo(k * chunk, chunk), shards), store
+
+
+def put(backend, name, payload, version=1):
+    txn = PGTransaction()
+    txn.write(hobject_t(pool=1, name=name), 0, payload)
+    done = []
+    backend.submit_transaction(txn, eversion_t(1, version),
+                               lambda: done.append(1))
+    assert done
+
+
+# -- scrub ------------------------------------------------------------------
+
+def test_scrub_clean_pg():
+    backend, _ = make_backend()
+    rng = np.random.default_rng(0)
+    oids = []
+    for i in range(3):
+        put(backend, f"o{i}", rng.integers(0, 256, 512, dtype=np.uint8),
+            version=i + 1)
+        oids.append(hobject_t(pool=1, name=f"o{i}"))
+    res = scrub_mod.scrub_pg(backend, oids, deep=True)
+    assert res.clean and res.objects == 3
+
+
+def test_scrub_detects_bitrot_and_repairs():
+    backend, store = make_backend()
+    rng = np.random.default_rng(1)
+    payload = rng.integers(0, 256, 1024, dtype=np.uint8)
+    put(backend, "victim", payload)
+    o = hobject_t(pool=1, name="victim")
+    # flip bytes in shard 3 without touching hinfo (silent bit rot)
+    cid = backend.shards.cids[3]
+    goid = ect.shard_oid(o, 3)
+    original = store.read(cid, goid).copy()
+    t = Transaction()
+    t.write(goid, 10, np.frombuffer(b"\xde\xad\xbe\xef", dtype=np.uint8))
+    store.queue_transactions(cid, [t])
+    res = scrub_mod.scrub_pg(backend, [o], deep=True)
+    assert not res.clean
+    assert any(e.kind == "crc_mismatch" and e.shard == 3
+               for e in res.errors)
+    # shallow scrub does NOT see it (crc check is deep-only)
+    res_shallow = scrub_mod.scrub_pg(backend, [o], deep=False)
+    assert res_shallow.clean
+    # repair restores the exact bytes
+    res2 = scrub_mod.scrub_pg(backend, [o], deep=True, repair=True)
+    assert res2.clean and res2.repaired
+    np.testing.assert_array_equal(store.read(cid, goid), original)
+
+
+def test_scrub_detects_missing_shard():
+    backend, store = make_backend()
+    put(backend, "x", np.ones(512, dtype=np.uint8))
+    o = hobject_t(pool=1, name="x")
+    cid = backend.shards.cids[1]
+    t = Transaction()
+    t.remove(ect.shard_oid(o, 1))
+    store.queue_transactions(cid, [t])
+    res = scrub_mod.scrub_pg(backend, [o], deep=False)
+    assert any(e.kind == "missing" and e.shard == 1 for e in res.errors)
+    res2 = scrub_mod.scrub_pg(backend, [o], deep=True, repair=True)
+    assert res2.clean
+
+
+# -- scheduler ---------------------------------------------------------------
+
+def test_wpq_strict_first():
+    from ceph_tpu.osd.scheduler import WeightedPriorityQueue
+    q = WeightedPriorityQueue()
+    q.enqueue("low", priority=1)
+    q.enqueue("urgent", priority=255, strict=True)
+    q.enqueue("mid", priority=64)
+    assert q.dequeue() == "urgent"
+    assert len(q) == 2
+
+
+def test_wpq_weighted_share():
+    from ceph_tpu.osd.scheduler import WeightedPriorityQueue
+    q = WeightedPriorityQueue()
+    for i in range(30):
+        q.enqueue(("hi", i), priority=90)
+        q.enqueue(("lo", i), priority=10)
+    first20 = [q.dequeue()[0] for _ in range(20)]
+    assert first20.count("hi") > first20.count("lo")
+
+
+def test_mclock_reservation_and_classes():
+    from ceph_tpu.osd.scheduler import MClockScheduler
+    s = MClockScheduler()
+    for i in range(5):
+        s.enqueue(("client", i), "client")
+        s.enqueue(("recovery", i), "recovery")
+    got = []
+    while not s.empty():
+        got.append(s.dequeue()[0])
+    assert got.count("client") == 5 and got.count("recovery") == 5
+    # client's higher reservation should front-load its ops
+    assert got[:3].count("client") >= 2
+
+
+def test_sharded_op_wq_executes():
+    from ceph_tpu.osd.scheduler import ShardedOpWQ
+    wq = ShardedOpWQ(n_threads=2)
+    done = []
+    import threading
+    ev = threading.Event()
+    for i in range(10):
+        wq.queue(lambda i=i: (done.append(i),
+                              ev.set() if len(done) == 10 else None))
+    assert ev.wait(5)
+    wq.drain_and_stop()
+    assert sorted(done) == list(range(10))
+
+
+# -- elastic recovery (cluster-level) ---------------------------------------
+
+def test_osd_out_triggers_backfill():
+    """Mark an OSD out: CRUSH remaps its shards; primaries must rebuild
+    them on the replacements; reads stay correct throughout."""
+    from ceph_tpu.tools.vstart import Cluster
+    with Cluster(n_osds=7) as c:
+        client = c.client()
+        client.set_ec_profile("p", {"plugin": "jerasure", "k": "3",
+                                    "m": "2"})
+        client.create_pool("ecp", "erasure", erasure_code_profile="p",
+                           pg_num=4)
+        io = client.open_ioctx("ecp")
+        rng = np.random.default_rng(2)
+        blobs = {f"obj{i}": rng.integers(0, 256, 2000 + i,
+                                         dtype=np.uint8).tobytes()
+                 for i in range(6)}
+        for name, data in blobs.items():
+            io.write_full(name, data)
+        # take osd 2 down AND out -> remap + backfill
+        c.kill_osd(2)
+        r, _ = client.mon_command({"prefix": "osd out", "id": 2})
+        assert r == 0
+        c.mark_osd_down(2)
+        # wait for recovery threads to settle
+        deadline = time.time() + 45
+        while time.time() < deadline:
+            time.sleep(0.5)
+            # every live PG mapping should now exclude osd 2 and the
+            # replacement shards should exist: verify via reads
+            try:
+                ok = all(io.read(nm, len(d)) == d
+                         for nm, d in blobs.items())
+            except Exception:  # noqa: BLE001 - transient during backfill
+                ok = False
+            if ok:
+                break
+        for name, data in blobs.items():
+            assert io.read(name, len(data)) == data
+        # verify replacements actually hold shard data: each object's
+        # acting set (without osd2) should stat everywhere
+        missing = 0
+        for name in blobs:
+            pgid = c.mon.osdmap.object_to_pg(
+                c.mon.osdmap.lookup_pool("ecp").id, name)
+            _, acting, _, primary = c.mon.osdmap.pg_to_up_acting_osds(pgid)
+            assert 2 not in acting
+            prim = c.osds[primary]
+            state = prim._get_pg(pgid)
+            for s in range(5):
+                if state.backend.shards.stat(
+                        s, hobject_t(pool=pgid.pool, name=name)) is None:
+                    missing += 1
+        assert missing == 0, f"{missing} shards not backfilled"
