@@ -3,4 +3,17 @@
 from .object_store import ObjectStore, Transaction
 from .mem_store import MemStore
 
-__all__ = ["ObjectStore", "Transaction", "MemStore"]
+
+def create_store(kind: str, path: str | None = None) -> ObjectStore:
+    """reference ObjectStore::create (src/ceph_osd.cc:286): pick a
+    backend by name."""
+    if kind == "memstore":
+        return MemStore()
+    if kind == "filestore":
+        from .file_store import FileStore
+        assert path, "filestore needs a path"
+        return FileStore(path)
+    raise ValueError(f"unknown objectstore {kind!r}")
+
+
+__all__ = ["ObjectStore", "Transaction", "MemStore", "create_store"]

@@ -25,19 +25,27 @@ from ..rados import RadosClient
 
 class Cluster:
     def __init__(self, n_osds: int = 6, heartbeat_interval: float = 0.0,
-                 failure_quorum: int = 2, asok_dir: str | None = None):
+                 failure_quorum: int = 2, asok_dir: str | None = None,
+                 objectstore: str = "memstore",
+                 data_dir: str | None = None):
         self.mon = Monitor(failure_quorum=failure_quorum)
         self.osds: list[OSDDaemon] = []
         self.n_osds = n_osds
         self.heartbeat_interval = heartbeat_interval
         self.asok_dir = asok_dir
+        self.objectstore = objectstore
+        self.data_dir = data_dir
         self._clients: list[RadosClient] = []
 
     def start(self) -> "Cluster":
+        from ..store import create_store
         for i in range(self.n_osds):
             asok = (f"{self.asok_dir}/osd.{i}.asok"
                     if self.asok_dir else None)
-            osd = OSDDaemon(i, self.mon.addr,
+            store = create_store(
+                self.objectstore,
+                f"{self.data_dir}/osd.{i}" if self.data_dir else None)
+            osd = OSDDaemon(i, self.mon.addr, store=store,
                             heartbeat_interval=self.heartbeat_interval,
                             asok_path=asok)
             self.osds.append(osd)
@@ -81,8 +89,16 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="vstart")
     ap.add_argument("--osds", type=int, default=6)
     ap.add_argument("--heartbeat", type=float, default=1.0)
+    ap.add_argument("--objectstore", choices=("memstore", "filestore"),
+                    default="memstore")
+    ap.add_argument("--data-dir", default=None,
+                    help="store root (filestore)")
+    ap.add_argument("--asok-dir", default=None)
     args = ap.parse_args(argv)
-    cluster = Cluster(args.osds, heartbeat_interval=args.heartbeat).start()
+    cluster = Cluster(args.osds, heartbeat_interval=args.heartbeat,
+                      asok_dir=args.asok_dir,
+                      objectstore=args.objectstore,
+                      data_dir=args.data_dir).start()
     print(f"mon at {cluster.mon.addr}; {args.osds} osds up; Ctrl-C to stop",
           flush=True)
     try:

@@ -1,0 +1,164 @@
+"""FileStore + LogDB durability tests (reference src/test/objectstore/
+store_test.cc role: same ObjectStore surface across backends, plus
+journal-replay crash consistency)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.osd.types import ghobject_t, hobject_t, pg_t, spg_t
+from ceph_tpu.store.file_store import FileStore
+from ceph_tpu.store.kv import LogDB, WriteBatch
+from ceph_tpu.store.object_store import Transaction
+
+CID = spg_t(pg_t(1, 0), 2)
+
+
+def goid(name, shard=2):
+    return ghobject_t(hobject_t(pool=1, name=name), shard=shard)
+
+
+# -- LogDB -------------------------------------------------------------------
+
+def test_logdb_persistence(tmp_path):
+    db = LogDB(str(tmp_path / "kv"))
+    b = WriteBatch()
+    b.set(b"a", b"1")
+    b.set(b"b/x", b"2")
+    db.submit(b)
+    db.set(b"b/y", b"3")
+    db.rm(b"a")
+    db.close()
+    db2 = LogDB(str(tmp_path / "kv"))
+    assert db2.get(b"a") is None
+    assert db2.get(b"b/x") == b"2"
+    assert list(db2.iterate(b"b/")) == [(b"b/x", b"2"), (b"b/y", b"3")]
+    db2.close()
+
+
+def test_logdb_compaction_preserves(tmp_path):
+    db = LogDB(str(tmp_path / "kv"), compact_every=5)
+    for i in range(20):
+        db.set(f"k{i:03}".encode(), str(i).encode())
+    db.close()
+    db2 = LogDB(str(tmp_path / "kv"))
+    assert db2.get(b"k019") == b"19"
+    assert len(list(db2.iterate(b"k"))) == 20
+    db2.close()
+
+
+def test_logdb_torn_wal_tail(tmp_path):
+    db = LogDB(str(tmp_path / "kv"))
+    db.set(b"good", b"1")
+    db.close()
+    # corrupt: append garbage (simulates a torn write at crash)
+    with open(tmp_path / "kv" / "wal.log", "ab") as f:
+        f.write(b"\x13\x00\x00\x00garbage-without-valid-crc")
+    db2 = LogDB(str(tmp_path / "kv"))
+    assert db2.get(b"good") == b"1"
+    db2.close()
+
+
+# -- FileStore ---------------------------------------------------------------
+
+def store_at(tmp_path):
+    s = FileStore(str(tmp_path / "store"))
+    s.mount()
+    s.create_collection(CID)
+    return s
+
+
+def test_filestore_roundtrip_and_remount(tmp_path):
+    s = store_at(tmp_path)
+    t = Transaction()
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, 5000, dtype=np.uint8)
+    t.write(goid("obj1"), 0, data)
+    t.setattr(goid("obj1"), "hinfo_key", b"\x01\x02\x03")
+    t.omap_setkeys(goid("obj1"), {b"mk": b"mv"})
+    s.queue_transactions(CID, [t])
+    np.testing.assert_array_equal(s.read(CID, goid("obj1")), data)
+    s.umount()
+    s2 = FileStore(str(tmp_path / "store"))
+    s2.mount()
+    assert s2.collection_exists(CID)
+    np.testing.assert_array_equal(s2.read(CID, goid("obj1")), data)
+    assert s2.getattr(CID, goid("obj1"), "hinfo_key") == b"\x01\x02\x03"
+    assert s2.omap_get(CID, goid("obj1")) == {b"mk": b"mv"}
+    assert s2.list_objects(CID) == [goid("obj1")]
+    s2.umount()
+
+
+def test_filestore_overwrite_truncate_remove(tmp_path):
+    s = store_at(tmp_path)
+    t = Transaction()
+    t.write(goid("o"), 0, np.arange(100, dtype=np.uint8))
+    s.queue_transactions(CID, [t])
+    t2 = Transaction()
+    t2.write(goid("o"), 50, np.full(10, 0xFF, dtype=np.uint8))
+    t2.truncate(goid("o"), 80)
+    s.queue_transactions(CID, [t2])
+    got = s.read(CID, goid("o"))
+    assert got.size == 80
+    assert (got[50:60] == 0xFF).all()
+    t3 = Transaction()
+    t3.remove(goid("o"))
+    s.queue_transactions(CID, [t3])
+    assert not s.exists(CID, goid("o"))
+    s.umount()
+
+
+def test_filestore_journal_replay(tmp_path):
+    """Simulated crash: journal written but effects lost -> replay on
+    mount restores them (WAL-before-apply contract)."""
+    s = store_at(tmp_path)
+    t = Transaction()
+    payload = np.full(64, 7, dtype=np.uint8)
+    t.write(goid("j"), 0, payload)
+    s.queue_transactions(CID, [t])
+    # simulate losing the applied state but keeping the journal: delete
+    # the data file and the size key behind the store's back
+    import json
+    path = s._data_path(CID, goid("j"))
+    journal_bytes = (s.root / "journal.log").read_bytes()
+    s.umount()
+    path.unlink()
+    # umount truncated the... no: umount only compacts kv. restore journal
+    (tmp_path / "store" / "journal.log").write_bytes(journal_bytes)
+    s2 = FileStore(str(tmp_path / "store"))
+    s2.mount()   # replays
+    np.testing.assert_array_equal(s2.read(CID, goid("j")), payload)
+    s2.umount()
+
+
+def test_filestore_runs_ec_pipeline(tmp_path):
+    """The whole EC backend on FileStore instead of MemStore."""
+    from ceph_tpu.ec import ErasureCodePluginRegistry
+    from ceph_tpu.osd.ec_backend import ECBackend, LocalShardBackend
+    from ceph_tpu.osd.ec_transaction import PGTransaction
+    from ceph_tpu.osd.ec_util import StripeInfo
+    from ceph_tpu.osd.types import eversion_t
+
+    codec = ErasureCodePluginRegistry.instance().factory(
+        "jerasure", {"k": "3", "m": "2"})
+    s = FileStore(str(tmp_path / "ecstore"))
+    s.mount()
+    shards = LocalShardBackend(s, pg_t(2, 0), 5)
+    backend = ECBackend(codec, StripeInfo(3 * 64, 64), shards)
+    o = hobject_t(pool=2, name="pobj")
+    rng = np.random.default_rng(1)
+    payload = rng.integers(0, 256, 1500, dtype=np.uint8)
+    txn = PGTransaction()
+    txn.write(o, 0, payload)
+    done = []
+    backend.submit_transaction(txn, eversion_t(1, 1),
+                               lambda: done.append(1))
+    assert done
+    np.testing.assert_array_equal(backend.read(o, 0, 1500), payload)
+    s.umount()
+    # survives remount
+    s2 = FileStore(str(tmp_path / "ecstore"))
+    s2.mount()
+    shards2 = LocalShardBackend(s2, pg_t(2, 0), 5)
+    backend2 = ECBackend(codec, StripeInfo(3 * 64, 64), shards2)
+    np.testing.assert_array_equal(backend2.read(o, 0, 1500), payload)
+    s2.umount()
