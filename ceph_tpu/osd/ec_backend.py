@@ -144,6 +144,13 @@ class ECBackend:
         self.batched_launches: int = 0
         self.batched_extents: int = 0
         self._hold = 0
+        from .extent_cache import ExtentCache
+        self.extent_cache = ExtentCache()
+        # projected per-object state for queued-but-uncommitted ops
+        # (reference HashInfo "projected sizes for in-flight ops",
+        # ECUtil.h:101-160): later ops in the pipeline plan against the
+        # in-flight hinfo instance, not the stored one.
+        self._projected: dict[hobject_t, dict] = {}
 
     def batch(self):
         """Batch window: ops submitted inside encode in one codec launch.
@@ -232,12 +239,23 @@ class ECBackend:
             cache: dict = {}
 
             def fetch(oid):
+                # projected (in-flight) state first, then the store
+                proj = self._projected.get(oid)
+                if proj is not None:
+                    return proj["hinfo"]
                 if oid not in cache:
                     cache[oid] = self._fetch_hinfo(oid)
                 return cache[oid]
 
             def get_hinfo(oid):
-                return fetch(oid) or HashInfo.make(self.n)
+                h = fetch(oid)
+                if h is None:
+                    h = HashInfo.make(self.n)
+                # later queued ops must chain off this same instance
+                proj = self._projected.setdefault(
+                    oid, {"hinfo": h, "refs": 0})
+                proj["refs"] += 1
+                return proj["hinfo"]
 
             def get_size(oid):
                 h = fetch(oid)
@@ -337,6 +355,8 @@ class ECBackend:
                 hi = min(e.end, roff + data.size)
                 if lo < hi:
                     buf[lo - e.off:hi - e.off] = data[lo - roff:hi - roff]
+        # bytes assembled by earlier in-flight ops win over store reads
+        self.extent_cache.overlay(oid, e.off, buf)
         for w in op.txn.ops[oid].writes:
             lo = max(e.off, w.offset)
             hi = min(e.end, w.end)
@@ -358,7 +378,11 @@ class ECBackend:
         for op in ready:
             for oid, extents in op.plan.will_write.items():
                 for e in extents:
-                    work.append((op, oid, e, self._assemble_extent(op, oid, e)))
+                    buf = self._assemble_extent(op, oid, e)
+                    # pin so later ops in this (or the next) drain see
+                    # these bytes instead of stale store reads
+                    self.extent_cache.present(oid, e.off, buf)
+                    work.append((op, oid, e, buf))
         encoded_by_op: dict[int, dict] = {id(op): {} for op in ready}
         crcs_by_op: dict[int, dict] = {id(op): {} for op in ready}
         if work:
@@ -440,6 +464,16 @@ class ECBackend:
             op = self.waiting_commit.pop(0)
             op.state = "done"
             self.log.roll_forward_to(op.version)
+            # unpin cached extents + drop projected refs
+            for oid, extents in (op.plan.will_write if op.plan else {}).items():
+                for e in extents:
+                    self.extent_cache.release(oid, e.off, e.length)
+            for oid in op.txn.ops:
+                proj = self._projected.get(oid)
+                if proj is not None:
+                    proj["refs"] -= 1
+                    if proj["refs"] <= 0:
+                        del self._projected[oid]
             self.completed += 1
             op.on_commit()
         self.check_ops()

@@ -315,3 +315,55 @@ def test_fused_crc_pipeline_matches_host_crc():
         want = C.crc32c(shards[s].tobytes(), 0xFFFFFFFF)
         assert hinfo.get_chunk_hash(s) == want, f"shard {s}"
     np.testing.assert_array_equal(backend.read(o, 0, 768), whole)
+
+
+def test_batched_overlapping_writes_same_object():
+    """Two ops on the same object in one batch window: the second must
+    see the first's bytes (ExtentCache + projected hinfo chaining,
+    reference ExtentCache reserve/present + projected sizes)."""
+    backend, _ = make_backend()
+    o = oid("overlap")
+    rng = np.random.default_rng(20)
+    base = rng.integers(0, 256, 512, dtype=np.uint8)
+    patch = rng.integers(0, 256, 40, dtype=np.uint8)
+    acks = []
+    with backend.batch():
+        t1 = PGTransaction()
+        t1.write(o, 0, base)
+        backend.submit_transaction(t1, eversion_t(1, 1),
+                                   lambda: acks.append(1))
+        # partial-stripe overwrite of data written by t1, same window
+        t2 = PGTransaction()
+        t2.write(o, 100, patch)
+        backend.submit_transaction(t2, eversion_t(1, 2),
+                                   lambda: acks.append(2))
+    assert acks == [1, 2]
+    expect = base.copy()
+    expect[100:140] = patch
+    np.testing.assert_array_equal(backend.read(o, 0, 512), expect)
+    assert len(backend.extent_cache) == 0      # all released
+    assert not backend._projected
+
+
+def test_batched_appends_same_object_chain_hinfo():
+    """Consecutive appends in one window chain the cumulative crc."""
+    from ceph_tpu.common import crc32c as C
+    backend, _ = make_backend()
+    o = oid("chain")
+    rng = np.random.default_rng(21)
+    p1 = rng.integers(0, 256, 256, dtype=np.uint8)
+    p2 = rng.integers(0, 256, 256, dtype=np.uint8)
+    with backend.batch():
+        t1 = PGTransaction()
+        t1.write(o, 0, p1)
+        backend.submit_transaction(t1, eversion_t(1, 1), lambda: None)
+        t2 = PGTransaction()
+        t2.write(o, 256, p2)
+        backend.submit_transaction(t2, eversion_t(1, 2), lambda: None)
+    whole = np.concatenate([p1, p2])
+    np.testing.assert_array_equal(backend.read(o, 0, 512), whole)
+    hinfo = backend.shards.get_hinfo(0, o)
+    shards = ec_util.encode(backend.sinfo, backend.ec_impl, whole)
+    for s in range(6):
+        assert hinfo.get_chunk_hash(s) == C.crc32c(
+            shards[s].tobytes(), 0xFFFFFFFF), f"shard {s}"
