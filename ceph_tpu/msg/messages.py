@@ -478,3 +478,34 @@ class MPGListReply(Message):
         self.pgid = spg_from_json(meta["pgid"])
         self.tid = meta["tid"]
         self.oids = meta["oids"]
+
+
+# -- watch / notify (reference MWatchNotify.h, osd/Watch.h) ------------------
+
+@register_message
+class MWatchNotify(Message):
+    """OSD -> watcher delivery AND watcher ack (dir field), plus the
+    client->OSD watch/unwatch/notify control ops ride MOSDOp; this
+    message carries the out-of-band notify fan-out."""
+
+    type_id = 120
+
+    def __init__(self, oid: hobject_t = None, notify_id: int = 0,
+                 cookie: int = 0, payload: bytes = b"",
+                 is_ack: bool = False):
+        super().__init__()
+        self.oid, self.notify_id, self.cookie = oid, notify_id, cookie
+        self.payload, self.is_ack = payload, is_ack
+
+    def to_meta(self):
+        return {"oid": hobj_to_json(self.oid), "nid": self.notify_id,
+                "cookie": self.cookie, "ack": self.is_ack}
+
+    def data_segment(self):
+        return self.payload
+
+    def decode_wire(self, meta, data):
+        self.oid = hobj_from_json(meta["oid"])
+        self.notify_id, self.cookie = meta["nid"], meta["cookie"]
+        self.is_ack = meta["ack"]
+        self.payload = data

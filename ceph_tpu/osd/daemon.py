@@ -273,6 +273,11 @@ class OSDDaemon:
         self._recovered_epochs: set[int] = set()
         self.recovery_enabled = True
         self.prev_osdmap: OSDMap | None = None
+        # watch/notify (reference osd/Watch.h:48):
+        # (pool, oid.name) -> {cookie: conn}
+        self.watchers: dict[tuple, dict[int, object]] = {}
+        self._notify_id = 0
+        self._notify_pending: dict[int, dict] = {}
         self.osdmap = OSDMap()
         self.map_event = threading.Event()
         self.pgs: dict[pg_t, PGState] = {}
@@ -354,6 +359,12 @@ class OSDDaemon:
                 waiter = self.raw_list_waiters.pop((msg.pgid, msg.tid), None)
                 if waiter is not None:
                     waiter(msg)
+            elif isinstance(msg, M.MWatchNotify) and msg.is_ack:
+                pend = self._notify_pending.get(msg.notify_id)
+                if pend is not None:
+                    pend["remaining"].discard(msg.cookie)
+                    if not pend["remaining"]:
+                        pend["event"].set()
             elif isinstance(msg, M.MOSDPing):
                 self._handle_ping(conn, msg)
         except Exception as e:  # noqa: BLE001 - daemon must not die
@@ -762,6 +773,47 @@ class OSDDaemon:
                     result = -errno.ENOENT
                 else:
                     out_meta.append(["stat", size])
+            elif name == "call":
+                # server-side compute (reference CEPH_OSD_OP_CALL ->
+                # ClassHandler dispatch, PrimaryLogPG.cc:5643)
+                from .. import cls as cls_mod
+                _, spec, inlen = op
+                inp = bytes(msg.data[data_off:data_off + inlen])
+                data_off += inlen
+                cls_name, _, method = spec.partition(".")
+                fn = cls_mod.get_method(cls_name, method)
+                if fn is None:
+                    result = -errno.EOPNOTSUPP
+                    break
+                ctx = cls_mod.ClsContext(self, state, msg.pgid.pgid,
+                                         msg.oid)
+                try:
+                    read_payload += fn(ctx, inp)
+                except cls_mod.ClsError as e:
+                    result = -e.errno
+                    break
+                if ctx._pending_write is not None:
+                    off_w, data_w = ctx._pending_write
+                    txn.write(msg.oid, off_w,
+                              np.frombuffer(data_w, dtype=np.uint8))
+                    txn.truncate(msg.oid, off_w + len(data_w))
+                for k, v in ctx._pending_attrs.items():
+                    txn.setattr(msg.oid, k, v)
+            elif name == "watch":
+                _, cookie = op
+                key = (msg.pgid.pgid.pool, msg.oid.name)
+                with self.pg_lock:
+                    self.watchers.setdefault(key, {})[cookie] = conn
+            elif name == "unwatch":
+                _, cookie = op
+                key = (msg.pgid.pgid.pool, msg.oid.name)
+                with self.pg_lock:
+                    self.watchers.get(key, {}).pop(cookie, None)
+            elif name == "notify":
+                _, ln = op
+                payload = bytes(msg.data[data_off:data_off + ln])
+                data_off += ln
+                self._do_notify(msg.pgid.pgid, msg.oid, payload)
             else:
                 result = -errno.EOPNOTSUPP
         if result == 0 and txn.ops:
@@ -790,6 +842,29 @@ class OSDDaemon:
             return size if size > 0 else (
                 None if be.shards.stat(0, oid) is None else size)
         return be.stat(oid)
+
+    # -- watch/notify (reference osd/Watch.h, PrimaryLogPG notify) ----------
+
+    def _do_notify(self, pgid: pg_t, oid: hobject_t,
+                   payload: bytes, timeout: float = 5.0) -> None:
+        key = (pgid.pool, oid.name)
+        with self.pg_lock:
+            targets = dict(self.watchers.get(key, {}))
+            self._notify_id += 1
+            nid = self._notify_id
+        if not targets:
+            return
+        ev = threading.Event()
+        self._notify_pending[nid] = {
+            "remaining": set(targets), "event": ev}
+        for cookie, conn in targets.items():
+            try:
+                conn.send_message(M.MWatchNotify(oid, nid, cookie,
+                                                 payload))
+            except Exception:  # noqa: BLE001 - dead watcher
+                self._notify_pending[nid]["remaining"].discard(cookie)
+        ev.wait(timeout)
+        self._notify_pending.pop(nid, None)
 
     # -- scrub (asok-driven; reference `ceph pg scrub`) ---------------------
 

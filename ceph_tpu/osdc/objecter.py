@@ -37,6 +37,10 @@ class Objecter:
         self._lock = threading.Lock()
         self._waiters: dict[int, dict] = {}
         self._mon_waiters: dict[int, dict] = {}
+        # linger ops: cookie -> callback(oid_name, payload)
+        # (reference linger_ops / watch support, Objecter.h)
+        self._watch_cbs: dict[int, object] = {}
+        self._next_cookie = 0
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -70,6 +74,15 @@ class Objecter:
             if w is not None:
                 w["reply"] = msg
                 w["event"].set()
+        elif isinstance(msg, M.MWatchNotify) and not msg.is_ack:
+            cb = self._watch_cbs.get(msg.cookie)
+            if cb is not None:
+                try:
+                    cb(msg.oid.name, msg.payload)
+                finally:
+                    conn.send_message(M.MWatchNotify(
+                        msg.oid, msg.notify_id, msg.cookie, b"",
+                        is_ack=True))
 
     # -- map plumbing -------------------------------------------------------
 
@@ -129,6 +142,26 @@ class Objecter:
             last_err = -errno.ETIMEDOUT
         raise TimedOut(f"op {name} failed after {attempts} attempts "
                        f"(last {last_err})")
+
+    # -- watch/notify -------------------------------------------------------
+
+    def watch(self, pool_id: int, name: str, callback) -> int:
+        """Register a watch; returns the cookie (reference
+        IoCtxImpl::watch via linger ops)."""
+        with self._lock:
+            self._next_cookie += 1
+            cookie = self._next_cookie
+            self._watch_cbs[cookie] = callback
+        self.op_submit(pool_id, name, [["watch", cookie]])
+        return cookie
+
+    def unwatch(self, pool_id: int, name: str, cookie: int) -> None:
+        self.op_submit(pool_id, name, [["unwatch", cookie]])
+        self._watch_cbs.pop(cookie, None)
+
+    def notify(self, pool_id: int, name: str, payload: bytes) -> None:
+        self.op_submit(pool_id, name, [["notify", len(payload)]],
+                       bytes(payload))
 
     # -- mon commands -------------------------------------------------------
 

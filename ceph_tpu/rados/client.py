@@ -115,6 +115,24 @@ class IoCtx:
     def setxattr(self, name: str, key: str, value: bytes) -> None:
         self._submit(name, [["setxattr", key, len(value)]], bytes(value))
 
+    # -- cls / watch-notify --------------------------------------------------
+
+    def execute(self, name: str, cls: str, method: str,
+                inp: bytes = b"") -> bytes:
+        """Server-side class call (reference rados_exec / IoCtx::exec)."""
+        return self._submit(name, [["call", f"{cls}.{method}", len(inp)]],
+                            bytes(inp))
+
+    def watch(self, name: str, callback) -> int:
+        """callback(oid_name, payload) fires on each notify."""
+        return self.client.objecter.watch(self.pool_id, name, callback)
+
+    def unwatch(self, name: str, cookie: int) -> None:
+        self.client.objecter.unwatch(self.pool_id, name, cookie)
+
+    def notify(self, name: str, payload: bytes = b"") -> None:
+        self.client.objecter.notify(self.pool_id, name, payload)
+
     # -- async --------------------------------------------------------------
 
     def aio_write_full(self, name: str, data: bytes) -> Future:

@@ -1,0 +1,5 @@
+"""RBD: block device images over RADOS (reference src/librbd/)."""
+
+from .image import RBD, Image
+
+__all__ = ["RBD", "Image"]

@@ -1,0 +1,195 @@
+"""RBD images: virtual block devices striped over RADOS objects.
+
+Re-expresses the core of reference src/librbd/ (ImageCtx + the
+ImageRequest -> ObjectRequest dispatch in io/): an image is a header
+object (`rbd_header.<name>`: JSON size/order) plus data objects
+`rbd_data.<name>.<block#>`, each 2^order bytes; block I/O at arbitrary
+offsets maps to per-object extents (reference Striper::file_to_extents
+role).  Snapshots are full-copy (`rbd_data.<name>@<snap>.<block#>`) —
+the layering/clone chain and journal-based mirroring of the reference
+are roadmap items, recorded in docs/PARITY.md.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+
+from ..rados.client import IoCtx, RadosError
+
+DEFAULT_ORDER = 22  # 4 MiB objects, the reference default
+
+
+class RBD:
+    """Image management (reference librbd.h rbd_create/list/remove)."""
+
+    def __init__(self, ioctx: IoCtx):
+        self.io = ioctx
+
+    def create(self, name: str, size: int,
+               order: int = DEFAULT_ORDER) -> None:
+        try:
+            self.io.read(_header(name), 1)
+            raise RadosError(errno.EEXIST, f"image {name} exists")
+        except RadosError as e:
+            if e.errno != errno.ENOENT:
+                raise
+        header = {"size": size, "order": order, "snaps": []}
+        self.io.write_full(_header(name), json.dumps(header).encode())
+        self._dir_add(name)
+
+    def list(self) -> list[str]:
+        # images register in a directory object (reference rbd_directory)
+        try:
+            raw = self.io.read("rbd_directory", 0)
+            return sorted(json.loads(raw.decode()))
+        except RadosError:
+            return []
+
+    def _dir_add(self, name: str) -> None:
+        names = set(self.list())
+        names.add(name)
+        self.io.write_full("rbd_directory",
+                           json.dumps(sorted(names)).encode())
+
+    def _dir_rm(self, name: str) -> None:
+        names = set(self.list())
+        names.discard(name)
+        self.io.write_full("rbd_directory",
+                           json.dumps(sorted(names)).encode())
+
+    def remove(self, name: str) -> None:
+        img = Image(self.io, name)
+        nblocks = -(-img.size() // img.block_size)
+        for b in range(nblocks):
+            try:
+                self.io.remove(_data(name, b))
+            except RadosError:
+                pass
+        self.io.remove(_header(name))
+        self._dir_rm(name)
+
+
+def _header(name: str) -> str:
+    return f"rbd_header.{name}"
+
+
+def _data(name: str, block: int, snap: str | None = None) -> str:
+    base = f"rbd_data.{name}" + (f"@{snap}" if snap else "")
+    return f"{base}.{block:016x}"
+
+
+class Image:
+    """Open image handle (reference ImageCtx + Image API)."""
+
+    def __init__(self, ioctx: IoCtx, name: str):
+        self.io = ioctx
+        self.name = name
+        self._header = json.loads(
+            self.io.read(_header(name), 0).decode())
+
+    @property
+    def block_size(self) -> int:
+        return 1 << self._header["order"]
+
+    def size(self) -> int:
+        return self._header["size"]
+
+    def _save_header(self) -> None:
+        self.io.write_full(_header(self.name),
+                           json.dumps(self._header).encode())
+
+    # -- block I/O ----------------------------------------------------------
+
+    def write(self, offset: int, data: bytes) -> int:
+        if offset + len(data) > self.size():
+            raise RadosError(errno.EINVAL, "write past end of image")
+        bs = self.block_size
+        pos = 0
+        while pos < len(data):
+            block, boff = divmod(offset + pos, bs)
+            run = min(bs - boff, len(data) - pos)
+            self.io.write(_data(self.name, block),
+                          data[pos:pos + run], offset=boff)
+            pos += run
+        return len(data)
+
+    def read(self, offset: int, length: int) -> bytes:
+        length = max(0, min(length, self.size() - offset))
+        bs = self.block_size
+        out = bytearray()
+        pos = 0
+        while pos < length:
+            block, boff = divmod(offset + pos, bs)
+            run = min(bs - boff, length - pos)
+            try:
+                piece = self.io.read(_data(self.name, block), run, boff)
+            except RadosError as e:
+                if e.errno == errno.ENOENT:
+                    piece = b""
+                else:
+                    raise
+            if len(piece) < run:                 # sparse: zero-fill
+                piece = piece + b"\0" * (run - len(piece))
+            out += piece
+            pos += run
+        return bytes(out)
+
+    def resize(self, new_size: int) -> None:
+        old_blocks = -(-self.size() // self.block_size)
+        new_blocks = -(-new_size // self.block_size)
+        for b in range(new_blocks, old_blocks):
+            try:
+                self.io.remove(_data(self.name, b))
+            except RadosError:
+                pass
+        self._header["size"] = new_size
+        self._save_header()
+
+    # -- snapshots (full-copy) ----------------------------------------------
+
+    def snap_create(self, snap: str) -> None:
+        if snap in self._header["snaps"]:
+            raise RadosError(errno.EEXIST, f"snap {snap} exists")
+        nblocks = -(-self.size() // self.block_size)
+        for b in range(nblocks):
+            try:
+                data = self.io.read(_data(self.name, b), 0)
+            except RadosError:
+                continue
+            if data:
+                self.io.write_full(_data(self.name, b, snap), data)
+        self._header["snaps"].append(snap)
+        self._save_header()
+
+    def snap_list(self) -> list[str]:
+        return list(self._header["snaps"])
+
+    def snap_rollback(self, snap: str) -> None:
+        if snap not in self._header["snaps"]:
+            raise RadosError(errno.ENOENT, f"no snap {snap}")
+        nblocks = -(-self.size() // self.block_size)
+        for b in range(nblocks):
+            try:
+                data = self.io.read(_data(self.name, b, snap), 0)
+            except RadosError:
+                data = b""
+            if data:
+                self.io.write_full(_data(self.name, b), data)
+            else:
+                try:
+                    self.io.remove(_data(self.name, b))
+                except RadosError:
+                    pass
+
+    def snap_remove(self, snap: str) -> None:
+        if snap not in self._header["snaps"]:
+            raise RadosError(errno.ENOENT, f"no snap {snap}")
+        nblocks = -(-self.size() // self.block_size)
+        for b in range(nblocks):
+            try:
+                self.io.remove(_data(self.name, b, snap))
+            except RadosError:
+                pass
+        self._header["snaps"].remove(snap)
+        self._save_header()
