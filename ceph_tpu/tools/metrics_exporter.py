@@ -1,0 +1,88 @@
+"""Prometheus-format metrics exporter (mgr prometheus module role).
+
+Re-expresses the reference's mgr prometheus module
+(src/pybind/mgr/prometheus/): scrapes every daemon's perf counters via
+their admin sockets and serves them as prometheus text exposition on
+an HTTP endpoint.
+
+  python -m ceph_tpu.tools.metrics_exporter --asok-dir DIR --port 9283
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import http.server
+import os
+import sys
+
+
+def collect(asok_dir: str) -> str:
+    from ..common.admin_socket import admin_command
+    lines = [
+        "# HELP ceph_tpu_perf daemon perf counters",
+        "# TYPE ceph_tpu_perf untyped",
+    ]
+    for path in sorted(glob.glob(os.path.join(asok_dir, "*.asok"))):
+        daemon = os.path.basename(path).rsplit(".asok", 1)[0]
+        try:
+            dump = admin_command(path, {"prefix": "perf dump"}, timeout=2)
+        except Exception:  # noqa: BLE001 - daemon may be down
+            continue
+        for group, counters in dump.items():
+            if not isinstance(counters, dict):
+                continue
+            for key, val in counters.items():
+                name = f"ceph_tpu_{key}"
+                labels = f'{{daemon="{daemon}",group="{group}"}}'
+                if isinstance(val, dict):   # time-avg
+                    lines.append(
+                        f'ceph_tpu_{key}_sum{labels} {val.get("sum", 0)}')
+                    lines.append(
+                        f'ceph_tpu_{key}_count{labels} '
+                        f'{val.get("avgcount", 0)}')
+                else:
+                    lines.append(f"{name}{labels} {val}")
+    return "\n".join(lines) + "\n"
+
+
+def serve(asok_dir: str, port: int) -> None:
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            if self.path != "/metrics":
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = collect(asok_dir).encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    httpd = http.server.HTTPServer(("127.0.0.1", port), Handler)
+    print(f"metrics on http://127.0.0.1:{httpd.server_port}/metrics",
+          flush=True)
+    httpd.serve_forever()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="metrics-exporter")
+    ap.add_argument("--asok-dir", required=True)
+    ap.add_argument("--port", type=int, default=9283)
+    ap.add_argument("--once", action="store_true",
+                    help="print one scrape to stdout and exit")
+    args = ap.parse_args(argv)
+    if args.once:
+        sys.stdout.write(collect(args.asok_dir))
+        return 0
+    serve(args.asok_dir, args.port)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

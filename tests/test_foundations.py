@@ -135,3 +135,18 @@ def test_osd_daemon_asok(tmp_path):
         out = admin_command(str(tmp_path / "osd.0.asok"),
                            {"prefix": "status"})
         assert out["osd"] == 0
+
+
+def test_metrics_exporter_scrape(tmp_path):
+    """Prometheus text exposition from live daemons' admin sockets."""
+    from ceph_tpu.tools.metrics_exporter import collect
+    from ceph_tpu.tools.vstart import Cluster
+    with Cluster(n_osds=3, asok_dir=str(tmp_path)) as c:
+        client = c.client()
+        client.create_pool("mp", "replicated", size=2, pg_num=4)
+        io = client.open_ioctx("mp")
+        io.write_full("m", b"x" * 100)
+        text = collect(str(tmp_path))
+        assert "ceph_tpu_op{" in text
+        assert 'daemon="osd.0"' in text
+        assert "ceph_tpu_op_latency_sum" in text
