@@ -34,7 +34,7 @@ def time_encode_cpu(codec, chunks, min_iters=5, min_time=2.0):
     return iters * SIZE / (time.perf_counter() - t0)
 
 
-def time_encode_jax(codec, chunks, batch=32, min_time=3.0):
+def time_encode_jax(codec, chunks, batch=32, min_time=2.0):
     import jax
     import jax.numpy as jnp
     stripes = jnp.asarray(np.stack([chunks] * batch))
@@ -48,6 +48,20 @@ def time_encode_jax(codec, chunks, batch=32, min_time=3.0):
     jax.block_until_ready(out)
     elapsed = time.perf_counter() - t0
     return iters * batch * SIZE / elapsed
+
+
+def best_jax_throughput(codec, chunks):
+    """Sweep batch sizes; device-resident batches amortize launch cost
+    differently on TPU vs the CPU fallback."""
+    import jax
+    batches = (8, 32, 128) if jax.default_backend() != "cpu" else (8,)
+    best = 0.0
+    for b in batches:
+        try:
+            best = max(best, time_encode_jax(codec, chunks, batch=b))
+        except Exception as e:  # noqa: BLE001 - e.g. OOM at large batch
+            print(f"# batch {b} failed: {e}", file=sys.stderr)
+    return best
 
 
 def main():
@@ -77,7 +91,7 @@ def main():
         except Exception as e:  # noqa: BLE001
             print(f"# cpu plugin {plugin} failed: {e}", file=sys.stderr)
 
-    value = time_encode_jax(jax_codec, chunks)
+    value = best_jax_throughput(jax_codec, chunks)
 
     out = {
         "metric": "ec_encode_k8_m3_1MiB",
