@@ -5,10 +5,21 @@ Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N}
 
 value       = jax-plugin (TPU when available) encode throughput, input
-              GB/s over 1 MiB objects split k=8 + m=3 parity, batched.
+              GB/s over 1 MiB objects split k=8 + m=3 parity, batched
+              and device-resident (the OSD worker keeps stripes on
+              device; reference analog is the in-memory buffer of
+              ceph_erasure_code_benchmark).
 vs_baseline = value / best-CPU-plugin throughput measured on this host —
               the stand-in for the reference's ISA-L single-socket number
               (the reference publishes no absolute numbers; BASELINE.md).
+
+Measurement method: the encode is chained through a `lax.fori_loop`
+(each iteration's input depends on the previous parity) and timed as
+the difference between a 150-iteration and a 50-iteration dispatch.
+This defeats both async-dispatch undercounting and any runtime-level
+elision/caching of repeated identical computations (observed over the
+axon tunnel: timing the same buffer repeatedly reports impossible,
+above-roofline numbers), and cancels the dispatch/tunnel latency.
 
 Mirrors the canonical invocation of the reference benchmark
 (src/erasure-code/isa/README: `-p isa -P k=8 -P m=3 -S 1048576 -i 1000`).
@@ -22,6 +33,8 @@ import time
 import numpy as np
 
 K, M, SIZE = 8, 3, 1 << 20
+BATCH = 32                      # 1 MiB objects per device batch
+ITERS_LO, ITERS_HI = 50, 150
 
 
 def time_encode_cpu(codec, chunks, min_iters=5, min_time=2.0):
@@ -34,34 +47,51 @@ def time_encode_cpu(codec, chunks, min_iters=5, min_time=2.0):
     return iters * SIZE / (time.perf_counter() - t0)
 
 
-def time_encode_jax(codec, chunks, batch=32, min_time=2.0):
+def time_encode_jax(codec):
+    """Chained fori_loop slope timing of the device-resident encode."""
     import jax
     import jax.numpy as jnp
-    stripes = jnp.asarray(np.stack([chunks] * batch))
-    out = codec.encode_stripes(stripes)
-    jax.block_until_ready(out)  # compile + warm
-    t0 = time.perf_counter()
-    iters = 0
-    while time.perf_counter() - t0 < min_time:
-        out = codec.encode_stripes(stripes)
-        iters += 1
-    jax.block_until_ready(out)
-    elapsed = time.perf_counter() - t0
-    return iters * batch * SIZE / elapsed
+    from jax import lax
 
+    on_tpu = jax.default_backend() != "cpu"
+    k, m, n = K, M, SIZE // K
+    rng = np.random.default_rng(0)
+    flat = rng.integers(0, 256, (k, BATCH * n), dtype=np.uint8)
 
-def best_jax_throughput(codec, chunks):
-    """Sweep batch sizes; device-resident batches amortize launch cost
-    differently on TPU vs the CPU fallback."""
-    import jax
-    batches = (8, 32, 128) if jax.default_backend() != "cpu" else (8,)
-    best = 0.0
-    for b in batches:
-        try:
-            best = max(best, time_encode_jax(codec, chunks, batch=b))
-        except Exception as e:  # noqa: BLE001 - e.g. OOM at large batch
-            print(f"# batch {b} failed: {e}", file=sys.stderr)
-    return best
+    if on_tpu:
+        x0 = jnp.asarray(flat.view(np.int32))        # word-packed path
+        enc = codec.encode_words
+    else:
+        x0 = jnp.asarray(flat)
+        enc = codec.encode_chunks_device
+    enc(x0)                                          # build bitmats eagerly
+
+    def make(iters):
+        @jax.jit
+        def f(x):
+            def body(i, x):
+                p = enc(x)
+                return x.at[:m, :].set(x[:m, :] ^ p)
+            return lax.fori_loop(0, iters, body, x)
+        return f
+
+    f_lo, f_hi = make(ITERS_LO), make(ITERS_HI)
+    jax.block_until_ready(f_lo(x0))                  # compile
+    jax.block_until_ready(f_hi(x0))
+    lo, hi = [], []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f_lo(x0))
+        lo.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        jax.block_until_ready(f_hi(x0))
+        hi.append(time.perf_counter() - t0)
+    dt = (min(hi) - min(lo)) / (ITERS_HI - ITERS_LO)
+    if dt <= 0:
+        raise RuntimeError(
+            f"non-positive slope dt={dt}: timing elided or too noisy "
+            f"(lo={min(lo):.4f}s hi={min(hi):.4f}s)")
+    return BATCH * SIZE / dt
 
 
 def main():
@@ -91,7 +121,11 @@ def main():
         except Exception as e:  # noqa: BLE001
             print(f"# cpu plugin {plugin} failed: {e}", file=sys.stderr)
 
-    value = best_jax_throughput(jax_codec, chunks)
+    try:
+        value = time_encode_jax(jax_codec)
+    except Exception as e:  # noqa: BLE001
+        print(f"# jax encode failed: {e}", file=sys.stderr)
+        value = 0.0
 
     out = {
         "metric": "ec_encode_k8_m3_1MiB",

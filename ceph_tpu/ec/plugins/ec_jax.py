@@ -70,9 +70,16 @@ class ErasureCodeJax(ErasureCode):
         else:
             self.matrix = gf.cauchy_rs_matrix(self.k, self.m)
         bs = _ops()
+        import jax
         import jax.numpy as jnp
         self._enc_bitmat = jnp.asarray(
             bs.interleave_bitmatrix(self.matrix[self.k:]), dtype=jnp.int8)
+        # word-packed variant: ~4x the byte kernel on TPU (bit unpack
+        # touches 4 bytes per VPU op); byte path retained for CPU/XLA
+        self._use_w32 = jax.default_backend() != "cpu"
+        self._enc_bitmat32 = jnp.asarray(
+            bs._w32_bitmat(self.matrix[self.k:]), dtype=jnp.int8) \
+            if self._use_w32 else None
         super().init(profile)
 
     def get_alignment(self) -> int:
@@ -81,17 +88,44 @@ class ErasureCodeJax(ErasureCode):
     # -- encode -------------------------------------------------------------
 
     def encode_chunks(self, chunks: np.ndarray) -> np.ndarray:
+        return self._apply_bitmat(self._enc_bitmat32 if self._use_w32
+                                  else self._enc_bitmat, chunks, self.m)
+
+    def _apply_bitmat(self, bitmat, chunks: np.ndarray, r: int) -> np.ndarray:
+        """Host-side single point of byte-vs-w32 dispatch: `bitmat` must
+        be in the format matching self._use_w32 (_w32_bitmat vs
+        interleave_bitmatrix layout — both builders and this dispatch
+        flip together on the backend probe in init)."""
         bs = _ops()
-        out = bs.gf_bitmatmul(self._enc_bitmat,
-                              np.ascontiguousarray(chunks, dtype=np.uint8),
-                              self.m)
-        return np.asarray(out)
+        chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
+        if not self._use_w32:
+            return np.asarray(bs.gf_bitmatmul(bitmat, chunks, r))
+        # word-packed TPU path; host-side views are free (row-major)
+        k, n = chunks.shape
+        pad = -n % 4
+        if pad:
+            chunks = np.pad(chunks, ((0, 0), (0, pad)))
+        words = chunks.view("<u4").view(np.int32)
+        out = np.asarray(bs.gf_bitmatmul_w32(bitmat, words, r))
+        out = out.view("<u4").view(np.uint8).reshape(r, n + pad)
+        return out[:, :n] if pad else out
 
     def encode_chunks_device(self, chunks):
         """Device-resident encode: chunks (k, N) jnp uint8 -> (m, N).
         No host transfer; for the OSD pipeline and benchmarks."""
         bs = _ops()
         return bs.gf_bitmatmul(self._enc_bitmat, chunks, self.m)
+
+    def encode_words(self, words):
+        """Word-packed device-resident encode: (k, W) int32 words
+        (little-endian packed chunk bytes) -> (m, W) int32 parity.
+        The fastest TPU path — no byte<->word relayout on device."""
+        bs = _ops()
+        if not self._use_w32:
+            raise RuntimeError(
+                "encode_words requires a TPU backend (the w32 kernel "
+                "uses Mosaic bitcasts); use encode_chunks_device on CPU")
+        return bs.gf_bitmatmul_w32(self._enc_bitmat32, words, self.m)
 
     def encode_stripes(self, stripes):
         """Batched encode: (B, k, C) -> (B, m, C), one kernel launch.
@@ -154,23 +188,24 @@ class ErasureCodeJax(ErasureCode):
             else:
                 rows.append(gf.gf_matmul(self.matrix[t:t + 1], inv)[0])
         coeff = np.stack(rows).astype(np.uint8)
-        bitmat = jnp.asarray(bs.interleave_bitmatrix(coeff), dtype=jnp.int8)
+        if self._use_w32:
+            bitmat = jnp.asarray(bs._w32_bitmat(coeff), dtype=jnp.int8)
+        else:
+            bitmat = jnp.asarray(bs.interleave_bitmatrix(coeff),
+                                 dtype=jnp.int8)
         plan = (coeff, bitmat)
         with self._lock:
             self._decode_cache[key] = plan
         return plan
 
     def decode_chunks(self, dense: np.ndarray, erasures) -> np.ndarray:
-        bs = _ops()
         n = self.get_chunk_count()
         erased = tuple(sorted(set(erasures)))
         survivors = tuple(i for i in range(n) if i not in set(erased))[: self.k]
         if len(survivors) < self.k:
             raise ErasureCodeError(errno.EIO, "not enough survivors")
         _, bitmat = self._decode_plan(survivors, erased)
-        rec = np.asarray(bs.gf_bitmatmul(
-            bitmat, np.ascontiguousarray(dense[list(survivors)]),
-            len(erased)))
+        rec = self._apply_bitmat(bitmat, dense[list(survivors)], len(erased))
         out = dense.copy()
         for idx, e in enumerate(erased):
             out[e] = rec[idx]

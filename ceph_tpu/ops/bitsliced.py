@@ -65,18 +65,25 @@ def interleave_bitmatrix(mat: np.ndarray) -> np.ndarray:
 
 
 def _unpack_bits(block: jnp.ndarray) -> jnp.ndarray:
-    """(k, T) uint8 -> (8k, T) int8 bit-planes, bit-major rows."""
-    k, t = block.shape
-    shifts = jnp.arange(8, dtype=jnp.uint8)[:, None, None]
-    bits = (block[None, :, :] >> shifts) & jnp.uint8(1)   # (8, k, T)
-    return bits.reshape(8 * k, t).astype(jnp.int8)
+    """(k, T) uint8 -> (8k, T) int8 bit-planes, bit-major rows.
+
+    Strictly rank-2 (concat of shifted tiles): Mosaic on real TPUs
+    cannot lower rank-3 reshapes with tiny leading dims.
+    """
+    # mask+compare stays in i8 end to end (4 bytes/lane-slot on the
+    # VPU); i8 vector shifts don't legalize in Mosaic, and an i32
+    # upcast would quadruple the elementwise work in the hot unpack
+    rows = [(block & jnp.uint8(1 << i)).astype(jnp.bool_).astype(jnp.int8)
+            for i in range(8)]
+    return jnp.concatenate(rows, axis=0)
 
 
 def _pack_bits(bits: jnp.ndarray, r: int) -> jnp.ndarray:
     """(8r, T) int32 0/1 bit-major rows -> (r, T) uint8 bytes."""
-    t = bits.shape[1]
-    weights = (jnp.int32(1) << jnp.arange(8, dtype=jnp.int32))[:, None, None]
-    return jnp.sum(bits.reshape(8, r, t) * weights, axis=0).astype(jnp.uint8)
+    out = bits[0:r]
+    for i in range(1, 8):
+        out = out + (bits[i * r:(i + 1) * r] << i)
+    return out.astype(jnp.uint8)
 
 
 # ----------------------------------------------------------------------------
@@ -130,7 +137,118 @@ def gf_bitmatmul_pallas(bitmat: jnp.ndarray, chunks: jnp.ndarray, r: int,
     )(bitmat.astype(jnp.int8), chunks)
 
 
+# ----------------------------------------------------------------------------
+# Word-packed Pallas kernel: 4 bytes per VPU op in the unpack/pack
+# ----------------------------------------------------------------------------
+#
+# The plain kernel above is VPU-bound in the bit unpack (8 shift+mask
+# passes over every byte).  Packing 4 bytes into an i32 word makes one
+# `(w >> i) & 0x01010101` extract bit i of four bytes at once, and
+# `pltpu.bitcast` reinterprets the result as byte sublanes for the MXU
+# (measured ~3x on v5e).  Sublane layout of the bitcast (probed on
+# hardware): i32 (r, W) <-> u8 (4r, W) with u8 row 4r+b = byte b
+# (little-endian) of word row r, so the generator matrix is expanded
+# block-diagonally over the byte offset b (`_w32_bitmat`).
+
+def _w32_bitmat(mat: np.ndarray) -> np.ndarray:
+    """(r, k) GF(2^8) matrix -> (32r, 32k) 0/1 matrix for the w32 kernel.
+
+    out[i*4r + 4ri + b, j*4k + 4cj + b] = bit (i, j) of mat[ri, cj];
+    zero for mismatched byte offsets b (bytes never mix positions in a
+    linear code over byte streams).
+    """
+    r, k = mat.shape
+    m8 = interleave_bitmatrix(mat)                     # (8r, 8k)
+    out = np.zeros((32 * r, 32 * k), dtype=m8.dtype)
+    for i in range(8):
+        for ri in range(r):
+            for j in range(8):
+                for cj in range(k):
+                    v = m8[i * r + ri, j * k + cj]
+                    if v:
+                        for b in range(4):
+                            out[i * 4 * r + 4 * ri + b,
+                                j * 4 * k + 4 * cj + b] = v
+    return out
+
+
+def _gf_kernel_w32(bitmat_ref, in_ref, out_ref):
+    r32 = bitmat_ref.shape[0]
+    m = r32 // 32
+    w = in_ref[:]                                      # (k, W) i32
+    mask = jnp.int32(0x01010101)
+    planes = [pltpu.bitcast((w >> i) & mask, jnp.int8) for i in range(8)]
+    bits = jnp.concatenate(planes, axis=0)             # (32k, W) i8
+    prod = jax.lax.dot_general(
+        bitmat_ref[:], bits,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    ) & 1                                              # (32m, W)
+    acc = prod[0:4 * m]
+    for i in range(1, 8):
+        acc = acc + (prod[i * 4 * m:(i + 1) * 4 * m] << i)
+    out_ref[:] = pltpu.bitcast(acc.astype(jnp.uint8), jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("r", "tile"))
+def gf_bitmatmul_pallas_w32(bitmat32: jnp.ndarray, words: jnp.ndarray,
+                            r: int, tile: int = DEFAULT_TILE) -> jnp.ndarray:
+    """Word-packed path: operates on i32 words end to end so no device
+    relayout is ever paid (a host numpy `.view('<u4')` is free; an XLA
+    u8<->i32 bitcast on TPU is a physical retiling copy that costs more
+    than the whole encode).  words (k, W) int32 = little-endian packed
+    chunk bytes, W % tile_words == 0; bitmat32 from _w32_bitmat.
+    Returns (r, W) int32 parity words."""
+    k, w = words.shape
+    wt = tile // 4                                     # lane words per step
+    assert w % wt == 0, (w, wt)
+    grid = (w // wt,)
+    return pl.pallas_call(
+        _gf_kernel_w32,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((32 * r, 32 * k), lambda t: (0, 0)),
+            pl.BlockSpec((k, wt), lambda t: (0, t)),
+        ],
+        out_specs=pl.BlockSpec((r, wt), lambda t: (0, t)),
+        out_shape=jax.ShapeDtypeStruct((r, w), jnp.int32),
+    )(bitmat32.astype(jnp.int8), words)
+
+
+W32_TILE = 131072  # bytes per grid step for the w32 kernel (VMEM-bound)
+
+
+def _pick_wt(w: int) -> int:
+    """Lane-words per grid step: divides w, multiple of LANE."""
+    wt = min(W32_TILE // 4, w)
+    while w % wt:
+        wt //= 2
+    return max(wt, LANE)
+
+
+def gf_bitmatmul_w32(bitmat32: jnp.ndarray, words: jnp.ndarray, r: int
+                     ) -> jnp.ndarray:
+    """Padding wrapper over gf_bitmatmul_pallas_w32: accepts any W,
+    pads the word axis to a lane multiple (zero words make zero parity
+    in a linear code), strips it after."""
+    k, w = words.shape
+    wpad = -w % LANE
+    if wpad:
+        words = jnp.pad(words, ((0, 0), (0, wpad)))
+    out = gf_bitmatmul_pallas_w32(bitmat32, words, r,
+                                  tile=4 * _pick_wt(w + wpad))
+    return out[:, :w] if wpad else out
+
+
 FUSED_TILE = 2048  # fused parity+crc kernel tile (cmat VMEM footprint)
+
+
+def _crc_rows(n_shards: int) -> int:
+    """Per-tile rows of the fused kernel's flat crc output: n_shards
+    sublane-padded to a multiple of 8.  Single source of truth for the
+    producer (out_spec/padding in the kernel) and the consumer (the
+    de-interleaving reshape in gf_encode_with_crc)."""
+    return -(-n_shards // 8) * 8
 
 
 def _gf_crc_kernel(bitmat_ref, cmat_ref, in_ref, par_ref, crc_ref):
@@ -150,8 +268,12 @@ def _gf_crc_kernel(bitmat_ref, cmat_ref, in_ref, par_ref, crc_ref):
     data_crc = cl.tile_crc_bits(bits, cmat_ref[:])            # (k, 32)
     par_crc = cl.tile_crc_bits(prod.astype(jnp.int8),
                                cmat_ref[:])                   # (m, 32)
-    crc_ref[:] = jnp.concatenate([data_crc, par_crc],
-                                 axis=0)[None, :, :]
+    crc = jnp.concatenate([data_crc, par_crc], axis=0)
+    pad = crc_ref.shape[0] - crc.shape[0]   # sublane-align to 8 rows
+    if pad:
+        crc = jnp.concatenate(
+            [crc, jnp.zeros((pad, 32), dtype=crc.dtype)], axis=0)
+    crc_ref[:] = crc
 
 
 @functools.partial(jax.jit, static_argnames=("m", "tile"))
@@ -160,21 +282,22 @@ def gf_encode_with_crc_pallas(bitmat, cmat, chunks, m: int,
     k, n = chunks.shape
     assert n % tile == 0, (n, tile)
     grid = (n // tile,)
+    rows = _crc_rows(k + m)
     return pl.pallas_call(
         _gf_crc_kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((8 * m, 8 * k), lambda t: (0, 0)),
-            pl.BlockSpec((8, tile, 32), lambda t: (0, 0, 0)),
+            pl.BlockSpec((8 * tile, 32), lambda t: (0, 0)),
             pl.BlockSpec((k, tile), lambda t: (0, t)),
         ],
         out_specs=[
             pl.BlockSpec((m, tile), lambda t: (0, t)),
-            pl.BlockSpec((1, k + m, 32), lambda t: (t, 0, 0)),
+            pl.BlockSpec((rows, 32), lambda t: (t, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((m, n), jnp.uint8),
-            jax.ShapeDtypeStruct((n // tile, k + m, 32), jnp.int32),
+            jax.ShapeDtypeStruct(((n // tile) * rows, 32), jnp.int32),
         ],
     )(bitmat.astype(jnp.int8), cmat, chunks)
 
@@ -221,7 +344,11 @@ def gf_encode_with_crc(bitmat, chunks, m: int,
     if body:
         fn = gf_encode_with_crc_xla if use_xla else gf_encode_with_crc_pallas
         parity_body, crc_bits = fn(bitmat, cmat, chunks[:, :body], m)
-        crc_bits = np.asarray(crc_bits)               # (ntiles, n_sh, 32)
+        # pallas emits flat (ntiles*rows, 32) with rows sublane-padded
+        # to a multiple of 8; xla emits (ntiles, k+m, 32)
+        crc_bits = np.asarray(crc_bits)
+        if crc_bits.ndim == 2:
+            crc_bits = crc_bits.reshape(-1, _crc_rows(k + m), 32)[:, :k + m]
         tile_ls = cl.bits_to_u32(crc_bits).T          # (n_sh, ntiles)
     else:
         parity_body = jnp.zeros((m, 0), dtype=jnp.uint8)

@@ -20,7 +20,8 @@ xor) — verified against ceph_tpu.common.crc32c in tests.
 
 Layout note: the encode kernel's bit rows are bit-major interleaved
 (row i*r + s = bit i of shard s), so the tile matrix is exposed as
-C_i^T slices, shape (8, T, 32): L_shard = sum_i bits_i(shard) @ C_i^T.
+stacked C_i^T slices, shape (8T, 32), rows [i*T:(i+1)*T] = C_i^T:
+L_shard = sum_i bits_i(shard) @ C_i^T.
 """
 
 from __future__ import annotations
@@ -34,8 +35,9 @@ from ..common import crc32c as _crc
 
 @functools.lru_cache(maxsize=8)
 def crc_tile_matrix(tile: int) -> np.ndarray:
-    """(8, tile, 32) int8: slice [i, t, :] = bits of L(block with only
-    bit i of byte t set)."""
+    """(8*tile, 32) int8: row [i*tile + t] = bits of L(block with only
+    bit i of byte t set).  Flat 2-D so Pallas/Mosaic never sees a
+    rank-3 operand."""
     out = np.zeros((8, tile, 32), dtype=np.int8)
     # contribution of byte v at position t in a T-byte block:
     # A_{T-1-t} . L1(v), with L1(v) = crc of the single byte from state 0
@@ -52,7 +54,7 @@ def crc_tile_matrix(tile: int) -> np.ndarray:
                 val = sum(int(cur[i, j]) << j for j in range(32))
                 adv = _crc.crc32c_zeros(val, 1)
                 cur[i] = [(adv >> j) & 1 for j in range(32)]
-    return out
+    return out.reshape(8 * tile, 32)
 
 
 def bits_to_u32(bits: np.ndarray) -> np.ndarray:
@@ -80,18 +82,19 @@ def fold_tile_crcs(tile_ls: np.ndarray, tile: int, seed: int,
 # ----------------------------------------------------------------------------
 
 def tile_crc_bits(bits, cmat):
-    """bits: (8r, T) int8 bit-major rows; cmat: (8, T, 32) -> (r, 32)
-    int32 0/1 L-bit matrix for each of the r shards of this tile."""
+    """bits: (8r, T) int8 bit-major rows; cmat: (8T, 32) with rows
+    [i*T:(i+1)*T] = C_i^T -> (r, 32) int32 0/1 L-bit matrix for each of
+    the r shards of this tile.  Rank-2 only (Mosaic-lowerable)."""
     import jax
     import jax.numpy as jnp
     r8, t = bits.shape
     r = r8 // 8
-    b = bits.reshape(8, r, t).astype(jnp.float32)
     # sum_i (r, T) @ (T, 32); f32 keeps 0/1 sums exact up to 2^24
     acc = jnp.zeros((r, 32), dtype=jnp.float32)
     for i in range(8):
         acc = acc + jax.lax.dot_general(
-            b[i], cmat[i].astype(jnp.float32),
+            bits[i * r:(i + 1) * r].astype(jnp.float32),
+            cmat[i * t:(i + 1) * t].astype(jnp.float32),
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
     return acc.astype(jnp.int32) & 1

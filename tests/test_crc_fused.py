@@ -89,25 +89,28 @@ def test_fused_pallas_kernel_interpret():
     cmat = jnp.asarray(cl.crc_tile_matrix(tile))
     rng = np.random.default_rng(4)
     chunks = jnp.asarray(rng.integers(0, 256, (k, n), dtype=np.uint8))
+    rows = -(-(k + m) // 8) * 8
     par, crcb = pl.pallas_call(
         bs._gf_crc_kernel,
         grid=(ntiles,),
         in_specs=[
             pl.BlockSpec((8 * m, 8 * k), lambda t: (0, 0)),
-            pl.BlockSpec((8, tile, 32), lambda t: (0, 0, 0)),
+            pl.BlockSpec((8 * tile, 32), lambda t: (0, 0)),
             pl.BlockSpec((k, tile), lambda t: (0, t)),
         ],
         out_specs=[
             pl.BlockSpec((m, tile), lambda t: (0, t)),
-            pl.BlockSpec((1, k + m, 32), lambda t: (t, 0, 0)),
+            pl.BlockSpec((rows, 32), lambda t: (t, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((m, n), jnp.uint8),
-            jax.ShapeDtypeStruct((ntiles, k + m, 32), jnp.int32),
+            jax.ShapeDtypeStruct((ntiles * rows, 32), jnp.int32),
         ],
         interpret=True,
     )(bitmat, cmat, chunks)
     par2, crcb2 = bs.gf_encode_with_crc_xla(bitmat, cmat, chunks, m,
                                             tile=tile)
     np.testing.assert_array_equal(np.asarray(par), np.asarray(par2))
-    np.testing.assert_array_equal(np.asarray(crcb), np.asarray(crcb2))
+    np.testing.assert_array_equal(
+        np.asarray(crcb).reshape(ntiles, rows, 32)[:, :k + m],
+        np.asarray(crcb2))

@@ -109,3 +109,37 @@ def test_pallas_kernel_interpret_mode():
     )(bitmat, chunks)
     ref = gf.gf_matvec(mat, np.asarray(chunks))
     np.testing.assert_array_equal(np.asarray(out), ref)
+
+
+def test_w32_bitmat_numpy_model():
+    """The word-packed kernel's expanded matrix, validated against the
+    byte-path encode via a pure-numpy model of the hardware layout
+    (bitcast row 4r+b = byte b of word row r, probed on TPU)."""
+    import numpy as np
+    from ceph_tpu.ec import gf
+    from ceph_tpu.ops import bitsliced as bs
+
+    k, m, n = 4, 2, 64
+    mat = gf.cauchy_rs_matrix(k, m)[k:]
+    big = bs._w32_bitmat(mat)
+    rng = np.random.default_rng(7)
+    chunks = rng.integers(0, 256, (k, n), dtype=np.uint8)
+    w = n // 4
+    # operand rows i*4k + 4j + b = bit i of chunks[j, 4*col + b]
+    op = np.zeros((32 * k, w), dtype=np.int64)
+    for i in range(8):
+        for j in range(k):
+            for b in range(4):
+                op[i * 4 * k + 4 * j + b] = (chunks[j, b::4] >> i) & 1
+    prod = (big.astype(np.int64) @ op) & 1
+    parity = np.zeros((m, n), dtype=np.uint8)
+    for i in range(8):
+        for mi in range(m):
+            for b in range(4):
+                parity[mi, b::4] |= (
+                    prod[i * 4 * m + 4 * mi + b] << i).astype(np.uint8)
+    bitmat = bs.interleave_bitmatrix(mat)
+    import jax.numpy as jnp
+    want = np.asarray(bs.gf_bitmatmul_xla(
+        jnp.asarray(bitmat, dtype=jnp.int8), jnp.asarray(chunks), m))
+    np.testing.assert_array_equal(parity, want)
