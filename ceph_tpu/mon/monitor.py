@@ -188,10 +188,14 @@ class Monitor:
                 rid = self.osdmap.crush.rule_id_by_name(rule_name)
                 if rid is None:
                     rid = codec.create_rule(rule_name, self.osdmap.crush)
+                # EC min_size defaults to k+1: one write-degraded shard
+                # allowed, never below reconstructability (reference
+                # OSDMonitor pool-create min_size for erasure pools)
                 pool = self.osdmap.create_pool(
                     name, PoolType.ERASURE, size=n, pg_num=pg_num,
                     crush_rule=rid, erasure_code_profile=prof_name,
-                    stripe_width=stripe_width)
+                    stripe_width=stripe_width,
+                    min_size=min(k + 1, n))
             else:
                 size = int(cmd.get("size", 3))
                 rule_name = cmd.get("crush_rule", "replicated_rule")

@@ -28,7 +28,24 @@ from ..common import crc32c as _crc
 MAGIC = b"CTPU"
 _HEADER = struct.Struct("<4sHxxQIQI")  # magic, type, seq, meta_len, data_len, hcrc
 
+# Control frames handled by the messenger itself, below the typed-message
+# registry (the analog of ProtocolV2's HELLO/ACK tag frames,
+# reference src/msg/async/frames_v2.h Tag::HELLO / Tag::ACK).
+CTRL_HELLO = 0xFFF0   # session open/resume: meta = {entity, in_seq, lossless}
+CTRL_ACK = 0xFFF1     # seq field = highest contiguously-received seq
+
 _REGISTRY: dict[int, type["Message"]] = {}
+
+
+def encode_frame(tid: int, seq: int, meta: dict, data: bytes = b"") -> bytes:
+    """Assemble one crc-protected wire frame (shared by typed messages
+    and the messenger's control frames)."""
+    meta_raw = json.dumps(meta, separators=(",", ":")).encode()
+    head = _HEADER.pack(MAGIC, tid, seq, len(meta_raw), len(data), 0)
+    hcrc = _crc.crc32c(head[:-4], 0xFFFFFFFF)
+    head = head[:-4] + struct.pack("<I", hcrc)
+    pcrc = _crc.crc32c(data, _crc.crc32c(meta_raw, 0xFFFFFFFF))
+    return head + meta_raw + data + struct.pack("<I", pcrc)
 
 
 def register_message(cls: type["Message"]) -> type["Message"]:
@@ -67,14 +84,8 @@ class Message:
     # -- envelope -----------------------------------------------------------
 
     def encode(self, seq: int = 0) -> bytes:
-        meta = json.dumps(self.to_meta(), separators=(",", ":")).encode()
-        data = self.data_segment()
-        head = _HEADER.pack(MAGIC, self.type_id, seq, len(meta),
-                            len(data), 0)
-        hcrc = _crc.crc32c(head[:-4], 0xFFFFFFFF)
-        head = head[:-4] + struct.pack("<I", hcrc)
-        pcrc = _crc.crc32c(data, _crc.crc32c(meta, 0xFFFFFFFF))
-        return head + meta + data + struct.pack("<I", pcrc)
+        return encode_frame(self.type_id, seq, self.to_meta(),
+                            self.data_segment())
 
     HEADER_SIZE = _HEADER.size
 

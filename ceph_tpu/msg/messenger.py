@@ -1,12 +1,26 @@
-"""Async messenger: reactor, connections, dispatch.
+"""Async messenger: reactor, sessions, connections, dispatch.
 
 Re-expresses the reference's AsyncMessenger stack (src/msg/async/
-AsyncMessenger.cc, AsyncConnection.cc, Stack.h Worker reactors): an
-event loop owns all sockets; daemons bind an address and register a
-dispatcher; clients connect lazily and get ordered, crc-verified message
-delivery with automatic reconnect + resend for lossless policies
-(reference Policy.h lossless_peer; ProtocolV2 session replay is
-approximated by a bounded unacked-resend queue).
+AsyncMessenger.cc, AsyncConnection.cc, Stack.h Worker reactors) and
+ProtocolV2's lossless session semantics (src/msg/async/ProtocolV2.cc:
+out_seq/in_seq, ack frames, session resume + replay on reconnect):
+
+- Every connection opens with a HELLO frame carrying a stable entity
+  identity and the receiver's highest-delivered seq; the server binds
+  the TCP stream to a per-entity Session that survives reconnects.
+- Senders keep unacked frames; receivers ack delivered seqs; acks trim
+  the replay window.  On reconnect the peer's HELLO tells the sender
+  what arrived, so replay starts exactly after it and the receive path
+  drops any already-seen seq — exactly-once delivery per session.
+- The Session owns the live TCP stream; Connections are facades over it,
+  so a server reply issued after the client reconnected rides the new
+  stream (the reference rebinds AsyncConnection to the existing session
+  the same way on reconnect_ok).
+- Lossy connections (heartbeats may opt in) skip retention and resume.
+
+Fault injection (reference ms_inject_socket_failures / ms_inject_delay_*
+in src/common/options.cc:1071-1092): per-messenger knobs that randomly
+reset sockets or delay frame writes, used by the thrasher tests.
 
 Idiomatic shift: one asyncio event loop in a dedicated thread replaces
 N epoll worker threads — Python's reactor economics differ from C++'s,
@@ -18,36 +32,110 @@ shape so daemon code reads the same.
 from __future__ import annotations
 
 import asyncio
+import collections
+import json
+import random
 import struct
 import threading
+import uuid
 from typing import Callable
 
-from .message import Message
+from .message import CTRL_ACK, CTRL_HELLO, Message, encode_frame
 
 Dispatcher = Callable[["Connection", Message], None]
 
+# A lossless peer that stops acking cannot hold frames forever: past this
+# many retained frames the session is torn down (abnormal reset, like the
+# reference's session reset after policy limits) rather than leaking.
+UNACKED_HARD_CAP = 65536
+
+
+async def read_frame(reader: asyncio.StreamReader
+                     ) -> tuple[int, int, bytes, bytes, int]:
+    """Read one wire frame -> (tid, seq, meta_raw, data, pcrc); raises
+    ValueError on corruption (bad magic / header crc)."""
+    head = await reader.readexactly(Message.HEADER_SIZE)
+    tid, seq, meta_len, data_len = Message.parse_header(head)
+    meta_raw = await reader.readexactly(meta_len)
+    data = await reader.readexactly(data_len)
+    (pcrc,) = struct.unpack("<I", await reader.readexactly(4))
+    return tid, seq, meta_raw, data, pcrc
+
+
+class Session:
+    """Per-peer-entity delivery state + the live wire; survives TCP
+    reconnects (reference ProtocolV2 session: out_seq/in_seq/out_queue
+    replay, rebound to a new AsyncConnection on resume)."""
+
+    def __init__(self, lossless: bool = True, nonce: str | None = None):
+        self.lossless = lossless
+        # Distinguishes incarnations: a client that abandons a session
+        # (unacked overflow) starts a new nonce, telling the server to
+        # discard its old seq window instead of dedup-dropping the fresh
+        # one (reference ProtocolV2 client_cookie semantics).
+        self.nonce = nonce or uuid.uuid4().hex[:12]
+        self.out_seq = 0          # last seq assigned to an outgoing frame
+        self.in_seq = 0           # highest seq delivered to the dispatcher
+        self.unacked: collections.deque[tuple[int, bytes]] = \
+            collections.deque()
+        self.reader: asyncio.StreamReader | None = None
+        self.writer: asyncio.StreamWriter | None = None
+        self.send_lock = asyncio.Lock()
+        self.broken = False
+        self.down_since: float | None = None
+        self.last_acked = 0       # highest seq we have acked to the peer
+
+    def record_out(self, seq: int, raw: bytes) -> None:
+        if self.lossless:
+            self.unacked.append((seq, raw))
+            if len(self.unacked) > UNACKED_HARD_CAP:
+                # peer has not acked for 64k frames: abnormal reset
+                self.unacked.clear()
+                self.broken = True
+                self.drop_wire()
+
+    def trim_acked(self, upto: int) -> None:
+        while self.unacked and self.unacked[0][0] <= upto:
+            self.unacked.popleft()
+
+    def replay_frames(self, peer_in_seq: int) -> list[bytes]:
+        self.trim_acked(peer_in_seq)
+        return [raw for _, raw in self.unacked]
+
+    def drop_wire(self) -> None:
+        import time
+        self.down_since = time.monotonic()
+        w, self.writer, self.reader = self.writer, None, None
+        if w is not None:
+            try:
+                w.transport.abort()
+            except Exception:  # noqa: BLE001
+                pass
+
 
 class Connection:
-    """One peer session (reference AsyncConnection)."""
+    """One peer endpoint (reference AsyncConnection).  Client connections
+    own their Session and reconnect on failure; accepted connections bind
+    to a server-side Session resumed via HELLO and never dial out —
+    frames they queue while the wire is down are replayed when the peer
+    reconnects."""
 
     def __init__(self, messenger: "Messenger",
                  peer_addr: tuple[str, int] | None,
-                 reader: asyncio.StreamReader | None = None,
-                 writer: asyncio.StreamWriter | None = None,
-                 lossless: bool = True):
+                 lossless: bool = True,
+                 session: Session | None = None,
+                 can_reconnect: bool = True):
         self.messenger = messenger
         self.peer_addr = peer_addr
-        self._reader = reader
-        self._writer = writer
         self.lossless = lossless
-        self._out_seq = 0
-        self._unacked: list[tuple[int, bytes]] = []
-        self._send_lock = asyncio.Lock()
+        self.session = session or Session(lossless)
+        self.can_reconnect = can_reconnect
         self._closed = False
         self.last_error: str | None = None
+        self.peer_entity: str | None = None
 
     def is_connected(self) -> bool:
-        return self._writer is not None and not self._closed
+        return self.session.writer is not None and not self._closed
 
     # -- sending (thread-safe entry) ---------------------------------------
 
@@ -55,54 +143,105 @@ class Connection:
         self.messenger._run_soon(self._send(msg))
 
     async def _send(self, msg: Message) -> None:
-        async with self._send_lock:
-            self._out_seq += 1
-            raw = msg.encode(self._out_seq)
-            if self.lossless:
-                self._unacked.append((self._out_seq, raw))
-                if len(self._unacked) > 4096:
-                    self._unacked.pop(0)
+        sess = self.session
+        async with sess.send_lock:
+            sess.out_seq += 1
+            raw = msg.encode(sess.out_seq)
+            sess.record_out(sess.out_seq, raw)
             try:
-                if self._writer is None:
+                if sess.writer is None:
+                    if not self.can_reconnect:
+                        return  # replayed when the peer reconnects
                     await self._connect()
-                self._writer.write(raw)
-                await self._writer.drain()
-            except (ConnectionError, OSError) as e:
+                    if self.lossless:
+                        return  # _connect's replay already carried raw
+                await self._write_raw(raw)
+            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
                 self.last_error = str(e)
-                await self._reconnect_and_replay()
+                await self._reconnect()
+
+    async def _write_raw(self, raw: bytes) -> None:
+        """Single choke point for outgoing bytes: fault injection hooks
+        live here (reference ms_inject_socket_failures / ms_inject_delay
+        applied in AsyncConnection::write)."""
+        m = self.messenger
+        if m.inject_delay_prob > 0 and \
+                m._inject_rng.random() < m.inject_delay_prob:
+            await asyncio.sleep(m._inject_rng.random() * m.inject_delay_max)
+        if m.inject_socket_failures > 0 and \
+                m._inject_rng.randrange(m.inject_socket_failures) == 0:
+            m.injected_failures += 1
+            self.session.drop_wire()
+            raise ConnectionResetError("injected socket failure")
+        writer = self.session.writer
+        writer.write(raw)
+        await writer.drain()
 
     async def _connect(self) -> None:
+        """Open the TCP stream and run the HELLO exchange: send our
+        entity + in_seq, read the peer's, trim + replay unacked."""
         assert self.peer_addr is not None
-        self._reader, self._writer = await asyncio.open_connection(
-            *self.peer_addr)
+        reader, writer = await asyncio.open_connection(*self.peer_addr)
+        sess = self.session
+        hello = encode_frame(CTRL_HELLO, 0, {
+            "entity": self.messenger.entity,
+            "session": sess.nonce,
+            "in_seq": sess.in_seq,
+            "lossless": self.lossless,
+        })
+        writer.write(hello)
+        await writer.drain()
+        tid, _seq, meta_raw, _data, _pcrc = await asyncio.wait_for(
+            read_frame(reader), timeout=5.0)
+        if tid != CTRL_HELLO:
+            writer.close()
+            raise ConnectionError(f"expected HELLO, got frame type {tid:#x}")
+        meta = json.loads(meta_raw.decode())
+        self.peer_entity = meta.get("entity")
+        sess.reader, sess.writer = reader, writer
+        for raw in sess.replay_frames(int(meta.get("in_seq", 0))):
+            writer.write(raw)
+        await writer.drain()
         self.messenger._spawn_read_loop(self)
 
-    async def _reconnect_and_replay(self) -> None:
-        """Lossless policy: reconnect and resend unacked messages
-        (reference session reset/replay)."""
-        if not self.lossless or self.peer_addr is None or self._closed:
+    async def _reconnect(self) -> None:
+        """Lossless policy: reconnect; the HELLO exchange replays exactly
+        the frames the peer is missing (reference session reset/replay)."""
+        if not self.lossless or not self.can_reconnect or \
+                self.peer_addr is None or self._closed:
             return
         for attempt in range(5):
             try:
                 await asyncio.sleep(0.05 * (attempt + 1))
-                self._reader = self._writer = None
+                self.session.drop_wire()
                 await self._connect()
-                for _, raw in self._unacked:
-                    self._writer.write(raw)
-                await self._writer.drain()
                 return
-            except (ConnectionError, OSError) as e:
+            except (ConnectionError, OSError,
+                    asyncio.TimeoutError, ValueError) as e:
                 self.last_error = str(e)
         self._closed = True
 
+    async def _send_ack(self) -> None:
+        sess = self.session
+        writer = sess.writer
+        if writer is None:
+            return
+        try:
+            sess.last_acked = sess.in_seq
+            writer.write(encode_frame(CTRL_ACK, sess.in_seq, {}))
+        except (ConnectionError, OSError):
+            pass  # peer will learn our in_seq from the next HELLO
+
     async def _close(self) -> None:
         self._closed = True
-        if self._writer is not None:
+        sess = self.session
+        if sess.writer is not None:
             try:
-                self._writer.close()
+                sess.writer.close()
             except Exception:  # noqa: BLE001
                 pass
-            self._writer = None
+            sess.writer = None
+            sess.reader = None
 
     def close(self) -> None:
         self.messenger._run_soon(self._close())
@@ -118,11 +257,21 @@ class Messenger:
 
     def __init__(self, name: str = "client"):
         self.name = name
+        # Stable per-instance identity; the session key (reference
+        # entity_name_t + nonce in the ProtocolV2 banner).
+        self.entity = f"{name}.{uuid.uuid4().hex[:12]}"
         self.dispatcher: Dispatcher | None = None
         self.my_addr: tuple[str, int] | None = None
         self._server: asyncio.AbstractServer | None = None
         self._conns: dict[tuple[str, int], Connection] = {}
         self._accepted: list[Connection] = []
+        self._sessions: dict[str, Session] = {}
+        # fault injection (reference ms_inject_* dev options)
+        self.inject_socket_failures = 0   # ~1/N frames resets the socket
+        self.inject_delay_prob = 0.0
+        self.inject_delay_max = 0.0
+        self.injected_failures = 0
+        self._inject_rng = random.Random(0xC3B7)
         self._ensure_loop()
 
     # -- shared reactor -----------------------------------------------------
@@ -180,46 +329,147 @@ class Messenger:
 
     async def _on_accept(self, reader: asyncio.StreamReader,
                          writer: asyncio.StreamWriter) -> None:
-        conn = Connection(self, None, reader, writer)
+        """Accept = read the peer's HELLO, bind/resume its Session, reply
+        with our in_seq, replay anything it is missing."""
+        try:
+            tid, _seq, meta_raw, _data, _pcrc = await asyncio.wait_for(
+                read_frame(reader), timeout=10.0)
+            if tid != CTRL_HELLO:
+                writer.close()
+                return
+            meta = json.loads(meta_raw.decode())
+        except (asyncio.IncompleteReadError, asyncio.TimeoutError,
+                ConnectionError, OSError, ValueError):
+            writer.close()
+            return
+        entity = str(meta.get("entity", ""))
+        lossless = bool(meta.get("lossless", True))
+        nonce = str(meta.get("session", ""))
+        self._prune_sessions()
+        if lossless:
+            sess = self._sessions.get(entity)
+            if sess is None or sess.nonce != nonce:
+                sess = Session(lossless=True, nonce=nonce)
+                self._sessions[entity] = sess
+        else:
+            sess = Session(lossless=False, nonce=nonce)
+        sess.drop_wire()          # supersede any stale stream
+        sess.reader, sess.writer = reader, writer
+        conn = Connection(self, None, lossless=lossless, session=sess,
+                          can_reconnect=False)
+        conn.peer_entity = entity
         peer = writer.get_extra_info("peername")
         conn.peer_addr = peer[:2] if peer else None
+        # one facade per session: drop superseded ones from the registry
+        self._accepted = [c for c in self._accepted
+                          if c.session is not sess]
         self._accepted.append(conn)
+        try:
+            writer.write(encode_frame(CTRL_HELLO, 0, {
+                "entity": self.entity, "in_seq": sess.in_seq}))
+            for raw in sess.replay_frames(int(meta.get("in_seq", 0))):
+                writer.write(raw)
+            await writer.drain()
+        except (ConnectionError, OSError):
+            writer.close()
+            return
         self._spawn_read_loop(conn)
+
+    def _prune_sessions(self, max_down: float = 600.0) -> None:
+        """Reap server-side sessions whose wire has been down for a long
+        time (their entities are per-process uuids, so a dead peer never
+        comes back) and accepted-conn facades whose wire was superseded."""
+        import time
+        now = time.monotonic()
+        for entity, sess in list(self._sessions.items()):
+            if sess.writer is None and sess.down_since is not None and \
+                    now - sess.down_since > max_down:
+                del self._sessions[entity]
+        self._accepted = [c for c in self._accepted
+                          if c.session.reader is not None]
 
     # -- client side --------------------------------------------------------
 
     def connect(self, addr: tuple[str, int],
                 lossless: bool = True) -> Connection:
-        addr = (addr[0], addr[1])
-        conn = self._conns.get(addr)
+        """Get-or-create the client connection for addr.  Lossless and
+        lossy conns are separate sessions (the reference runs heartbeats
+        on dedicated lossy messengers for the same reason: ping retention
+        and replay make no sense)."""
+        key = (addr[0], addr[1], lossless)
+        conn = self._conns.get(key)
         if conn is None or conn._closed:
-            conn = Connection(self, addr, lossless=lossless)
-            self._conns[addr] = conn
+            # Carry the old session into the replacement connection: the
+            # server resumes sessions by entity, so a fresh seq space
+            # would collide with its dedup window (frames silently
+            # dropped as "already seen").  A broken session (unacked
+            # overflow) starts over with a new nonce.
+            old = conn
+            sess = None
+            if old is not None and not old.session.broken:
+                sess = old.session
+            conn = Connection(self, (addr[0], addr[1]), lossless=lossless,
+                              session=sess)
+            self._conns[key] = conn
         return conn
 
     # -- read loop ----------------------------------------------------------
 
     def _spawn_read_loop(self, conn: Connection) -> None:
-        self._run_soon(self._read_loop(conn))
+        self._run_soon(self._read_loop(conn, conn.session.reader))
 
-    async def _read_loop(self, conn: Connection) -> None:
-        reader = conn._reader
+    async def _read_loop(self, conn: Connection,
+                         reader: asyncio.StreamReader) -> None:
+        sess = conn.session
         try:
-            while not conn._closed:
-                head = await reader.readexactly(Message.HEADER_SIZE)
-                tid, seq, meta_len, data_len = Message.parse_header(head)
-                meta_raw = await reader.readexactly(meta_len)
-                data = await reader.readexactly(data_len)
-                (pcrc,) = struct.unpack("<I", await reader.readexactly(4))
+            while not conn._closed and reader is sess.reader:
+                tid, seq, meta_raw, data, pcrc = await read_frame(reader)
+                if tid == CTRL_ACK:
+                    sess.trim_acked(seq)
+                    continue
+                if tid == CTRL_HELLO:
+                    continue  # late/duplicate hello: ignore
+                if conn.lossless and seq <= sess.in_seq:
+                    # replayed frame we already delivered: re-ack, drop
+                    # (reference ProtocolV2 in_seq dedup on session resume)
+                    await conn._send_ack()
+                    continue
                 msg = Message.decode(tid, seq, meta_raw, data, pcrc)
+                sess.in_seq = seq
                 if self.dispatcher is not None:
                     # dispatch off-reactor so handlers may send synchronously
                     await asyncio.get_event_loop().run_in_executor(
                         None, self.dispatcher, conn, msg)
+                # Batch acks: piggyback-style — ack when the pipe goes
+                # idle or every 64 frames, not per message (reference
+                # ProtocolV2 acks lazily from the write path too).
+                buffered = getattr(reader, "_buffer", None)
+                if (buffered is not None and len(buffered) == 0) or \
+                        sess.in_seq - sess.last_acked >= 64:
+                    await conn._send_ack()
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
-            pass
-        except ValueError as e:  # crc/corruption: drop session
+            # Wire died under us.  Mark the wire down (starts the prune
+            # clock for accepted sessions); client conns re-dial so
+            # pending server replies (in the peer's unacked window) flow.
+            if not conn.can_reconnect:
+                if sess.reader is reader:
+                    sess.drop_wire()
+            elif not conn._closed:
+                async with sess.send_lock:
+                    if sess.reader is reader or sess.reader is None:
+                        sess.drop_wire()
+                        await conn._reconnect()
+        except ValueError as e:
+            # crc/corruption: abort this wire; the session (seq window)
+            # survives, so a reconnect replays cleanly (reference
+            # ProtocolV2 treats a bad crc as a session-preserving reset)
             conn.last_error = str(e)
+            if sess.reader is reader:
+                sess.drop_wire()
+            if conn.can_reconnect and not conn._closed:
+                async with sess.send_lock:
+                    if sess.writer is None:
+                        await conn._reconnect()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -229,6 +479,9 @@ class Messenger:
                 self._server.close()
             for c in list(self._conns.values()) + self._accepted:
                 await c._close()
+            self._sessions.clear()
+            self._accepted.clear()
+            self._conns.clear()
         try:
             self._run_sync(_stop(), timeout=5)
         except Exception:  # noqa: BLE001

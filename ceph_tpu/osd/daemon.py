@@ -75,8 +75,10 @@ class MessengerShardBackend(ShardBackend):
         spg = spg_t(self.pgid, shard)
         if osd is None:
             # Hole in the acting set: the shard is degraded; ack now and
-            # leave the rebuild to recovery (min_size-relaxed commit; the
-            # reference blocks below min_size and backfills the rest).
+            # leave the rebuild to recovery.  Safe only because op
+            # admission already enforced pool min_size (live shards >=
+            # min_size), mirroring the reference's split between
+            # PeeringState min_size gating and degraded-write tolerance.
             self.degraded_shards.add(shard)
             on_commit(shard)
             return
@@ -288,6 +290,7 @@ class OSDDaemon:
         self._hb_stop = threading.Event()
         self._hb_thread: threading.Thread | None = None
         self._hb_last_seen: dict[int, float] = {}
+        self._hb_first_ping: dict[int, float] = {}
 
         self.messenger = Messenger(f"osd.{osd_id}")
         self.messenger.add_dispatcher(self._dispatch)
@@ -377,6 +380,12 @@ class OSDDaemon:
     def _handle_map(self, msg: M.MMonMap) -> None:
         newmap = OSDMap.from_json(msg.map_json)
         self.prev_osdmap = self.osdmap if self.osdmap.epoch else None
+        # peers that (re)joined start their heartbeat clock fresh
+        for oid_, o in newmap.osds.items():
+            if o.up and not (self.prev_osdmap is not None and
+                             self.prev_osdmap.is_up(oid_)):
+                self._hb_last_seen.pop(oid_, None)
+                self._hb_first_ping.pop(oid_, None)
         self.osdmap = newmap
         # refresh acting sets of cached backends (mini re-peering)
         with self.pg_lock:
@@ -816,7 +825,13 @@ class OSDDaemon:
                 self._do_notify(msg.pgid.pgid, msg.oid, payload)
             else:
                 result = -errno.EOPNOTSUPP
-        if result == 0 and txn.ops:
+        if result == 0 and txn.ops and \
+                self._live_shards(state) < self._pool_min_size(msg.pgid.pgid):
+            # Below min_size an acked write could land on fewer than k
+            # shards and be unrecoverable; block it (reference
+            # PrimaryLogPG/PeeringState min_size enforcement).
+            result = -errno.EAGAIN
+        elif result == 0 and txn.ops:
             self.perf.inc("op_w")
             done = threading.Event()
             version = state.next_version(self.osdmap.epoch)
@@ -828,6 +843,18 @@ class OSDDaemon:
         self.perf.tinc("op_latency", time.perf_counter() - _t0)
         conn.send_message(M.MOSDOpReply(msg.tid, result, read_payload,
                                         self.osdmap.epoch))
+
+    def _pool_min_size(self, pgid: pg_t) -> int:
+        pool = self.osdmap.pools.get(pgid.pool)
+        return pool.min_size if pool is not None else 1
+
+    def _live_shards(self, state: PGState) -> int:
+        """Count acting-set members that are placed and up."""
+        from ..crush.map import CRUSH_ITEM_NONE
+        be = state.backend
+        tgt = be.shards if state.kind == "ec" else be.replicas
+        return sum(1 for o in tgt.acting
+                   if o != CRUSH_ITEM_NONE and self.osdmap.is_up(o))
 
     def _object_exists(self, state: PGState, oid: hobject_t) -> bool:
         be = state.backend
@@ -905,14 +932,23 @@ class OSDDaemon:
                      if o.up and o.id != self.osd_id]
             for o in peers:
                 try:
-                    self.messenger.connect(tuple(o.addr)).send_message(
+                    # lossy: a dead peer must not accumulate a replay
+                    # window of stale pings (reference runs heartbeats on
+                    # dedicated lossy messengers)
+                    self.messenger.connect(
+                        tuple(o.addr), lossless=False).send_message(
                         M.MOSDPing(self.osd_id, self.osdmap.epoch,
                                    stamp=now))
                 except Exception:  # noqa: BLE001
                     pass
-                last = self._hb_last_seen.get(o.id)
+                # A peer that has never answered counts from its first
+                # ping, so silence-from-birth is also reported (reference
+                # OSD.cc:5210 ping accounting tracks first_tx per peer).
+                self._hb_first_ping.setdefault(o.id, now)
+                last = self._hb_last_seen.get(o.id,
+                                              self._hb_first_ping[o.id])
                 grace = self.heartbeat_interval * 4
-                if last is not None and now - last > grace:
+                if now - last > grace:
                     self.mon_conn.send_message(M.MOSDFailure(
                         self.osd_id, o.id, self.osdmap.epoch))
 

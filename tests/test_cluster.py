@@ -48,9 +48,22 @@ def test_profile_roundtrip(client):
     assert "p2" in out["profiles"]
 
 
-def test_profile_validation_rejects_bad():
-    # run against a dedicated client to keep module fixtures clean
-    pass
+def test_profile_validation_rejects_bad(client):
+    """The mon validates profiles by instantiating the plugin (reference
+    OSDMonitor::normalize_profile); bad plugin / bad params are rejected
+    without mutating cluster state."""
+    r, out = client.mon_command({
+        "prefix": "osd erasure-code-profile set", "name": "badplug",
+        "profile": {"plugin": "no_such_plugin"}})
+    assert r < 0 and "error" in out
+    r, out = client.mon_command({
+        "prefix": "osd erasure-code-profile set", "name": "badk",
+        "profile": {"plugin": "jax", "k": "0", "m": "1"}})
+    assert r < 0
+    r, out = client.mon_command(
+        {"prefix": "osd erasure-code-profile ls"})
+    assert "badplug" not in out["profiles"]
+    assert "badk" not in out["profiles"]
 
 
 def test_ec_pool_write_read(ecpool):
@@ -108,10 +121,24 @@ def test_write_while_degraded(cluster, client, ecpool):
     writable in this min_size-relaxed build."""
     rng = np.random.default_rng(4)
     payload = rng.integers(0, 256, 3000, dtype=np.uint8).tobytes()
-    # osd 5 is down from the previous test
-    try:
-        ecpool.write_full("degraded_write", payload)
-        assert ecpool.read("degraded_write", len(payload)) == payload
-    except Exception:
-        pytest.skip("degraded write path requires hole-tolerant commit "
-                    "(roadmap)")
+    # osd 5 is down from the previous test: live = 5 == min_size (k+1)
+    ecpool.write_full("degraded_write", payload)
+    assert ecpool.read("degraded_write", len(payload)) == payload
+
+
+def test_write_blocked_below_min_size(cluster, client, ecpool):
+    """k=4,m=2 -> min_size=5.  With two OSDs down only 4 live shards
+    remain: an acked write could be unrecoverable, so the primary must
+    refuse it (reference PeeringState min_size enforcement)."""
+    from ceph_tpu.osdc.objecter import TimedOut
+    cluster.kill_osd(4)
+    cluster.mark_osd_down(4)
+    time.sleep(0.3)
+    # the objecter retries EAGAIN (the reference client blocks until the
+    # PG is writeable again) and eventually surfaces the timeout
+    with pytest.raises(TimedOut) as ei:
+        ecpool.write_full("below_min_size", b"x" * 2000)
+    assert "-11" in str(ei.value)  # EAGAIN was the last refusal
+    # reads still work: k=4 shards survive
+    got = ecpool.read("degraded_write", 3000)
+    assert len(got) == 3000
