@@ -24,9 +24,17 @@ from __future__ import annotations
 
 import errno
 import functools
+import sys
 import threading
 
 import numpy as np
+
+# the w32 host path reinterprets byte buffers as little-endian words
+# (`.view('<u4').view(np.int32)`); on a big-endian host the int32 view
+# would silently byte-swap relative to the kernel's layout, producing
+# wrong parity rather than an error — fail loudly instead (ADVICE r1)
+assert sys.byteorder == "little", \
+    "ec_jax w32 paths assume a little-endian host"
 
 from .. import gf
 from ..base import ErasureCode
@@ -143,6 +151,28 @@ class ErasureCodeJax(ErasureCode):
         par = bs.gf_bitmatmul(self._enc_bitmat, flat, self.m)
         return jnp.transpose(par.reshape(self.m, b, c), (1, 0, 2))
 
+    def encode_extents_with_crc(self, runs: list[np.ndarray]):
+        """Multi-extent fused launch: every run of a pipeline drain gets
+        parity + per-tile crc L-vectors from ONE kernel call (w32 on
+        TPU — the headline kernel, not the 4x-slower byte variant).
+
+        Returns per-run (parity (m, Wi), tile_ls, tail_bytes, tile);
+        fold each with fold_extent_crcs, chaining seeds per object.
+        """
+        from ...ops import bitsliced as bs
+        return bs.gf_encode_extents_with_crc(
+            self._enc_bitmat, self._enc_bitmat32, runs, self.m,
+            use_w32=self._use_w32)
+
+    def fold_extent_crcs(self, tile_ls, tail_bytes, seeds: list[int],
+                         tile: int) -> list[int]:
+        """Host fold of one run's kernel crc output into cumulative
+        shard crcs with per-shard seeds (the hinfo chain)."""
+        from ...ops import crc32c_linear as cl
+        return [cl.fold_tile_crcs(tile_ls[s], tile, seeds[s],
+                                  tail_bytes[s].tobytes())
+                for s in range(self.k + self.m)]
+
     def encode_chunks_with_crc(self, chunks: np.ndarray,
                                seeds: list[int] | None = None
                                ) -> tuple[np.ndarray, list[int]]:
@@ -154,17 +184,12 @@ class ErasureCodeJax(ErasureCode):
         Returns (parity (m, N), crcs for all k+m shards seeded `seeds`
         (default 0xFFFFFFFF each, the HashInfo convention)).
         """
-        from ...ops import bitsliced as bs
-        from ...ops import crc32c_linear as cl
         chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
-        parity, tile_ls, tail_bytes, tile = bs.gf_encode_with_crc(
-            self._enc_bitmat, chunks, self.m)
-        n_sh = self.k + self.m
         if seeds is None:
-            seeds = [0xFFFFFFFF] * n_sh
-        crcs = [cl.fold_tile_crcs(tile_ls[s], tile, seeds[s],
-                                  tail_bytes[s].tobytes())
-                for s in range(n_sh)]
+            seeds = [0xFFFFFFFF] * (self.k + self.m)
+        [(parity, tile_ls, tail_bytes, tile)] = \
+            self.encode_extents_with_crc([chunks])
+        crcs = self.fold_extent_crcs(tile_ls, tail_bytes, seeds, tile)
         return np.asarray(parity), crcs
 
     # -- decode -------------------------------------------------------------

@@ -57,6 +57,37 @@ def crc_tile_matrix(tile: int) -> np.ndarray:
     return out.reshape(8 * tile, 32)
 
 
+@functools.lru_cache(maxsize=8)
+def crc_tile_matrix_w32(wt: int) -> np.ndarray:
+    """(32*wt, 32) int8 for the word-packed kernel: rows [i*wt + t] =
+    L-contribution of word-bit i at word position t.  Word bit i of a
+    little-endian i32 word is bit (i%8) of the byte at tile position
+    4t + i//8, so this is a re-indexing of crc_tile_matrix(4*wt)."""
+    base = crc_tile_matrix(4 * wt).reshape(8, 4 * wt, 32)
+    out = np.zeros((32, wt, 32), dtype=np.int8)
+    for i in range(32):
+        out[i] = base[i % 8, (i // 8)::4, :]
+    return out.reshape(32 * wt, 32)
+
+
+def tile_crc_bits_w32(words, cmat32):
+    """words: (r, Wt) i32 packed bytes; cmat32: (32*Wt, 32) from
+    crc_tile_matrix_w32 -> (r, 32) int32 0/1 L-bit matrix per shard.
+    i32 shifts legalize in Mosaic (i8 shifts don't), so the 32
+    bit-plane extractions stay word-wide."""
+    import jax
+    import jax.numpy as jnp
+    r, wt = words.shape
+    acc = jnp.zeros((r, 32), dtype=jnp.float32)
+    for i in range(32):
+        plane = ((words >> i) & 1).astype(jnp.float32)   # (r, Wt)
+        acc = acc + jax.lax.dot_general(
+            plane, cmat32[i * wt:(i + 1) * wt].astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    return acc.astype(jnp.int32) & 1
+
+
 def bits_to_u32(bits: np.ndarray) -> np.ndarray:
     """(..., 32) 0/1 -> (...,) uint32, bit j = lsb weight 2^j."""
     weights = (1 << np.arange(32, dtype=np.uint64))

@@ -393,39 +393,61 @@ class ECBackend:
                 runs.append(logical.reshape(
                     nstripes, k, self.sinfo.chunk_size)
                     .transpose(1, 0, 2).reshape(k, -1))
-            # North-star fused path: a single appending extent gets
-            # parity + cumulative shard crcs from ONE kernel launch,
-            # seeded with the current hinfo state.
-            fused = None
-            if len(work) == 1 and hasattr(self.ec_impl,
-                                          "encode_chunks_with_crc"):
-                op, oid, e, _ = work[0]
-                hinfo = op.plan.hash_infos[oid]
-                chunk_off = self.sinfo.aligned_logical_offset_to_chunk_offset(
-                    e.off)
-                if chunk_off == hinfo.total_chunk_size:
-                    seeds = list(hinfo.cumulative_shard_hashes)
-                    parity, crcs = self.ec_impl.encode_chunks_with_crc(
-                        runs[0], seeds=seeds)
-                    fused = (np.asarray(parity), crcs)
-            if fused is not None:
-                parity, crcs = fused
-                big = runs[0]
-                op, oid, e, _ = work[0]
-                crcs_by_op[id(op)][(oid, e.off)] = crcs
+            # North-star fused path: every chunk-aligned appending extent
+            # of the WHOLE drain gets parity + cumulative shard crcs from
+            # one kernel launch, seeds chained per object across in-drain
+            # ops (round-1 restricted this to single-op drains — exactly
+            # not the batched case the pipeline exists for).  Non-append
+            # extents (overwrites) take the plain parity path: their
+            # incremental crc is invalidated anyway (generations work).
+            fused_idx: list[int] = []
+            plain_idx: list[int] = []
+            if hasattr(self.ec_impl, "encode_extents_with_crc"):
+                sim_size: dict[hobject_t, int] = {}
+                for i, ((op, oid, e, _), run) in enumerate(zip(work, runs)):
+                    hinfo = op.plan.hash_infos[oid]
+                    cur = sim_size.get(oid, hinfo.total_chunk_size)
+                    chunk_off = (self.sinfo
+                                 .aligned_logical_offset_to_chunk_offset(
+                                     e.off))
+                    if chunk_off == cur:
+                        fused_idx.append(i)
+                        sim_size[oid] = cur + run.shape[1]
+                    else:
+                        plain_idx.append(i)
             else:
-                big = np.concatenate(runs, axis=1) if len(runs) > 1 \
-                    else runs[0]
+                plain_idx = list(range(len(work)))
+            parities: dict[int, np.ndarray] = {}
+            if fused_idx:
+                results = self.ec_impl.encode_extents_with_crc(
+                    [runs[i] for i in fused_idx])
+                sim_hash: dict[hobject_t, list[int]] = {}
+                for i, (par, tls, tail, tile) in zip(fused_idx, results):
+                    op, oid, e, _ = work[i]
+                    hinfo = op.plan.hash_infos[oid]
+                    seeds = sim_hash.get(
+                        oid, list(hinfo.cumulative_shard_hashes))
+                    crcs = self.ec_impl.fold_extent_crcs(
+                        tls, tail, seeds, tile)
+                    sim_hash[oid] = crcs
+                    crcs_by_op[id(op)][(oid, e.off)] = crcs
+                    parities[i] = np.asarray(par)
+            if plain_idx:
+                plain_runs = [runs[i] for i in plain_idx]
+                big = np.concatenate(plain_runs, axis=1) \
+                    if len(plain_runs) > 1 else plain_runs[0]
                 parity = np.asarray(self.ec_impl.encode_chunks(big))
-            allshards = np.concatenate([big, parity], axis=0)
-            self.batched_launches += 1
+                col = 0
+                for i in plain_idx:
+                    width = runs[i].shape[1]
+                    parities[i] = parity[:, col:col + width]
+                    col += width
+            self.batched_launches += 1 + (1 if fused_idx and plain_idx
+                                          else 0)
             self.batched_extents += len(work)
-            col = 0
-            for (op, oid, e, _), run in zip(work, runs):
-                width = run.shape[1]
+            for i, ((op, oid, e, _), run) in enumerate(zip(work, runs)):
                 encoded_by_op[id(op)][(oid, e.off)] = \
-                    allshards[:, col:col + width]
-                col += width
+                    np.concatenate([run, parities[i]], axis=0)
 
         for op in ready:
             self._commit_op(op, encoded_by_op[id(op)],
