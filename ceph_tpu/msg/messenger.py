@@ -145,9 +145,17 @@ class Connection:
     async def _send(self, msg: Message) -> None:
         sess = self.session
         async with sess.send_lock:
+            if sess.broken:
+                # session lost frames (unacked overflow): this facade is
+                # done; Messenger.connect hands out a fresh session/nonce
+                self._closed = True
+                return
             sess.out_seq += 1
             raw = msg.encode(sess.out_seq)
             sess.record_out(sess.out_seq, raw)
+            if sess.broken:       # overflow tripped by this very frame
+                self._closed = True
+                return
             try:
                 if sess.writer is None:
                     if not self.can_reconnect:
@@ -156,7 +164,12 @@ class Connection:
                     if self.lossless:
                         return  # _connect's replay already carried raw
                 await self._write_raw(raw)
-            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+            except (ConnectionError, OSError, asyncio.TimeoutError,
+                    asyncio.IncompleteReadError, ValueError) as e:
+                # IncompleteReadError (EOF mid-HELLO) and ValueError
+                # (corrupt HELLO reply) must not escape: an unhandled
+                # reactor-task exception would strand the frame in
+                # sess.unacked with no reconnect scheduled
                 self.last_error = str(e)
                 await self._reconnect()
 
@@ -174,6 +187,10 @@ class Connection:
             self.session.drop_wire()
             raise ConnectionResetError("injected socket failure")
         writer = self.session.writer
+        if writer is None:
+            # wire dropped while we slept in the injected delay (the
+            # accepted-conn read loop nulls it without the send lock)
+            raise ConnectionResetError("wire dropped during delayed write")
         writer.write(raw)
         await writer.drain()
 
@@ -198,6 +215,13 @@ class Connection:
             raise ConnectionError(f"expected HELLO, got frame type {tid:#x}")
         meta = json.loads(meta_raw.decode())
         self.peer_entity = meta.get("entity")
+        if self.lossless and not meta.get("resumed", False):
+            # The server did not resume our session — it is a new
+            # incarnation (restart) or it pruned us; its out_seq space
+            # starts over at 0, so our dedup window must too, or we
+            # would silently drop its first in_seq frames as replays.
+            sess.in_seq = 0
+            sess.last_acked = 0
         sess.reader, sess.writer = reader, writer
         for raw in sess.replay_frames(int(meta.get("in_seq", 0))):
             writer.write(raw)
@@ -216,8 +240,8 @@ class Connection:
                 self.session.drop_wire()
                 await self._connect()
                 return
-            except (ConnectionError, OSError,
-                    asyncio.TimeoutError, ValueError) as e:
+            except (ConnectionError, OSError, asyncio.TimeoutError,
+                    asyncio.IncompleteReadError, ValueError) as e:
                 self.last_error = str(e)
         self._closed = True
 
@@ -346,11 +370,14 @@ class Messenger:
         lossless = bool(meta.get("lossless", True))
         nonce = str(meta.get("session", ""))
         self._prune_sessions()
+        resumed = False
         if lossless:
             sess = self._sessions.get(entity)
             if sess is None or sess.nonce != nonce:
                 sess = Session(lossless=True, nonce=nonce)
                 self._sessions[entity] = sess
+            else:
+                resumed = True
         else:
             sess = Session(lossless=False, nonce=nonce)
         sess.drop_wire()          # supersede any stale stream
@@ -366,7 +393,8 @@ class Messenger:
         self._accepted.append(conn)
         try:
             writer.write(encode_frame(CTRL_HELLO, 0, {
-                "entity": self.entity, "in_seq": sess.in_seq}))
+                "entity": self.entity, "in_seq": sess.in_seq,
+                "resumed": resumed}))
             for raw in sess.replay_frames(int(meta.get("in_seq", 0))):
                 writer.write(raw)
             await writer.drain()

@@ -166,6 +166,59 @@ async def _abort_wire(conn):
     conn.session.drop_wire()
 
 
+def test_server_restart_resets_dedup_window():
+    """A new server incarnation starts its seq space at 0; the client
+    must not drop its first replies as replays of the old session
+    (HELLO `resumed` flag resets the client's in_seq)."""
+    def echo(conn, msg):
+        conn.send_message(M.MOSDPing(msg.from_osd, is_reply=True))
+
+    server = Messenger("server")
+    server.add_dispatcher(echo)
+    addr = server.bind(("127.0.0.1", 0))
+    replies = []
+    client = Messenger("client")
+    client.add_dispatcher(lambda conn, msg: replies.append(msg.from_osd))
+    conn = client.connect(addr)
+    for i in range(20):
+        conn.send_message(M.MOSDPing(from_osd=i))
+    deadline = time.time() + 10
+    while len(replies) < 20 and time.time() < deadline:
+        time.sleep(0.01)
+    assert len(replies) == 20
+    server.shutdown()
+    # new incarnation on the same port
+    server2 = Messenger("server")
+    server2.add_dispatcher(echo)
+    server2.bind(addr)
+    for i in range(20, 40):
+        client.connect(addr).send_message(M.MOSDPing(from_osd=i))
+    deadline = time.time() + 10
+    while len(replies) < 40 and time.time() < deadline:
+        time.sleep(0.02)
+    assert sorted(set(replies)) == list(range(40)), \
+        f"client saw {len(replies)} replies, lost {set(range(40)) - set(replies)}"
+    server2.shutdown()
+    client.shutdown()
+
+
+def test_broken_session_replaced_with_new_nonce():
+    """After an unacked-window overflow the session is abandoned: the
+    facade closes and Messenger.connect hands out a fresh session."""
+    client = Messenger("client")
+    addr = ("127.0.0.1", 1)        # never dialed in this test
+    conn = client.connect(addr)
+    old_nonce = conn.session.nonce
+    conn.session.broken = True
+    client._run_sync(conn._send(M.MOSDPing(from_osd=0)))
+    assert conn._closed
+    conn2 = client.connect(addr)
+    assert conn2 is not conn
+    assert conn2.session is not conn.session
+    assert conn2.session.nonce != old_nonce
+    client.shutdown()
+
+
 def test_large_payload():
     got = []
     server = Messenger("server")
