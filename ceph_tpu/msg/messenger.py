@@ -74,6 +74,14 @@ class Session:
         # discard its old seq window instead of dedup-dropping the fresh
         # one (reference ProtocolV2 client_cookie semantics).
         self.nonce = nonce or uuid.uuid4().hex[:12]
+        # Epoch cookies (reference ProtocolV2 client_cookie/server_cookie):
+        # local_cookie identifies THIS session object; peer_cookie is the
+        # last cookie seen from the peer.  A seq number is only meaningful
+        # within the epoch whose cookie it was learned under — trusting a
+        # stale in_seq would trim undelivered frames from the peer's
+        # replay window (observed as lost replies across server restarts).
+        self.local_cookie = uuid.uuid4().hex[:12]
+        self.peer_cookie: str | None = None
         self.out_seq = 0          # last seq assigned to an outgoing frame
         self.in_seq = 0           # highest seq delivered to the dispatcher
         self.unacked: collections.deque[tuple[int, bytes]] = \
@@ -84,6 +92,23 @@ class Session:
         self.broken = False
         self.down_since: float | None = None
         self.last_acked = 0       # highest seq we have acked to the peer
+
+    def reset_epoch(self) -> None:
+        """Abandon this session's delivery state and start a fresh epoch
+        in place: new nonce (receiver will not dedup against the old seq
+        space) and new cookie (peer resets its dedup window).  Used to
+        self-heal after an unacked-window overflow so callers holding a
+        cached Connection keep working (at-least-once across the reset;
+        the overflow already lost the old window)."""
+        self.nonce = uuid.uuid4().hex[:12]
+        self.local_cookie = uuid.uuid4().hex[:12]
+        self.peer_cookie = None
+        self.out_seq = 0
+        self.in_seq = 0
+        self.last_acked = 0
+        self.unacked.clear()
+        self.broken = False
+        self.drop_wire()
 
     def record_out(self, seq: int, raw: bytes) -> None:
         if self.lossless:
@@ -146,16 +171,21 @@ class Connection:
         sess = self.session
         async with sess.send_lock:
             if sess.broken:
-                # session lost frames (unacked overflow): this facade is
-                # done; Messenger.connect hands out a fresh session/nonce
-                self._closed = True
-                return
+                if not self.can_reconnect:
+                    # accepted side cannot dial; the peer's next
+                    # reconnect gets a fresh session (see _on_accept)
+                    return
+                sess.reset_epoch()
             sess.out_seq += 1
             raw = msg.encode(sess.out_seq)
             sess.record_out(sess.out_seq, raw)
             if sess.broken:       # overflow tripped by this very frame
-                self._closed = True
-                return
+                if not self.can_reconnect:
+                    return
+                sess.reset_epoch()          # carry this frame into the
+                sess.out_seq = 1            # fresh epoch
+                raw = msg.encode(1)
+                sess.record_out(1, raw)
             try:
                 if sess.writer is None:
                     if not self.can_reconnect:
@@ -204,6 +234,7 @@ class Connection:
             "entity": self.messenger.entity,
             "session": sess.nonce,
             "in_seq": sess.in_seq,
+            "peer_cookie": sess.peer_cookie,
             "lossless": self.lossless,
         })
         writer.write(hello)
@@ -215,13 +246,15 @@ class Connection:
             raise ConnectionError(f"expected HELLO, got frame type {tid:#x}")
         meta = json.loads(meta_raw.decode())
         self.peer_entity = meta.get("entity")
-        if self.lossless and not meta.get("resumed", False):
-            # The server did not resume our session — it is a new
-            # incarnation (restart) or it pruned us; its out_seq space
-            # starts over at 0, so our dedup window must too, or we
-            # would silently drop its first in_seq frames as replays.
+        cookie = meta.get("cookie")
+        if self.lossless and cookie != sess.peer_cookie:
+            # New server-side session epoch (restart, prune, or we never
+            # saw this session's first reply): its out_seq space starts
+            # over at 0, so our dedup window must too, or we would
+            # silently drop its first in_seq frames as replays.
             sess.in_seq = 0
             sess.last_acked = 0
+            sess.peer_cookie = cookie
         sess.reader, sess.writer = reader, writer
         for raw in sess.replay_frames(int(meta.get("in_seq", 0))):
             writer.write(raw)
@@ -370,14 +403,14 @@ class Messenger:
         lossless = bool(meta.get("lossless", True))
         nonce = str(meta.get("session", ""))
         self._prune_sessions()
-        resumed = False
         if lossless:
             sess = self._sessions.get(entity)
-            if sess is None or sess.nonce != nonce:
+            # a broken session (unacked overflow) must not be resumed:
+            # its _send path drops frames, so hand out a fresh one — the
+            # new cookie makes the client reset its dedup window
+            if sess is None or sess.nonce != nonce or sess.broken:
                 sess = Session(lossless=True, nonce=nonce)
                 self._sessions[entity] = sess
-            else:
-                resumed = True
         else:
             sess = Session(lossless=False, nonce=nonce)
         sess.drop_wire()          # supersede any stale stream
@@ -394,8 +427,13 @@ class Messenger:
         try:
             writer.write(encode_frame(CTRL_HELLO, 0, {
                 "entity": self.entity, "in_seq": sess.in_seq,
-                "resumed": resumed}))
-            for raw in sess.replay_frames(int(meta.get("in_seq", 0))):
+                "cookie": sess.local_cookie}))
+            # The client's in_seq only counts frames of THIS session
+            # epoch if it has seen our cookie; a stale epoch's in_seq
+            # must trim nothing or undelivered replies would be lost.
+            peer_in = int(meta.get("in_seq", 0)) \
+                if meta.get("peer_cookie") == sess.local_cookie else 0
+            for raw in sess.replay_frames(peer_in):
                 writer.write(raw)
             await writer.drain()
         except (ConnectionError, OSError):
