@@ -47,31 +47,20 @@ def time_encode_cpu(codec, chunks, min_iters=5, min_time=2.0):
     return iters * SIZE / (time.perf_counter() - t0)
 
 
-def time_encode_jax(codec):
-    """Chained fori_loop slope timing of the device-resident encode."""
+def _slope_time(step, x0, rows):
+    """Chained fori_loop slope timing: `step(x)` returns (rows, W); each
+    iteration XORs the result back into x's first `rows` rows so no two
+    iterations are identical (defeats runtime elision/caching — see
+    module docstring).  Returns bytes/sec over BATCH*SIZE per iter."""
     import jax
-    import jax.numpy as jnp
     from jax import lax
-
-    on_tpu = jax.default_backend() != "cpu"
-    k, m, n = K, M, SIZE // K
-    rng = np.random.default_rng(0)
-    flat = rng.integers(0, 256, (k, BATCH * n), dtype=np.uint8)
-
-    if on_tpu:
-        x0 = jnp.asarray(flat.view(np.int32))        # word-packed path
-        enc = codec.encode_words
-    else:
-        x0 = jnp.asarray(flat)
-        enc = codec.encode_chunks_device
-    enc(x0)                                          # build bitmats eagerly
 
     def make(iters):
         @jax.jit
         def f(x):
             def body(i, x):
-                p = enc(x)
-                return x.at[:m, :].set(x[:m, :] ^ p)
+                r = step(x)
+                return x.at[:rows, :].set(x[:rows, :] ^ r)
             return lax.fori_loop(0, iters, body, x)
         return f
 
@@ -92,6 +81,58 @@ def time_encode_jax(codec):
             f"non-positive slope dt={dt}: timing elided or too noisy "
             f"(lo={min(lo):.4f}s hi={min(hi):.4f}s)")
     return BATCH * SIZE / dt
+
+
+def time_encode_jax(codec):
+    """Slope-timed device-resident encode (see _slope_time)."""
+    import jax
+    import jax.numpy as jnp
+
+    on_tpu = jax.default_backend() != "cpu"
+    k, m, n = K, M, SIZE // K
+    rng = np.random.default_rng(0)
+    flat = rng.integers(0, 256, (k, BATCH * n), dtype=np.uint8)
+
+    if on_tpu:
+        x0 = jnp.asarray(flat.view(np.int32))        # word-packed path
+        enc = codec.encode_words
+    else:
+        x0 = jnp.asarray(flat)
+        enc = codec.encode_chunks_device
+    enc(x0)                                          # build bitmats eagerly
+    return _slope_time(enc, x0, m)
+
+
+def time_decode_jax(codec, erasures):
+    """Slope-timed device-resident decode.
+
+    Mirrors the reference decode benchmark (`-w decode -e 1/2/3`,
+    src/erasure-code/isa/README): erase the first `erasures` chunks,
+    reconstruct them from k survivors.  Input accounting matches the
+    reference (bytes of the original object per iteration).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    on_tpu = jax.default_backend() != "cpu"
+    k, m, n = K, M, SIZE // K
+    erased = tuple(range(erasures))
+    survivors = tuple(i for i in range(k + m) if i not in erased)[:k]
+    rng = np.random.default_rng(1)
+    flat = rng.integers(0, 256, (k, BATCH * n), dtype=np.uint8)
+
+    if on_tpu:
+        x0 = jnp.asarray(flat.view(np.int32))
+        def dec(x):
+            return codec.decode_words(x, survivors, erased)
+    else:
+        from ceph_tpu.ops import bitsliced as bs
+        x0 = jnp.asarray(flat)
+        bitmat = codec._decode_plan(survivors, erased)[1]
+        def dec(x):
+            return bs.gf_bitmatmul(bitmat, x, len(erased))
+    dec(x0)                                          # build decode plan
+    return _slope_time(dec, x0, erasures)
 
 
 def main():
@@ -121,19 +162,42 @@ def main():
         except Exception as e:  # noqa: BLE001
             print(f"# cpu plugin {plugin} failed: {e}", file=sys.stderr)
 
+    error = None
     try:
         value = time_encode_jax(jax_codec)
     except Exception as e:  # noqa: BLE001
         print(f"# jax encode failed: {e}", file=sys.stderr)
-        value = 0.0
+        value, error = 0.0, f"encode: {e}"
+
+    # decode-1/2/3 tracked alongside the headline (BASELINE.json
+    # north_star; reference `-w decode -e 1/2/3`)
+    extras = {}
+    for e_count in (1, 2, 3):
+        try:
+            extras[f"decode{e_count}_GBps"] = round(
+                time_decode_jax(jax_codec, e_count) / 1e9, 3)
+        except Exception as e:  # noqa: BLE001
+            print(f"# jax decode-{e_count} failed: {e}", file=sys.stderr)
+            extras[f"decode{e_count}_GBps"] = None
+            if error is None:
+                error = f"decode-{e_count}: {e}"
 
     out = {
         "metric": "ec_encode_k8_m3_1MiB",
         "value": round(value / 1e9, 3),
         "unit": "GB/s",
         "vs_baseline": round(value / cpu_best, 3) if cpu_best else None,
+        # numerator is device-resident batched slope timing; denominator
+        # is per-call synchronous CPU encode (includes Python dispatch) —
+        # see BASELINE.md for the methodology note
+        "baseline_method": "cpu_per_call_sync",
+        **extras,
     }
+    if error is not None:
+        out["error"] = error
     print(json.dumps(out))
+    if error is not None:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

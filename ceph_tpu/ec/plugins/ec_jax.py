@@ -223,6 +223,19 @@ class ErasureCodeJax(ErasureCode):
             self._decode_cache[key] = plan
         return plan
 
+    def decode_words(self, words, survivors, targets):
+        """Device-resident word-packed decode: `words` is the survivors'
+        packed chunk bytes (len(survivors)=k, W) int32; returns the
+        reconstructed `targets` shards (len(targets), W) int32.  Same
+        kernel as encode_words with the inverted bitmatrix — the repair
+        hot loop (reference ECUtil::decode, src/osd/ECUtil.cc:9)."""
+        bs = _ops()
+        if not self._use_w32:
+            raise RuntimeError("decode_words requires a TPU backend; "
+                               "use decode_chunks on CPU")
+        _, bitmat = self._decode_plan(tuple(survivors), tuple(targets))
+        return bs.gf_bitmatmul_w32(bitmat, words, len(targets))
+
     def decode_chunks(self, dense: np.ndarray, erasures) -> np.ndarray:
         n = self.get_chunk_count()
         erased = tuple(sorted(set(erasures)))

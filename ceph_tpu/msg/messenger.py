@@ -490,6 +490,11 @@ class Messenger:
         try:
             while not conn._closed and reader is sess.reader:
                 tid, seq, meta_raw, data, pcrc = await read_frame(reader)
+                if reader is not sess.reader:
+                    # epoch reset while we were blocked in read_frame: a
+                    # buffered old-epoch frame must not touch the fresh
+                    # epoch's seq window (in_seq poisoning)
+                    break
                 if tid == CTRL_ACK:
                     sess.trim_acked(seq)
                     continue
