@@ -126,11 +126,9 @@ def time_decode_jax(codec, erasures):
         def dec(x):
             return codec.decode_words(x, survivors, erased)
     else:
-        from ceph_tpu.ops import bitsliced as bs
         x0 = jnp.asarray(flat)
-        bitmat = codec._decode_plan(survivors, erased)[1]
         def dec(x):
-            return bs.gf_bitmatmul(bitmat, x, len(erased))
+            return codec.decode_chunks_device(x, survivors, erased)
     dec(x0)                                          # build decode plan
     return _slope_time(dec, x0, erasures)
 

@@ -284,3 +284,20 @@ def cauchy_rs_matrix(k: int, m: int) -> np.ndarray:
         for j in range(k):
             g[k + i, j] = gf_inv((k + i) ^ j)
     return g
+
+
+def recovery_matrix(matrix: np.ndarray, k: int, survivors, targets
+                    ) -> np.ndarray:
+    """(len(targets), k) GF(2^8) coefficients rebuilding `targets` shards
+    from the k `survivors` rows of the systematic generator `matrix`
+    ((k+m, k)).  Shared by the single-chip plugin decode plan and the
+    mesh codec's distributed repair (reference ECUtil::decode inversion,
+    src/osd/ECUtil.cc:9; ISA-L decode tables, ErasureCodeIsa.cc:385)."""
+    inv = gf_invert_matrix(matrix[list(survivors), :])
+    rows = []
+    for t in targets:
+        if t < k:
+            rows.append(inv[t])
+        else:
+            rows.append(gf_matmul(matrix[t:t + 1], inv)[0])
+    return np.stack(rows).astype(np.uint8)

@@ -205,14 +205,7 @@ class ErasureCodeJax(ErasureCode):
             return hit
         import jax.numpy as jnp
         bs = _ops()
-        inv = gf.gf_invert_matrix(self.matrix[list(survivors), :])
-        rows = []
-        for t in targets:
-            if t < self.k:
-                rows.append(inv[t])
-            else:
-                rows.append(gf.gf_matmul(self.matrix[t:t + 1], inv)[0])
-        coeff = np.stack(rows).astype(np.uint8)
+        coeff = gf.recovery_matrix(self.matrix, self.k, survivors, targets)
         if self._use_w32:
             bitmat = jnp.asarray(bs._w32_bitmat(coeff), dtype=jnp.int8)
         else:
@@ -235,6 +228,17 @@ class ErasureCodeJax(ErasureCode):
                                "use decode_chunks on CPU")
         _, bitmat = self._decode_plan(tuple(survivors), tuple(targets))
         return bs.gf_bitmatmul_w32(bitmat, words, len(targets))
+
+    def decode_chunks_device(self, chunks, survivors, targets):
+        """Device-resident byte-path decode (CPU/XLA twin of
+        decode_words): `chunks` (k, N) survivor rows in `survivors`
+        order -> reconstructed (len(targets), N).  Public entry for
+        benchmarks/pipelines holding device arrays."""
+        bs = _ops()
+        if self._use_w32:
+            raise RuntimeError("backend is w32 (TPU): use decode_words")
+        _, bitmat = self._decode_plan(tuple(survivors), tuple(targets))
+        return bs.gf_bitmatmul(bitmat, chunks, len(tuple(targets)))
 
     def decode_chunks(self, dense: np.ndarray, erasures) -> np.ndarray:
         n = self.get_chunk_count()

@@ -127,7 +127,8 @@ class ECOp:
 
 class ECBackend:
     def __init__(self, ec_impl: ErasureCodeInterface, sinfo: StripeInfo,
-                 shards: ShardBackend, log: PGLog | None = None):
+                 shards: ShardBackend, log: PGLog | None = None,
+                 mesh_codec=None):
         self.ec_impl = ec_impl
         self.sinfo = sinfo
         self.shards = shards
@@ -135,6 +136,19 @@ class ECBackend:
         self.m = ec_impl.get_coding_chunk_count()
         self.n = ec_impl.get_chunk_count()
         assert sinfo.k == self.k
+        # Optional multi-chip data plane (parallel.DistributedStripeCodec):
+        # when set, batched drains and repair decodes dispatch to the
+        # sharded collective program instead of the single-chip codec.
+        self.mesh_codec = mesh_codec
+        if mesh_codec is not None:
+            assert (mesh_codec.k, mesh_codec.m) == (self.k, self.m), \
+                "mesh codec geometry must match the EC profile"
+            # technique must match too: cauchy parity written by the mesh
+            # is garbage to a reed_sol_van plugin's decode matrix
+            impl_matrix = getattr(ec_impl, "matrix", None)
+            assert impl_matrix is None or \
+                np.array_equal(mesh_codec.matrix, impl_matrix), \
+                "mesh codec generator matrix must match the plugin's"
         self.log = log or PGLog()
         self.lock = threading.RLock()
         self.waiting_state: list[ECOp] = []
@@ -402,7 +416,12 @@ class ECBackend:
             # incremental crc is invalidated anyway (generations work).
             fused_idx: list[int] = []
             plain_idx: list[int] = []
-            if hasattr(self.ec_impl, "encode_extents_with_crc"):
+            if self.mesh_codec is not None:
+                # multi-chip drain: the whole batch goes through the
+                # sharded collective program; crc folds on host (the
+                # fused in-kernel crc is a single-chip formulation)
+                plain_idx = list(range(len(work)))
+            elif hasattr(self.ec_impl, "encode_extents_with_crc"):
                 sim_size: dict[hobject_t, int] = {}
                 for i, ((op, oid, e, _), run) in enumerate(zip(work, runs)):
                     hinfo = op.plan.hash_infos[oid]
@@ -436,7 +455,10 @@ class ECBackend:
                 plain_runs = [runs[i] for i in plain_idx]
                 big = np.concatenate(plain_runs, axis=1) \
                     if len(plain_runs) > 1 else plain_runs[0]
-                parity = np.asarray(self.ec_impl.encode_chunks(big))
+                if self.mesh_codec is not None:
+                    parity = self.mesh_codec.encode_flat(big)
+                else:
+                    parity = np.asarray(self.ec_impl.encode_chunks(big))
                 col = 0
                 for i in plain_idx:
                     width = runs[i].shape[1]
@@ -578,11 +600,20 @@ class ECBackend:
         if len(got) < self.k:
             raise ErasureCodeError(5, f"cannot recover {oid}: "
                                    f"{len(got)} < k={self.k}")
-        dense = np.zeros((self.n, chunk_len), dtype=np.uint8)
-        for s, d in got.items():
-            dense[s] = d
-        erasures = [s for s in range(self.n) if s not in got]
-        rebuilt = self.ec_impl.decode_chunks(dense, erasures)
+        if self.mesh_codec is not None:
+            # distributed repair: survivor rows shard over the mesh,
+            # the rebuild is the sharded inverted-matrix contraction
+            survivors = tuple(sorted(got))[: self.k]
+            avail = np.stack([got[s] for s in survivors])
+            rebuilt_rows = self.mesh_codec.decode_flat(
+                avail, survivors, tuple(missing))
+            rebuilt = {s: rebuilt_rows[i] for i, s in enumerate(missing)}
+        else:
+            dense = np.zeros((self.n, chunk_len), dtype=np.uint8)
+            for s, d in got.items():
+                dense[s] = d
+            erasures = [s for s in range(self.n) if s not in got]
+            rebuilt = self.ec_impl.decode_chunks(dense, erasures)
         for s in missing:
             data = rebuilt[s]
             # verify against stored hinfo (reference handle_sub_read crc

@@ -2,23 +2,39 @@
 
 Where the reference scales with CRUSH placement over OSD hosts and ships
 shard writes over its async messenger (reference src/osd/ECBackend.cc:2074
-MOSDECSubOpWrite fan-out), the TPU-native data plane scales over a
-`jax.sharding.Mesh` with XLA collectives riding ICI:
+MOSDECSubOpWrite fan-out; recovery fan-in :570), the TPU-native data
+plane scales over a `jax.sharding.Mesh` with XLA collectives riding ICI:
 
   axis 'shard' — tensor-parallel over the k data chunks.  Each device
-      holds a slice of the data chunks and the matching columns of the
-      generator bit-matrix, computes a *partial* bit-product, and a
-      `psum` over 'shard' followed by mod-2 completes the GF(2) sum —
-      XOR-reduction expressed as an integer all-reduce, which is exactly
-      how a parity fan-in over the messenger becomes a collective.
-  axis 'data' — data-parallel over the stripe batch (and the byte axis),
-      no communication: stripes are independent, like separate PGs.
+      holds a slice of the chunk rows and the matching *columns* of the
+      generator bit-matrix, runs the SAME fused Pallas kernel the
+      single-chip path uses on its slice, and the cross-device GF(2)
+      fan-in is an `all_gather` + XOR fold of the packed partial
+      parities (mod-2 commutes with the sum, so per-device parities XOR
+      to the total — the parity fan-in a messenger would carry becomes
+      one collective of exactly the parity bytes).
+  axis 'data' — data-parallel over the byte/stripe axis, no
+      communication: stripes are independent, like separate PGs.
 
-This module is deliberately shape-static and jit-clean: one compiled
-program per (k, m, batch-geometry), reused across the write pipeline.
+Round 1 shipped a psum-of-unpacked-bitplanes fan-in; that moves 32x the
+parity bytes over ICI (8 bit-planes x int32) and forces the pack out of
+the kernel.  The XOR-of-packed fold moves (n_shard-1) x m x W bytes and
+lets each device run the full w32 Pallas kernel locally — both encode
+and decode ride the headline kernel now.
+
+Decode/repair is the same contraction with the inverted matrix: the k
+survivor rows shard over 'shard', each device applies its column slice
+of the (targets x k) recovery matrix, XOR fold completes the rebuild
+(reference ECBackend recovery reads k shards to the primary and decodes
+locally; here the gather IS the collective).
+
+Everything is shape-static and jit-clean: one compiled program per
+(r, geometry), cached; `jax.jit` re-specializes per byte-width.
 """
 
 from __future__ import annotations
+
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -27,6 +43,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ec import gf
 from ..ops import bitsliced
+
+LANE = bitsliced.LANE
 
 
 def make_mesh(n_shard: int, n_data: int, devices=None) -> Mesh:
@@ -41,76 +59,192 @@ def make_mesh(n_shard: int, n_data: int, devices=None) -> Mesh:
 class DistributedStripeCodec:
     """Sharded batched RS encode/decode over a device mesh.
 
-    The flagship distributed computation: stripes (B, k, C) arrive
-    sharded B-over-'data'; data chunks are split k-over-'shard'; parity
-    comes back sharded like the batch and replicated over 'shard'.
+    The flagship distributed computation.  Two entry families:
+
+      encode_flat / decode_flat — (k, W) chunk rows, the OSD pipeline's
+          native drain layout (ECBackend concatenates every extent of
+          every in-flight transaction along the byte axis);
+      encode — (B, k, C) stripe batches (benchmarks, tests).
+
+    `use_w32` selects the word-packed Pallas kernel (the single-chip
+    headline path) inside each device's shard of the contraction; the
+    byte/XLA formulation remains for CPU meshes (the driver's virtual
+    8-device dry run) and as the oracle.  `interpret=True` runs the w32
+    Pallas kernel in interpret mode so the word-packed mesh path is
+    exercised on CPU CI too.
     """
 
     def __init__(self, k: int, m: int, mesh: Mesh,
-                 technique: str = "cauchy"):
+                 technique: str = "cauchy",
+                 use_w32: bool | None = None,
+                 interpret: bool | None = None):
         self.k, self.m, self.mesh = k, m, mesh
-        n_shard = mesh.shape["shard"]
-        if k % n_shard:
-            raise ValueError(f"k={k} not divisible by shard axis {n_shard}")
-        self.k_local = k // n_shard
+        on_cpu = jax.default_backend() == "cpu"
+        self.use_w32 = use_w32 if use_w32 is not None else not on_cpu
+        self.interpret = interpret if interpret is not None else on_cpu
+        self.n_shard = mesh.shape["shard"]
+        self.n_data = mesh.shape["data"]
+        if k % self.n_shard:
+            raise ValueError(
+                f"k={k} not divisible by shard axis {self.n_shard}")
+        self.k_local = k // self.n_shard
         self.matrix = (gf.cauchy_rs_matrix(k, m) if technique == "cauchy"
                        else gf.vandermonde_rs_matrix(k, m))
-        coding = self.matrix[k:]
-        # Per-device interleaved bitmatrix: device s gets the columns for
-        # its k_local chunks, stacked on a leading 'shard'-sharded axis.
-        mats = [bitsliced.interleave_bitmatrix(
-                    np.ascontiguousarray(
-                        coding[:, s * self.k_local:(s + 1) * self.k_local]))
-                for s in range(n_shard)]
-        stacked = np.stack(mats).astype(np.int8)   # (n_shard, 8m, 8k_local)
-        self.bitmats = jax.device_put(
-            stacked, NamedSharding(mesh, P("shard", None, None)))
-        self._encode = self._build_encode()
+        self.enc_bitmats = self._column_bitmats(self.matrix[k:])
+        self._apply_cache: dict[int, object] = {}
+        self._decode_plans: dict[tuple, object] = {}
 
-    def _build_encode(self):
-        m = self.m
-        k_local = self.k_local
-        mesh = self.mesh
+    # -- bitmatrix plumbing -------------------------------------------------
 
-        def local_encode(bitmat, chunks):
-            # bitmat (1, 8m, 8k_local); chunks (k_local, b_local, C)
-            kl, b, c = chunks.shape
-            flat = chunks.reshape(kl, b * c)
-            bits = bitsliced._unpack_bits(flat)          # (8k_local, b*C)
-            partial = jax.lax.dot_general(
-                bitmat[0], bits,
-                dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.int32,
-            )
-            total = jax.lax.psum(partial, "shard") & 1   # GF(2) fan-in
-            parity = bitsliced._pack_bits(total, m)      # (m, b*C)
-            return parity.reshape(m, b, c).transpose(1, 0, 2)
+    def _column_bitmats(self, coeff: np.ndarray):
+        """(r, k) GF(2^8) matrix -> device-put stack of per-shard column
+        slices in the kernel's layout: device s gets the columns for its
+        k_local chunk rows ((n_shard, 32r, 32k_local) w32 or
+        (n_shard, 8r, 8k_local) byte), 'shard'-sharded on dim 0."""
+        build = bitsliced._w32_bitmat if self.use_w32 \
+            else bitsliced.interleave_bitmatrix
+        mats = [build(np.ascontiguousarray(
+                    coeff[:, s * self.k_local:(s + 1) * self.k_local]))
+                for s in range(self.n_shard)]
+        stacked = np.stack(mats).astype(np.int8)
+        return jax.device_put(
+            stacked, NamedSharding(self.mesh, P("shard", None, None)))
 
-        shard_fn = jax.shard_map(
-            local_encode, mesh=mesh,
-            in_specs=(P("shard", None, None), P("shard", "data", None)),
-            out_specs=P("data", None, None),
-        )
-        return jax.jit(shard_fn)
+    def _sharded_apply(self, r: int):
+        """shard_map'd contraction for r output rows: local kernel on
+        each device's (k_local, W_local) slice, all_gather + XOR fold
+        over 'shard'.  Cached per r; jit respecializes per width."""
+        fn = self._apply_cache.get(r)
+        if fn is not None:
+            return fn
+        n_shard = self.n_shard
+        use_w32, interpret = self.use_w32, self.interpret
+
+        def local(bitmat, x):
+            # bitmat (1, R, C); x (k_local, W_local)
+            if use_w32:
+                part = bitsliced.gf_bitmatmul_pallas_w32(
+                    bitmat[0], x, r,
+                    tile=4 * bitsliced._pick_wt(x.shape[1]),
+                    interpret=interpret)
+            else:
+                part = bitsliced.gf_bitmatmul_xla(bitmat[0], x, r)
+            gath = jax.lax.all_gather(part, "shard")   # (n_shard, r, W)
+            return functools.reduce(
+                jnp.bitwise_xor, [gath[i] for i in range(n_shard)])
+
+        # check_vma=False: the checker can't statically infer that the
+        # XOR fold of an all_gather over 'shard' is 'shard'-replicated
+        # (it is: every member folds the same gathered operands)
+        fn = jax.jit(jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(P("shard", None, None), P("shard", "data")),
+            out_specs=P(None, "data"), check_vma=False))
+        self._apply_cache[r] = fn
+        return fn
+
+    def _quantum(self) -> int:
+        """Byte-axis pad quantum: every device slice must be a LANE
+        multiple (words for w32, bytes otherwise)."""
+        per_dev = LANE * 4 if self.use_w32 else LANE
+        return self.n_data * per_dev
+
+    def _apply_flat(self, bitmats, rows: np.ndarray, r: int) -> np.ndarray:
+        """rows (j, W) uint8 (j = k data rows or k survivor rows) ->
+        (r, W) uint8 via the sharded contraction."""
+        rows = np.ascontiguousarray(rows, dtype=np.uint8)
+        j, w = rows.shape
+        pad = -w % self._quantum()
+        if pad:
+            rows = np.pad(rows, ((0, 0), (0, pad)))
+        if self.use_w32:
+            x = rows.view("<u4").view(np.int32)
+        else:
+            x = rows
+        x = jax.device_put(
+            jnp.asarray(x), NamedSharding(self.mesh, P("shard", "data")))
+        out = np.asarray(self._sharded_apply(r)(bitmats, x))
+        if self.use_w32:
+            out = out.view("<u4").view(np.uint8).reshape(r, w + pad)
+        return out[:, :w] if pad else out
+
+    # -- device-resident entry (no host round-trip) -------------------------
+
+    def apply_words(self, bitmats, words, r: int):
+        """Fully device-resident contraction for callers that keep the
+        data plane on device (benchmarks, chained pipelines): `words`
+        (k, W) i32, already 'shard'x'data'-sharded or not (jit will
+        reshard), W divisible by the device quantum.  Returns the
+        (r, W) i32 result as a device array — zero host traffic.
+        w32 codecs only (the device layout IS the word layout)."""
+        if not self.use_w32:
+            raise RuntimeError("apply_words requires a w32 mesh codec")
+        assert words.shape[1] % (self.n_data * LANE) == 0
+        return self._sharded_apply(r)(bitmats, words)
+
+    def encode_words(self, words):
+        """Device-resident sharded encode: (k, W) i32 -> (m, W) i32."""
+        return self.apply_words(self.enc_bitmats, words, self.m)
+
+    # -- encode (host byte API: the OSD pipeline entry) ---------------------
+
+    def encode_flat(self, chunks: np.ndarray) -> np.ndarray:
+        """(k, W) uint8 data rows -> (m, W) parity.  The OSD pipeline
+        entry: ECBackend hands the whole batched drain here when a mesh
+        is configured (reference analog: the per-shard MOSDECSubOpWrite
+        fan-out, ECBackend.cc:2074, as one collective program)."""
+        assert chunks.shape[0] == self.k
+        return self._apply_flat(self.enc_bitmats, chunks, self.m)
 
     def encode(self, stripes):
-        """stripes (B, k, C) uint8 (any sharding) -> parity (B, m, C).
+        """stripes (B, k, C) uint8 -> parity (B, m, C): batch and byte
+        axes ride 'data' together via the flat layout."""
+        stripes = np.ascontiguousarray(stripes, dtype=np.uint8)
+        b, k, c = stripes.shape
+        assert k == self.k
+        flat = stripes.transpose(1, 0, 2).reshape(k, b * c)
+        par = self.encode_flat(flat)
+        return par.reshape(self.m, b, c).transpose(1, 0, 2)
 
-        Input is laid out (k, B, C) internally so the chunk axis shards
-        over 'shard'; callers holding already-sharded device arrays skip
-        the relayout.
-        """
-        stripes = jnp.asarray(stripes, dtype=jnp.uint8)
-        n_data = self.mesh.shape["data"]
-        if stripes.shape[0] % n_data:
-            raise ValueError(
-                f"stripe batch {stripes.shape[0]} not divisible by 'data' "
-                f"mesh axis {n_data}")
-        chunks_first = jnp.transpose(stripes, (1, 0, 2))
-        chunks_first = jax.device_put(
-            chunks_first,
-            NamedSharding(self.mesh, P("shard", "data", None)))
-        return self._encode(self.bitmats, chunks_first)
+    # -- decode / repair ----------------------------------------------------
+
+    def _decode_bitmats(self, survivors: tuple[int, ...],
+                        targets: tuple[int, ...]):
+        """Column-sharded bitmats of the (targets x survivors) recovery
+        matrix (reference ECUtil::decode inversion, ECUtil.cc:9; the
+        ISA-L table-cache role for the mesh)."""
+        key = (survivors, targets)
+        hit = self._decode_plans.get(key)
+        if hit is not None:
+            return hit
+        coeff = gf.recovery_matrix(self.matrix, self.k, survivors, targets)
+        mats = self._column_bitmats(coeff)
+        self._decode_plans[key] = mats
+        return mats
+
+    def decode_flat(self, avail: np.ndarray, survivors, targets
+                    ) -> np.ndarray:
+        """Distributed reconstruct: `avail` (k, W) holds the survivor
+        shards' bytes in `survivors` order; returns the rebuilt `targets`
+        shards (len(targets), W).  Survivor rows shard over 'shard', so
+        repair reads stay distributed end to end (reference
+        continue_recovery_op gathers k shards to one node instead)."""
+        survivors = tuple(survivors)
+        targets = tuple(targets)
+        if len(survivors) != self.k:
+            raise ValueError(f"need exactly k={self.k} survivors")
+        mats = self._decode_bitmats(survivors, targets)
+        return self._apply_flat(mats, avail, len(targets))
+
+    def decode(self, stripes_avail, survivors, targets):
+        """(B, k, C) survivor stripes -> (B, len(targets), C)."""
+        a = np.ascontiguousarray(stripes_avail, dtype=np.uint8)
+        b, k, c = a.shape
+        flat = a.transpose(1, 0, 2).reshape(k, b * c)
+        out = self.decode_flat(flat, survivors, targets)
+        return out.reshape(len(tuple(targets)), b, c).transpose(1, 0, 2)
+
+    # -- oracle -------------------------------------------------------------
 
     def encode_reference(self, stripes) -> np.ndarray:
         """Single-host oracle for tests."""
