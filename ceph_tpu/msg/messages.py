@@ -200,22 +200,29 @@ class MOSDOpReply(Message):
 @register_message
 class MOSDECSubOpWrite(Message):
     """Primary -> shard write (reference MOSDECSubOpWrite.h carrying
-    ECSubWrite: shard transaction + version, ECMsgTypes.h)."""
+    ECSubWrite: shard transaction + version + log entries + committed
+    bound, ECMsgTypes.h:38 — log_entries ride the sub-write so the data
+    and its history land in one shard transaction)."""
 
     type_id = 108
 
     def __init__(self, pgid: spg_t, tid: int, at_version: eversion_t,
-                 txn: Transaction):
+                 txn: Transaction, log_entries: list | None = None,
+                 rollforward_to: eversion_t | None = None):
         super().__init__()
         self.pgid, self.tid, self.at_version, self.txn = \
             pgid, tid, at_version, txn
+        self.log_entries = log_entries or []    # wire lists (entry_to_wire)
+        self.rollforward_to = rollforward_to
 
     def to_meta(self):
         ops, blob = txn_to_wire(self.txn)
         self._blob = blob
+        rf = self.rollforward_to
         return {"pgid": spg_to_json(self.pgid), "tid": self.tid,
                 "v": [self.at_version.epoch, self.at_version.version],
-                "ops": ops}
+                "ops": ops, "log": self.log_entries,
+                "rf": [rf.epoch, rf.version] if rf is not None else None}
 
     def data_segment(self):
         return self._blob
@@ -225,6 +232,9 @@ class MOSDECSubOpWrite(Message):
         self.tid = meta["tid"]
         self.at_version = eversion_t(*meta["v"])
         self.txn = txn_from_wire(meta["ops"], data)
+        self.log_entries = meta.get("log", [])
+        rf = meta.get("rf")
+        self.rollforward_to = eversion_t(*rf) if rf else None
 
 
 @register_message
@@ -478,6 +488,141 @@ class MPGListReply(Message):
         self.pgid = spg_from_json(meta["pgid"])
         self.tid = meta["tid"]
         self.oids = meta["oids"]
+
+
+# -- peering (reference MOSDPGLog.h / MOSDPGInfo.h / PeeringState GetLog) ----
+
+@register_message
+class MPGLogQuery(Message):
+    """New primary -> shard: send me your pg_info + log (reference
+    PeeringState GetInfo/GetLog phases, pg_query_t)."""
+
+    type_id = 116
+
+    def __init__(self, pgid: spg_t = None, tid: int = 0):
+        super().__init__()
+        self.pgid, self.tid = pgid, tid
+
+    def to_meta(self):
+        return {"pgid": spg_to_json(self.pgid), "tid": self.tid}
+
+    def decode_wire(self, meta, data):
+        self.pgid = spg_from_json(meta["pgid"])
+        self.tid = meta["tid"]
+
+
+@register_message
+class MPGLogReply(Message):
+    """Shard -> querying primary: pg_info + full log entries (reference
+    MOSDPGLog carrying pg_log_t)."""
+
+    type_id = 117
+
+    def __init__(self, pgid: spg_t = None, tid: int = 0,
+                 info: dict | None = None, entries: list | None = None):
+        super().__init__()
+        self.pgid, self.tid = pgid, tid
+        self.info = info or {}          # pg_info_t.to_json()
+        self.entries = entries or []    # entry_to_wire lists
+
+    def to_meta(self):
+        return {"pgid": spg_to_json(self.pgid), "tid": self.tid,
+                "info": self.info, "entries": self.entries}
+
+    def decode_wire(self, meta, data):
+        self.pgid = spg_from_json(meta["pgid"])
+        self.tid = meta["tid"]
+        self.info, self.entries = meta["info"], meta["entries"]
+
+
+@register_message
+class MPGLogRollback(Message):
+    """Primary -> divergent shard: roll your log back to `v` using local
+    rollback state (the reference expresses this as the divergent-entry
+    branch of PGLog::merge_log + ECBackend rollback transactions)."""
+
+    type_id = 118
+
+    def __init__(self, pgid: spg_t = None, tid: int = 0,
+                 v: eversion_t = None):
+        super().__init__()
+        self.pgid, self.tid, self.v = pgid, tid, v
+
+    def to_meta(self):
+        return {"pgid": spg_to_json(self.pgid), "tid": self.tid,
+                "v": [self.v.epoch, self.v.version]}
+
+    def decode_wire(self, meta, data):
+        self.pgid = spg_from_json(meta["pgid"])
+        self.tid = meta["tid"]
+        self.v = eversion_t(*meta["v"])
+
+
+@register_message
+class MPGLogRollbackReply(Message):
+    type_id = 119
+
+    def __init__(self, pgid: spg_t = None, tid: int = 0,
+                 removed: list | None = None):
+        super().__init__()
+        self.pgid, self.tid = pgid, tid
+        self.removed = removed or []    # hobj json lists needing recovery
+
+    def to_meta(self):
+        return {"pgid": spg_to_json(self.pgid), "tid": self.tid,
+                "removed": self.removed}
+
+    def decode_wire(self, meta, data):
+        self.pgid = spg_from_json(meta["pgid"])
+        self.tid = meta["tid"]
+        self.removed = meta["removed"]
+
+
+@register_message
+class MPGActivate(Message):
+    """Primary -> shard: the interval is peered; persist
+    last_epoch_started (and, for a stale shard, adopt the authoritative
+    log).  Reference MOSDPGLog activation + PeeringState::activate."""
+
+    type_id = 121
+
+    def __init__(self, pgid: spg_t = None, tid: int = 0, les: int = 0,
+                 head: eversion_t = None, entries: list | None = None,
+                 adopt: bool = False):
+        super().__init__()
+        self.pgid, self.tid, self.les = pgid, tid, les
+        self.head = head or eversion_t()
+        self.entries = entries or []
+        self.adopt = adopt
+
+    def to_meta(self):
+        return {"pgid": spg_to_json(self.pgid), "tid": self.tid,
+                "les": self.les, "head": [self.head.epoch,
+                                          self.head.version],
+                "entries": self.entries, "adopt": self.adopt}
+
+    def decode_wire(self, meta, data):
+        self.pgid = spg_from_json(meta["pgid"])
+        self.tid, self.les = meta["tid"], meta["les"]
+        self.head = eversion_t(*meta["head"])
+        self.entries = meta["entries"]
+        self.adopt = meta["adopt"]
+
+
+@register_message
+class MPGActivateReply(Message):
+    type_id = 122
+
+    def __init__(self, pgid: spg_t = None, tid: int = 0):
+        super().__init__()
+        self.pgid, self.tid = pgid, tid
+
+    def to_meta(self):
+        return {"pgid": spg_to_json(self.pgid), "tid": self.tid}
+
+    def decode_wire(self, meta, data):
+        self.pgid = spg_from_json(meta["pgid"])
+        self.tid = meta["tid"]
 
 
 # -- watch / notify (reference MWatchNotify.h, osd/Watch.h) ------------------

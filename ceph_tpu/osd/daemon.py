@@ -70,7 +70,9 @@ class MessengerShardBackend(ShardBackend):
 
     # -- writes -------------------------------------------------------------
 
-    def sub_write(self, shard, txn, on_commit):
+    def sub_write(self, shard, txn, on_commit, log_entries=None,
+                  at_version=None, rollforward_to=None):
+        from .pg_log import entry_to_wire
         osd = self._osd_for(shard)
         spg = spg_t(self.pgid, shard)
         if osd is None:
@@ -82,15 +84,20 @@ class MessengerShardBackend(ShardBackend):
             self.degraded_shards.add(shard)
             on_commit(shard)
             return
+        wire_entries = [entry_to_wire(e) for e in (log_entries or [])]
         if osd == self.daemon.osd_id:
-            self.daemon.apply_shard_txn(spg, txn)
+            self.daemon.apply_sub_write(spg, txn, wire_entries,
+                                        at_version or eversion_t(),
+                                        rollforward_to)
             on_commit(shard)
             return
         tid = self._next_tid()
         with self.lock:
             self._pending_writes[tid] = (on_commit, shard)
         conn = self.daemon.conn_to_osd(osd)
-        conn.send_message(M.MOSDECSubOpWrite(spg, tid, eversion_t(), txn))
+        conn.send_message(M.MOSDECSubOpWrite(
+            spg, tid, at_version or eversion_t(), txn,
+            log_entries=wire_entries, rollforward_to=rollforward_to))
 
     def handle_write_reply(self, msg: M.MOSDECSubOpWriteReply) -> None:
         with self.lock:
@@ -223,6 +230,10 @@ class PGState:
         self.kind = kind  # "ec" | "replicated"
         self.version = 0
         self.lock = threading.Lock()
+        # peering: a fresh primary must collect shard logs before
+        # serving (reference PeeringState: no ops until Active)
+        self.needs_peer = True
+        self.peer_lock = threading.Lock()
 
     def next_version(self, epoch: int) -> eversion_t:
         with self.lock:
@@ -285,6 +296,10 @@ class OSDDaemon:
         self.pgs: dict[pg_t, PGState] = {}
         self.pg_lock = threading.RLock()
         self.raw_read_waiters: dict = {}
+        # shard-resident replicated PG logs (reference: pglog omap keys
+        # in the pg meta collection) + peering RPC plumbing
+        self.shard_logs: dict = {}
+        self.peer_waiters: dict = {}
         self._created_cids: set[spg_t] = set()
         self.heartbeat_interval = heartbeat_interval
         self._hb_stop = threading.Event()
@@ -337,9 +352,29 @@ class OSDDaemon:
                 self._handle_client_op(conn, msg)
             elif isinstance(msg, M.MOSDECSubOpWrite):
                 self.perf.inc("subop_w")
-                self.apply_shard_txn(msg.pgid, msg.txn)
+                self.apply_sub_write(msg.pgid, msg.txn, msg.log_entries,
+                                     msg.at_version, msg.rollforward_to)
                 conn.send_message(M.MOSDECSubOpWriteReply(
                     msg.pgid, msg.tid, msg.pgid.shard))
+            elif isinstance(msg, M.MPGLogQuery):
+                slog = self._shard_log(msg.pgid)
+                from .pg_log import entry_to_wire
+                conn.send_message(M.MPGLogReply(
+                    msg.pgid, msg.tid, slog.info.to_json(),
+                    [entry_to_wire(e) for e in slog.log.entries]))
+            elif isinstance(msg, M.MPGLogRollback):
+                removed = self._shard_log(msg.pgid).rollback_to(msg.v)
+                conn.send_message(M.MPGLogRollbackReply(
+                    msg.pgid, msg.tid,
+                    [M.hobj_to_json(o) for o in removed]))
+            elif isinstance(msg, M.MPGActivate):
+                self._handle_activate(msg)
+                conn.send_message(M.MPGActivateReply(msg.pgid, msg.tid))
+            elif isinstance(msg, (M.MPGLogReply, M.MPGLogRollbackReply,
+                                  M.MPGActivateReply)):
+                waiter = self.peer_waiters.pop((msg.pgid, msg.tid), None)
+                if waiter is not None:
+                    waiter(msg)
             elif isinstance(msg, M.MOSDECSubOpRead):
                 self.perf.inc("subop_r")
                 reply = self.stat_shard(msg.pgid, msg.oid, msg.want_attrs) \
@@ -352,12 +387,8 @@ class OSDDaemon:
             elif isinstance(msg, M.MOSDECSubOpReadReply):
                 self._route_read_reply(msg)
             elif isinstance(msg, M.MPGList):
-                try:
-                    oids = [M.hobj_to_json(g.hobj) for g in
-                            self.store.list_objects(self._cid(msg.pgid))]
-                except KeyError:
-                    oids = []
-                conn.send_message(M.MPGListReply(msg.pgid, msg.tid, oids))
+                conn.send_message(M.MPGListReply(
+                    msg.pgid, msg.tid, self._list_pg_objects(msg.pgid)))
             elif isinstance(msg, M.MPGListReply):
                 waiter = self.raw_list_waiters.pop((msg.pgid, msg.tid), None)
                 if waiter is not None:
@@ -387,13 +418,18 @@ class OSDDaemon:
                 self._hb_last_seen.pop(oid_, None)
                 self._hb_first_ping.pop(oid_, None)
         self.osdmap = newmap
-        # refresh acting sets of cached backends (mini re-peering)
+        # refresh acting sets of cached backends; an interval change
+        # (acting set differs) forces re-peering before the next op
+        # (reference PeeringState start_peering_interval)
         with self.pg_lock:
             for pgid, state in list(self.pgs.items()):
                 up, acting, _, primary = newmap.pg_to_up_acting_osds(pgid)
                 shards = getattr(state.backend, "shards", None) or \
                     getattr(state.backend, "replicas", None)
                 if hasattr(shards, "acting"):
+                    if list(acting) != list(shards.acting) and \
+                            state.kind == "ec":
+                        state.needs_peer = True
                     shards.acting = list(acting)
                 if primary != self.osd_id:
                     self.pgs.pop(pgid, None)  # primary moved away
@@ -444,14 +480,22 @@ class OSDDaemon:
                 names.add(M.hobj_from_json(oj))
         return names
 
+    def _list_pg_objects(self, spg: spg_t) -> list:
+        """Enumerate user objects of a shard collection, hiding the
+        per-PG log/info meta object (the reference keeps pg metadata in
+        a separate meta collection; here it's a reserved name)."""
+        from .pg_log import PG_META_NAME
+        try:
+            return [M.hobj_to_json(g.hobj)
+                    for g in self.store.list_objects(self._cid(spg))
+                    if g.hobj.name != PG_META_NAME]
+        except KeyError:
+            return []
+
     def _remote_list(self, osd: int, spg: spg_t,
                      timeout: float = 10.0) -> list:
         if osd == self.osd_id:
-            try:
-                return [M.hobj_to_json(g.hobj)
-                        for g in self.store.list_objects(self._cid(spg))]
-            except KeyError:
-                return []
+            return self._list_pg_objects(spg)
         with self.pg_lock:
             self._raw_tid += 1
             tid = self._raw_tid
@@ -539,12 +583,32 @@ class OSDDaemon:
                     self.prev_osdmap.pg_to_up_acting_osds(pgid)
             except Exception:  # noqa: BLE001
                 prev_acting = None
-        # objects may live on old holders only: list those too
+        # objects may live on old holders only: list those too.  Map
+        # history beyond one epoch isn't kept (the reference consults
+        # past_intervals), so when the acting set changed, the shard
+        # scan widens to every up OSD — a moved shard is findable
+        # wherever CRUSH last put it.  Steady-state (acting == prev)
+        # PGs skip the wide scan.
+        up_osds = [o.id for o in self.osdmap.osds.values() if o.up]
         names = self._pg_object_names(pgid, acting, range(be.n))
         if prev_acting:
             for s, osd in enumerate(prev_acting):
                 if osd != CRUSH_ITEM_NONE and self.osdmap.is_up(osd):
                     for oj in self._remote_list(osd, spg_t(pgid, s)):
+                        names.add(M.hobj_from_json(oj))
+        moved = prev_acting is None or list(prev_acting) != list(acting) \
+            or any(osd == CRUSH_ITEM_NONE or not self.osdmap.is_up(osd)
+                   for osd in acting)
+        if moved:
+            for s in range(be.n):
+                spg = spg_t(pgid, s)
+                known = {acting[s] if s < len(acting) else None,
+                         prev_acting[s] if prev_acting and
+                         s < len(prev_acting) else None}
+                for osd in up_osds:
+                    if osd in known:
+                        continue
+                    for oj in self._remote_list(osd, spg, timeout=3.0):
                         names.add(M.hobj_from_json(oj))
         for oid in names:
             missing = []
@@ -555,26 +619,44 @@ class OSDDaemon:
                     missing.append(s)
             if not missing:
                 continue
-            # 1: backfill-by-copy from the previous holder of each shard
+            # 1: backfill-by-copy from wherever the shard still lives
+            # (previous holder first, then any up OSD).  A leftover
+            # copy from an older interval could be stale, so candidates
+            # must match the authoritative hinfo's chunk crc when one
+            # is known (reference verifies pushed chunks the same way,
+            # ECBackend.cc:991).
+            from ..common import crc32c as _crc
+            auth_hinfo = be._fetch_hinfo(oid)
             still_missing = []
             for s in missing:
                 copied = False
+                candidates: list[int] = []
                 if prev_acting and s < len(prev_acting):
                     old = prev_acting[s]
                     if old != CRUSH_ITEM_NONE and old != acting[s] and \
                             self.osdmap.is_up(old):
-                        got = self._remote_read_full(
-                            old, spg_t(pgid, s), oid)
-                        if got is not None:
-                            data, attrs = got
-                            txn = Transaction()
-                            goid = shard_oid(oid, s)
-                            txn.write(goid, 0, data)
-                            if attrs:
-                                txn.setattrs(goid, attrs)
-                            self._push_shard_txn(
-                                acting[s], spg_t(pgid, s), txn)
-                            copied = True
+                        candidates.append(old)
+                candidates.extend(o for o in up_osds
+                                  if o != acting[s] and
+                                  o not in candidates)
+                for old in candidates:
+                    got = self._remote_read_full(old, spg_t(pgid, s), oid)
+                    if got is None:
+                        continue
+                    data, attrs = got
+                    if auth_hinfo is not None and (
+                            auth_hinfo.total_chunk_size != data.size or
+                            _crc.crc32c(data.tobytes(), 0xFFFFFFFF) !=
+                            auth_hinfo.get_chunk_hash(s)):
+                        continue   # stale leftover from an older interval
+                    txn = Transaction()
+                    goid = shard_oid(oid, s)
+                    txn.write(goid, 0, data)
+                    if attrs:
+                        txn.setattrs(goid, attrs)
+                    self._push_shard_txn(acting[s], spg_t(pgid, s), txn)
+                    copied = True
+                    break
                 if not copied:
                     still_missing.append(s)
             if not still_missing:
@@ -652,6 +734,42 @@ class OSDDaemon:
     def apply_shard_txn(self, spg: spg_t, txn: Transaction) -> None:
         self.store.queue_transactions(self._cid(spg), [txn])
 
+    def _shard_log(self, spg: spg_t):
+        from .pg_log import ShardPGLog
+        with self.pg_lock:
+            slog = self.shard_logs.get(spg)
+            if slog is None:
+                slog = self.shard_logs[spg] = ShardPGLog(
+                    self.store, self._cid(spg), spg.shard)
+            return slog
+
+    def apply_sub_write(self, spg: spg_t, txn: Transaction,
+                        wire_entries: list, at_version: eversion_t,
+                        rollforward_to: eversion_t | None) -> None:
+        """Shard write + atomic log persistence (reference
+        ECBackend::handle_sub_write, ECBackend.cc:915: the log entries
+        ride the same ObjectStore transaction as the data)."""
+        from .pg_log import entry_from_wire
+        if not wire_entries:
+            self.apply_shard_txn(spg, txn)
+            return
+        entries = [entry_from_wire(w) for w in wire_entries]
+        slog = self._shard_log(spg)
+        slog.append_to_txn(txn, entries, at_version)
+        self.store.queue_transactions(self._cid(spg), [txn])
+        slog.record(entries, at_version)
+        if rollforward_to is not None:
+            slog.log.roll_forward_to(rollforward_to)
+
+    def _handle_activate(self, msg: M.MPGActivate) -> None:
+        from .pg_log import entry_from_wire
+        slog = self._shard_log(msg.pgid)
+        if msg.adopt:
+            slog.adopt([entry_from_wire(w) for w in msg.entries],
+                       msg.head, msg.les)
+        else:
+            slog.set_les(msg.les)
+
     def read_shard(self, spg: spg_t, oid: hobject_t, off: int,
                    length: int) -> np.ndarray | None:
         goid = ghobject_t(oid, shard=spg.shard)
@@ -710,28 +828,174 @@ class OSDDaemon:
     def _get_pg(self, pgid: pg_t) -> PGState:
         with self.pg_lock:
             state = self.pgs.get(pgid)
-            if state is not None:
-                return state
-            pool = self.osdmap.pools[pgid.pool]
-            up, acting, _, primary = self.osdmap.pg_to_up_acting_osds(pgid)
-            if primary != self.osd_id:
-                raise ErasureCodeError(
-                    errno.EAGAIN, f"not primary for {pgid} (is {primary})")
-            if pool.is_erasure():
-                prof = self.osdmap.ec_profiles[pool.erasure_code_profile]
-                codec = ErasureCodePluginRegistry.instance().factory(
-                    prof["plugin"], Profile(dict(prof)))
-                k = codec.get_data_chunk_count()
-                sinfo = StripeInfo(pool.stripe_width, pool.stripe_width // k)
-                shards = MessengerShardBackend(self, pgid, acting)
-                backend = ECBackend(codec, sinfo, shards)
-                state = PGState(backend, "ec")
+            if state is None:
+                pool = self.osdmap.pools[pgid.pool]
+                up, acting, _, primary = \
+                    self.osdmap.pg_to_up_acting_osds(pgid)
+                if primary != self.osd_id:
+                    raise ErasureCodeError(
+                        errno.EAGAIN,
+                        f"not primary for {pgid} (is {primary})")
+                if pool.is_erasure():
+                    prof = self.osdmap.ec_profiles[
+                        pool.erasure_code_profile]
+                    codec = ErasureCodePluginRegistry.instance().factory(
+                        prof["plugin"], Profile(dict(prof)))
+                    k = codec.get_data_chunk_count()
+                    sinfo = StripeInfo(pool.stripe_width,
+                                       pool.stripe_width // k)
+                    shards = MessengerShardBackend(self, pgid, acting)
+                    backend = ECBackend(codec, sinfo, shards)
+                    state = PGState(backend, "ec")
+                else:
+                    replicas = MessengerReplicaBackend(self, pgid, acting)
+                    backend = ReplicatedBackend(replicas)
+                    state = PGState(backend, "replicated")
+                    state.needs_peer = False  # log peering is EC-scoped
+                self.pgs[pgid] = state
+        # Peer outside pg_lock: the shard-log RPCs must not stall every
+        # other PG's dispatch (reference peering happens in its own
+        # state machine, ops wait on Active).
+        if state.kind == "ec" and state.needs_peer:
+            with state.peer_lock:
+                if state.needs_peer:
+                    self._peer_pg(pgid, state)
+                    state.needs_peer = False
+        return state
+
+    # -- peering (reference PeeringState.cc GetInfo/GetLog/Activate:
+    #    collect shard logs, pick the authoritative one, reconcile) ---------
+
+    def _peer_rpc(self, osd: int, spg: spg_t, msg_cls,
+                  timeout: float = 5.0, **kw):
+        """One synchronous peering RPC to a remote shard; None on
+        timeout/unreachable (the shard is then treated as down)."""
+        with self.pg_lock:
+            self._raw_tid += 1
+            tid = self._raw_tid
+        box: dict = {}
+        ev = threading.Event()
+        self.peer_waiters[(spg, tid)] = \
+            lambda m: (box.update(msg=m), ev.set())
+        try:
+            self.conn_to_osd(osd).send_message(msg_cls(spg, tid, **kw))
+        except Exception:  # noqa: BLE001
+            self.peer_waiters.pop((spg, tid), None)
+            return None
+        if not ev.wait(timeout):
+            self.peer_waiters.pop((spg, tid), None)
+            return None
+        return box.get("msg")
+
+    def _peer_pg(self, pgid: pg_t, state: PGState) -> None:
+        """Authoritative-log peering for one EC PG this OSD now leads.
+
+        1. GetLog: every live shard reports (pg_info, log entries).
+        2. Shards that missed an interval (last_epoch_started below the
+           max) are STALE: they don't vote — their data is healed by
+           recovery, their history by adoption.
+        3. Among current shards the authoritative head is the MINIMUM
+           last_update: an acked write committed on every live shard,
+           so min >= every acked version; anything above min is an
+           unacked partial write.  (reference PeeringState::calc_acting
+           + the EC min-on-acting rule.)
+        4. Divergent shards roll back locally; objects whose rollback
+           state can't undo (deletes, overwrites pre-generations) are
+           removed and reconstructed from the authoritative shards.
+        5. Activate: everyone persists last_epoch_started = this epoch.
+        """
+        from ..crush.map import CRUSH_ITEM_NONE
+        from .pg_log import (LogEntry, PGLog, entry_from_wire,
+                             entry_to_wire, pg_info_t)
+        be = state.backend
+        acting = be.shards.acting
+        live = {s: osd for s, osd in enumerate(acting)
+                if osd != CRUSH_ITEM_NONE and self.osdmap.is_up(osd)}
+        replies: dict[int, tuple] = {}   # shard -> (pg_info_t, [LogEntry])
+        for s, osd in live.items():
+            spg = spg_t(pgid, s)
+            if osd == self.osd_id:
+                slog = self._shard_log(spg)
+                replies[s] = (slog.info, list(slog.log.entries))
             else:
-                replicas = MessengerReplicaBackend(self, pgid, acting)
-                backend = ReplicatedBackend(replicas)
-                state = PGState(backend, "replicated")
-            self.pgs[pgid] = state
-            return state
+                m = self._peer_rpc(osd, spg, M.MPGLogQuery)
+                if m is not None:
+                    replies[s] = (pg_info_t.from_json(m.info),
+                                  [entry_from_wire(w) for w in m.entries])
+        if not replies:
+            return   # nothing to peer against; min_size gate holds ops
+        max_les = max(info.last_epoch_started for info, _ in
+                      replies.values())
+        current = {s for s, (info, _) in replies.items()
+                   if info.last_epoch_started == max_les}
+        auth_head = min(replies[s][0].last_update for s in current)
+        donor = max(current, key=lambda s: replies[s][0].last_update)
+        auth_entries = [e for e in replies[donor][1]
+                        if e.version <= auth_head]
+        if any(replies[s][0].last_update > auth_head for s in replies) \
+                or any(s not in current for s in replies):
+            self.cct.dout("osd", 3,
+                          f"peering {pgid}: auth_head={auth_head} "
+                          f"current={sorted(current)} "
+                          f"live={sorted(replies)}")
+        # 4: divergent rollback
+        removed: list[hobject_t] = []
+        for s, (info, _) in replies.items():
+            if info.last_update <= auth_head:
+                continue
+            spg = spg_t(pgid, s)
+            if live[s] == self.osd_id:
+                removed.extend(self._shard_log(spg).rollback_to(auth_head))
+            else:
+                m = self._peer_rpc(live[s], spg, M.MPGLogRollback,
+                                   v=auth_head)
+                if m is not None:
+                    removed.extend(M.hobj_from_json(j)
+                                   for j in m.removed)
+        # 5: activate (stale shards adopt the authoritative log)
+        les_new = self.osdmap.epoch
+        wire_auth = [entry_to_wire(e) for e in auth_entries]
+        for s in replies:
+            spg = spg_t(pgid, s)
+            adopt = s not in current
+            if live[s] == self.osd_id:
+                self._handle_activate(M.MPGActivate(
+                    spg, 0, les_new, auth_head, wire_auth, adopt))
+            else:
+                self._peer_rpc(live[s], spg, M.MPGActivate, les=les_new,
+                               head=auth_head, entries=wire_auth,
+                               adopt=adopt)
+        # seed the primary's in-memory log + version counter
+        newlog = PGLog()
+        for e in sorted(auth_entries, key=lambda e: e.version):
+            newlog.add(e)
+        newlog.head = max(newlog.head, auth_head)
+        newlog.can_rollback_to = auth_head
+        newlog.rollforward_to = auth_head
+        be.log = newlog
+        with state.lock:
+            state.version = max(state.version, auth_head.version)
+        # reconstruct objects whose divergent entries weren't locally
+        # rollbackable (authoritative shards never applied them, so
+        # decode-from-k yields the pre-divergence object)
+        for oid in dict.fromkeys(removed):
+            missing = [s for s in live
+                       if be.shards.stat(s, oid) is None]
+            if not missing:
+                continue
+            try:
+                def push(s, data, hinfo, oid=oid):
+                    txn = Transaction()
+                    goid = shard_oid(oid, s)
+                    txn.write(goid, 0, data)
+                    txn.setattr(goid, HINFO_KEY, hinfo.encode())
+                    self._push_shard_txn(acting[s], spg_t(pgid, s), txn)
+
+                be.recover_shard(oid, missing, push)
+            except Exception as e:  # noqa: BLE001
+                self.cct.dout("osd", 1,
+                              f"post-peering recovery of {oid.name} "
+                              f"failed: {e!r}")
 
     def _handle_client_op(self, conn, msg: M.MOSDOp) -> None:
         """reference PrimaryLogPG::do_op/do_osd_ops: decode the op

@@ -50,7 +50,11 @@ class ShardBackend:
     """Transport seam to one PG's shard replicas (primary's view)."""
 
     def sub_write(self, shard: int, txn: Transaction,
-                  on_commit: Callable[[int], None]) -> None:
+                  on_commit: Callable[[int], None],
+                  log_entries: list | None = None,
+                  at_version=None, rollforward_to=None) -> None:
+        """Apply txn on `shard`; log_entries (pg_log.LogEntry) persist
+        atomically with it (reference ECSubWrite.log_entries)."""
         raise NotImplementedError
 
     def sub_read(self, shard: int, oid: hobject_t, off: int, length: int,
@@ -72,14 +76,25 @@ class LocalShardBackend(ShardBackend):
     handle_sub_write, reference ECBackend.cc:2086)."""
 
     def __init__(self, store: ObjectStore, pgid, n_shards: int):
+        from .pg_log import ShardPGLog
         self.store = store
         self.n_shards = n_shards
         self.cids = {s: spg_t(pgid, s) for s in range(n_shards)}
         for cid in self.cids.values():
             store.create_collection(cid)
+        self.shard_logs = {s: ShardPGLog(store, self.cids[s], s)
+                           for s in range(n_shards)}
 
-    def sub_write(self, shard, txn, on_commit):
+    def sub_write(self, shard, txn, on_commit, log_entries=None,
+                  at_version=None, rollforward_to=None):
+        slog = self.shard_logs[shard]
+        if log_entries and at_version is not None:
+            slog.append_to_txn(txn, log_entries, at_version)
         self.store.queue_transactions(self.cids[shard], [txn])
+        if log_entries:
+            slog.record(log_entries, at_version)
+        if rollforward_to is not None:
+            slog.log.roll_forward_to(rollforward_to)
         on_commit(shard)
 
     def sub_read(self, shard, oid, off, length, on_done):
@@ -477,16 +492,40 @@ class ECBackend:
 
     def _commit_op(self, op: ECOp, encoded: dict,
                    crcs: dict | None = None) -> None:
-        txns, _ = ect.generate_transactions(
-            self.sinfo, self.n, op.plan, op.txn, encoded, crcs)
-        # PG log entries with rollback info (reference log_operation :958)
+        # PG log entries with rollback info (reference log_operation :958
+        # + ecbackend.rst local-rollbackability).  Snapshot rollback
+        # state BEFORE generate_transactions mutates the hinfo.
+        entries: list[LogEntry] = []
         for oid, objop in op.txn.ops.items():
             rb = RollbackInfo()
+            old_size = op.plan.sizes.get(oid, 0)
+            hinfo = op.plan.hash_infos.get(oid)
+            existed = old_size > 0 or (
+                hinfo is not None and hinfo.total_chunk_size > 0)
             if not objop.delete:
-                rb.append_old_size = op.plan.sizes.get(oid, 0)
+                rb.append_old_size = old_size
+                aligned_old = self.sinfo.logical_to_next_stripe_offset(
+                    old_size)
+                rb.old_chunk_size = (
+                    self.sinfo.aligned_logical_offset_to_chunk_offset(
+                        aligned_old))
+                # pure_append == undo is a truncate: tail-only writes,
+                # no truncate of existing data, and no user xattr
+                # mutations (those aren't captured for undo; rollback
+                # falls back to remove+recover from auth shards)
+                rb.pure_append = (
+                    bool(op.plan.will_write.get(oid))
+                    and all(e.off >= aligned_old
+                            for e in op.plan.will_write.get(oid, []))
+                    and (objop.truncate_to is None or not existed)
+                    and not objop.attrs)
+                rb.hinfo_old = hinfo.encode() if existed else None
             self.log.add(LogEntry(
                 op.version, oid,
                 LogOp.DELETE if objop.delete else LogOp.MODIFY, rb))
+            entries.append(self.log.entries[-1])
+        txns, _ = ect.generate_transactions(
+            self.sinfo, self.n, op.plan, op.txn, encoded, crcs)
         op.state = "committing"
         op.pending_commits = self.n
         self.waiting_commit.append(op)
@@ -497,8 +536,12 @@ class ECBackend:
                 if op.pending_commits == 0:
                     self._try_finish_rmw()
 
+        rf = self.log.rollforward_to
         for s in range(self.n):
-            self.shards.sub_write(s, txns[s], on_commit)
+            self.shards.sub_write(s, txns[s], on_commit,
+                                  log_entries=entries,
+                                  at_version=op.version,
+                                  rollforward_to=rf)
 
     def _try_finish_rmw(self) -> None:
         """reference try_finish_rmw :2103: in-order completion, advance

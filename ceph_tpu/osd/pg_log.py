@@ -1,4 +1,4 @@
-"""PG log: per-PG op journal for recovery and EC rollback.
+"""PG log: per-PG op journal for recovery, EC rollback, and peering.
 
 Re-expresses reference src/osd/PGLog.{h,cc} at the fidelity the EC
 pipeline needs: an ordered list of entries keyed by eversion, each
@@ -8,14 +8,32 @@ doc/dev/osd_internals/erasure_coding/ecbackend.rst:9-27: append records
 the old size, delete keeps the old generation, setattr keeps prior
 values), plus the can_rollback_to / rollforward bounds ECBackend
 advances in try_finish_rmw (reference ECBackend.cc:2115-2134).
+
+The log is REPLICATED: every ECSubWrite carries its entries (reference
+ECSubWrite.log_entries, src/osd/ECMsgTypes.h:38) and each shard persists
+them durably alongside the data — omap of a per-PG meta object, the
+analog of the reference's pglog omap keys in the pg meta collection
+(src/osd/PGLog.cc _write_log_and_missing) — so a new primary can collect
+shard logs and select the authoritative one after failover (reference
+PeeringState::calc_acting / GetLog).
 """
 
 from __future__ import annotations
 
+import json
 from dataclasses import dataclass, field
 from enum import Enum
 
-from .types import eversion_t, hobject_t
+from .types import eversion_t, ghobject_t, hobject_t
+
+# Reserved per-PG metadata object carrying the shard's log (omap) and
+# info (xattr).  Filtered out of object enumeration (MPGList, scrub).
+PG_META_NAME = "__pg_meta__"
+INFO_ATTR = "_info"
+
+
+def meta_oid(pool: int, shard: int) -> ghobject_t:
+    return ghobject_t(hobject_t(pool, PG_META_NAME), shard=shard)
 
 
 class LogOp(Enum):
@@ -27,10 +45,12 @@ class LogOp(Enum):
 @dataclass
 class RollbackInfo:
     """What a shard must remember to undo this entry locally."""
-    append_old_size: int | None = None          # size before an append
+    append_old_size: int | None = None          # logical size before
     old_attrs: dict[str, bytes | None] | None = None  # prior xattr values
     kept_generation: int | None = None          # delete renamed to this gen
     hinfo_old: bytes | None = None              # prior hinfo xattr
+    old_chunk_size: int | None = None           # per-shard size before
+    pure_append: bool = False                   # undo == truncate
 
 
 @dataclass
@@ -39,6 +59,45 @@ class LogEntry:
     oid: hobject_t
     op: LogOp = LogOp.MODIFY
     rollback: RollbackInfo = field(default_factory=RollbackInfo)
+
+
+@dataclass
+class pg_info_t:
+    """Shard-resident PG summary (reference osd_types.h pg_info_t, the
+    slice peering needs: last_update orders logs inside an interval,
+    last_epoch_started fences out shards that missed an interval)."""
+    last_update: eversion_t = field(default_factory=eversion_t)
+    last_epoch_started: int = 0
+
+    def to_json(self) -> dict:
+        return {"lu": [self.last_update.epoch, self.last_update.version],
+                "les": self.last_epoch_started}
+
+    @classmethod
+    def from_json(cls, j: dict) -> "pg_info_t":
+        return cls(eversion_t(*j["lu"]), j["les"])
+
+
+def entry_to_wire(e: LogEntry) -> list:
+    rb = e.rollback
+    return [e.version.epoch, e.version.version,
+            [e.oid.pool, e.oid.name, e.oid.key, e.oid.snap, e.oid.hash],
+            e.op.value, rb.append_old_size, rb.old_chunk_size,
+            rb.pure_append,
+            rb.hinfo_old.hex() if rb.hinfo_old is not None else None]
+
+
+def entry_from_wire(w: list) -> LogEntry:
+    return LogEntry(
+        eversion_t(w[0], w[1]), hobject_t(*w[2]), LogOp(w[3]),
+        RollbackInfo(append_old_size=w[4], old_chunk_size=w[5],
+                     pure_append=w[6],
+                     hinfo_old=bytes.fromhex(w[7]) if w[7] else None))
+
+
+def _omap_key(e: LogEntry) -> bytes:
+    return (f"{e.version.epoch:010d}.{e.version.version:010d}."
+            f"{e.oid.name}").encode()
 
 
 class PGLog:
@@ -50,7 +109,9 @@ class PGLog:
         self.rollforward_to = eversion_t()  # entries before this are durable
 
     def add(self, entry: LogEntry) -> None:
-        assert entry.version > self.head, (entry.version, self.head)
+        # >= not >: one txn's objects share the op version (reference
+        # keeps one entry per object too, pg_log_entry_t per hobject)
+        assert entry.version >= self.head, (entry.version, self.head)
         self.entries.append(entry)
         self.head = entry.version
 
@@ -81,3 +142,139 @@ class PGLog:
         self.entries = [e for e in self.entries if e.version > to]
         if to > self.tail:
             self.tail = to
+
+
+class ShardPGLog:
+    """The shard-resident replicated log: entries + pg_info persisted in
+    the store (omap + xattr of the per-PG meta object) in the SAME
+    transaction as the data they describe, so the write and its log
+    entry are atomic (reference ECBackend::handle_sub_write appends
+    log_entries into the sub-write's ObjectStore::Transaction).
+
+    Also owns shard-local rollback: a divergent shard undoes entries
+    past the authoritative head using only its own persisted rollback
+    state (the reference's "EC ops must be locally rollbackable"
+    contract, ecbackend.rst:9-27).
+    """
+
+    def __init__(self, store, cid, shard: int):
+        self.store = store
+        self.cid = cid
+        self.shard = shard
+        self.moid = meta_oid(cid.pgid.pool, shard)
+        self.log = PGLog()
+        self.info = pg_info_t()
+        self._load()
+
+    def _load(self) -> None:
+        try:
+            raw = self.store.getattr(self.cid, self.moid, INFO_ATTR)
+            self.info = pg_info_t.from_json(json.loads(raw.decode()))
+        except KeyError:
+            return
+        try:
+            omap = self.store.omap_get(self.cid, self.moid)
+        except KeyError:
+            omap = {}
+        for key in sorted(omap):
+            e = entry_from_wire(json.loads(omap[key].decode()))
+            if e.version >= self.log.head:
+                self.log.add(e)
+        if self.log.entries:
+            self.log.tail = self.log.entries[0].version
+
+    def append_to_txn(self, txn, entries: list[LogEntry],
+                      at_version: eversion_t) -> None:
+        """Augment the shard data transaction with log persistence."""
+        txn.touch(self.moid)
+        if entries:
+            txn.omap_setkeys(self.moid, {
+                _omap_key(e): json.dumps(entry_to_wire(e)).encode()
+                for e in entries})
+        self.info.last_update = max(self.info.last_update, at_version)
+        txn.setattr(self.moid, INFO_ATTR,
+                    json.dumps(self.info.to_json()).encode())
+
+    def record(self, entries: list[LogEntry], at_version: eversion_t
+               ) -> None:
+        """In-memory bookkeeping after the txn committed."""
+        for e in entries:
+            if e.version >= self.log.head:
+                self.log.add(e)
+
+    def set_les(self, les: int) -> None:
+        self.info.last_epoch_started = max(
+            self.info.last_epoch_started, les)
+        txn = _txn()
+        txn.touch(self.moid)
+        txn.setattr(self.moid, INFO_ATTR,
+                    json.dumps(self.info.to_json()).encode())
+        self.store.queue_transactions(self.cid, [txn])
+
+    def adopt(self, entries: list[LogEntry], head: eversion_t,
+              les: int) -> None:
+        """Replace this shard's log with the authoritative one (a stale
+        shard rejoining: its data is healed by recovery, its history by
+        adoption — reference PGLog::merge_log for the divergent-free
+        case)."""
+        txn = _txn()
+        txn.touch(self.moid)
+        txn.omap_clear(self.moid)
+        if entries:
+            txn.omap_setkeys(self.moid, {
+                _omap_key(e): json.dumps(entry_to_wire(e)).encode()
+                for e in entries})
+        self.log = PGLog()
+        for e in sorted(entries, key=lambda e: e.version):
+            self.log.add(e)
+        self.info.last_update = head
+        self.info.last_epoch_started = max(
+            self.info.last_epoch_started, les)
+        txn.setattr(self.moid, INFO_ATTR,
+                    json.dumps(self.info.to_json()).encode())
+        self.store.queue_transactions(self.cid, [txn])
+
+    def rollback_to(self, v: eversion_t) -> list[hobject_t]:
+        """Undo local entries newer than v.  Pure appends truncate back
+        (and restore the prior hinfo xattr); anything else removes the
+        shard object outright and reports it, so the primary's recovery
+        rebuilds it from the authoritative shards (which never applied
+        the divergent entry, hence still hold the pre-entry state).
+        Returns the oids needing such recovery."""
+        from .ec_util import HINFO_KEY
+
+        undone = [e for e in self.log.entries if e.version > v]
+        undone.sort(key=lambda e: e.version, reverse=True)
+        removed: list[hobject_t] = []
+        txn = _txn()
+        for e in undone:
+            goid = ghobject_t(e.oid, shard=self.shard)
+            rb = e.rollback
+            if (e.op is LogOp.MODIFY and rb.pure_append
+                    and rb.old_chunk_size is not None):
+                if rb.old_chunk_size == 0 and rb.hinfo_old is None:
+                    txn.remove(goid)
+                else:
+                    txn.truncate(goid, rb.old_chunk_size)
+                    if rb.hinfo_old is not None:
+                        txn.setattr(goid, HINFO_KEY, rb.hinfo_old)
+                    else:
+                        txn.rmattr(goid, HINFO_KEY)
+            else:
+                txn.remove(goid)
+                if e.oid not in removed:
+                    removed.append(e.oid)
+            txn.omap_rmkeys(self.moid, [_omap_key(e)])
+        self.log.rollforward_to = min(self.log.rollforward_to, v)
+        self.log.rollback_to(v)
+        self.info.last_update = v
+        txn.touch(self.moid)
+        txn.setattr(self.moid, INFO_ATTR,
+                    json.dumps(self.info.to_json()).encode())
+        self.store.queue_transactions(self.cid, [txn])
+        return removed
+
+
+def _txn():
+    from ..store.object_store import Transaction
+    return Transaction()
