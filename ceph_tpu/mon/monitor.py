@@ -1,33 +1,46 @@
-"""Monitor: the cluster control plane.
+"""Monitor: the replicated cluster control plane.
 
 Re-expresses the slice of reference src/mon/ the storage path needs —
-the OSDMonitor role (src/mon/OSDMonitor.cc): sole author of the OSDMap,
+the OSDMonitor role (src/mon/OSDMonitor.cc): author of the OSDMap,
 consumer of boot/failure reports with a quorum-of-reporters rule
 (prepare_failure, reference OSDMonitor.cc:3226 / can_mark_down :3019),
 EC profile management with plugin validation (normalize_profile :7190 +
 stripe_unit validation :7211-7229), pool creation, and map distribution
 to every subscriber on each epoch.
 
-Single-instance: the reference replicates this state machine over Paxos
-across 3+ mons; here the map authority is one process and the Paxos
-quorum is future work recorded in docs/ROADMAP (the OSD/client contract
-— "mon is where maps come from" — is identical either way).
+Replication: 2f+1 monitors run rank-based election + Paxos
+(mon/paxos.py — reference src/mon/ElectionLogic.cc, src/mon/Paxos.cc).
+Every map mutation is a paxos value; it takes effect (and is published
+to subscribers) only on commit, on every mon in the quorum.  Peons
+forward mutating traffic to the leader (reference Monitor::forward_
+request_leader, Monitor.cc:4583) and serve reads from committed state
+under the leader's lease.  A single-mon deployment runs the same code
+with a quorum of one.
 """
 
 from __future__ import annotations
 
 import errno
 import threading
+import time
 
 from ..ec import ErasureCodeError, ErasureCodePluginRegistry, Profile
 from ..msg import Messenger
 from ..msg import messages as M
 from ..osd.osd_map import OSDMap
 from ..osd.types import PoolType
+from .paxos import ElectionLogic, Paxos
 
 DEFAULT_EC_PROFILE = {"plugin": "jax", "k": "2", "m": "1",
                       "technique": "cauchy",
                       "crush-failure-domain": "host"}
+
+READONLY_COMMANDS = {
+    "osd erasure-code-profile get", "osd erasure-code-profile ls",
+    "osd pool ls", "status", "osd tree", "mon stat",
+}
+
+FWD_TID_BASE = 1 << 40
 
 
 class Monitor:
@@ -42,40 +55,212 @@ class Monitor:
         self.messenger = Messenger("mon")
         self.messenger.add_dispatcher(self._dispatch)
         self.addr = self.messenger.bind(addr)
+        # quorum state (filled by join(); defaults to standalone)
+        self.rank = 0
+        self.mon_addrs: list[tuple[str, int]] = [self.addr]
+        self._committed_json = self.osdmap.to_json()
+        self._fwd_tid = FWD_TID_BASE
+        self._fwd_waiters: dict[int, tuple] = {}
+        self._stop = threading.Event()
+        self._maint: threading.Thread | None = None
+        self.election: ElectionLogic | None = None
+        self.paxos: Paxos | None = None
+        self.join([self.addr], 0, start_election=False)
+        self.paxos.role = "leader"
+        self.paxos.leader = 0
+        self.paxos.quorum = [0]
+
+    # -- quorum wiring -------------------------------------------------------
+
+    def join(self, mon_addrs: list[tuple[str, int]], rank: int,
+             start_election: bool = True) -> None:
+        """Join a monitor cluster: ranks index mon_addrs (the monmap,
+        reference MonMap)."""
+        self.rank = rank
+        self.mon_addrs = [tuple(a) for a in mon_addrs]
+        n = len(self.mon_addrs)
+        self.election = ElectionLogic(
+            rank, n, self._send_paxos, self._on_win, self._on_defeat)
+        self.paxos = Paxos(rank, n, self._send_paxos, self._apply_commit,
+                           lambda: self._committed_json,
+                           self._on_quorum_loss)
+        if self._maint is None:
+            self._maint = threading.Thread(
+                target=self._maintenance_loop, daemon=True,
+                name=f"mon.{rank}.maint")
+            self._maint.start()
+        if start_election and n > 1:
+            threading.Thread(target=self.election.start,
+                             daemon=True).start()
+
+    def _send_paxos(self, peer: int, **fields) -> None:
+        try:
+            conn = self.messenger.connect(self.mon_addrs[peer])
+            conn.send_message(M.MMonPaxos(rank=self.rank, **fields))
+        except Exception:  # noqa: BLE001 - dead peer
+            pass
+
+    def _on_win(self, epoch: int, quorum: list[int]) -> None:
+        self.paxos.win(epoch, quorum)
+
+    def _on_defeat(self, leader: int, epoch: int,
+                   quorum: list[int]) -> None:
+        self.paxos.defeat(leader, epoch, quorum)
+
+    def _on_quorum_loss(self) -> None:
+        # restore the last committed map (an uncommitted local mutation
+        # must not leak) and go back to the polls
+        with self.lock:
+            self.osdmap = OSDMap.from_json(self._committed_json)
+        if len(self.mon_addrs) > 1:
+            self.election.start()
+
+    def _apply_commit(self, value: dict) -> None:
+        """A paxos value committed: adopt + publish (every quorum mon)."""
+        with self.lock:
+            if value.get("epoch", 0) >= self.osdmap.epoch:
+                self.osdmap = OSDMap.from_json(value)
+            self._committed_json = value
+        self._publish()
+
+    def _maintenance_loop(self) -> None:
+        """Leader: lease grants.  Peon: lease expiry -> election.
+        Candidate: election retry (reference Monitor::tick)."""
+        while not self._stop.wait(Paxos.LEASE_INTERVAL / 2):
+            try:
+                if self.paxos.role == "leader":
+                    self.paxos.grant_lease()
+                elif not self.election.electing and \
+                        not self.election.recently_deferred() and \
+                        len(self.mon_addrs) > 1 and \
+                        (self.paxos.lease_expired() or
+                         self.paxos.role == "electing"):
+                    # lease gone (leader dead) or never settled: go to
+                    # the polls — but never while a round we proposed or
+                    # deferred to is still in flight (livelock)
+                    self.election.start()
+                self.election.tick()
+            except Exception:  # noqa: BLE001
+                pass
+
+    @property
+    def is_leader(self) -> bool:
+        return self.paxos.role == "leader"
+
+    def _lease_ok(self) -> bool:
+        """May this mon serve reads from committed state?"""
+        return self.is_leader or (self.paxos.role == "peon" and
+                                  not self.paxos.lease_expired())
+
+    def quorum_status(self) -> dict:
+        return {"rank": self.rank, "role": self.paxos.role,
+                "leader": self.paxos.leader,
+                "quorum": list(self.paxos.quorum),
+                "election_epoch": self.election.epoch}
 
     def shutdown(self) -> None:
+        self._stop.set()
         self.messenger.shutdown()
 
-    # -- dispatch -----------------------------------------------------------
+    # -- commit / publish ----------------------------------------------------
 
-    def _dispatch(self, conn, msg) -> None:
-        if isinstance(msg, M.MMonGetMap):
-            with self.lock:
-                if conn not in self._subscribers:
-                    self._subscribers.append(conn)
-                conn.send_message(M.MMonMap(self.osdmap.to_json()))
-        elif isinstance(msg, M.MOSDBoot):
-            self._handle_boot(msg)
-        elif isinstance(msg, M.MOSDFailure):
-            self._handle_failure(msg)
-        elif isinstance(msg, M.MMonCommand):
-            result, out = self.handle_command(msg.cmd)
-            conn.send_message(M.MMonCommandAck(msg.tid, result, out))
+    def _propose_current(self) -> bool:
+        """Leader-only: replicate the locally-mutated map.  On failure
+        the mutation is rolled back (quorum-loss path)."""
+        value = self.osdmap.to_json()
+        ok = self.paxos.propose(value)
+        return ok
 
     def _publish(self) -> None:
-        """Push the new map to every subscriber (reference OSDMap epoch
-        share; subscribers are daemons and clients)."""
-        j = self.osdmap.to_json()
+        """Push the committed map to every subscriber (reference OSDMap
+        epoch share; subscribers are daemons and clients)."""
+        j = self._committed_json
         for conn in list(self._subscribers):
             try:
                 conn.send_message(M.MMonMap(j))
             except Exception:  # noqa: BLE001
                 self._subscribers.remove(conn)
 
-    # -- osd lifecycle ------------------------------------------------------
+    def _leader_conn(self):
+        return self.messenger.connect(self.mon_addrs[self.paxos.leader])
+
+    # -- dispatch -----------------------------------------------------------
+
+    def _dispatch(self, conn, msg) -> None:
+        if isinstance(msg, M.MMonPaxos):
+            if msg.op in ("propose", "ack", "victory"):
+                self.election.handle(msg.rank, msg.op, msg.epoch,
+                                     msg.quorum)
+            else:
+                self.paxos.handle(msg.rank, msg.op, pn=msg.pn,
+                                  value=msg.value,
+                                  committed=msg.committed,
+                                  uncommitted=msg.uncommitted)
+        elif isinstance(msg, M.MMonGetMap):
+            with self.lock:
+                if conn not in self._subscribers:
+                    self._subscribers.append(conn)
+            # lease reads only: a mon outside the quorum (partitioned,
+            # electing) must not serve a possibly-stale map — silence
+            # makes daemons/clients hunt to a live mon (reference
+            # Paxos::is_lease_valid gating on reads)
+            if self._lease_ok():
+                conn.send_message(M.MMonMap(self._committed_json))
+        elif isinstance(msg, M.MOSDBoot):
+            if self.is_leader:
+                self._handle_boot(msg)
+            else:
+                self._forward(msg)
+        elif isinstance(msg, M.MOSDFailure):
+            if self.is_leader:
+                self._handle_failure(msg)
+            else:
+                self._forward(msg)
+        elif isinstance(msg, M.MMonCommand):
+            prefix = msg.cmd.get("prefix", "")
+            if self.is_leader or (prefix in READONLY_COMMANDS and
+                                  self._lease_ok()):
+                result, out = self.handle_command(msg.cmd)
+                conn.send_message(M.MMonCommandAck(msg.tid, result, out))
+            elif self.paxos.leader >= 0 and \
+                    self.paxos.role == "peon":
+                # forward to the leader, relay the ack back (reference
+                # Monitor::forward_request_leader)
+                with self.lock:
+                    self._fwd_tid += 1
+                    ftid = self._fwd_tid
+                    self._fwd_waiters[ftid] = (conn, msg.tid)
+                self._leader_conn().send_message(
+                    M.MMonCommand(msg.cmd, ftid))
+            else:
+                conn.send_message(M.MMonCommandAck(
+                    msg.tid, -errno.EAGAIN, {"error": "no quorum"}))
+        elif isinstance(msg, M.MMonCommandAck):
+            with self.lock:
+                ent = self._fwd_waiters.pop(msg.tid, None)
+            if ent is not None:
+                oconn, otid = ent
+                try:
+                    oconn.send_message(
+                        M.MMonCommandAck(otid, msg.result, msg.out))
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _forward(self, msg) -> None:
+        if self.paxos.leader >= 0 and self.paxos.leader != self.rank:
+            try:
+                self._leader_conn().send_message(msg)
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- osd lifecycle (leader only) ----------------------------------------
 
     def _handle_boot(self, msg: M.MOSDBoot) -> None:
         with self.lock:
+            info = self.osdmap.osds.get(msg.osd_id)
+            if info is not None and info.up and \
+                    tuple(info.addr or ()) == tuple(msg.addr or ()):
+                return   # idempotent re-boot (keepalive rotation)
             if msg.osd_id not in self.osdmap.osds:
                 # auto-create with one host per osd unless pre-declared
                 self.osdmap.add_osd(msg.osd_id, f"host{msg.osd_id}",
@@ -83,7 +268,7 @@ class Monitor:
             self.osdmap.set_osd_up(msg.osd_id, msg.addr)
             self._failure_reports.pop(msg.osd_id, None)
             self.osdmap.bump_epoch()
-            self._publish()
+            self._propose_current()
 
     def _handle_failure(self, msg: M.MOSDFailure) -> None:
         with self.lock:
@@ -97,7 +282,7 @@ class Monitor:
                 self.osdmap.set_osd_down(msg.failed)
                 self._failure_reports.pop(msg.failed, None)
                 self.osdmap.bump_epoch()
-                self._publish()
+                self._propose_current()
 
     # -- admin commands (reference OSDMonitor command surface) --------------
 
@@ -123,7 +308,7 @@ class Monitor:
                 with self.lock:
                     self.osdmap.set_osd_out(osd_id)
                     self.osdmap.bump_epoch()
-                    self._publish()
+                    self._propose_current()
                 return 0, {"out": osd_id}
             if prefix == "osd in":
                 osd_id = int(cmd["id"])
@@ -131,12 +316,22 @@ class Monitor:
                     if osd_id in self.osdmap.osds:
                         self.osdmap.osds[osd_id].in_ = True
                     self.osdmap.bump_epoch()
-                    self._publish()
+                    self._propose_current()
                 return 0, {"in": osd_id}
+            if prefix == "osd down":
+                osd_id = int(cmd["id"])
+                with self.lock:
+                    self.osdmap.set_osd_down(osd_id)
+                    self._failure_reports.pop(osd_id, None)
+                    self.osdmap.bump_epoch()
+                    self._propose_current()
+                return 0, {"down": osd_id}
             if prefix == "status":
                 return self._cmd_status()
             if prefix == "osd tree":
                 return self._cmd_tree()
+            if prefix == "mon stat":
+                return 0, self.quorum_status()
             return -errno.EINVAL, {"error": f"unknown command {prefix!r}"}
         except ErasureCodeError as e:
             return -e.errno, {"error": str(e)}
@@ -157,7 +352,7 @@ class Monitor:
         with self.lock:
             self.osdmap.ec_profiles[name] = normalized
             self.osdmap.bump_epoch()
-            self._publish()
+            self._propose_current()
         return 0, {"profile": normalized,
                    "chunk_count": codec.get_chunk_count()}
 
@@ -207,7 +402,7 @@ class Monitor:
                     name, PoolType.REPLICATED, size=size, pg_num=pg_num,
                     crush_rule=rid)
             self.osdmap.bump_epoch()
-            self._publish()
+            self._propose_current()
         return 0, {"pool_id": pool.id, "stripe_width": pool.stripe_width}
 
     def _cmd_status(self) -> tuple[int, dict]:
@@ -220,6 +415,7 @@ class Monitor:
                 "num_in_osds": sum(1 for o in self.osdmap.osds.values()
                                    if o.in_),
                 "pools": len(self.osdmap.pools),
+                "quorum": self.quorum_status(),
             }
 
     def _cmd_tree(self) -> tuple[int, dict]:

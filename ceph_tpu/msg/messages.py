@@ -490,6 +490,41 @@ class MPGListReply(Message):
         self.oids = meta["oids"]
 
 
+# -- mon quorum (reference MMonElection.h / MMonPaxos.h) ---------------------
+
+@register_message
+class MMonPaxos(Message):
+    """Mon <-> mon consensus traffic: election (propose/ack/victory)
+    and paxos (collect/last/begin/accept/commit/lease) share one frame
+    (the reference splits MMonElection and MMonPaxos; the field union
+    is small enough to carry in one typed message here)."""
+
+    type_id = 60
+
+    def __init__(self, op: str = "", rank: int = -1, epoch: int = 0,
+                 pn: int = 0, value: dict | None = None,
+                 quorum: list | None = None,
+                 committed: dict | None = None,
+                 uncommitted: list | None = None):
+        super().__init__()
+        self.op, self.rank, self.epoch, self.pn = op, rank, epoch, pn
+        self.value, self.quorum = value, quorum
+        self.committed, self.uncommitted = committed, uncommitted
+
+    def to_meta(self):
+        return {"op": self.op, "rank": self.rank, "epoch": self.epoch,
+                "pn": self.pn, "value": self.value,
+                "quorum": self.quorum, "committed": self.committed,
+                "uncommitted": self.uncommitted}
+
+    def decode_wire(self, meta, data):
+        self.op, self.rank = meta["op"], meta["rank"]
+        self.epoch, self.pn = meta["epoch"], meta["pn"]
+        self.value, self.quorum = meta["value"], meta["quorum"]
+        self.committed = meta["committed"]
+        self.uncommitted = meta["uncommitted"]
+
+
 # -- peering (reference MOSDPGLog.h / MOSDPGInfo.h / PeeringState GetLog) ----
 
 @register_message

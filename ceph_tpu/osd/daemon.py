@@ -242,7 +242,7 @@ class PGState:
 
 
 class OSDDaemon:
-    def __init__(self, osd_id: int, mon_addr: tuple[str, int],
+    def __init__(self, osd_id: int, mon_addr,
                  store: ObjectStore | None = None,
                  addr: tuple[str, int] = ("127.0.0.1", 0),
                  heartbeat_interval: float = 0.0,
@@ -310,7 +310,12 @@ class OSDDaemon:
         self.messenger = Messenger(f"osd.{osd_id}")
         self.messenger.add_dispatcher(self._dispatch)
         self.addr = self.messenger.bind(addr)
-        self.mon_conn = self.messenger.connect(mon_addr)
+        # one mon or a monmap list (reference MonClient hunting)
+        from ..msg.addrs import normalize_mon_addrs
+        self.mon_addrs = normalize_mon_addrs(mon_addr)
+        self._mon_idx = 0
+        self._last_map_time = time.time()
+        self.mon_conn = self.messenger.connect(self.mon_addrs[0])
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -409,7 +414,11 @@ class OSDDaemon:
                     msg.tid, -getattr(e, "errno", errno.EIO)))
 
     def _handle_map(self, msg: M.MMonMap) -> None:
+        self._last_map_time = time.time()
         newmap = OSDMap.from_json(msg.map_json)
+        if newmap.epoch <= self.osdmap.epoch and self.osdmap.epoch:
+            self.map_event.set()
+            return
         self.prev_osdmap = self.osdmap if self.osdmap.epoch else None
         # peers that (re)joined start their heartbeat clock fresh
         for oid_, o in newmap.osds.items():
@@ -596,20 +605,29 @@ class OSDDaemon:
                 if osd != CRUSH_ITEM_NONE and self.osdmap.is_up(osd):
                     for oj in self._remote_list(osd, spg_t(pgid, s)):
                         names.add(M.hobj_from_json(oj))
-        moved = prev_acting is None or list(prev_acting) != list(acting) \
-            or any(osd == CRUSH_ITEM_NONE or not self.osdmap.is_up(osd)
-                   for osd in acting)
-        if moved:
-            for s in range(be.n):
-                spg = spg_t(pgid, s)
-                known = {acting[s] if s < len(acting) else None,
-                         prev_acting[s] if prev_acting and
-                         s < len(prev_acting) else None}
-                for osd in up_osds:
-                    if osd in known:
-                        continue
-                    for oj in self._remote_list(osd, spg, timeout=3.0):
-                        names.add(M.hobj_from_json(oj))
+        # wide scan only for shards whose holder changed or is gone —
+        # steady-state shards are already listed from acting above
+        def shard_moved(s: int) -> bool:
+            cur = acting[s] if s < len(acting) else CRUSH_ITEM_NONE
+            if cur == CRUSH_ITEM_NONE or not self.osdmap.is_up(cur):
+                return True
+            if prev_acting is None:
+                return True
+            prev = prev_acting[s] if s < len(prev_acting) \
+                else CRUSH_ITEM_NONE
+            return prev != cur
+        for s in range(be.n):
+            if not shard_moved(s):
+                continue
+            spg = spg_t(pgid, s)
+            known = {acting[s] if s < len(acting) else None,
+                     prev_acting[s] if prev_acting and
+                     s < len(prev_acting) else None}
+            for osd in up_osds:
+                if osd in known:
+                    continue
+                for oj in self._remote_list(osd, spg, timeout=3.0):
+                    names.add(M.hobj_from_json(oj))
         for oid in names:
             missing = []
             for s, osd in enumerate(acting):
@@ -646,8 +664,9 @@ class OSDDaemon:
                     data, attrs = got
                     if auth_hinfo is not None and (
                             auth_hinfo.total_chunk_size != data.size or
-                            _crc.crc32c(data.tobytes(), 0xFFFFFFFF) !=
-                            auth_hinfo.get_chunk_hash(s)):
+                            (auth_hinfo.crc_valid and
+                             _crc.crc32c(data.tobytes(), 0xFFFFFFFF) !=
+                             auth_hinfo.get_chunk_hash(s))):
                         continue   # stale leftover from an older interval
                     txn = Transaction()
                     goid = shard_oid(oid, s)
@@ -859,8 +878,10 @@ class OSDDaemon:
         if state.kind == "ec" and state.needs_peer:
             with state.peer_lock:
                 if state.needs_peer:
-                    self._peer_pg(pgid, state)
-                    state.needs_peer = False
+                    # incomplete peering (a live shard didn't answer)
+                    # keeps needs_peer set: the next op retries until
+                    # every live shard's log has been reconciled
+                    state.needs_peer = not self._peer_pg(pgid, state)
         return state
 
     # -- peering (reference PeeringState.cc GetInfo/GetLog/Activate:
@@ -887,8 +908,10 @@ class OSDDaemon:
             return None
         return box.get("msg")
 
-    def _peer_pg(self, pgid: pg_t, state: PGState) -> None:
+    def _peer_pg(self, pgid: pg_t, state: PGState) -> bool:
         """Authoritative-log peering for one EC PG this OSD now leads.
+        Returns False when a live shard could not be reconciled (the
+        caller must retry before trusting the PG).
 
         1. GetLog: every live shard reports (pg_info, log entries).
         2. Shards that missed an interval (last_epoch_started below the
@@ -922,8 +945,9 @@ class OSDDaemon:
                 if m is not None:
                     replies[s] = (pg_info_t.from_json(m.info),
                                   [entry_from_wire(w) for w in m.entries])
+        complete = set(replies) == set(live)
         if not replies:
-            return   # nothing to peer against; min_size gate holds ops
+            return False  # nothing to peer against; retry on next op
         max_les = max(info.last_epoch_started for info, _ in
                       replies.values())
         current = {s for s, (info, _) in replies.items()
@@ -996,6 +1020,7 @@ class OSDDaemon:
                 self.cct.dout("osd", 1,
                               f"post-peering recovery of {oid.name} "
                               f"failed: {e!r}")
+        return complete
 
     def _handle_client_op(self, conn, msg: M.MOSDOp) -> None:
         """reference PrimaryLogPG::do_op/do_osd_ops: decode the op
@@ -1192,6 +1217,24 @@ class OSDDaemon:
     def _heartbeat_loop(self) -> None:
         while not self._hb_stop.wait(self.heartbeat_interval):
             now = time.time()
+            # mon keepalive + hunting: no map traffic for too long means
+            # our mon may be dead — rotate to the next one and
+            # re-announce (reference MonClient::tick hunting)
+            try:
+                self.mon_conn.send_message(M.MMonGetMap())
+                stale = max(2.0, 4 * self.heartbeat_interval)
+                if len(self.mon_addrs) > 1 and \
+                        now - self._last_map_time > stale:
+                    self._mon_idx = (self._mon_idx + 1) % \
+                        len(self.mon_addrs)
+                    self.mon_conn = self.messenger.connect(
+                        self.mon_addrs[self._mon_idx])
+                    self._last_map_time = now
+                    self.mon_conn.send_message(M.MMonGetMap())
+                    self.mon_conn.send_message(
+                        M.MOSDBoot(self.osd_id, self.addr))
+            except Exception:  # noqa: BLE001
+                pass
             peers = [o for o in self.osdmap.osds.values()
                      if o.up and o.id != self.osd_id]
             for o in peers:

@@ -664,7 +664,9 @@ class ECBackend:
             from ..common import crc32c as _crc
             want = hinfo.get_chunk_hash(s)
             got_crc = _crc.crc32c(data.tobytes(), 0xFFFFFFFF)
-            if hinfo.total_chunk_size == chunk_len and got_crc != want:
+            if hinfo.crc_valid and \
+                    hinfo.total_chunk_size == chunk_len and \
+                    got_crc != want:
                 raise ErasureCodeError(
                     5, f"recovered shard {s} of {oid} crc mismatch "
                        f"{got_crc:#x} != {want:#x}")

@@ -135,6 +135,14 @@ class HashInfo:
     def get_chunk_hash(self, shard: int) -> int:
         return self.cumulative_shard_hashes[shard]
 
+    @property
+    def crc_valid(self) -> bool:
+        """False once truncate/overwrite reset the cumulative hashes
+        (all back at the -1 seed with bytes present): consumers must
+        not treat the seeds as real chunk crcs."""
+        return self.total_chunk_size == 0 or \
+            any(h != 0xFFFFFFFF for h in self.cumulative_shard_hashes)
+
     # -- persistence (shard xattr) -----------------------------------------
 
     def encode(self) -> bytes:

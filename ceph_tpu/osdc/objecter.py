@@ -26,11 +26,16 @@ class TimedOut(Exception):
 
 
 class Objecter:
-    def __init__(self, mon_addr: tuple[str, int], name: str = "client"):
+    def __init__(self, mon_addr, name: str = "client"):
         self.messenger = Messenger(name)
         self.messenger.add_dispatcher(self._dispatch)
-        self.mon_addr = mon_addr
-        self.mon_conn = self.messenger.connect(mon_addr)
+        # one (host, port) or a monmap-style list of them (reference
+        # MonClient hunts across the monmap)
+        from ..msg.addrs import normalize_mon_addrs
+        self.mon_addrs = normalize_mon_addrs(mon_addr)
+        self._mon_idx = 0
+        self.mon_addr = self.mon_addrs[0]
+        self.mon_conn = self.messenger.connect(self.mon_addrs[0])
         self.osdmap = OSDMap()
         self.map_event = threading.Event()
         self._tid = 0
@@ -45,13 +50,23 @@ class Objecter:
     # -- lifecycle ----------------------------------------------------------
 
     def start(self, timeout: float = 10.0) -> None:
-        self.mon_conn.send_message(M.MMonGetMap())
         deadline = time.time() + timeout
         while self.osdmap.epoch == 0 and time.time() < deadline:
-            self.map_event.wait(0.05)
+            self.mon_conn.send_message(M.MMonGetMap())
+            if not self.map_event.wait(1.0):
+                self._rotate_mon()
             self.map_event.clear()
         if self.osdmap.epoch == 0:
             raise TimedOut("no osdmap from mon")
+
+    def _rotate_mon(self) -> None:
+        """Hunt to the next monitor (reference MonClient::_reopen_session
+        rotation when the current mon stops answering)."""
+        if len(self.mon_addrs) == 1:
+            return
+        self._mon_idx = (self._mon_idx + 1) % len(self.mon_addrs)
+        self.mon_addr = self.mon_addrs[self._mon_idx]
+        self.mon_conn = self.messenger.connect(self.mon_addr)
 
     def shutdown(self) -> None:
         self.messenger.shutdown()
@@ -89,7 +104,10 @@ class Objecter:
     def refresh_map(self, timeout: float = 5.0) -> None:
         self.map_event.clear()
         self.mon_conn.send_message(M.MMonGetMap())
-        self.map_event.wait(timeout)
+        if not self.map_event.wait(timeout):
+            self._rotate_mon()
+            self.mon_conn.send_message(M.MMonGetMap())
+            self.map_event.wait(timeout)
 
     def _calc_target(self, pool_id: int, name: str
                      ) -> tuple[spg_t, int] | None:
@@ -167,13 +185,30 @@ class Objecter:
 
     def mon_command(self, cmd: dict, timeout: float = 15.0
                     ) -> tuple[int, dict]:
-        with self._lock:
-            self._tid += 1
-            tid = self._tid
-            w = {"event": threading.Event(), "reply": None}
-            self._mon_waiters[tid] = w
-        self.mon_conn.send_message(M.MMonCommand(cmd, tid))
-        if not w["event"].wait(timeout):
-            raise TimedOut(f"mon command {cmd.get('prefix')}")
-        ack = w["reply"]
-        return ack.result, ack.out
+        """Admin command with mon failover: a dead or quorum-less mon
+        rotates the session to the next one (reference MonClient
+        hunting + command resend on session reset)."""
+        deadline = time.time() + timeout
+        attempt_timeout = min(3.0, timeout)
+        while True:
+            with self._lock:
+                self._tid += 1
+                tid = self._tid
+                w = {"event": threading.Event(), "reply": None}
+                self._mon_waiters[tid] = w
+            self.mon_conn.send_message(M.MMonCommand(cmd, tid))
+            if w["event"].wait(attempt_timeout):
+                ack = w["reply"]
+                if ack.result == -errno.EAGAIN and \
+                        time.time() < deadline:
+                    # electing / quorum-less mon: another mon may have a
+                    # working leader — rotate before retrying
+                    self._rotate_mon()
+                    time.sleep(0.3)
+                    continue
+                return ack.result, ack.out
+            with self._lock:
+                self._mon_waiters.pop(tid, None)
+            if time.time() >= deadline:
+                raise TimedOut(f"mon command {cmd.get('prefix')}")
+            self._rotate_mon()

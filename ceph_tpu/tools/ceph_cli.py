@@ -66,6 +66,11 @@ def main(argv=None) -> int:
                    "name": words[3]}
         elif words[:3] == ["osd", "erasure-code-profile", "ls"]:
             cmd = {"prefix": "osd erasure-code-profile ls"}
+        elif words == ["mon", "stat"]:
+            cmd = {"prefix": "mon stat"}
+        elif words[:2] in (["osd", "out"], ["osd", "in"],
+                           ["osd", "down"]) and len(words) == 3:
+            cmd = {"prefix": f"osd {words[1]}", "id": int(words[2])}
         if cmd is None:
             print(f"ceph: unknown command {' '.join(words)!r}",
                   file=sys.stderr)

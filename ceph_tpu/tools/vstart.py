@@ -27,8 +27,14 @@ class Cluster:
     def __init__(self, n_osds: int = 6, heartbeat_interval: float = 0.0,
                  failure_quorum: int = 2, asok_dir: str | None = None,
                  objectstore: str = "memstore",
-                 data_dir: str | None = None):
-        self.mon = Monitor(failure_quorum=failure_quorum)
+                 data_dir: str | None = None, n_mons: int = 1):
+        self.mons = [Monitor(failure_quorum=failure_quorum)
+                     for _ in range(n_mons)]
+        self.mon_addrs = [m.addr for m in self.mons]
+        if n_mons > 1:
+            for i, m in enumerate(self.mons):
+                m.join(self.mon_addrs, i)
+        self.mon = self.mons[0]   # convenience alias (rank 0)
         self.osds: list[OSDDaemon] = []
         self.n_osds = n_osds
         self.heartbeat_interval = heartbeat_interval
@@ -37,15 +43,25 @@ class Cluster:
         self.data_dir = data_dir
         self._clients: list[RadosClient] = []
 
+    def wait_for_leader(self, timeout: float = 10.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            for m in self.mons:
+                if m.is_leader:
+                    return m
+            time.sleep(0.05)
+        raise RuntimeError("no mon leader elected")
+
     def start(self) -> "Cluster":
         from ..store import create_store
+        self.wait_for_leader()
         for i in range(self.n_osds):
             asok = (f"{self.asok_dir}/osd.{i}.asok"
                     if self.asok_dir else None)
             store = create_store(
                 self.objectstore,
                 f"{self.data_dir}/osd.{i}" if self.data_dir else None)
-            osd = OSDDaemon(i, self.mon.addr, store=store,
+            osd = OSDDaemon(i, self.mon_addrs, store=store,
                             heartbeat_interval=self.heartbeat_interval,
                             asok_path=asok)
             self.osds.append(osd)
@@ -54,7 +70,7 @@ class Cluster:
         return self
 
     def client(self) -> RadosClient:
-        c = RadosClient(self.mon.addr).connect()
+        c = RadosClient(self.mon_addrs).connect()
         self._clients.append(c)
         return c
 
@@ -64,19 +80,28 @@ class Cluster:
         osd = self.osds[osd_id]
         osd.shutdown()
 
+    def kill_mon(self, rank: int) -> None:
+        """Hard-kill a monitor (quorum must re-elect)."""
+        self.mons[rank].shutdown()
+
     def mark_osd_down(self, osd_id: int) -> None:
         """Administratively mark down (what failure detection would do)."""
-        with self.mon.lock:
-            self.mon.osdmap.set_osd_down(osd_id)
-            self.mon.osdmap.bump_epoch()
-            self.mon._publish()
+        r, _ = self.admin().mon_command(
+            {"prefix": "osd down", "id": osd_id})
+        assert r == 0, f"osd down failed: {r}"
+
+    def admin(self) -> RadosClient:
+        if not self._clients:
+            return self.client()
+        return self._clients[0]
 
     def stop(self) -> None:
         for c in self._clients:
             c.shutdown()
         for osd in self.osds:
             osd.shutdown()
-        self.mon.shutdown()
+        for m in self.mons:
+            m.shutdown()
 
     def __enter__(self) -> "Cluster":
         return self.start()
@@ -88,6 +113,7 @@ class Cluster:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="vstart")
     ap.add_argument("--osds", type=int, default=6)
+    ap.add_argument("--mons", type=int, default=1)
     ap.add_argument("--heartbeat", type=float, default=1.0)
     ap.add_argument("--objectstore", choices=("memstore", "filestore"),
                     default="memstore")
@@ -98,9 +124,9 @@ def main(argv=None) -> int:
     cluster = Cluster(args.osds, heartbeat_interval=args.heartbeat,
                       asok_dir=args.asok_dir,
                       objectstore=args.objectstore,
-                      data_dir=args.data_dir).start()
-    print(f"mon at {cluster.mon.addr}; {args.osds} osds up; Ctrl-C to stop",
-          flush=True)
+                      data_dir=args.data_dir, n_mons=args.mons).start()
+    print(f"mon at {cluster.mon.addr}; {args.mons} mons, "
+          f"{args.osds} osds up; Ctrl-C to stop", flush=True)
     try:
         while True:
             time.sleep(1)
