@@ -72,8 +72,11 @@ class DoutStream:
         ts = time.time()
         self.ring.add((ts, subsys, level, msg))
         if level <= log_lvl:
-            print(f"{ts:.6f} {self.name} {subsys:>6} {level} : {msg}",
-                  file=self.sink)
+            try:
+                print(f"{ts:.6f} {self.name} {subsys:>6} {level} : {msg}",
+                      file=self.sink)
+            except ValueError:
+                pass   # sink closed (daemon thread logging at teardown)
 
     def dump_recent(self, out=sys.stderr) -> None:
         self.ring.dump(out)

@@ -77,6 +77,15 @@ register_options([
     Option("ms_dispatch_workers", int, 64,
            "dispatcher thread pool width", Level.ADVANCED, min=1),
     Option("ms_crc_data", bool, True, "crc-protect message payloads"),
+    Option("ms_inject_socket_failures", int, 0,
+           "inject a socket reset roughly every N frames (0 = off; "
+           "reference ms_inject_socket_failures, options.cc:1071)",
+           min=0),
+    Option("ms_inject_delay_probability", float, 0.0,
+           "probability of delaying a frame write (reference "
+           "ms_inject_delay_probability)", min=0.0, max=1.0),
+    Option("ms_inject_delay_max", float, 0.1,
+           "max injected delay in seconds", min=0.0),
     # osd
     Option("osd_heartbeat_interval", float, 1.0,
            "seconds between peer pings", min=0.05),

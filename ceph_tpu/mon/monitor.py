@@ -128,8 +128,15 @@ class Monitor:
         Candidate: election retry (reference Monitor::tick)."""
         while not self._stop.wait(Paxos.LEASE_INTERVAL / 2):
             try:
+                self._reap_fwd_waiters()
                 if self.paxos.role == "leader":
-                    self.paxos.grant_lease()
+                    if not self.paxos.quorum_alive():
+                        # partitioned into a minority: stop serving
+                        with self.paxos.lock:
+                            self.paxos.role = "electing"
+                        self._on_quorum_loss()
+                    else:
+                        self.paxos.grant_lease()
                 elif not self.election.electing and \
                         not self.election.recently_deferred() and \
                         len(self.mon_addrs) > 1 and \
@@ -158,8 +165,19 @@ class Monitor:
                 "quorum": list(self.paxos.quorum),
                 "election_epoch": self.election.epoch}
 
+    def _reap_fwd_waiters(self, max_age: float = 30.0) -> None:
+        """Drop forwarded-command waiters whose leader died before
+        acking (the client has long since timed out and retried)."""
+        cutoff = time.time() - max_age
+        with self.lock:
+            for ftid in [t for t, e in self._fwd_waiters.items()
+                         if e[2] < cutoff]:
+                del self._fwd_waiters[ftid]
+
     def shutdown(self) -> None:
         self._stop.set()
+        with self.paxos.lock:
+            self.paxos.role = "down"   # wait_for_leader must skip us
         self.messenger.shutdown()
 
     # -- commit / publish ----------------------------------------------------
@@ -229,7 +247,8 @@ class Monitor:
                 with self.lock:
                     self._fwd_tid += 1
                     ftid = self._fwd_tid
-                    self._fwd_waiters[ftid] = (conn, msg.tid)
+                    self._fwd_waiters[ftid] = (conn, msg.tid,
+                                               time.time())
                 self._leader_conn().send_message(
                     M.MMonCommand(msg.cmd, ftid))
             else:
@@ -239,7 +258,7 @@ class Monitor:
             with self.lock:
                 ent = self._fwd_waiters.pop(msg.tid, None)
             if ent is not None:
-                oconn, otid = ent
+                oconn, otid, _ts = ent
                 try:
                     oconn.send_message(
                         M.MMonCommandAck(otid, msg.result, msg.out))

@@ -175,6 +175,7 @@ class Paxos:
         self.promised = 0                   # highest pn promised (peon)
         self.uncommitted: tuple | None = None   # (pn, value)
         self.lease_expire = 0.0             # peon-side lease
+        self.lease_acks: dict[int, float] = {}   # leader-side liveness
         self._round = None                  # in-flight round state
         self.proposal_lock = threading.Lock()  # one proposal at a time
 
@@ -191,6 +192,9 @@ class Paxos:
             self.leader = self.rank
             self.quorum = quorum
             self.pn = (election_epoch << 16) | self.rank
+            now = time.monotonic()
+            self.lease_acks = {p: now for p in range(self.n)
+                               if p != self.rank}
             self._collect = {
                 "acks": {self.rank},
                 "best": (self.get_committed(), None),   # (committed, unc)
@@ -304,8 +308,10 @@ class Paxos:
                     best_c = committed
                 if uncommitted and (
                         best_u is None or
-                        uncommitted[1].get("epoch", 0) >
-                        best_u[1].get("epoch", 0)):
+                        (uncommitted[1].get("epoch", 0), uncommitted[0])
+                        > (best_u[1].get("epoch", 0), best_u[0])):
+                    # tie-break equal map epochs by proposal number:
+                    # the majority-accepted value carries the higher pn
                     best_u = (uncommitted[0], uncommitted[1])
                 col["best"] = (best_c, best_u)
                 if len(col["acks"]) >= self.majority():
@@ -337,8 +343,18 @@ class Paxos:
                 self.on_commit(value)
         elif op == "lease":
             with self.lock:
+                # only OUR leader may extend the lease: a stale leader
+                # on the wrong side of a partition must not keep its
+                # minority serving old maps
+                if self.role != "peon" or from_rank != self.leader:
+                    return
                 self.lease_expire = time.monotonic() + \
                     3 * self.LEASE_INTERVAL
+            self.send(from_rank, op="lease_ack")
+        elif op == "lease_ack":
+            with self.lock:
+                if self.role == "leader":
+                    self.lease_acks[from_rank] = time.monotonic()
 
     # -- periodic -----------------------------------------------------------
 
@@ -346,3 +362,18 @@ class Paxos:
         with self.lock:
             return (self.role == "peon" and
                     time.monotonic() > self.lease_expire)
+
+    def quorum_alive(self) -> bool:
+        """Leader-side: do the peers' lease acks still witness a
+        majority?  A leader partitioned into a minority must stand down
+        rather than serve stale reads (reference Paxos lease_ack +
+        Monitor quorum health)."""
+        with self.lock:
+            if self.role != "leader":
+                return True
+            if self.n == 1:
+                return True
+            cutoff = time.monotonic() - 3 * self.LEASE_INTERVAL
+            live = 1 + sum(1 for t in self.lease_acks.values()
+                           if t > cutoff)
+            return live >= self.majority()

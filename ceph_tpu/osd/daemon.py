@@ -309,6 +309,23 @@ class OSDDaemon:
 
         self.messenger = Messenger(f"osd.{osd_id}")
         self.messenger.add_dispatcher(self._dispatch)
+        # fault-injection knobs ride the config system so the thrasher
+        # (and injectargs at runtime) can set them per daemon
+        # (reference ms_inject_* dev options, options.cc:1071-1092)
+        conf = self.cct.conf
+
+        def _apply_inject(_k=None, _v=None):
+            self.messenger.inject_socket_failures = \
+                int(conf.get("ms_inject_socket_failures"))
+            self.messenger.inject_delay_prob = \
+                float(conf.get("ms_inject_delay_probability"))
+            self.messenger.inject_delay_max = \
+                float(conf.get("ms_inject_delay_max"))
+        _apply_inject()
+        for _opt in ("ms_inject_socket_failures",
+                     "ms_inject_delay_probability",
+                     "ms_inject_delay_max"):
+            conf.add_observer(_opt, _apply_inject)
         self.addr = self.messenger.bind(addr)
         # one mon or a monmap list (reference MonClient hunting)
         from ..msg.addrs import normalize_mon_addrs

@@ -75,7 +75,11 @@ class Objecter:
 
     def _dispatch(self, conn, msg) -> None:
         if isinstance(msg, M.MMonMap):
-            self.osdmap = OSDMap.from_json(msg.map_json)
+            newmap = OSDMap.from_json(msg.map_json)
+            # multiple mons publish to us after rotation; a slower
+            # mon's older epoch must not regress the map
+            if newmap.epoch >= self.osdmap.epoch:
+                self.osdmap = newmap
             self.map_event.set()
         elif isinstance(msg, M.MOSDOpReply):
             with self._lock:

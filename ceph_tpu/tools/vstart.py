@@ -80,6 +80,19 @@ class Cluster:
         osd = self.osds[osd_id]
         osd.shutdown()
 
+    def revive_osd(self, osd_id: int) -> None:
+        """Restart a killed OSD on its surviving store (reference
+        qa/tasks/ceph_manager.py revive_osd): FileStore replays its
+        WAL on mount; MemStore data survives in-process."""
+        old = self.osds[osd_id]
+        asok = (f"{self.asok_dir}/osd.{osd_id}.asok"
+                if self.asok_dir else None)
+        osd = OSDDaemon(osd_id, self.mon_addrs, store=old.store,
+                        heartbeat_interval=self.heartbeat_interval,
+                        asok_path=asok)
+        self.osds[osd_id] = osd
+        osd.boot()
+
     def kill_mon(self, rank: int) -> None:
         """Hard-kill a monitor (quorum must re-elect)."""
         self.mons[rank].shutdown()

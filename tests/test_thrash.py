@@ -1,0 +1,132 @@
+"""Thrasher: kill/revive/out OSDs under a live write workload with
+messenger fault injection, then assert zero acked-data loss and a
+clean deep scrub.
+
+Reference analogs: qa/tasks/ceph_manager.py:247 (kill_osd thrash loop),
+qa/tasks/thrashosds.py, and the ms_inject_socket_failures soak style of
+qa/standalone tests.  This is the trust anchor for the write-safety
+stack: min_size gating, exactly-once messenger sessions, replicated PG
+logs + peering, and elastic recovery all run here under fire at once.
+"""
+
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.osdc.objecter import TimedOut
+from ceph_tpu.rados.client import RadosError
+from ceph_tpu.tools.vstart import Cluster
+
+
+def test_thrash_osds_no_acked_data_loss():
+    rng = np.random.default_rng(7)
+    pyrng = random.Random(7)
+    with Cluster(n_osds=7, heartbeat_interval=0.25) as c:
+        client = c.client()
+        client.set_ec_profile("thrash_p", {
+            "plugin": "jerasure", "k": "2", "m": "2",
+            "stripe_unit": "1024"})
+        client.create_pool("thrashpool", "erasure",
+                           erasure_code_profile="thrash_p", pg_num=8)
+        io = client.open_ioctx("thrashpool")
+        # light wire chaos everywhere: ~1/80 frames resets its socket
+        for osd in c.osds:
+            osd.cct.conf.set("ms_inject_socket_failures", 80)
+
+        acked: dict[str, bytes] = {}
+        stop = threading.Event()
+        write_errors = []
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                name = f"t{i}"
+                data = rng.integers(0, 256, 700 + (i % 5) * 331,
+                                    dtype=np.uint8).tobytes()
+                try:
+                    io.write_full(name, data)
+                    acked[name] = data   # server acked: must survive
+                except (TimedOut, RadosError):
+                    pass                 # refused/unacked: no promise
+                except Exception as e:  # noqa: BLE001
+                    write_errors.append(e)
+                    return
+                i += 1
+                time.sleep(0.02)
+
+        wt = threading.Thread(target=writer, daemon=True)
+        wt.start()
+        time.sleep(1.0)   # build a baseline of acked objects
+
+        # the thrash loop: kill -> (down via heartbeats/mon) -> revive;
+        # one cycle also outs/ins the victim to force CRUSH remaps
+        dead: set[int] = set()
+        for cycle in range(3):
+            victim = pyrng.choice([o for o in range(7) if o not in dead])
+            c.kill_osd(victim)
+            dead.add(victim)
+            c.mark_osd_down(victim)
+            if cycle == 1:
+                r, _ = client.mon_command(
+                    {"prefix": "osd out", "id": victim})
+                assert r == 0
+            time.sleep(2.0)   # let peering/recovery churn under load
+            c.revive_osd(victim)
+            dead.discard(victim)
+            if cycle == 1:
+                r, _ = client.mon_command(
+                    {"prefix": "osd in", "id": victim})
+                assert r == 0
+            time.sleep(1.0)
+
+        stop.set()
+        wt.join(10)
+        assert not write_errors, f"writer crashed: {write_errors[0]!r}"
+        assert len(acked) >= 20, \
+            f"workload too small to be meaningful: {len(acked)} acked"
+
+        # every acked write must be readable and bit-identical once the
+        # cluster settles (recovery + backfill converging)
+        deadline = time.time() + 60
+        missing = dict(acked)
+        last_err = None
+        while missing and time.time() < deadline:
+            for name in list(missing):
+                try:
+                    got = io.read(name, len(missing[name]))
+                    assert got == missing[name], \
+                        f"acked object {name} corrupted"
+                    del missing[name]
+                except AssertionError:
+                    raise
+                except Exception as e:  # noqa: BLE001
+                    last_err = e
+            if missing:
+                time.sleep(1.0)
+        assert not missing, \
+            f"{len(missing)} acked objects unreadable after settle " \
+            f"(e.g. {sorted(missing)[:3]}, last error {last_err!r})"
+
+        # turn injection off and deep-scrub every PG from its primary:
+        # shard payloads and hinfo crcs must agree everywhere
+        for osd in c.osds:
+            osd.cct.conf.set("ms_inject_socket_failures", 0)
+        deadline = time.time() + 60
+        while True:
+            errors = []
+            for osd in c.osds:
+                if not osd.osdmap.is_up(osd.osd_id):
+                    continue
+                try:
+                    out = osd._asok_scrub({"deep": True, "repair": True})
+                except Exception:  # noqa: BLE001
+                    continue
+                for pg, res in out.items():
+                    errors.extend(res["errors"])
+            if not errors or time.time() > deadline:
+                break
+            time.sleep(2.0)
+        assert not errors, f"scrub errors after thrash: {errors[:5]}"
