@@ -45,14 +45,15 @@ FWD_TID_BASE = 1 << 40
 
 class Monitor:
     def __init__(self, addr: tuple[str, int] = ("127.0.0.1", 0),
-                 failure_quorum: int = 2):
+                 failure_quorum: int = 2, auth=None, secure: bool = False):
         self.osdmap = OSDMap()
         self.osdmap.ec_profiles["default"] = dict(DEFAULT_EC_PROFILE)
         self.lock = threading.RLock()
         self.failure_quorum = failure_quorum
         self._failure_reports: dict[int, set[int]] = {}
         self._subscribers: list = []
-        self.messenger = Messenger("mon")
+        self.auth = auth       # auth.CephxAuth with keyring (AuthMonitor)
+        self.messenger = Messenger("mon", auth=auth, secure=secure)
         self.messenger.add_dispatcher(self._dispatch)
         self.addr = self.messenger.bind(addr)
         # quorum state (filled by join(); defaults to standalone)
@@ -204,8 +205,28 @@ class Monitor:
 
     # -- dispatch -----------------------------------------------------------
 
+    def _peer_kind(self, conn) -> str | None:
+        """Authenticated peer category: 'service' for cluster daemons,
+        'client_key'/'ticket' for clients, None when auth is off."""
+        if self.auth is None:
+            return None
+        ident = getattr(conn.session, "auth_identity", None)
+        return ident.get("kind") if ident else "none"
+
     def _dispatch(self, conn, msg) -> None:
+        kind = self._peer_kind(conn)
+        # privilege fence: consensus and daemon lifecycle traffic is
+        # cluster-internal — only service-keyed peers may speak it
+        # (reference MonCap service caps on mon/osd messages)
+        if kind is not None and kind != "service" and isinstance(
+                msg, (M.MMonPaxos, M.MOSDBoot, M.MOSDFailure)):
+            return
         if isinstance(msg, M.MMonPaxos):
+            # paxos peers must be monitors, not arbitrary daemons
+            ident = getattr(conn.session, "auth_identity", None)
+            if kind == "service" and ident and \
+                    ident.get("entity") != "mon":
+                return
             if msg.op in ("propose", "ack", "victory"):
                 self.election.handle(msg.rank, msg.op, msg.epoch,
                                      msg.quorum)
@@ -234,10 +255,15 @@ class Monitor:
                 self._handle_failure(msg)
             else:
                 self._forward(msg)
+        elif isinstance(msg, M.MAuth):
+            self._handle_auth(conn, msg)
         elif isinstance(msg, M.MMonCommand):
             prefix = msg.cmd.get("prefix", "")
-            if self.is_leader or (prefix in READONLY_COMMANDS and
-                                  self._lease_ok()):
+            if not self._caps_allow(conn, prefix):
+                conn.send_message(M.MMonCommandAck(
+                    msg.tid, -errno.EACCES, {"error": "caps deny"}))
+            elif self.is_leader or (prefix in READONLY_COMMANDS and
+                                    self._lease_ok()):
                 result, out = self.handle_command(msg.cmd)
                 conn.send_message(M.MMonCommandAck(msg.tid, result, out))
             elif self.paxos.leader >= 0 and \
@@ -271,6 +297,45 @@ class Monitor:
                 self._leader_conn().send_message(msg)
             except Exception:  # noqa: BLE001
                 pass
+
+    # -- auth (reference AuthMonitor + cephx ticket service) ----------------
+
+    def _caps_allow(self, conn, prefix: str) -> bool:
+        """Minimal caps model: daemons and 'allow *' entities do
+        anything; 'allow r' entities only read (reference MonCap is a
+        full grammar; this is the subset the keyring writes)."""
+        if self.auth is None:
+            return True
+        ident = getattr(conn.session, "auth_identity", None)
+        if ident is None:
+            return False
+        caps = ident.get("caps", "")
+        if "allow *" in caps:
+            return True
+        return prefix in READONLY_COMMANDS and "allow r" in caps
+
+    def _handle_auth(self, conn, msg: M.MAuth) -> None:
+        from ..auth import cephx
+        if self.auth is None or self.auth.keyring is None or \
+                self.auth.service_key is None:
+            conn.send_message(M.MAuthReply(msg.tid, -errno.EOPNOTSUPP))
+            return
+        ident = getattr(conn.session, "auth_identity", None)
+        key = self.auth.keyring.get(msg.entity)
+        # the ticket goes only to the entity the CONNECTION proved
+        if ident is None or ident["entity"] != msg.entity or key is None:
+            conn.send_message(M.MAuthReply(msg.tid, -errno.EPERM))
+            return
+        import base64
+        caps = self.auth.keyring.caps.get(msg.entity, "allow *")
+        ttl = 3600.0
+        expires = time.time() + ttl
+        ticket, skey = cephx.issue_ticket(
+            self.auth.service_key, msg.entity, caps, ttl=ttl)
+        sealed = cephx.seal(key, {
+            "session_key": base64.b64encode(skey).decode(),
+            "expires": expires})
+        conn.send_message(M.MAuthReply(msg.tid, 0, ticket, sealed))
 
     # -- osd lifecycle (leader only) ----------------------------------------
 

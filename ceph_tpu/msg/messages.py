@@ -490,6 +490,50 @@ class MPGListReply(Message):
         self.oids = meta["oids"]
 
 
+# -- auth (reference MAuth.h / MAuthReply.h, cephx ticket exchange) ----------
+
+@register_message
+class MAuth(Message):
+    """Client -> mon: issue me a service ticket (reference MAuth
+    carrying CephXRequest; the connection itself was already
+    authenticated with the client's own key)."""
+
+    type_id = 63
+
+    def __init__(self, entity: str = "", tid: int = 0):
+        super().__init__()
+        self.entity, self.tid = entity, tid
+
+    def to_meta(self):
+        return {"entity": self.entity, "tid": self.tid}
+
+    def decode_wire(self, meta, data):
+        self.entity, self.tid = meta["entity"], meta["tid"]
+
+
+@register_message
+class MAuthReply(Message):
+    """Mon -> client: sealed ticket + session key (session key sealed
+    under the CLIENT's key so only it can read it — reference
+    CephXTicketBlob + encrypted session key)."""
+
+    type_id = 64
+
+    def __init__(self, tid: int = 0, result: int = 0,
+                 ticket: str = "", sealed_key: str = ""):
+        super().__init__()
+        self.tid, self.result = tid, result
+        self.ticket, self.sealed_key = ticket, sealed_key
+
+    def to_meta(self):
+        return {"tid": self.tid, "result": self.result,
+                "ticket": self.ticket, "sealed_key": self.sealed_key}
+
+    def decode_wire(self, meta, data):
+        self.tid, self.result = meta["tid"], meta["result"]
+        self.ticket, self.sealed_key = meta["ticket"], meta["sealed_key"]
+
+
 # -- mon quorum (reference MMonElection.h / MMonPaxos.h) ---------------------
 
 @register_message

@@ -40,7 +40,7 @@ import threading
 import uuid
 from typing import Callable
 
-from .message import CTRL_ACK, CTRL_HELLO, Message, encode_frame
+from .message import CTRL_ACK, CTRL_ENC, CTRL_HELLO, Message, encode_frame
 
 Dispatcher = Callable[["Connection", Message], None]
 
@@ -92,6 +92,44 @@ class Session:
         self.broken = False
         self.down_since: float | None = None
         self.last_acked = 0       # highest seq we have acked to the peer
+        # auth state (per wire epoch; re-derived on every HELLO):
+        # conn_key signs/encrypts this connection, auth_identity is the
+        # verified peer {entity, caps} (reference CephXAuthorizer
+        # session_key + secure-mode keys from crypto_onwire.cc)
+        self.conn_key: bytes | None = None
+        self.secure = False
+        self.auth_identity: dict | None = None
+        self._enc_ctr = 0
+        self._enc_dir = b"\x01"   # \x01 = connector, \x02 = acceptor
+        self._aead = None         # cached AESGCM (one key schedule)
+
+    def set_conn_key(self, key: bytes | None, direction: bytes) -> None:
+        """Install the per-wire-epoch key; the counter reset is safe
+        because every HELLO derives a fresh key from a fresh nonce."""
+        self.conn_key = key
+        self._enc_ctr = 0
+        self._enc_dir = direction
+        if key is not None:
+            from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+            self._aead = AESGCM(key)
+        else:
+            self._aead = None
+
+    def wire_encrypt(self, raw: bytes) -> bytes:
+        """AES-GCM-wrap one plaintext frame for the wire (secure mode,
+        reference msg/async/crypto_onwire.cc rx/tx handlers)."""
+        self._enc_ctr += 1
+        nonce = self._enc_dir * 4 + self._enc_ctr.to_bytes(8, "little")
+        ct = self._aead.encrypt(nonce, raw, b"")
+        return encode_frame(CTRL_ENC, self._enc_ctr, {}, nonce + ct)
+
+    def wire_decrypt(self, data: bytes) -> bytes:
+        try:
+            return self._aead.decrypt(data[:12], data[12:], b"")
+        except Exception as e:  # noqa: BLE001 - InvalidTag et al
+            # surfaces as a session-preserving wire reset (same path as
+            # a crc failure in plain mode)
+            raise ValueError(f"secure frame rejected: {e}") from e
 
     def reset_epoch(self) -> None:
         """Abandon this session's delivery state and start a fresh epoch
@@ -221,23 +259,32 @@ class Connection:
             # wire dropped while we slept in the injected delay (the
             # accepted-conn read loop nulls it without the send lock)
             raise ConnectionResetError("wire dropped during delayed write")
+        if self.session.secure and self.session.conn_key:
+            raw = self.session.wire_encrypt(raw)
         writer.write(raw)
         await writer.drain()
 
     async def _connect(self) -> None:
         """Open the TCP stream and run the HELLO exchange: send our
-        entity + in_seq, read the peer's, trim + replay unacked."""
+        entity + in_seq (+ authorizer), read the peer's (+ mutual auth
+        proof), trim + replay unacked."""
         assert self.peer_addr is not None
         reader, writer = await asyncio.open_connection(*self.peer_addr)
         sess = self.session
-        hello = encode_frame(CTRL_HELLO, 0, {
-            "entity": self.messenger.entity,
+        m = self.messenger
+        hello_meta = {
+            "entity": m.entity,
             "session": sess.nonce,
             "in_seq": sess.in_seq,
             "peer_cookie": sess.peer_cookie,
             "lossless": self.lossless,
-        })
-        writer.write(hello)
+            "secure": m.secure,
+        }
+        authorizer = None
+        if m.auth is not None:
+            authorizer = m.auth.build_authorizer(secure=m.secure)
+            hello_meta["auth"] = authorizer
+        writer.write(encode_frame(CTRL_HELLO, 0, hello_meta))
         await writer.drain()
         tid, _seq, meta_raw, _data, _pcrc = await asyncio.wait_for(
             read_frame(reader), timeout=5.0)
@@ -245,6 +292,30 @@ class Connection:
             writer.close()
             raise ConnectionError(f"expected HELLO, got frame type {tid:#x}")
         meta = json.loads(meta_raw.decode())
+        if meta.get("auth_error"):
+            # bad credentials are fatal, not retryable
+            writer.close()
+            self._closed = True
+            raise ConnectionError(f"auth rejected: {meta['auth_error']}")
+        if authorizer is not None:
+            from ..auth.cephx import AuthError
+            try:
+                key = m.auth.check_reply(
+                    authorizer, meta.get("auth_reply"))
+            except AuthError as e:
+                writer.close()
+                self._closed = True
+                raise ConnectionError(str(e)) from e
+            sess.set_conn_key(key, b"\x01")
+            # the secure decision was authenticated by check_reply
+            # (mismatch already raised); m.secure == the agreed mode
+            sess.secure = m.secure
+            # mutual proof: whoever answered holds cluster-side
+            # credentials (service key, keyring, or our ticket's
+            # session key — all daemon-resident), so frames arriving
+            # on this outbound session are from a cluster daemon
+            sess.auth_identity = {"entity": meta.get("entity"),
+                                  "kind": "service", "caps": ""}
         self.peer_entity = meta.get("entity")
         cookie = meta.get("cookie")
         if self.lossless and cookie != sess.peer_cookie:
@@ -257,7 +328,8 @@ class Connection:
             sess.peer_cookie = cookie
         sess.reader, sess.writer = reader, writer
         for raw in sess.replay_frames(int(meta.get("in_seq", 0))):
-            writer.write(raw)
+            writer.write(sess.wire_encrypt(raw)
+                         if sess.secure and sess.conn_key else raw)
         await writer.drain()
         self.messenger._spawn_read_loop(self)
 
@@ -285,7 +357,10 @@ class Connection:
             return
         try:
             sess.last_acked = sess.in_seq
-            writer.write(encode_frame(CTRL_ACK, sess.in_seq, {}))
+            raw = encode_frame(CTRL_ACK, sess.in_seq, {})
+            if sess.secure and sess.conn_key:
+                raw = sess.wire_encrypt(raw)
+            writer.write(raw)
         except (ConnectionError, OSError):
             pass  # peer will learn our in_seq from the next HELLO
 
@@ -312,11 +387,18 @@ class Messenger:
     _loop_thread: threading.Thread | None = None
     _loop_lock = threading.Lock()
 
-    def __init__(self, name: str = "client"):
+    def __init__(self, name: str = "client", auth=None,
+                 secure: bool = False):
         self.name = name
         # Stable per-instance identity; the session key (reference
         # entity_name_t + nonce in the ProtocolV2 banner).
         self.entity = f"{name}.{uuid.uuid4().hex[:12]}"
+        # auth context (auth.CephxAuth) — when set, every accepted
+        # connection must present a verifiable authorizer and every
+        # outgoing HELLO carries one; secure=True additionally AES-GCM
+        # encrypts all frames under the per-connection key
+        self.auth = auth
+        self.secure = secure
         self.dispatcher: Dispatcher | None = None
         self.my_addr: tuple[str, int] | None = None
         self._server: asyncio.AbstractServer | None = None
@@ -402,6 +484,26 @@ class Messenger:
         entity = str(meta.get("entity", ""))
         lossless = bool(meta.get("lossless", True))
         nonce = str(meta.get("session", ""))
+        # authorizer gate (reference AuthAuthorizeHandler at accept):
+        # with an auth context, no verifiable authorizer -> no session
+        auth_identity = None
+        conn_key = None
+        auth_reply = None
+        if self.auth is not None:
+            from ..auth.cephx import AuthError
+            try:
+                auth_identity, conn_key, auth_reply = \
+                    self.auth.verify_authorizer(meta.get("auth"),
+                                                server_secure=self.secure)
+            except AuthError as e:
+                try:
+                    writer.write(encode_frame(CTRL_HELLO, 0, {
+                        "entity": self.entity, "auth_error": str(e)}))
+                    await writer.drain()
+                except (ConnectionError, OSError):
+                    pass
+                writer.close()
+                return
         self._prune_sessions()
         if lossless:
             sess = self._sessions.get(entity)
@@ -415,6 +517,10 @@ class Messenger:
             sess = Session(lossless=False, nonce=nonce)
         sess.drop_wire()          # supersede any stale stream
         sess.reader, sess.writer = reader, writer
+        sess.auth_identity = auth_identity
+        sess.set_conn_key(conn_key, b"\x02")
+        sess.secure = bool(auth_identity and
+                           auth_identity.get("secure"))
         conn = Connection(self, None, lossless=lossless, session=sess,
                           can_reconnect=False)
         conn.peer_entity = entity
@@ -425,16 +531,20 @@ class Messenger:
                           if c.session is not sess]
         self._accepted.append(conn)
         try:
-            writer.write(encode_frame(CTRL_HELLO, 0, {
-                "entity": self.entity, "in_seq": sess.in_seq,
-                "cookie": sess.local_cookie}))
+            reply_meta = {"entity": self.entity, "in_seq": sess.in_seq,
+                          "cookie": sess.local_cookie,
+                          "secure": sess.secure}
+            if auth_reply is not None:
+                reply_meta["auth_reply"] = auth_reply
+            writer.write(encode_frame(CTRL_HELLO, 0, reply_meta))
             # The client's in_seq only counts frames of THIS session
             # epoch if it has seen our cookie; a stale epoch's in_seq
             # must trim nothing or undelivered replies would be lost.
             peer_in = int(meta.get("in_seq", 0)) \
                 if meta.get("peer_cookie") == sess.local_cookie else 0
             for raw in sess.replay_frames(peer_in):
-                writer.write(raw)
+                writer.write(sess.wire_encrypt(raw)
+                             if sess.secure and sess.conn_key else raw)
             await writer.drain()
         except (ConnectionError, OSError):
             writer.close()
@@ -495,6 +605,20 @@ class Messenger:
                     # buffered old-epoch frame must not touch the fresh
                     # epoch's seq window (in_seq poisoning)
                     break
+                if tid == CTRL_ENC:
+                    if sess.conn_key is None:
+                        raise ValueError("encrypted frame on plain session")
+                    inner = sess.wire_decrypt(data)  # raises on tamper
+                    tid, seq, meta_len, data_len = \
+                        Message.parse_header(inner[:Message.HEADER_SIZE])
+                    off = Message.HEADER_SIZE
+                    meta_raw = inner[off:off + meta_len]
+                    data = inner[off + meta_len:off + meta_len + data_len]
+                    pcrc = int.from_bytes(inner[-4:], "little")
+                elif sess.secure and sess.conn_key is not None and \
+                        tid != CTRL_HELLO:
+                    # plaintext data frame on a secure session: reject
+                    raise ValueError("plaintext frame on secure session")
                 if tid == CTRL_ACK:
                     sess.trim_acked(seq)
                     continue

@@ -246,7 +246,8 @@ class OSDDaemon:
                  store: ObjectStore | None = None,
                  addr: tuple[str, int] = ("127.0.0.1", 0),
                  heartbeat_interval: float = 0.0,
-                 asok_path: str | None = None):
+                 asok_path: str | None = None,
+                 auth=None, secure: bool = False):
         from ..common.context import CephContext
         from ..common.perf_counters import PerfCountersBuilder
         self.osd_id = osd_id
@@ -307,7 +308,8 @@ class OSDDaemon:
         self._hb_last_seen: dict[int, float] = {}
         self._hb_first_ping: dict[int, float] = {}
 
-        self.messenger = Messenger(f"osd.{osd_id}")
+        self.messenger = Messenger(f"osd.{osd_id}", auth=auth,
+                                   secure=secure)
         self.messenger.add_dispatcher(self._dispatch)
         # fault-injection knobs ride the config system so the thrasher
         # (and injectargs at runtime) can set them per daemon
@@ -368,6 +370,16 @@ class OSDDaemon:
 
     def _dispatch(self, conn, msg) -> None:
         try:
+            # privilege fence (reference OSDCap): with auth on, only
+            # service-keyed peers (other daemons, the mon) may speak
+            # cluster-internal protocol; clients are limited to the
+            # public op surface
+            if self.messenger.auth is not None:
+                ident = getattr(conn.session, "auth_identity", None)
+                kind = ident.get("kind") if ident else "none"
+                if kind != "service" and not isinstance(
+                        msg, (M.MOSDOp, M.MWatchNotify)):
+                    return
             if isinstance(msg, M.MMonMap):
                 self._handle_map(msg)
             elif isinstance(msg, M.MOSDOp):

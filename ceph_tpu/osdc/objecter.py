@@ -26,8 +26,10 @@ class TimedOut(Exception):
 
 
 class Objecter:
-    def __init__(self, mon_addr, name: str = "client"):
-        self.messenger = Messenger(name)
+    def __init__(self, mon_addr, name: str = "client", auth=None,
+                 secure: bool = False):
+        self.auth = auth
+        self.messenger = Messenger(name, auth=auth, secure=secure)
         self.messenger.add_dispatcher(self._dispatch)
         # one (host, port) or a monmap-style list of them (reference
         # MonClient hunts across the monmap)
@@ -42,6 +44,7 @@ class Objecter:
         self._lock = threading.Lock()
         self._waiters: dict[int, dict] = {}
         self._mon_waiters: dict[int, dict] = {}
+        self._auth_waiters: dict[int, dict] = {}
         # linger ops: cookie -> callback(oid_name, payload)
         # (reference linger_ops / watch support, Objecter.h)
         self._watch_cbs: dict[int, object] = {}
@@ -58,6 +61,32 @@ class Objecter:
             self.map_event.clear()
         if self.osdmap.epoch == 0:
             raise TimedOut("no osdmap from mon")
+        # cephx: trade our client key for a service ticket so OSD
+        # connections can be authorized (reference MonClient
+        # authenticate + CephxTicketManager)
+        if self.auth is not None and self.auth.key is not None and \
+                self.auth.ticket_blob is None:
+            self._fetch_ticket()
+
+    def _fetch_ticket(self, timeout: float = 5.0) -> None:
+        import base64
+        from ..auth import cephx
+        with self._lock:
+            self._tid += 1
+            tid = self._tid
+            w = {"event": threading.Event(), "reply": None}
+            self._auth_waiters[tid] = w
+        self.mon_conn.send_message(M.MAuth(self.auth.entity, tid))
+        if not w["event"].wait(timeout):
+            raise TimedOut("no auth reply from mon")
+        reply = w["reply"]
+        if reply.result != 0:
+            raise PermissionError(
+                f"mon refused ticket: errno {-reply.result}")
+        sealed = cephx.unseal(self.auth.key, reply.sealed_key)
+        self.auth.set_ticket(
+            reply.ticket, base64.b64decode(sealed["session_key"]),
+            float(sealed.get("expires", 0.0)))
 
     def _rotate_mon(self) -> None:
         """Hunt to the next monitor (reference MonClient::_reopen_session
@@ -90,6 +119,12 @@ class Objecter:
         elif isinstance(msg, M.MMonCommandAck):
             with self._lock:
                 w = self._mon_waiters.pop(msg.tid, None)
+            if w is not None:
+                w["reply"] = msg
+                w["event"].set()
+        elif isinstance(msg, M.MAuthReply):
+            with self._lock:
+                w = self._auth_waiters.pop(msg.tid, None)
             if w is not None:
                 w["reply"] = msg
                 w["event"].set()
@@ -128,6 +163,15 @@ class Objecter:
     def op_submit(self, pool_id: int, name: str, ops: list,
                   data: bytes = b"", timeout: float = 30.0,
                   attempts: int = 3) -> M.MOSDOpReply:
+        # an expired ticket would make every OSD reconnect fail
+        # permanently; refresh before it lapses (reference
+        # CephxTicketManager renewal)
+        if self.auth is not None and self.auth.key is not None and \
+                not self.auth.ticket_valid():
+            try:
+                self._fetch_ticket()
+            except Exception:  # noqa: BLE001 - mon may be electing
+                pass
         oid = hobject_t(pool=pool_id, name=name)
         last_err = None
         for attempt in range(attempts):

@@ -24,8 +24,10 @@ class RadosError(Exception):
 
 
 class RadosClient:
-    def __init__(self, mon_addr: tuple[str, int], name: str = "client"):
-        self.objecter = Objecter(mon_addr, name)
+    def __init__(self, mon_addr, name: str = "client", auth=None,
+                 secure: bool = False):
+        self.objecter = Objecter(mon_addr, name, auth=auth,
+                                 secure=secure)
         self._pool = ThreadPoolExecutor(max_workers=16,
                                         thread_name_prefix="rados-aio")
 

@@ -34,12 +34,16 @@ def main(argv=None) -> int:
     ap.add_argument("--pg-num", type=int, default=8)
     ap.add_argument("--size", type=int, default=3)
     ap.add_argument("words", nargs="+")
+    from .rados_cli import add_auth_args, cli_auth
+    add_auth_args(ap)
     args = ap.parse_args(argv)
     words = args.words
 
     from ..osdc import Objecter
 
-    obj = Objecter(parse_addr(args.mon), "ceph-cli")
+    auth, secure = cli_auth(args)
+    obj = Objecter(parse_addr(args.mon), "ceph-cli", auth=auth,
+                   secure=secure)
     try:
         obj.start()
         cmd = None

@@ -24,6 +24,27 @@ def parse_addr(s: str) -> tuple[str, int]:
     return host, int(port)
 
 
+def cli_auth(args):
+    """--keyring/--name/--secure -> (auth_ctx, secure) for CLIs
+    (reference CEPH_KEYRING + --name plumbing in the tool frontends)."""
+    if not getattr(args, "keyring", None):
+        return None, False
+    from ..auth import CephxAuth, Keyring
+    kr = Keyring.load(args.keyring)
+    key = kr.get(args.name)
+    if key is None:
+        raise SystemExit(f"entity {args.name!r} not in {args.keyring}")
+    return CephxAuth(args.name, key=key), bool(args.secure)
+
+
+def add_auth_args(ap) -> None:
+    ap.add_argument("--keyring", default=None,
+                    help="keyring file (enables cephx)")
+    ap.add_argument("--name", default="client.admin")
+    ap.add_argument("--secure", action="store_true",
+                    help="AES-GCM frame mode")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="rados")
     ap.add_argument("-m", "--mon", required=True, help="mon HOST:PORT")
@@ -31,11 +52,14 @@ def main(argv=None) -> int:
     ap.add_argument("command", choices=("put", "get", "rm", "bench"))
     ap.add_argument("args", nargs="*")
     ap.add_argument("-b", "--block-size", type=int, default=1 << 20)
+    add_auth_args(ap)
     args = ap.parse_args(argv)
 
     from ..rados import RadosClient
 
-    client = RadosClient(parse_addr(args.mon)).connect()
+    auth, secure = cli_auth(args)
+    client = RadosClient(parse_addr(args.mon), auth=auth,
+                         secure=secure).connect()
     try:
         io = client.open_ioctx(args.pool)
         if args.command == "put":

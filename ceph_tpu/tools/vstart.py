@@ -27,9 +27,28 @@ class Cluster:
     def __init__(self, n_osds: int = 6, heartbeat_interval: float = 0.0,
                  failure_quorum: int = 2, asok_dir: str | None = None,
                  objectstore: str = "memstore",
-                 data_dir: str | None = None, n_mons: int = 1):
-        self.mons = [Monitor(failure_quorum=failure_quorum)
-                     for _ in range(n_mons)]
+                 data_dir: str | None = None, n_mons: int = 1,
+                 auth: str = "none", secure: bool = False):
+        # cephx deployment: one cluster service key shared by daemons,
+        # a keyring of client entities on the mon (reference
+        # vstart.sh's keyring bootstrap + ceph auth get-or-create)
+        self.auth_mode = auth
+        self.secure = secure
+        self.keyring = None
+        self.service_key = None
+        mon_auths = [None] * n_mons
+        if auth == "cephx":
+            import os as _os
+            from ..auth import CephxAuth, Keyring
+            self.keyring = Keyring()
+            self.service_key = _os.urandom(16)
+            self.keyring.gen_key("client.admin", "allow *")
+            mon_auths = [CephxAuth("mon", service_key=self.service_key,
+                                   keyring=self.keyring)
+                         for _ in range(n_mons)]
+        self.mons = [Monitor(failure_quorum=failure_quorum,
+                             auth=mon_auths[i], secure=secure)
+                     for i in range(n_mons)]
         self.mon_addrs = [m.addr for m in self.mons]
         if n_mons > 1:
             for i, m in enumerate(self.mons):
@@ -63,14 +82,29 @@ class Cluster:
                 f"{self.data_dir}/osd.{i}" if self.data_dir else None)
             osd = OSDDaemon(i, self.mon_addrs, store=store,
                             heartbeat_interval=self.heartbeat_interval,
-                            asok_path=asok)
+                            asok_path=asok, auth=self._daemon_auth(i),
+                            secure=self.secure)
             self.osds.append(osd)
         for osd in self.osds:
             osd.boot()
         return self
 
+    def _daemon_auth(self, osd_id: int):
+        if self.auth_mode != "cephx":
+            return None
+        from ..auth import CephxAuth
+        return CephxAuth(f"osd.{osd_id}", service_key=self.service_key)
+
+    def _client_auth(self):
+        if self.auth_mode != "cephx":
+            return None
+        from ..auth import CephxAuth
+        return CephxAuth("client.admin",
+                         key=self.keyring.get("client.admin"))
+
     def client(self) -> RadosClient:
-        c = RadosClient(self.mon_addrs).connect()
+        c = RadosClient(self.mon_addrs, auth=self._client_auth(),
+                        secure=self.secure).connect()
         self._clients.append(c)
         return c
 
@@ -89,7 +123,8 @@ class Cluster:
                 if self.asok_dir else None)
         osd = OSDDaemon(osd_id, self.mon_addrs, store=old.store,
                         heartbeat_interval=self.heartbeat_interval,
-                        asok_path=asok)
+                        asok_path=asok, auth=self._daemon_auth(osd_id),
+                        secure=self.secure)
         self.osds[osd_id] = osd
         osd.boot()
 
@@ -133,11 +168,19 @@ def main(argv=None) -> int:
     ap.add_argument("--data-dir", default=None,
                     help="store root (filestore)")
     ap.add_argument("--asok-dir", default=None)
+    ap.add_argument("--auth", choices=("none", "cephx"), default="none")
+    ap.add_argument("--secure", action="store_true")
+    ap.add_argument("--keyring-out", default=None,
+                    help="write the client keyring here (cephx)")
     args = ap.parse_args(argv)
     cluster = Cluster(args.osds, heartbeat_interval=args.heartbeat,
                       asok_dir=args.asok_dir,
                       objectstore=args.objectstore,
-                      data_dir=args.data_dir, n_mons=args.mons).start()
+                      data_dir=args.data_dir, n_mons=args.mons,
+                      auth=args.auth, secure=args.secure).start()
+    if args.auth == "cephx" and args.keyring_out:
+        cluster.keyring.save(args.keyring_out)
+        print(f"keyring written to {args.keyring_out}", flush=True)
     print(f"mon at {cluster.mon.addr}; {args.mons} mons, "
           f"{args.osds} osds up; Ctrl-C to stop", flush=True)
     try:

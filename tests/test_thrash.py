@@ -75,6 +75,8 @@ def test_thrash_osds_no_acked_data_loss():
                 assert r == 0
             time.sleep(2.0)   # let peering/recovery churn under load
             c.revive_osd(victim)
+            # the revived daemon has a fresh CephContext: re-arm chaos
+            c.osds[victim].cct.conf.set("ms_inject_socket_failures", 80)
             dead.discard(victim)
             if cycle == 1:
                 r, _ = client.mon_command(
