@@ -484,6 +484,7 @@ class Messenger:
         entity = str(meta.get("entity", ""))
         lossless = bool(meta.get("lossless", True))
         nonce = str(meta.get("session", ""))
+        claimed_entity = entity
         # authorizer gate (reference AuthAuthorizeHandler at accept):
         # with an auth context, no verifiable authorizer -> no session
         auth_identity = None
@@ -504,6 +505,12 @@ class Messenger:
                     pass
                 writer.close()
                 return
+            # Session resumption is a capability of the AUTHENTICATED
+            # identity: a peer holding different credentials must not
+            # resume (and thereby hijack + drain the replay window of)
+            # another daemon's session just by claiming its entity
+            # string from a sniffed HELLO.
+            entity = f"{auth_identity['entity']}/{claimed_entity}"
         self._prune_sessions()
         if lossless:
             sess = self._sessions.get(entity)
@@ -523,7 +530,7 @@ class Messenger:
                            auth_identity.get("secure"))
         conn = Connection(self, None, lossless=lossless, session=sess,
                           can_reconnect=False)
-        conn.peer_entity = entity
+        conn.peer_entity = claimed_entity
         peer = writer.get_extra_info("peername")
         conn.peer_addr = peer[:2] if peer else None
         # one facade per session: drop superseded ones from the registry

@@ -169,6 +169,12 @@ class MessengerShardBackend(ShardBackend):
         raw = reply.attrs.get(HINFO_KEY)
         return HashInfo.decode(raw) if raw else None
 
+    def get_attrs(self, shard, oid):
+        reply = self._stat_rpc(shard, oid, want_attrs=True)
+        if reply is None or reply.result != 0:
+            return None
+        return dict(reply.attrs)
+
     def stat(self, shard, oid):
         reply = self._stat_rpc(shard, oid, want_attrs=False)
         if reply is None or reply.result != 0 or reply.size < 0:
@@ -521,12 +527,16 @@ class OSDDaemon:
     def _list_pg_objects(self, spg: spg_t) -> list:
         """Enumerate user objects of a shard collection, hiding the
         per-PG log/info meta object (the reference keeps pg metadata in
-        a separate meta collection; here it's a reserved name)."""
+        a separate meta collection; here it's a reserved name) and
+        rollback generations (reference ghobject NO_GEN filtering in
+        collection_list)."""
         from .pg_log import PG_META_NAME
+        from .types import NO_GEN
         try:
             return [M.hobj_to_json(g.hobj)
                     for g in self.store.list_objects(self._cid(spg))
-                    if g.hobj.name != PG_META_NAME]
+                    if g.hobj.name != PG_META_NAME
+                    and g.generation == NO_GEN]
         except KeyError:
             return []
 
@@ -547,6 +557,21 @@ class OSDDaemon:
             return []
         ev.wait(timeout)
         return box.get("oids", [])
+
+    def _make_recovery_push(self, pgid: pg_t, acting: list[int],
+                            oid: hobject_t):
+        """Shared recovery sink: write a rebuilt shard chunk (+ its
+        integrity attrs) to its acting home (used by epoch recovery and
+        post-peering repair)."""
+        from .ec_util import recovery_attrs
+
+        def push(s, data, hinfo):
+            txn = Transaction()
+            goid = shard_oid(oid, s)
+            txn.write(goid, 0, data)
+            txn.setattrs(goid, recovery_attrs(hinfo, data))
+            self._push_shard_txn(acting[s], spg_t(pgid, s), txn)
+        return push
 
     def _push_shard_txn(self, osd: int, spg: spg_t, txn,
                         timeout: float = 20.0) -> bool:
@@ -697,6 +722,16 @@ class OSDDaemon:
                              _crc.crc32c(data.tobytes(), 0xFFFFFFFF) !=
                              auth_hinfo.get_chunk_hash(s))):
                         continue   # stale leftover from an older interval
+                    if auth_hinfo is not None and \
+                            not auth_hinfo.crc_valid:
+                        # overwritten object: at least require the
+                        # candidate to match its own chunk_crc (bitrot)
+                        from .ec_util import CHUNK_CRC_KEY
+                        cc = (attrs or {}).get(CHUNK_CRC_KEY)
+                        if cc is not None and \
+                                int.from_bytes(cc, "little") != \
+                                _crc.crc32c(data.tobytes(), 0xFFFFFFFF):
+                            continue
                     txn = Transaction()
                     goid = shard_oid(oid, s)
                     txn.write(goid, 0, data)
@@ -721,15 +756,9 @@ class OSDDaemon:
             try:
                 hinfo = be._get_hinfo(oid)
 
-                def push(s, data, hinfo=hinfo, oid=oid):
-                    txn = Transaction()
-                    goid = shard_oid(oid, s)
-                    txn.write(goid, 0, data)
-                    from .ec_util import HINFO_KEY
-                    txn.setattr(goid, HINFO_KEY, hinfo.encode())
-                    self._push_shard_txn(acting[s], spg_t(pgid, s), txn)
-
-                be.recover_shard(oid, still_missing, push)
+                be.recover_shard(
+                    oid, still_missing,
+                    self._make_recovery_push(pgid, acting, oid))
                 self.cct.dout("osd", 5,
                               f"recovered {oid.name} shards "
                               f"{still_missing} of pg {pgid} by decode")
@@ -806,8 +835,11 @@ class OSDDaemon:
         slog.append_to_txn(txn, entries, at_version)
         self.store.queue_transactions(self._cid(spg), [txn])
         slog.record(entries, at_version)
+        from .ec_util import refresh_chunk_crcs
+        refresh_chunk_crcs(self.store, self._cid(spg), spg.shard,
+                           entries)
         if rollforward_to is not None:
-            slog.log.roll_forward_to(rollforward_to)
+            slog.advance_rollforward(rollforward_to)
 
     def _handle_activate(self, msg: M.MPGActivate) -> None:
         from .pg_log import entry_from_wire
@@ -1037,23 +1069,32 @@ class OSDDaemon:
             if not missing:
                 continue
             try:
-                def push(s, data, hinfo, oid=oid):
-                    txn = Transaction()
-                    goid = shard_oid(oid, s)
-                    txn.write(goid, 0, data)
-                    txn.setattr(goid, HINFO_KEY, hinfo.encode())
-                    self._push_shard_txn(acting[s], spg_t(pgid, s), txn)
-
-                be.recover_shard(oid, missing, push)
+                be.recover_shard(
+                    oid, missing,
+                    self._make_recovery_push(pgid, acting, oid))
             except Exception as e:  # noqa: BLE001
                 self.cct.dout("osd", 1,
                               f"post-peering recovery of {oid.name} "
                               f"failed: {e!r}")
         return complete
 
+    WRITE_OPS = {"write", "writefull", "truncate", "delete", "setxattr",
+                 "call", "notify"}
+
     def _handle_client_op(self, conn, msg: M.MOSDOp) -> None:
         """reference PrimaryLogPG::do_op/do_osd_ops: decode the op
         vector, build a PGTransaction for mutations, execute reads."""
+        # OSDCap check: a read-only client credential cannot mutate
+        # (reference OSDCap grammar reduced to the keyring's subset)
+        if self.messenger.auth is not None:
+            ident = getattr(conn.session, "auth_identity", None) or {}
+            caps = ident.get("caps", "")
+            if ident.get("kind") in ("ticket", "client_key") and \
+                    "allow *" not in caps and "allow w" not in caps and \
+                    any(op[0] in self.WRITE_OPS for op in msg.ops):
+                conn.send_message(M.MOSDOpReply(
+                    msg.tid, -errno.EACCES, b"", self.osdmap.epoch))
+                return
         self.perf.inc("op")
         _t0 = time.perf_counter()
         state = self._get_pg(msg.pgid.pgid)

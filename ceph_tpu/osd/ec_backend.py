@@ -66,6 +66,11 @@ class ShardBackend:
     def get_hinfo(self, shard: int, oid: hobject_t) -> HashInfo | None:
         raise NotImplementedError
 
+    def get_attrs(self, shard: int, oid: hobject_t) -> dict | None:
+        """All xattrs of the shard object (hinfo + chunk_crc + user);
+        None when the shard object is absent."""
+        raise NotImplementedError
+
     def stat(self, shard: int, oid: hobject_t) -> int | None:
         raise NotImplementedError
 
@@ -93,8 +98,10 @@ class LocalShardBackend(ShardBackend):
         self.store.queue_transactions(self.cids[shard], [txn])
         if log_entries:
             slog.record(log_entries, at_version)
+            ec_util.refresh_chunk_crcs(self.store, self.cids[shard],
+                                       shard, log_entries)
         if rollforward_to is not None:
-            slog.log.roll_forward_to(rollforward_to)
+            slog.advance_rollforward(rollforward_to)
         on_commit(shard)
 
     def sub_read(self, shard, oid, off, length, on_done):
@@ -116,6 +123,13 @@ class LocalShardBackend(ShardBackend):
         except KeyError:
             return None
         return HashInfo.decode(raw)
+
+    def get_attrs(self, shard, oid):
+        try:
+            return self.store.getattrs(self.cids[shard],
+                                       shard_oid(oid, shard))
+        except KeyError:
+            return None
 
     def stat(self, shard, oid):
         try:
@@ -496,6 +510,7 @@ class ECBackend:
         # + ecbackend.rst local-rollbackability).  Snapshot rollback
         # state BEFORE generate_transactions mutates the hinfo.
         entries: list[LogEntry] = []
+        gen_oids: set[hobject_t] = set()
         for oid, objop in op.txn.ops.items():
             rb = RollbackInfo()
             old_size = op.plan.sizes.get(oid, 0)
@@ -511,8 +526,7 @@ class ECBackend:
                         aligned_old))
                 # pure_append == undo is a truncate: tail-only writes,
                 # no truncate of existing data, and no user xattr
-                # mutations (those aren't captured for undo; rollback
-                # falls back to remove+recover from auth shards)
+                # mutations
                 rb.pure_append = (
                     bool(op.plan.will_write.get(oid))
                     and all(e.off >= aligned_old
@@ -520,12 +534,19 @@ class ECBackend:
                     and (objop.truncate_to is None or not existed)
                     and not objop.attrs)
                 rb.hinfo_old = hinfo.encode() if existed else None
+            # anything not a pure append keeps the old object under a
+            # generation so the shard can roll it back locally
+            # (reference ecbackend.rst local-rollbackability contract)
+            if objop.delete or (existed and not rb.pure_append):
+                rb.kept_generation = op.version.version
+                gen_oids.add(oid)
             self.log.add(LogEntry(
                 op.version, oid,
                 LogOp.DELETE if objop.delete else LogOp.MODIFY, rb))
             entries.append(self.log.entries[-1])
         txns, _ = ect.generate_transactions(
-            self.sinfo, self.n, op.plan, op.txn, encoded, crcs)
+            self.sinfo, self.n, op.plan, op.txn, encoded, crcs,
+            gen=op.version.version, gen_oids=gen_oids)
         op.state = "committing"
         op.pending_commits = self.n
         self.waiting_commit.append(op)

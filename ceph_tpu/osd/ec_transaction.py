@@ -161,6 +161,8 @@ def generate_transactions(
     txn: PGTransaction,
     encoded: dict[tuple[hobject_t, int], np.ndarray],
     encoded_crcs: dict[tuple[hobject_t, int], list[int]] | None = None,
+    gen: int | None = None,
+    gen_oids: set[hobject_t] | None = None,
 ) -> tuple[dict[int, Transaction], dict[hobject_t, HashInfo]]:
     """Turn encoded extents + metadata ops into per-shard Transactions.
 
@@ -174,13 +176,27 @@ def generate_transactions(
     ECTransaction.cc:25-60 encode_and_write).
     """
     encoded_crcs = encoded_crcs or {}
+    gen_oids = gen_oids or set()
     txns = {s: Transaction() for s in range(n_shards)}
     new_hinfos: dict[hobject_t, HashInfo] = {}
     for oid, op in txn.ops.items():
+        # Object generations (reference ecbackend.rst:9-27 "delete
+        # keeps the old generation"): a mutation that cannot be undone
+        # by truncation snapshots the shard object under the op's
+        # generation id first, making EVERY entry locally rollbackable.
+        keep_gen = gen is not None and oid in gen_oids
         if op.delete:
             for s in range(n_shards):
-                txns[s].remove(shard_oid(oid, s))
+                if keep_gen:
+                    txns[s].rename(shard_oid(oid, s),
+                                   shard_oid(oid, s, generation=gen))
+                else:
+                    txns[s].remove(shard_oid(oid, s))
             continue
+        if keep_gen:
+            for s in range(n_shards):
+                txns[s].clone(shard_oid(oid, s),
+                              shard_oid(oid, s, generation=gen))
         hinfo = plan.hash_infos[oid]
         for ext in plan.will_write.get(oid, []):
             shards = encoded[(oid, ext.off)]
@@ -193,10 +209,11 @@ def generate_transactions(
             elif appending:
                 hinfo.append(chunk_off, shards)
             else:
-                # overwrite inside the object: incremental crc no longer
-                # valid; reference bumps generations — we mark invalidated
-                hinfo.truncate(max(hinfo.total_chunk_size,
-                                   chunk_off + chunk_run))
+                # overwrite inside the object: incremental crc is dead
+                # even at unchanged size; the generation kept above
+                # carries rollback, the shard chunk_crc carries integrity
+                hinfo.invalidate(max(hinfo.total_chunk_size,
+                                     chunk_off + chunk_run))
             for s in range(n_shards):
                 txns[s].write(shard_oid(oid, s), chunk_off, shards[s])
         if op.truncate_to is not None:

@@ -73,6 +73,57 @@ class StripeInfo:
 
 
 HINFO_KEY = "hinfo_key"  # shard xattr name (reference ECUtil.cc get_hinfo_key)
+# Per-shard full-chunk crc32c, maintained BY THE SHARD on every write
+# once the object's cumulative hinfo is invalidated by an overwrite
+# (the integrity story for overwritten objects; the reference's
+# allow_ec_overwrites pools lean on deep-scrub reads the same way).
+CHUNK_CRC_KEY = "chunk_crc"
+
+
+def chunk_crc_of(data) -> bytes:
+    from ..common import crc32c as _crc32c
+    import numpy as _np
+    return _crc32c.crc32c(_np.asarray(data).tobytes(),
+                          0xFFFFFFFF).to_bytes(4, "little")
+
+
+def recovery_attrs(hinfo: "HashInfo", data) -> dict[str, bytes]:
+    """Xattrs a freshly-rebuilt shard should carry: the hinfo always,
+    plus a chunk_crc when the hinfo's cumulative hashes are dead."""
+    attrs = {HINFO_KEY: hinfo.encode()}
+    if hinfo.invalidated:
+        attrs[CHUNK_CRC_KEY] = chunk_crc_of(data)
+    return attrs
+
+
+def refresh_chunk_crcs(store, cid, shard: int, entries) -> None:
+    """Shard-side integrity upkeep after applying a sub-write: an
+    object that has entered overwrite mode (a generation was kept, or
+    a chunk_crc attr already exists from an earlier overwrite) gets
+    its full-chunk crc recomputed from local bytes.  Pure appends on
+    never-overwritten objects skip this — their cumulative hinfo is
+    still authoritative."""
+    from .pg_log import LogOp
+    from .types import ghobject_t
+    seen = set()
+    for e in entries:
+        if e.op is not LogOp.MODIFY or e.oid in seen:
+            continue
+        seen.add(e.oid)
+        goid = ghobject_t(e.oid, shard=shard)
+        if e.rollback.kept_generation is None:
+            try:
+                store.getattr(cid, goid, CHUNK_CRC_KEY)
+            except KeyError:
+                continue   # append-only object: hinfo covers it
+        try:
+            data = store.read(cid, goid)
+        except KeyError:
+            continue
+        from ..store.object_store import Transaction
+        txn = Transaction()
+        txn.setattr(goid, CHUNK_CRC_KEY, chunk_crc_of(data))
+        store.queue_transactions(cid, [txn])
 
 
 @dataclass
@@ -93,6 +144,10 @@ class HashInfo:
     total_chunk_size: int = 0
     cumulative_shard_hashes: list[int] = field(default_factory=list)
     logical_size: int = 0
+    # Sticky: once an in-place overwrite/shrink broke the cumulative
+    # crcs, later appends fold onto meaningless seeds — the flag must
+    # survive so consumers switch to the per-shard chunk_crc attr.
+    invalidated: bool = False
 
     @classmethod
     def make(cls, n_shards: int) -> "HashInfo":
@@ -122,33 +177,42 @@ class HashInfo:
                                         for h in new_hashes]
         self.total_chunk_size += added
 
-    def truncate(self, new_size: int) -> None:
-        """EC can only roll back appends; a truncate to a smaller size
-        invalidates incremental crcs, so reset (reference keeps old
-        generations instead — same observable contract for scrub)."""
-        if new_size != self.total_chunk_size:
+    def invalidate(self, new_size: int | None = None) -> None:
+        """An in-place change breaks the incremental crcs permanently
+        (sticky flag); rollback safety comes from the object generation
+        kept at overwrite time, and integrity from the shard-maintained
+        chunk_crc attr.  NOTE: a same-size overwrite must invalidate
+        too — stale cumulative crcs over new bytes read as corruption."""
+        if new_size is not None:
             self.total_chunk_size = new_size
-            self.cumulative_shard_hashes = [
-                0xFFFFFFFF] * len(self.cumulative_shard_hashes)
-            self.invalidated = True
+        self.cumulative_shard_hashes = [
+            0xFFFFFFFF] * len(self.cumulative_shard_hashes)
+        self.invalidated = True
+
+    def truncate(self, new_size: int) -> None:
+        if new_size != self.total_chunk_size:
+            self.invalidate(new_size)
 
     def get_chunk_hash(self, shard: int) -> int:
         return self.cumulative_shard_hashes[shard]
 
     @property
     def crc_valid(self) -> bool:
-        """False once truncate/overwrite reset the cumulative hashes
-        (all back at the -1 seed with bytes present): consumers must
-        not treat the seeds as real chunk crcs."""
-        return self.total_chunk_size == 0 or \
-            any(h != 0xFFFFFFFF for h in self.cumulative_shard_hashes)
+        """False once an overwrite/shrink broke the cumulative hashes:
+        consumers must use the per-shard chunk_crc attr instead."""
+        return not self.invalidated and (
+            self.total_chunk_size == 0 or
+            any(h != 0xFFFFFFFF for h in self.cumulative_shard_hashes))
 
     # -- persistence (shard xattr) -----------------------------------------
 
+    _MAGIC_V2 = b"HIv2"
+
     def encode(self) -> bytes:
         import struct
-        return struct.pack(
-            "<QQI", self.total_chunk_size, self.logical_size,
+        return self._MAGIC_V2 + struct.pack(
+            "<QQII", self.total_chunk_size, self.logical_size,
+            1 if self.invalidated else 0,
             len(self.cumulative_shard_hashes)) + b"".join(
             int(h).to_bytes(4, "little")
             for h in self.cumulative_shard_hashes)
@@ -156,10 +220,18 @@ class HashInfo:
     @classmethod
     def decode(cls, raw: bytes) -> "HashInfo":
         import struct
-        size, logical, n = struct.unpack_from("<QQI", raw)
-        hashes = [int.from_bytes(raw[20 + 4 * i:24 + 4 * i], "little")
-                  for i in range(n)]
-        return cls(size, hashes, logical)
+        if raw[:4] == cls._MAGIC_V2:
+            size, logical, flags, n = struct.unpack_from("<QQII", raw, 4)
+            off = 4 + 24
+            inval = bool(flags & 1)
+        else:
+            # legacy (pre-invalidated-flag) layout: <QQI + hashes
+            size, logical, n = struct.unpack_from("<QQI", raw)
+            off = 20
+            inval = False
+        hashes = [int.from_bytes(raw[off + 4 * i:off + 4 + 4 * i],
+                                 "little") for i in range(n)]
+        return cls(size, hashes, logical, invalidated=inval)
 
 
 def encode(sinfo: StripeInfo, ec_impl: ErasureCodeInterface,

@@ -84,7 +84,8 @@ def entry_to_wire(e: LogEntry) -> list:
             [e.oid.pool, e.oid.name, e.oid.key, e.oid.snap, e.oid.hash],
             e.op.value, rb.append_old_size, rb.old_chunk_size,
             rb.pure_append,
-            rb.hinfo_old.hex() if rb.hinfo_old is not None else None]
+            rb.hinfo_old.hex() if rb.hinfo_old is not None else None,
+            rb.kept_generation]
 
 
 def entry_from_wire(w: list) -> LogEntry:
@@ -92,7 +93,8 @@ def entry_from_wire(w: list) -> LogEntry:
         eversion_t(w[0], w[1]), hobject_t(*w[2]), LogOp(w[3]),
         RollbackInfo(append_old_size=w[4], old_chunk_size=w[5],
                      pure_append=w[6],
-                     hinfo_old=bytes.fromhex(w[7]) if w[7] else None))
+                     hinfo_old=bytes.fromhex(w[7]) if w[7] else None,
+                     kept_generation=w[8] if len(w) > 8 else None))
 
 
 def _omap_key(e: LogEntry) -> bytes:
@@ -202,6 +204,22 @@ class ShardPGLog:
             if e.version >= self.log.head:
                 self.log.add(e)
 
+    def advance_rollforward(self, rf: eversion_t) -> None:
+        """Entries at or below rf are durable everywhere: their kept
+        generations will never be rolled back to — reclaim them
+        (reference trim_rollback_object on rollforward,
+        ECBackend.cc try_finish_rmw)."""
+        newly = self.log.roll_forward_to(rf)
+        purge = [e for e in newly
+                 if e.rollback.kept_generation is not None]
+        if not purge:
+            return
+        txn = _txn()
+        for e in purge:
+            txn.remove(ghobject_t(e.oid, e.rollback.kept_generation,
+                                  self.shard))
+        self.store.queue_transactions(self.cid, [txn])
+
     def set_les(self, les: int) -> None:
         self.info.last_epoch_started = max(
             self.info.last_epoch_started, les)
@@ -236,10 +254,10 @@ class ShardPGLog:
 
     def rollback_to(self, v: eversion_t) -> list[hobject_t]:
         """Undo local entries newer than v.  Pure appends truncate back
-        (and restore the prior hinfo xattr); anything else removes the
-        shard object outright and reports it, so the primary's recovery
-        rebuilds it from the authoritative shards (which never applied
-        the divergent entry, hence still hold the pre-entry state).
+        (and restore the prior hinfo xattr); overwrites/deletes restore
+        the object generation snapshotted at write time; only legacy
+        entries with neither are removed and reported, so the primary's
+        recovery rebuilds them from the authoritative shards.
         Returns the oids needing such recovery."""
         from .ec_util import HINFO_KEY
 
@@ -250,7 +268,16 @@ class ShardPGLog:
         for e in undone:
             goid = ghobject_t(e.oid, shard=self.shard)
             rb = e.rollback
-            if (e.op is LogOp.MODIFY and rb.pure_append
+            has_gen = rb.kept_generation is not None and \
+                self.store.exists(self.cid, ghobject_t(
+                    e.oid, rb.kept_generation, self.shard))
+            if has_gen:
+                # the generation IS the pre-entry object (data + attrs)
+                gen_goid = ghobject_t(e.oid, rb.kept_generation,
+                                      self.shard)
+                txn.remove(goid)
+                txn.rename(gen_goid, goid)
+            elif (e.op is LogOp.MODIFY and rb.pure_append
                     and rb.old_chunk_size is not None):
                 if rb.old_chunk_size == 0 and rb.hinfo_old is None:
                     txn.remove(goid)

@@ -51,13 +51,19 @@ class ScrubResult:
 
 def scrub_object(backend: ECBackend, oid: hobject_t,
                  deep: bool = True) -> list[ScrubError]:
+    from .ec_util import CHUNK_CRC_KEY, HashInfo
     errors: list[ScrubError] = []
     n = backend.n
     hinfos = {}
     sizes = {}
+    chunk_crcs = {}
     for s in range(n):
         sizes[s] = backend.shards.stat(s, oid)
-        hinfos[s] = backend.shards.get_hinfo(s, oid)
+        attrs = backend.shards.get_attrs(s, oid) or {}
+        raw = attrs.get(HINFO_KEY)
+        hinfos[s] = HashInfo.decode(raw) if raw else None
+        cc = attrs.get(CHUNK_CRC_KEY)
+        chunk_crcs[s] = int.from_bytes(cc, "little") if cc else None
     present = [s for s in range(n) if sizes[s] is not None]
     if not present:
         return errors
@@ -83,7 +89,7 @@ def scrub_object(backend: ECBackend, oid: hobject_t,
     for s in present:
         if hinfos[s] is None:
             errors.append(ScrubError(oid, s, "hinfo", "missing hinfo"))
-        elif ref_hinfo is not None and \
+        elif ref_hinfo is not None and not ref_hinfo.invalidated and \
                 hinfos[s].cumulative_shard_hashes != \
                 ref_hinfo.cumulative_shard_hashes:
             errors.append(ScrubError(oid, s, "hinfo",
@@ -107,7 +113,18 @@ def scrub_object(backend: ECBackend, oid: hobject_t,
             if data is None:
                 continue
             got = _crc.crc32c(np.asarray(data).tobytes(), 0xFFFFFFFF)
-            want = ref_hinfo.get_chunk_hash(s)
+            # integrity source: cumulative hinfo for append-only
+            # objects; the shard's self-maintained chunk_crc once an
+            # overwrite invalidated the hinfo
+            if ref_hinfo.invalidated:
+                want = chunk_crcs[s]
+                if want is None:
+                    errors.append(ScrubError(
+                        oid, s, "crc_source",
+                        "overwritten object lacks chunk_crc"))
+                    continue
+            else:
+                want = ref_hinfo.get_chunk_hash(s)
             if got != want:
                 errors.append(ScrubError(
                     oid, s, "crc_mismatch", f"{got:#x} != {want:#x}"))
@@ -172,10 +189,11 @@ def _repair_shards(backend: ECBackend, oid: hobject_t,
         return
     erasures = [s for s in range(backend.n) if s not in got]
     rebuilt = backend.ec_impl.decode_chunks(dense, erasures)
+    from .ec_util import recovery_attrs
     for s in bad_shards:
         txn = Transaction()
         goid = shard_oid(oid, s)
         txn.remove(goid)
         txn.write(goid, 0, rebuilt[s])
-        txn.setattr(goid, HINFO_KEY, hinfo.encode())
+        txn.setattrs(goid, recovery_attrs(hinfo, rebuilt[s]))
         backend.shards.sub_write(s, txn, lambda _s: None)

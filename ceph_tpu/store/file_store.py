@@ -230,6 +230,14 @@ class FileStore(ObjectStore):
                 self._set_size(b, cid, op.dst,
                                self._size(cid, op.src) or 0)
                 b.rm(self._okey(cid, op.src, "S"))
+                # attrs and omap travel with the object (generations
+                # rely on rename preserving the hinfo xattr)
+                for kind in ("A", "O"):
+                    for k, v in list(self.kv.iterate(
+                            self._okey(cid, op.src, kind))):
+                        suffix = k.decode().rsplit("/", 1)[-1]
+                        b.set(self._okey(cid, op.dst, kind, suffix), v)
+                        b.rm(k)
         elif isinstance(op, os_.OpOmapSet):
             for k, v in op.kv.items():
                 b.set(self._okey(cid, op.oid, "O", k.hex()), v)

@@ -108,12 +108,16 @@ def test_shard_local_rollback_pure_append():
     assert [e.version.version for e in slog.log.entries] == [1]
 
 
-def test_shard_local_rollback_overwrite_removes():
-    """A divergent overwrite isn't locally undoable (pre-generations):
-    the shard object is removed and reported for recovery."""
+def test_shard_local_rollback_overwrite_via_generation():
+    """A divergent overwrite rolls back from the generation kept at
+    write time — fully local, bit-identical, nothing reported for
+    remote recovery (reference ecbackend.rst local-rollbackability)."""
     backend, store = make_backend()
     rng = np.random.default_rng(3)
     put(backend, "w", rng.integers(0, 256, 256, dtype=np.uint8), 1)
+    cid = spg_t(pg_t(1, 0), 1)
+    goid = shard_oid(hobject_t(pool=1, name="w"), 1)
+    before = store.read(cid, goid).tobytes()
     # in-place overwrite of the first bytes (RMW path)
     txn = PGTransaction()
     txn.write(hobject_t(pool=1, name="w"), 0,
@@ -123,12 +127,12 @@ def test_shard_local_rollback_overwrite_removes():
                                lambda: done.append(1))
     assert done
     slog = backend.shards.shard_logs[1]
-    assert not slog.log.entries[-1].rollback.pure_append
+    entry = slog.log.entries[-1]
+    assert not entry.rollback.pure_append
+    assert entry.rollback.kept_generation == 2
     removed = slog.rollback_to(eversion_t(1, 1))
-    assert removed == [hobject_t(pool=1, name="w")]
-    cid = spg_t(pg_t(1, 0), 1)
-    goid = shard_oid(hobject_t(pool=1, name="w"), 1)
-    assert not store.exists(cid, goid)
+    assert removed == []
+    assert store.read(cid, goid).tobytes() == before
 
 
 # -- tier 3: cluster peering ------------------------------------------------
