@@ -109,4 +109,4 @@ class ClsContext:
 
 # -- built-in classes --------------------------------------------------------
 
-from . import cls_lock, cls_numops, cls_refcount  # noqa: E402,F401
+from . import cls_lock, cls_numops, cls_refcount, cls_rgw  # noqa: E402,F401

@@ -1,0 +1,99 @@
+"""cls_rgw-role: the bucket directory object class.
+
+Re-expresses the slice of reference src/cls/rgw/cls_rgw.cc the gateway
+needs: the bucket index lives in a directory object the OSD mutates
+server-side, so index updates are atomic with respect to each other
+(reference cls_rgw_bucket_dir_entry + rgw_bucket_dir ops; the OSD
+serializes CALL ops per object).
+
+Idiomatic shift: the reference keeps one omap row per entry; here the
+directory is a JSON document in the object body (this build's EC/
+replicated PGTransaction does not carry omap — and the reference also
+restricts omap to replicated pools, so index pools are small-metadata
+pools either way).  The op surface (add/rm/list with prefix+marker
+pagination) is the same.
+"""
+
+from __future__ import annotations
+
+import json
+
+from . import ClsError, register_class
+
+
+def _load(ctx) -> dict:
+    raw = ctx.read()
+    if not raw:
+        return {}
+    try:
+        return json.loads(raw.decode())
+    except ValueError as e:
+        raise ClsError(5, f"corrupt bucket dir: {e}") from e
+
+
+def _store(ctx, d: dict) -> None:
+    ctx.write_full(json.dumps(d, separators=(",", ":")).encode())
+
+
+def dir_init(ctx, _inp: bytes) -> bytes:
+    if not ctx.read():
+        _store(ctx, {})
+    return b""
+
+
+def dir_add(ctx, inp: bytes) -> bytes:
+    """input: {"key": str, "meta": {...}} — upsert one entry."""
+    req = json.loads(inp.decode())
+    d = _load(ctx)
+    d[req["key"]] = req.get("meta", {})
+    _store(ctx, d)
+    return b""
+
+
+def dir_rm(ctx, inp: bytes) -> bytes:
+    req = json.loads(inp.decode())
+    d = _load(ctx)
+    if req["key"] not in d:
+        raise ClsError(2, "no such key")
+    del d[req["key"]]
+    _store(ctx, d)
+    return b""
+
+
+def dir_get(ctx, inp: bytes) -> bytes:
+    req = json.loads(inp.decode())
+    d = _load(ctx)
+    ent = d.get(req["key"])
+    if ent is None:
+        raise ClsError(2, "no such key")
+    return json.dumps(ent).encode()
+
+
+def dir_list(ctx, inp: bytes) -> bytes:
+    """input: {"prefix": str, "marker": str, "max": int} ->
+    {"entries": [[key, meta]...], "truncated": bool} in key order
+    (reference rgw_bucket_dir list with pagination)."""
+    req = json.loads(inp.decode()) if inp else {}
+    prefix = req.get("prefix", "")
+    marker = req.get("marker", "")
+    limit = int(req.get("max", 1000))
+    d = _load(ctx)
+    keys = sorted(k for k in d
+                  if k.startswith(prefix) and k > marker)
+    out = [[k, d[k]] for k in keys[:limit]]
+    return json.dumps({"entries": out,
+                       "truncated": len(keys) > limit}).encode()
+
+
+def dir_count(ctx, _inp: bytes) -> bytes:
+    return str(len(_load(ctx))).encode()
+
+
+register_class("rgw", {
+    "dir_init": dir_init,
+    "dir_add": dir_add,
+    "dir_rm": dir_rm,
+    "dir_get": dir_get,
+    "dir_list": dir_list,
+    "dir_count": dir_count,
+})

@@ -307,6 +307,9 @@ class OSDDaemon:
         # in the pg meta collection) + peering RPC plumbing
         self.shard_logs: dict = {}
         self.peer_waiters: dict = {}
+        # striped per-object op ordering (bounded; rare false sharing
+        # is harmless — it only over-serializes)
+        self._obj_locks = [threading.Lock() for _ in range(256)]
         self._created_cids: set[spg_t] = set()
         self.heartbeat_interval = heartbeat_interval
         self._hb_stop = threading.Event()
@@ -754,8 +757,6 @@ class OSDDaemon:
                 continue
             # 2: reconstruct-from-k via the EC decode path
             try:
-                hinfo = be._get_hinfo(oid)
-
                 be.recover_shard(
                     oid, still_missing,
                     self._make_recovery_push(pgid, acting, oid))
@@ -1079,7 +1080,16 @@ class OSDDaemon:
         return complete
 
     WRITE_OPS = {"write", "writefull", "truncate", "delete", "setxattr",
-                 "call", "notify"}
+                 "call", "notify", "watch", "unwatch"}
+
+    @staticmethod
+    def _caps_can_write(caps: str) -> bool:
+        """'allow *' or any allow grant containing w ('allow w',
+        'allow rw', 'allow rwx' — the OSDCap spellings the keyring
+        writes)."""
+        import re
+        return "allow *" in caps or \
+            re.search(r"allow\s+[rx]*w", caps) is not None
 
     def _handle_client_op(self, conn, msg: M.MOSDOp) -> None:
         """reference PrimaryLogPG::do_op/do_osd_ops: decode the op
@@ -1090,13 +1100,22 @@ class OSDDaemon:
             ident = getattr(conn.session, "auth_identity", None) or {}
             caps = ident.get("caps", "")
             if ident.get("kind") in ("ticket", "client_key") and \
-                    "allow *" not in caps and "allow w" not in caps and \
+                    not self._caps_can_write(caps) and \
                     any(op[0] in self.WRITE_OPS for op in msg.ops):
                 conn.send_message(M.MOSDOpReply(
                     msg.tid, -errno.EACCES, b"", self.osdmap.epoch))
                 return
         self.perf.inc("op")
         _t0 = time.perf_counter()
+        # Per-object op ordering (reference PrimaryLogPG do_op obc
+        # ordering): ALL ops on one object serialize — cls calls are
+        # read-modify-write and must not interleave with each other OR
+        # with plain writes.  Striped locks keep the table bounded.
+        key = (msg.pgid.pgid.pool, msg.oid.name)
+        with self._obj_locks[hash(key) % len(self._obj_locks)]:
+            self._do_client_op(conn, msg, _t0)
+
+    def _do_client_op(self, conn, msg: M.MOSDOp, _t0: float) -> None:
         state = self._get_pg(msg.pgid.pgid)
         be = state.backend
         txn = PGTransaction()

@@ -89,7 +89,7 @@ def scrub_object(backend: ECBackend, oid: hobject_t,
     for s in present:
         if hinfos[s] is None:
             errors.append(ScrubError(oid, s, "hinfo", "missing hinfo"))
-        elif ref_hinfo is not None and not ref_hinfo.invalidated and \
+        elif ref_hinfo is not None and ref_hinfo.crc_valid and \
                 hinfos[s].cumulative_shard_hashes != \
                 ref_hinfo.cumulative_shard_hashes:
             errors.append(ScrubError(oid, s, "hinfo",
@@ -115,8 +115,9 @@ def scrub_object(backend: ECBackend, oid: hobject_t,
             got = _crc.crc32c(np.asarray(data).tobytes(), 0xFFFFFFFF)
             # integrity source: cumulative hinfo for append-only
             # objects; the shard's self-maintained chunk_crc once an
-            # overwrite invalidated the hinfo
-            if ref_hinfo.invalidated:
+            # overwrite invalidated the hinfo (crc_valid also covers
+            # legacy blobs persisted before the sticky flag existed)
+            if not ref_hinfo.crc_valid:
                 want = chunk_crcs[s]
                 if want is None:
                     errors.append(ScrubError(

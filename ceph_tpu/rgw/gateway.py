@@ -1,0 +1,241 @@
+"""S3 REST frontend (radosgw role).
+
+Re-expresses the reference's civetweb/beast + rgw_rest_s3 stack
+(src/rgw/rgw_rest_s3.cc op dispatch, rgw_op.cc:RGWListBucket/RGWPutObj/
+RGWGetObj/RGWDeleteObj...) over Python's threading HTTP server: the
+S3 dialect subset a librados-backed object store needs —
+
+  GET  /                bucket listing (ListAllMyBucketsResult)
+  PUT  /b               create bucket
+  DELETE /b             delete bucket (409 BucketNotEmpty)
+  GET  /b?list-type=2   ListBucketResult v2 (prefix/start-after/max-keys)
+  PUT  /b/k             put object (ETag = md5)
+  GET  /b/k             get object
+  HEAD /b/k             object metadata
+  DELETE /b/k           delete object
+
+Requests authenticate with AWS SigV4 (sigv4.py) unless the gateway is
+constructed without credentials.
+"""
+
+from __future__ import annotations
+
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from xml.sax.saxutils import escape
+
+from . import sigv4
+from .store import RGWError, RGWStore
+
+
+def _xml_error(code: str, msg: str) -> bytes:
+    return (f'<?xml version="1.0" encoding="UTF-8"?>'
+            f"<Error><Code>{escape(code)}</Code>"
+            f"<Message>{escape(msg)}</Message></Error>").encode()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "ceph-tpu-rgw/1.0"
+
+    # quiet request logging (the daemon's dout owns the log surface)
+    def log_message(self, fmt, *args):  # noqa: A003
+        pass
+
+    @property
+    def gw(self) -> "S3Gateway":
+        return self.server.gateway
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(length) if length else b""
+
+    def _reply(self, status: int, body: bytes = b"",
+               content_type: str = "application/xml",
+               extra: dict | None = None) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        if self.command != "HEAD":
+            self.wfile.write(body)
+
+    def _fail(self, e: RGWError) -> None:
+        self._reply(e.status, _xml_error(e.code, str(e)))
+
+    def _route(self) -> None:
+        parsed = urllib.parse.urlsplit(self.path)
+        path = urllib.parse.unquote(parsed.path)
+        body = self._read_body()
+        if self.gw.creds is not None:
+            try:
+                sigv4.verify_request(
+                    self.command, parsed.path, parsed.query,
+                    dict(self.headers), body, self.gw.creds)
+            except sigv4.SigError as e:
+                self._reply(403, _xml_error("SignatureDoesNotMatch",
+                                            str(e)))
+                return
+        parts = path.lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = parts[1] if len(parts) > 1 else None
+        query = dict(urllib.parse.parse_qsl(
+            parsed.query, keep_blank_values=True))
+        try:
+            if not bucket:
+                self._service_get()
+            elif key is None or key == "":
+                self._bucket_op(bucket, query, body)
+            else:
+                self._object_op(bucket, key, body)
+        except RGWError as e:
+            self._fail(e)
+        except Exception as e:  # noqa: BLE001 - surface as 500
+            self._reply(500, _xml_error("InternalError", repr(e)))
+
+    do_GET = do_PUT = do_DELETE = do_HEAD = _route
+
+    # -- service -------------------------------------------------------------
+
+    def _service_get(self) -> None:
+        if self.command != "GET":
+            self._reply(405, _xml_error("MethodNotAllowed", self.command))
+            return
+        rows = "".join(
+            f"<Bucket><Name>{escape(b)}</Name></Bucket>"
+            for b, _m in self.gw.store.list_buckets())
+        self._reply(200, (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            "<ListAllMyBucketsResult>"
+            f"<Buckets>{rows}</Buckets>"
+            "</ListAllMyBucketsResult>").encode())
+
+    # -- buckets -------------------------------------------------------------
+
+    def _bucket_op(self, bucket: str, query: dict, body: bytes) -> None:
+        st = self.gw.store
+        if self.command == "PUT":
+            st.create_bucket(bucket)
+            self._reply(200)
+        elif self.command == "DELETE":
+            st.delete_bucket(bucket)
+            self._reply(204)
+        elif self.command in ("GET", "HEAD"):
+            if self.command == "HEAD":
+                if st.bucket_exists(bucket):
+                    self._reply(200)
+                else:
+                    self._reply(404, _xml_error("NoSuchBucket", bucket))
+                return
+            prefix = query.get("prefix", "")
+            marker = query.get("start-after",
+                               query.get("continuation-token", ""))
+            max_keys = int(query.get("max-keys", 1000))
+            entries, truncated = st.list_objects(
+                bucket, prefix, marker, max_keys)
+            rows = "".join(
+                "<Contents>"
+                f"<Key>{escape(k)}</Key>"
+                f"<Size>{m['size']}</Size>"
+                f"<ETag>&quot;{m['etag']}&quot;</ETag>"
+                "</Contents>" for k, m in entries)
+            nct = (f"<NextContinuationToken>{escape(entries[-1][0])}"
+                   f"</NextContinuationToken>"
+                   if truncated and entries else "")
+            self._reply(200, (
+                '<?xml version="1.0" encoding="UTF-8"?>'
+                "<ListBucketResult>"
+                f"<Name>{escape(bucket)}</Name>"
+                f"<Prefix>{escape(prefix)}</Prefix>"
+                f"<KeyCount>{len(entries)}</KeyCount>"
+                f"<IsTruncated>{'true' if truncated else 'false'}"
+                f"</IsTruncated>{nct}{rows}"
+                "</ListBucketResult>").encode())
+        else:
+            self._reply(405, _xml_error("MethodNotAllowed", self.command))
+
+    # -- objects -------------------------------------------------------------
+
+    def _object_op(self, bucket: str, key: str, body: bytes) -> None:
+        st = self.gw.store
+        if self.command == "PUT":
+            etag = st.put_object(bucket, key, body)
+            self._reply(200, extra={"ETag": f'"{etag}"'})
+        elif self.command == "GET":
+            data, meta = st.get_object(bucket, key)
+            self._reply(200, data, "application/octet-stream",
+                        {"ETag": f'"{meta["etag"]}"'})
+        elif self.command == "HEAD":
+            meta = st.head_object(bucket, key)
+            self.send_response(200)
+            self.send_header("Content-Length", str(meta["size"]))
+            self.send_header("ETag", f'"{meta["etag"]}"')
+            self.end_headers()
+        elif self.command == "DELETE":
+            st.delete_object(bucket, key)
+            self._reply(204)
+        else:
+            self._reply(405, _xml_error("MethodNotAllowed", self.command))
+
+
+class S3Gateway:
+    """One radosgw instance: an RGWStore + the HTTP frontend."""
+
+    def __init__(self, client, addr: tuple[str, int] = ("127.0.0.1", 0),
+                 creds: dict[str, str] | None = None,
+                 ec_profile: str | None = None):
+        self.store = RGWStore(client, ec_profile=ec_profile)
+        self.creds = creds          # access_key -> secret; None = open
+        self.httpd = ThreadingHTTPServer(addr, _Handler)
+        self.httpd.gateway = self
+        self.addr = self.httpd.server_address[:2]
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True,
+            name="rgw-frontend")
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def main(argv=None) -> int:
+    import argparse
+    import sys
+    import time
+
+    ap = argparse.ArgumentParser(prog="radosgw")
+    ap.add_argument("-m", "--mon", required=True, help="mon HOST:PORT")
+    ap.add_argument("--port", type=int, default=7480)
+    ap.add_argument("--access-key", default=None)
+    ap.add_argument("--secret", default=None)
+    ap.add_argument("--ec-profile", default=None,
+                    help="EC profile for the data pool")
+    from ..tools.rados_cli import add_auth_args, cli_auth, parse_addr
+    add_auth_args(ap)
+    args = ap.parse_args(argv)
+    from ..rados import RadosClient
+    auth, secure = cli_auth(args)
+    client = RadosClient(parse_addr(args.mon), "rgw", auth=auth,
+                         secure=secure).connect()
+    creds = {args.access_key: args.secret} \
+        if args.access_key and args.secret else None
+    gw = S3Gateway(client, ("0.0.0.0", args.port), creds=creds,
+                   ec_profile=args.ec_profile)
+    print(f"radosgw listening on {gw.addr}", flush=True)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        gw.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,157 @@
+"""RGWRados role: bucket/object layout over librados.
+
+Re-expresses the reference's src/rgw/rgw_rados.cc storage model at the
+fidelity the S3 surface needs:
+
+- bucket registry: a directory object ("buckets") in the meta pool,
+  maintained by the rgw object class (atomic server-side updates —
+  reference cls_rgw + the RGWRados bucket metadata handlers)
+- per-bucket index: one directory object ("index.<bucket>") in the
+  meta pool (reference bucket index shards; one shard here)
+- object data: one rados object per S3 object in the data pool, named
+  with a length-prefixed bucket separator so keys may contain any
+  character (reference rgw_obj raw-object naming)
+
+The data pool may be erasure-coded (pass an EC profile); the meta pool
+is replicated, matching the reference's constraint that index pools be
+replicated.
+"""
+
+from __future__ import annotations
+
+import errno
+import hashlib
+import json
+import time
+
+from ..rados.client import RadosError
+
+META_POOL = ".rgw.meta"
+DATA_POOL = ".rgw.data"
+BUCKETS_OBJ = "buckets"
+
+
+class RGWError(Exception):
+    def __init__(self, status: int, code: str, msg: str = ""):
+        super().__init__(f"{code}: {msg}")
+        self.status = status
+        self.code = code
+
+
+def _data_oid(bucket: str, key: str) -> str:
+    return f"{len(bucket)}_{bucket}_{key}"
+
+
+class RGWStore:
+    def __init__(self, client, ec_profile: str | None = None,
+                 pg_num: int = 8):
+        self.client = client
+        self._ensure_pools(ec_profile, pg_num)
+        self.meta = client.open_ioctx(META_POOL)
+        self.data = client.open_ioctx(DATA_POOL)
+        self._cls(self.meta, BUCKETS_OBJ, "dir_init")
+
+    def _ensure_pools(self, ec_profile, pg_num) -> None:
+        for name, kind in ((META_POOL, "replicated"),
+                           (DATA_POOL,
+                            "erasure" if ec_profile else "replicated")):
+            try:
+                kw = {"pg_num": pg_num}
+                if kind == "erasure":
+                    kw["erasure_code_profile"] = ec_profile
+                else:
+                    kw["size"] = 2
+                self.client.create_pool(name, kind, **kw)
+            except RadosError as e:
+                if e.errno != errno.EEXIST:
+                    raise
+
+    def _cls(self, io, oid: str, method: str, payload: dict | None = None
+             ) -> bytes:
+        inp = json.dumps(payload).encode() if payload is not None else b""
+        return io.execute(oid, "rgw", method, inp)
+
+    # -- buckets -------------------------------------------------------------
+
+    def create_bucket(self, bucket: str) -> None:
+        if not bucket or "/" in bucket:
+            raise RGWError(400, "InvalidBucketName", bucket)
+        self._cls(self.meta, BUCKETS_OBJ, "dir_add", {
+            "key": bucket,
+            "meta": {"created": time.time()}})
+        self._cls(self.meta, f"index.{bucket}", "dir_init")
+
+    def bucket_exists(self, bucket: str) -> bool:
+        try:
+            self._cls(self.meta, BUCKETS_OBJ, "dir_get", {"key": bucket})
+            return True
+        except RadosError:
+            return False
+
+    def delete_bucket(self, bucket: str) -> None:
+        self._require_bucket(bucket)
+        count = int(self._cls(self.meta, f"index.{bucket}", "dir_count"))
+        if count:
+            raise RGWError(409, "BucketNotEmpty", bucket)
+        self._cls(self.meta, BUCKETS_OBJ, "dir_rm", {"key": bucket})
+        try:
+            self.meta.remove(f"index.{bucket}")
+        except RadosError:
+            pass
+
+    def list_buckets(self) -> list[tuple[str, dict]]:
+        out = json.loads(self._cls(self.meta, BUCKETS_OBJ, "dir_list",
+                                   {"max": 10000}).decode())
+        return [(k, m) for k, m in out["entries"]]
+
+    def _require_bucket(self, bucket: str) -> None:
+        if not self.bucket_exists(bucket):
+            raise RGWError(404, "NoSuchBucket", bucket)
+
+    # -- objects -------------------------------------------------------------
+
+    def put_object(self, bucket: str, key: str, body: bytes) -> str:
+        """Returns the ETag (md5 hex, S3 semantics)."""
+        self._require_bucket(bucket)
+        etag = hashlib.md5(body).hexdigest()
+        self.data.write_full(_data_oid(bucket, key), body)
+        self._cls(self.meta, f"index.{bucket}", "dir_add", {
+            "key": key, "meta": {"size": len(body), "etag": etag,
+                                 "mtime": time.time()}})
+        return etag
+
+    def head_object(self, bucket: str, key: str) -> dict:
+        self._require_bucket(bucket)
+        try:
+            raw = self._cls(self.meta, f"index.{bucket}", "dir_get",
+                            {"key": key})
+        except RadosError as e:
+            raise RGWError(404, "NoSuchKey", key) from e
+        return json.loads(raw.decode())
+
+    def get_object(self, bucket: str, key: str) -> tuple[bytes, dict]:
+        meta = self.head_object(bucket, key)
+        body = self.data.read(_data_oid(bucket, key), meta["size"])
+        return body, meta
+
+    def delete_object(self, bucket: str, key: str) -> None:
+        self._require_bucket(bucket)
+        try:
+            self._cls(self.meta, f"index.{bucket}", "dir_rm",
+                      {"key": key})
+        except RadosError as e:
+            raise RGWError(404, "NoSuchKey", key) from e
+        try:
+            self.data.remove(_data_oid(bucket, key))
+        except RadosError:
+            pass
+
+    def list_objects(self, bucket: str, prefix: str = "",
+                     marker: str = "", max_keys: int = 1000
+                     ) -> tuple[list[tuple[str, dict]], bool]:
+        self._require_bucket(bucket)
+        out = json.loads(self._cls(
+            self.meta, f"index.{bucket}", "dir_list",
+            {"prefix": prefix, "marker": marker,
+             "max": max_keys}).decode())
+        return [(k, m) for k, m in out["entries"]], out["truncated"]

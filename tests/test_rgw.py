@@ -1,0 +1,145 @@
+"""RGW-role S3 gateway tests: bucket/object lifecycle, listing
+pagination, SigV4 auth, EC-backed data pool.
+
+Reference analogs: src/rgw/rgw_op.cc op surface, src/cls/rgw bucket
+index behavior, and the s3-tests smoke subset (create/put/get/list/
+delete + auth failures)."""
+
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ceph_tpu.rgw import S3Gateway
+from ceph_tpu.rgw import sigv4
+from ceph_tpu.tools.vstart import Cluster
+
+ACCESS, SECRET = "testid", "testsecret"
+
+
+class S3Client:
+    """Raw-HTTP S3 client signing with SigV4 (boto-shaped surface)."""
+
+    def __init__(self, addr, access=ACCESS, secret=SECRET):
+        self.base = f"http://{addr[0]}:{addr[1]}"
+        self.host = f"{addr[0]}:{addr[1]}"
+        self.access, self.secret = access, secret
+
+    def request(self, method, path, query="", body=b""):
+        headers = {"host": self.host}
+        headers.update(sigv4.sign_request(
+            method, path, query, headers, body, self.access,
+            self.secret))
+        url = self.base + path + (f"?{query}" if query else "")
+        req = urllib.request.Request(url, data=body if body else None,
+                                     method=method, headers=headers)
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+
+
+@pytest.fixture(scope="module")
+def gw():
+    with Cluster(n_osds=4) as c:
+        client = c.client()
+        client.set_ec_profile("rgw_ec", {
+            "plugin": "jerasure", "k": "2", "m": "1",
+            "stripe_unit": "1024"})
+        gateway = S3Gateway(client, creds={ACCESS: SECRET},
+                            ec_profile="rgw_ec")
+        yield gateway
+        gateway.shutdown()
+
+
+@pytest.fixture(scope="module")
+def s3(gw):
+    return S3Client(gw.addr)
+
+
+def test_bucket_lifecycle(s3):
+    st, _, _ = s3.request("PUT", "/buck1")
+    assert st == 200
+    st, _, body = s3.request("GET", "/")
+    assert st == 200 and b"<Name>buck1</Name>" in body
+    st, _, _ = s3.request("DELETE", "/buck1")
+    assert st == 204
+    st, _, body = s3.request("GET", "/")
+    assert b"buck1" not in body
+
+
+def test_object_put_get_head_delete(s3):
+    s3.request("PUT", "/data1")
+    rng = np.random.default_rng(0)
+    payload = rng.integers(0, 256, 50000, dtype=np.uint8).tobytes()
+    st, hdrs, _ = s3.request("PUT", "/data1/some/nested/key.bin",
+                             body=payload)
+    assert st == 200
+    etag = hdrs["ETag"].strip('"')
+    st, hdrs, got = s3.request("GET", "/data1/some/nested/key.bin")
+    assert st == 200 and got == payload
+    assert hdrs["ETag"].strip('"') == etag
+    st, hdrs, _ = s3.request("HEAD", "/data1/some/nested/key.bin")
+    assert st == 200 and int(hdrs["Content-Length"]) == len(payload)
+    st, _, _ = s3.request("DELETE", "/data1/some/nested/key.bin")
+    assert st == 204
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        s3.request("GET", "/data1/some/nested/key.bin")
+    assert ei.value.code == 404
+
+
+def test_listing_prefix_and_pagination(s3):
+    s3.request("PUT", "/list1")
+    for i in range(7):
+        s3.request("PUT", f"/list1/a/{i:02d}", body=b"x" * (i + 1))
+    s3.request("PUT", "/list1/b/zz", body=b"y")
+    st, _, body = s3.request("GET", "/list1",
+                             query="list-type=2&prefix=a/")
+    assert st == 200
+    assert body.count(b"<Key>") == 7 and b"b/zz" not in body
+    # pagination: 3 at a time
+    keys = []
+    marker = ""
+    while True:
+        q = "list-type=2&max-keys=3" + \
+            (f"&start-after={marker}" if marker else "")
+        st, _, body = s3.request("GET", "/list1", query=q)
+        import re
+        page = re.findall(rb"<Key>([^<]+)</Key>", body)
+        keys.extend(page)
+        if b"<IsTruncated>true</IsTruncated>" not in body:
+            break
+        marker = page[-1].decode()
+    assert len(keys) == 8 and keys == sorted(keys)
+
+
+def test_bucket_not_empty_and_missing(s3):
+    s3.request("PUT", "/full1")
+    s3.request("PUT", "/full1/obj", body=b"z")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        s3.request("DELETE", "/full1")
+    assert ei.value.code == 409
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        s3.request("GET", "/no_such_bucket", query="list-type=2")
+    assert ei.value.code == 404
+
+
+def test_bad_signature_rejected(gw):
+    bad = S3Client(gw.addr, secret="wrong")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        bad.request("GET", "/")
+    assert ei.value.code == 403
+    anon = urllib.request.Request(
+        f"http://{gw.addr[0]}:{gw.addr[1]}/", method="GET")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(anon, timeout=10)
+    assert ei.value.code == 403
+
+
+def test_data_rides_ec_pool(gw, s3):
+    """The S3 data pool is erasure-coded: verify placement by checking
+    the pool type on the cluster map."""
+    store = gw.store
+    pool = store.client.objecter.osdmap.lookup_pool(".rgw.data")
+    assert pool is not None and pool.is_erasure()
+    meta = store.client.objecter.osdmap.lookup_pool(".rgw.meta")
+    assert meta is not None and not meta.is_erasure()
