@@ -86,6 +86,13 @@ register_options([
            "ms_inject_delay_probability)", min=0.0, max=1.0),
     Option("ms_inject_delay_max", float, 0.1,
            "max injected delay in seconds", min=0.0),
+    Option("ms_compress", str, "",
+           "on-wire frame compression algorithm (reference msgr2.1 "
+           "compression / ms_osd_compress_mode); empty = off",
+           enum_values=("", "zlib", "bz2", "lzma")),
+    Option("ms_compress_min_size", int, 4096,
+           "only compress frames at least this large (reference "
+           "ms_osd_compress_min_size)", min=0),
     # osd
     Option("osd_heartbeat_interval", float, 1.0,
            "seconds between peer pings", min=0.05),
@@ -97,6 +104,15 @@ register_options([
     Option("osd_max_backfills", int, 1,
            "concurrent recovery ops per OSD", min=1),
     Option("osd_scrub_auto", bool, False, "run background scrub"),
+    Option("osd_scrub_interval", float, 60.0,
+           "seconds between background shallow scrubs (reference "
+           "osd_scrub_min_interval)", min=0.1),
+    Option("osd_deep_scrub_interval", float, 600.0,
+           "seconds between background deep scrubs (reference "
+           "osd_deep_scrub_interval)", min=0.1),
+    Option("osd_scrub_auto_repair", bool, False,
+           "repair inconsistencies found by background scrub "
+           "(reference osd_scrub_auto_repair)"),
     # tpu data plane
     Option("tpu_encode_tile", int, 8192,
            "byte-axis tile of the GF matmul kernel", Level.DEV, min=128),

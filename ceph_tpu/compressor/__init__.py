@@ -1,0 +1,107 @@
+"""Compressor subsystem: pluggable codecs + registry.
+
+Re-expresses reference src/compressor/ (Compressor.h create/registry,
+plugin classes for zlib/snappy/lz4/zstd/brotli): a small uniform
+compress/decompress contract behind a factory.  This image bakes in
+Python's zlib/bz2/lzma, which map onto the reference's zlib/bzip2/
+(zstd-role) plugins; snappy/lz4 have no local library and register as
+unavailable (the registry reports what it can actually build, like the
+reference's plugin load errors).
+
+Consumers: the messenger's on-wire frame compression (reference msgr2.1
+compression feature) and any host-side caller.  A TPU kernel family for
+decompression is a natural future target (the byte-plane infrastructure
+from the EC kernels applies); the subsystem seam is codec-shaped so a
+device-backed plugin drops in.
+"""
+
+from __future__ import annotations
+
+import bz2
+import lzma
+import zlib
+
+
+class CompressorError(Exception):
+    pass
+
+
+class Compressor:
+    """One codec (reference Compressor.h interface)."""
+
+    name = "none"
+
+    def compress(self, data: bytes) -> bytes:
+        raise NotImplementedError
+
+    def decompress(self, data: bytes) -> bytes:
+        raise NotImplementedError
+
+
+class ZlibCompressor(Compressor):
+    name = "zlib"
+
+    def __init__(self, level: int = 1):
+        self.level = level   # wire compression favors speed
+
+    def compress(self, data: bytes) -> bytes:
+        return zlib.compress(data, self.level)
+
+    def decompress(self, data: bytes) -> bytes:
+        try:
+            return zlib.decompress(data)
+        except zlib.error as e:
+            raise CompressorError(str(e)) from e
+
+
+class Bz2Compressor(Compressor):
+    name = "bz2"
+
+    def compress(self, data: bytes) -> bytes:
+        return bz2.compress(data, 1)
+
+    def decompress(self, data: bytes) -> bytes:
+        try:
+            return bz2.decompress(data)
+        except (OSError, ValueError) as e:
+            raise CompressorError(str(e)) from e
+
+
+class LzmaCompressor(Compressor):
+    name = "lzma"
+
+    def compress(self, data: bytes) -> bytes:
+        return lzma.compress(data, preset=0)
+
+    def decompress(self, data: bytes) -> bytes:
+        try:
+            return lzma.decompress(data)
+        except lzma.LZMAError as e:
+            raise CompressorError(str(e)) from e
+
+
+_FACTORY = {
+    "zlib": ZlibCompressor,
+    "bz2": Bz2Compressor,
+    "lzma": LzmaCompressor,
+}
+# roles the reference ships that this image cannot build (no library):
+# the registry names them so callers get ENOENT-style clarity, matching
+# the reference's plugin load failure surface
+_UNAVAILABLE = {"snappy": "no snappy library in this image",
+                "lz4": "no lz4 library in this image",
+                "zstd": "no zstd library in this image"}
+
+
+def create(name: str) -> Compressor:
+    """Factory (reference Compressor::create)."""
+    if name in _FACTORY:
+        return _FACTORY[name]()
+    if name in _UNAVAILABLE:
+        raise CompressorError(
+            f"compressor {name!r} unavailable: {_UNAVAILABLE[name]}")
+    raise CompressorError(f"unknown compressor {name!r}")
+
+
+def available() -> list[str]:
+    return sorted(_FACTORY)

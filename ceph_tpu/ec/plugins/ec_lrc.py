@@ -3,23 +3,29 @@
 Fills the role of reference src/erasure-code/lrc/ErasureCodeLrc.{h,cc}:
 cheap single-failure repair by adding local parities over groups.
 
-Profile (the reference's "low-level" k/m/l form, doc/rados/operations/
-erasure-code-lrc.rst): k data chunks, m global RS parities, and one
-local XOR parity per group of l chunks taken over the ordered sequence
-[data..., global parities...] — so k=8 m=4 l=4 yields 3 groups and 15
-chunks total, and a single lost chunk rebuilds from its group's l
-surviving members instead of k.
+Two profile forms, like the reference:
 
-The layered-grammar form of the reference (layers= / mapping= JSON with
-recursive plugin composition) is intentionally not replicated; the k/m/l
-form covers the placement/repair capability the grammar exists to
-describe.  minimum_to_decode prefers the local group for single
-erasures — the property LRC exists for.
+1. k/m/l (doc/rados/operations/erasure-code-lrc.rst "low-level"): k
+   data chunks, m global RS parities, and one local XOR parity per
+   group of l chunks over the ordered [data..., global parities...]
+   sequence.
+2. layers=/mapping= (reference ErasureCodeLrc.h:61): the recursive
+   grammar.  mapping= is a string over the physical chunk positions
+   ('D' = user data, anything else = derived); layers= is a JSON list
+   of [layer_string, layer_profile] pairs, each layer running its own
+   plugin (default jerasure) whose data inputs are the positions its
+   string marks 'D' and whose coding outputs are the positions marked
+   'c'.  Earlier layers' outputs may feed later layers' inputs; decode
+   iterates layers, repairing locally wherever a single layer can.
+
+minimum_to_decode prefers the smallest repair set — the property LRC
+exists for.
 """
 
 from __future__ import annotations
 
 import errno
+import json
 
 import numpy as np
 
@@ -169,8 +175,175 @@ class ErasureCodeLrc(ErasureCode):
         return out
 
 
+class _Layer:
+    """One grammar layer: a sub-codec over a subset of positions."""
+
+    def __init__(self, spec: str, prof_str: str, phys2log: dict[int, int]):
+        self.spec = spec
+        try:
+            self.d_rows = [phys2log[p] for p, ch in enumerate(spec)
+                           if ch == "D"]
+            self.c_rows = [phys2log[p] for p, ch in enumerate(spec)
+                           if ch == "c"]
+        except KeyError as e:
+            raise ErasureCodeError(
+                errno.EINVAL, f"layer {spec!r} indexes beyond the "
+                f"mapping: {e}") from e
+        if not self.d_rows or not self.c_rows:
+            raise ErasureCodeError(
+                errno.EINVAL, f"layer {spec!r} needs both D and c")
+        prof = {"plugin": "jerasure"}
+        for tok in prof_str.split():
+            if "=" in tok:
+                key, val = tok.split("=", 1)
+                prof[key] = val
+        prof["k"] = str(len(self.d_rows))
+        prof["m"] = str(len(self.c_rows))
+        plugin = prof.pop("plugin")
+        self.codec = ErasureCodePluginRegistry.instance().factory(
+            plugin, Profile(prof))
+        self.rows = self.d_rows + self.c_rows   # sub logical order
+
+    def members(self) -> list[int]:
+        return self.rows
+
+
+class ErasureCodeLrcLayered(ErasureCode):
+    """The layers=/mapping= grammar (reference ErasureCodeLrc.cc
+    parse_kml's general path + layers_description/layers_init)."""
+
+    ALLOW_PARTIAL_DECODE = True
+
+    def init(self, profile: Profile) -> None:
+        mapping = profile.get("mapping") or ""
+        try:
+            layer_list = json.loads(profile.get("layers") or "[]")
+        except ValueError as e:
+            raise ErasureCodeError(errno.EINVAL,
+                                   f"bad layers JSON: {e}") from e
+        if not mapping or not layer_list:
+            raise ErasureCodeError(errno.EINVAL,
+                                   "layered LRC needs mapping= and layers=")
+        n = len(mapping)
+        data_pos = [p for p, ch in enumerate(mapping) if ch == "D"]
+        if not data_pos:
+            raise ErasureCodeError(errno.EINVAL,
+                                   f"mapping {mapping!r} has no D")
+        self.k = len(data_pos)
+        self.m = n - self.k
+        # logical order: data chunks (mapping D's) then derived chunks;
+        # chunk_mapping records the physical position of each logical id
+        # (the placement contract of get_chunk_mapping)
+        other_pos = [p for p in range(n) if mapping[p] != "D"]
+        self.chunk_mapping = data_pos + other_pos
+        phys2log = {p: i for i, p in enumerate(self.chunk_mapping)}
+        self.layers: list[_Layer] = []
+        computed = set(range(self.k))
+        for ent in layer_list:
+            spec, prof_str = (ent[0], ent[1] if len(ent) > 1 else "")
+            if len(spec) != n:
+                raise ErasureCodeError(
+                    errno.EINVAL,
+                    f"layer {spec!r} length != mapping length {n}")
+            layer = _Layer(spec, prof_str, phys2log)
+            clobbers = [r for r in layer.c_rows if r < self.k]
+            if clobbers:
+                raise ErasureCodeError(
+                    errno.EINVAL,
+                    f"layer {spec!r} writes coding output over data "
+                    f"positions {clobbers}")
+            missing_inputs = set(layer.d_rows) - computed
+            if missing_inputs:
+                raise ErasureCodeError(
+                    errno.EINVAL,
+                    f"layer {spec!r} consumes chunks no earlier layer "
+                    f"produced: logical {sorted(missing_inputs)}")
+            computed |= set(layer.c_rows)
+            self.layers.append(layer)
+        uncovered = set(range(n)) - computed
+        if uncovered:
+            raise ErasureCodeError(
+                errno.EINVAL,
+                f"no layer produces logical chunks {sorted(uncovered)}")
+        self.profile = profile
+
+    # -- codec ---------------------------------------------------------------
+
+    def encode_chunks(self, chunks: np.ndarray) -> np.ndarray:
+        n = self.get_chunk_count()
+        full = np.zeros((n, chunks.shape[1]), dtype=np.uint8)
+        full[: self.k] = chunks
+        for layer in self.layers:
+            parity = np.asarray(
+                layer.codec.encode_chunks(full[layer.d_rows]))
+            for i, row in enumerate(layer.c_rows):
+                full[row] = parity[i]
+        return full[self.k:]
+
+    def decode_chunks(self, dense: np.ndarray, erasures) -> np.ndarray:
+        out = dense.copy()
+        erased = set(erasures)
+        progress = True
+        while erased and progress:
+            progress = False
+            for layer in self.layers:
+                rows = layer.members()
+                gone = [r for r in rows if r in erased]
+                if not gone or \
+                        len(gone) > layer.codec.get_coding_chunk_count():
+                    continue
+                sub = out[rows]
+                sub_erasures = [rows.index(r) for r in gone]
+                try:
+                    rebuilt = np.asarray(layer.codec.decode_chunks(
+                        sub, sub_erasures))
+                except ErasureCodeError:
+                    continue
+                for i, r in enumerate(rows):
+                    out[r] = rebuilt[i]
+                erased -= set(gone)
+                progress = True
+        self._unsolved = set(erased)
+        return out
+
+    def minimum_to_decode(self, want_to_read, available):
+        want, avail = set(want_to_read), set(available)
+        missing = want - avail
+        if not missing:
+            return {i: [(0, 1)] for i in want}
+        helpers: set[int] = set(want & avail)
+        for mchunk in missing:
+            best = None
+            for layer in self.layers:
+                rows = set(layer.members())
+                if mchunk not in rows:
+                    continue
+                others = rows - {mchunk}
+                # a layer only repairs from chunks that actually exist
+                if others <= avail and (best is None or
+                                        len(others) < len(best)):
+                    best = others
+            if best is None:
+                # no single layer repairs it: offer everything we have
+                # (the iterative decode may still chain layers)
+                return {i: [(0, 1)] for i in avail}
+            helpers |= best
+        return {i: [(0, 1)] for i in helpers}
+
+    def decode(self, want_to_read, chunks, chunk_size):
+        out = super().decode(want_to_read, chunks, chunk_size)
+        bad = set(want_to_read) & getattr(self, "_unsolved", set())
+        if bad:
+            raise ErasureCodeError(
+                errno.EIO,
+                f"LRC: chunks {sorted(bad)} unrecoverable from provided set")
+        return out
+
+
 class ErasureCodePluginLrc(ErasureCodePlugin):
     def factory(self, profile: Profile):
+        if profile.get("layers") or profile.get("mapping"):
+            return ErasureCodeLrcLayered()
         return ErasureCodeLrc()
 
 

@@ -34,6 +34,7 @@ _HEADER = struct.Struct("<4sHxxQIQI")  # magic, type, seq, meta_len, data_len, h
 CTRL_HELLO = 0xFFF0   # session open/resume: meta = {entity, in_seq, lossless}
 CTRL_ACK = 0xFFF1     # seq field = highest contiguously-received seq
 CTRL_ENC = 0xFFF2     # secure mode: data = 12-byte nonce + AESGCM(frame)
+CTRL_COMP = 0xFFF3    # compressed: meta={"a": algo}, data = comp(frame)
 
 _REGISTRY: dict[int, type["Message"]] = {}
 

@@ -40,7 +40,8 @@ import threading
 import uuid
 from typing import Callable
 
-from .message import CTRL_ACK, CTRL_ENC, CTRL_HELLO, Message, encode_frame
+from .message import (CTRL_ACK, CTRL_COMP, CTRL_ENC, CTRL_HELLO, Message,
+                      encode_frame)
 
 Dispatcher = Callable[["Connection", Message], None]
 
@@ -48,6 +49,18 @@ Dispatcher = Callable[["Connection", Message], None]
 # many retained frames the session is torn down (abnormal reset, like the
 # reference's session reset after policy limits) rather than leaking.
 UNACKED_HARD_CAP = 65536
+
+
+def _parse_raw(raw: bytes) -> tuple[int, int, bytes, bytes, int]:
+    """Split one frame already in memory (the unwrapped payload of an
+    ENC/COMP envelope) into (tid, seq, meta_raw, data, pcrc)."""
+    tid, seq, meta_len, data_len = \
+        Message.parse_header(raw[:Message.HEADER_SIZE])
+    off = Message.HEADER_SIZE
+    meta_raw = raw[off:off + meta_len]
+    data = raw[off + meta_len:off + meta_len + data_len]
+    pcrc = int.from_bytes(raw[-4:], "little")
+    return tid, seq, meta_raw, data, pcrc
 
 
 async def read_frame(reader: asyncio.StreamReader
@@ -102,6 +115,36 @@ class Session:
         self._enc_ctr = 0
         self._enc_dir = b"\x01"   # \x01 = connector, \x02 = acceptor
         self._aead = None         # cached AESGCM (one key schedule)
+        # on-wire compression (reference msgr2.1 compression feature):
+        # negotiated at HELLO; frames >= comp_min wrap in CTRL_COMP
+        # before (optional) encryption
+        self.comp = None          # Compressor | None
+        self.comp_min = 4096
+        self.compressed_out = 0
+        self._decomp_cache: dict = {}
+
+    def wire_prepare(self, raw: bytes) -> bytes:
+        """Outbound frame pipeline: compress-then-encrypt."""
+        if self.comp is not None and len(raw) >= self.comp_min:
+            raw = encode_frame(CTRL_COMP, 0, {"a": self.comp.name},
+                               self.comp.compress(raw))
+            self.compressed_out += 1
+        if self.secure and self.conn_key:
+            raw = self.wire_encrypt(raw)
+        return raw
+
+    def wire_decompress(self, algo: str, data: bytes) -> bytes:
+        from ..compressor import CompressorError, create
+        c = self._decomp_cache.get(algo)
+        if c is None:
+            try:
+                c = self._decomp_cache[algo] = create(algo)
+            except CompressorError as e:
+                raise ValueError(f"bad compression algo: {e}") from e
+        try:
+            return c.decompress(data)
+        except CompressorError as e:
+            raise ValueError(f"corrupt compressed frame: {e}") from e
 
     def set_conn_key(self, key: bytes | None, direction: bytes) -> None:
         """Install the per-wire-epoch key; the counter reset is safe
@@ -259,9 +302,7 @@ class Connection:
             # wire dropped while we slept in the injected delay (the
             # accepted-conn read loop nulls it without the send lock)
             raise ConnectionResetError("wire dropped during delayed write")
-        if self.session.secure and self.session.conn_key:
-            raw = self.session.wire_encrypt(raw)
-        writer.write(raw)
+        writer.write(self.session.wire_prepare(raw))
         await writer.drain()
 
     async def _connect(self) -> None:
@@ -279,6 +320,7 @@ class Connection:
             "peer_cookie": sess.peer_cookie,
             "lossless": self.lossless,
             "secure": m.secure,
+            "compress": [m.compress_algo] if m.compress_algo else [],
         }
         authorizer = None
         if m.auth is not None:
@@ -316,6 +358,14 @@ class Connection:
             # on this outbound session are from a cluster daemon
             sess.auth_identity = {"entity": meta.get("entity"),
                                   "kind": "service", "caps": ""}
+        # compression: the server echoes the chosen algo (or none)
+        chosen = meta.get("compress")
+        if chosen and m.compress_algo:
+            from ..compressor import create
+            sess.comp = create(chosen)
+            sess.comp_min = m.compress_min
+        else:
+            sess.comp = None
         self.peer_entity = meta.get("entity")
         cookie = meta.get("cookie")
         if self.lossless and cookie != sess.peer_cookie:
@@ -328,8 +378,7 @@ class Connection:
             sess.peer_cookie = cookie
         sess.reader, sess.writer = reader, writer
         for raw in sess.replay_frames(int(meta.get("in_seq", 0))):
-            writer.write(sess.wire_encrypt(raw)
-                         if sess.secure and sess.conn_key else raw)
+            writer.write(sess.wire_prepare(raw))
         await writer.drain()
         self.messenger._spawn_read_loop(self)
 
@@ -357,10 +406,8 @@ class Connection:
             return
         try:
             sess.last_acked = sess.in_seq
-            raw = encode_frame(CTRL_ACK, sess.in_seq, {})
-            if sess.secure and sess.conn_key:
-                raw = sess.wire_encrypt(raw)
-            writer.write(raw)
+            writer.write(sess.wire_prepare(
+                encode_frame(CTRL_ACK, sess.in_seq, {})))
         except (ConnectionError, OSError):
             pass  # peer will learn our in_seq from the next HELLO
 
@@ -399,6 +446,10 @@ class Messenger:
         # encrypts all frames under the per-connection key
         self.auth = auth
         self.secure = secure
+        # on-wire compression opt-in (reference ms_osd_compress_mode);
+        # effective only when both endpoints enable it
+        self.compress_algo: str | None = None
+        self.compress_min = 4096
         self.dispatcher: Dispatcher | None = None
         self.my_addr: tuple[str, int] | None = None
         self._server: asyncio.AbstractServer | None = None
@@ -528,6 +579,19 @@ class Messenger:
         sess.set_conn_key(conn_key, b"\x02")
         sess.secure = bool(auth_identity and
                            auth_identity.get("secure"))
+        # compression: accept the client's offer when we opt in too
+        offered = meta.get("compress") or []
+        chosen = None
+        if self.compress_algo and offered:
+            from ..compressor import available, create
+            for algo in offered:
+                if algo in available():
+                    chosen = algo
+                    sess.comp = create(algo)
+                    sess.comp_min = self.compress_min
+                    break
+        if chosen is None:
+            sess.comp = None
         conn = Connection(self, None, lossless=lossless, session=sess,
                           can_reconnect=False)
         conn.peer_entity = claimed_entity
@@ -540,7 +604,8 @@ class Messenger:
         try:
             reply_meta = {"entity": self.entity, "in_seq": sess.in_seq,
                           "cookie": sess.local_cookie,
-                          "secure": sess.secure}
+                          "secure": sess.secure,
+                          "compress": chosen}
             if auth_reply is not None:
                 reply_meta["auth_reply"] = auth_reply
             writer.write(encode_frame(CTRL_HELLO, 0, reply_meta))
@@ -550,8 +615,7 @@ class Messenger:
             peer_in = int(meta.get("in_seq", 0)) \
                 if meta.get("peer_cookie") == sess.local_cookie else 0
             for raw in sess.replay_frames(peer_in):
-                writer.write(sess.wire_encrypt(raw)
-                             if sess.secure and sess.conn_key else raw)
+                writer.write(sess.wire_prepare(raw))
             await writer.drain()
         except (ConnectionError, OSError):
             writer.close()
@@ -616,16 +680,15 @@ class Messenger:
                     if sess.conn_key is None:
                         raise ValueError("encrypted frame on plain session")
                     inner = sess.wire_decrypt(data)  # raises on tamper
-                    tid, seq, meta_len, data_len = \
-                        Message.parse_header(inner[:Message.HEADER_SIZE])
-                    off = Message.HEADER_SIZE
-                    meta_raw = inner[off:off + meta_len]
-                    data = inner[off + meta_len:off + meta_len + data_len]
-                    pcrc = int.from_bytes(inner[-4:], "little")
+                    tid, seq, meta_raw, data, pcrc = _parse_raw(inner)
                 elif sess.secure and sess.conn_key is not None and \
                         tid != CTRL_HELLO:
                     # plaintext data frame on a secure session: reject
                     raise ValueError("plaintext frame on secure session")
+                if tid == CTRL_COMP:
+                    algo = json.loads(meta_raw.decode()).get("a", "")
+                    inner = sess.wire_decompress(algo, data)
+                    tid, seq, meta_raw, data, pcrc = _parse_raw(inner)
                 if tid == CTRL_ACK:
                     sess.trim_acked(seq)
                     continue

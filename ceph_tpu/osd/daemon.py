@@ -332,10 +332,15 @@ class OSDDaemon:
                 float(conf.get("ms_inject_delay_probability"))
             self.messenger.inject_delay_max = \
                 float(conf.get("ms_inject_delay_max"))
+            self.messenger.compress_algo = \
+                str(conf.get("ms_compress")) or None
+            self.messenger.compress_min = \
+                int(conf.get("ms_compress_min_size"))
         _apply_inject()
         for _opt in ("ms_inject_socket_failures",
                      "ms_inject_delay_probability",
-                     "ms_inject_delay_max"):
+                     "ms_inject_delay_max", "ms_compress",
+                     "ms_compress_min_size"):
             conf.add_observer(_opt, _apply_inject)
         self.addr = self.messenger.bind(addr)
         # one mon or a monmap list (reference MonClient hunting)
@@ -362,6 +367,10 @@ class OSDDaemon:
                 target=self._heartbeat_loop, daemon=True,
                 name=f"osd.{self.osd_id}.hb")
             self._hb_thread.start()
+        if bool(self.cct.conf.get("osd_scrub_auto")):
+            threading.Thread(
+                target=self._scrub_loop, daemon=True,
+                name=f"osd.{self.osd_id}.scrub").start()
 
     def shutdown(self) -> None:
         self._hb_stop.set()
@@ -1111,6 +1120,13 @@ class OSDDaemon:
         # ordering): ALL ops on one object serialize — cls calls are
         # read-modify-write and must not interleave with each other OR
         # with plain writes.  Striped locks keep the table bounded.
+        # Watch/notify control ops stay lock-free: notify blocks on
+        # watcher acks, and a watcher that touches the object in its
+        # handler would deadlock against the stripe (the reference
+        # drops the obc lock around the ack wait too).
+        if {op[0] for op in msg.ops} <= {"watch", "unwatch", "notify"}:
+            self._do_client_op(conn, msg, _t0)
+            return
         key = (msg.pgid.pgid.pool, msg.oid.name)
         with self._obj_locks[hash(key) % len(self._obj_locks)]:
             self._do_client_op(conn, msg, _t0)
@@ -1271,12 +1287,12 @@ class OSDDaemon:
         ev.wait(timeout)
         self._notify_pending.pop(nid, None)
 
-    # -- scrub (asok-driven; reference `ceph pg scrub`) ---------------------
+    # -- scrub (asok-driven AND background-scheduled; reference
+    #    `ceph pg scrub` + PG::sched_scrub) ---------------------------------
 
-    def _asok_scrub(self, cmd: dict) -> dict:
+    def _scrub_led_pgs(self, deep: bool, repair: bool) -> dict:
+        """Scrub every EC PG this OSD currently leads."""
         from . import scrub as scrub_mod
-        deep = bool(cmd.get("deep", True))
-        repair = bool(cmd.get("repair", False))
         out = {}
         for pool in list(self.osdmap.pools.values()):
             if not pool.is_erasure():
@@ -1300,6 +1316,34 @@ class OSDDaemon:
                     "repaired": len(res.repaired),
                 }
         return out
+
+    def _asok_scrub(self, cmd: dict) -> dict:
+        return self._scrub_led_pgs(deep=bool(cmd.get("deep", True)),
+                                   repair=bool(cmd.get("repair", False)))
+
+    def _scrub_loop(self) -> None:
+        """Background scheduler (reference PG scrub scheduling with
+        min/deep intervals): shallow every osd_scrub_interval, deep
+        every osd_deep_scrub_interval, optional auto-repair."""
+        conf = self.cct.conf
+        last_deep = time.time()
+        interval = float(conf.get("osd_scrub_interval"))
+        while not self._hb_stop.wait(interval):
+            try:
+                interval = float(conf.get("osd_scrub_interval"))
+                deep_iv = float(conf.get("osd_deep_scrub_interval"))
+                repair = bool(conf.get("osd_scrub_auto_repair"))
+                deep = time.time() - last_deep >= deep_iv
+                if deep:
+                    last_deep = time.time()
+                out = self._scrub_led_pgs(deep=deep, repair=repair)
+                nerr = sum(len(r["errors"]) for r in out.values())
+                if nerr:
+                    self.cct.dout("osd", 1,
+                                  f"background scrub: {nerr} errors "
+                                  f"across {len(out)} pgs")
+            except Exception as e:  # noqa: BLE001 - scheduler survives
+                self.cct.dout("osd", 1, f"background scrub failed: {e!r}")
 
     # -- heartbeats (reference OSD::handle_osd_ping / failure_queue) --------
 

@@ -27,9 +27,10 @@ class TimedOut(Exception):
 
 class Objecter:
     def __init__(self, mon_addr, name: str = "client", auth=None,
-                 secure: bool = False):
+                 secure: bool = False, compress: str | None = None):
         self.auth = auth
         self.messenger = Messenger(name, auth=auth, secure=secure)
+        self.messenger.compress_algo = compress
         self.messenger.add_dispatcher(self._dispatch)
         # one (host, port) or a monmap-style list of them (reference
         # MonClient hunts across the monmap)

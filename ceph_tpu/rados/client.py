@@ -25,9 +25,9 @@ class RadosError(Exception):
 
 class RadosClient:
     def __init__(self, mon_addr, name: str = "client", auth=None,
-                 secure: bool = False):
+                 secure: bool = False, compress: str | None = None):
         self.objecter = Objecter(mon_addr, name, auth=auth,
-                                 secure=secure)
+                                 secure=secure, compress=compress)
         self._pool = ThreadPoolExecutor(max_workers=16,
                                         thread_name_prefix="rados-aio")
 

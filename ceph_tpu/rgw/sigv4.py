@@ -112,6 +112,19 @@ def verify_request(method: str, path: str, query: str, headers: dict,
     if secret is None:
         raise SigError(f"unknown access key {access_key!r}")
     amzdate = hdrs.get("x-amz-date", "")
+    # freshness: a captured signed request must not replay forever
+    # (reference rgw_auth_s3 enforces a 15-minute skew window)
+    try:
+        ts = datetime.datetime.strptime(
+            amzdate, "%Y%m%dT%H%M%SZ").replace(
+            tzinfo=datetime.timezone.utc)
+    except ValueError as e:
+        raise SigError(f"bad x-amz-date: {e}") from e
+    now = datetime.datetime.now(datetime.timezone.utc)
+    if abs((now - ts).total_seconds()) > 900:
+        raise SigError("request outside the 15-minute skew window")
+    if not amzdate.startswith(datestamp):
+        raise SigError("x-amz-date does not match credential scope date")
     payload_hash = hdrs.get("x-amz-content-sha256", "UNSIGNED-PAYLOAD")
     if payload_hash not in ("UNSIGNED-PAYLOAD",) and \
             payload_hash != _sha256(payload):

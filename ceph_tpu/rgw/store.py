@@ -81,12 +81,21 @@ class RGWStore:
             "meta": {"created": time.time()}})
         self._cls(self.meta, f"index.{bucket}", "dir_init")
 
+    @staticmethod
+    def _not_found(e: RadosError) -> bool:
+        """Only ENOENT means absence; anything else is a cluster fault
+        that must surface as a 5xx, not a phantom 404 (a sync client
+        treating EIO as 'gone' would re-upload or diverge)."""
+        if e.errno == errno.ENOENT:
+            return True
+        raise RGWError(503, "ServiceUnavailable", str(e))
+
     def bucket_exists(self, bucket: str) -> bool:
         try:
             self._cls(self.meta, BUCKETS_OBJ, "dir_get", {"key": bucket})
             return True
-        except RadosError:
-            return False
+        except RadosError as e:
+            return not self._not_found(e)
 
     def delete_bucket(self, bucket: str) -> None:
         self._require_bucket(bucket)
@@ -126,6 +135,7 @@ class RGWStore:
             raw = self._cls(self.meta, f"index.{bucket}", "dir_get",
                             {"key": key})
         except RadosError as e:
+            self._not_found(e)
             raise RGWError(404, "NoSuchKey", key) from e
         return json.loads(raw.decode())
 
@@ -140,6 +150,7 @@ class RGWStore:
             self._cls(self.meta, f"index.{bucket}", "dir_rm",
                       {"key": key})
         except RadosError as e:
+            self._not_found(e)
             raise RGWError(404, "NoSuchKey", key) from e
         try:
             self.data.remove(_data_oid(bucket, key))

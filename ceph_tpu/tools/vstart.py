@@ -28,7 +28,9 @@ class Cluster:
                  failure_quorum: int = 2, asok_dir: str | None = None,
                  objectstore: str = "memstore",
                  data_dir: str | None = None, n_mons: int = 1,
-                 auth: str = "none", secure: bool = False):
+                 auth: str = "none", secure: bool = False,
+                 conf: dict | None = None):
+        self.conf = dict(conf or {})   # applied to every OSD pre-boot
         # cephx deployment: one cluster service key shared by daemons,
         # a keyring of client entities on the mon (reference
         # vstart.sh's keyring bootstrap + ceph auth get-or-create)
@@ -86,6 +88,8 @@ class Cluster:
                             secure=self.secure)
             self.osds.append(osd)
         for osd in self.osds:
+            for k, v in self.conf.items():
+                osd.cct.conf.set(k, v)
             osd.boot()
         return self
 

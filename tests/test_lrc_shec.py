@@ -133,3 +133,80 @@ def test_lrc_minimum_to_decode_is_sufficient():
         avail = {i: enc[i] for i in need}
         dec = codec.decode({e}, avail, cs)
         np.testing.assert_array_equal(dec[e], enc[e])
+
+
+# -- layered grammar (reference ErasureCodeLrc.h:61 layers=/mapping=) --------
+
+LAYERED_PROFILE = {
+    "plugin": "lrc",
+    "mapping": "__DD__DD",
+    "layers": '[["_cDD_cDD",""],["cDDD____",""],["____cDDD",""]]',
+}
+
+
+def _layered():
+    return REG.factory("lrc", dict(LAYERED_PROFILE))
+
+
+def test_layered_geometry():
+    c = _layered()
+    assert c.get_data_chunk_count() == 4
+    assert c.get_chunk_count() == 8
+    # logical->physical placement: data at the mapping's D positions
+    assert c.get_chunk_mapping()[:4] == [2, 3, 6, 7]
+
+
+def test_layered_encode_decode_all_singles_and_pairs():
+    import itertools
+    c = _layered()
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, 4 * 128, dtype=np.uint8)
+    chunks = c.encode(set(range(8)), data)
+    for gone in itertools.chain(
+            ((i,) for i in range(8)),
+            itertools.combinations(range(8), 2)):
+        avail = {i: chunks[i] for i in range(8) if i not in gone}
+        want = set(range(4))
+        try:
+            out = c.decode(want, avail, len(chunks[0]))
+        except Exception:
+            continue   # some pairs are legitimately unrecoverable
+        for i in want:
+            assert np.array_equal(out[i], chunks[i]), \
+                f"chunk {i} wrong after erasing {gone}"
+
+
+def test_layered_single_loss_repairs_locally():
+    """One lost data chunk must be repairable from its local layer —
+    fewer helpers than k=4 global decode would need."""
+    c = _layered()
+    helpers = c.minimum_to_decode({0}, set(range(1, 8)))
+    assert len(helpers) <= 3, helpers
+
+
+def test_layered_grammar_validation():
+    with pytest.raises(Exception, match="mapping"):
+        REG.factory("lrc", {"plugin": "lrc",
+                            "layers": '[["cDD",""]]'})
+    with pytest.raises(Exception, match="length"):
+        REG.factory("lrc", {"plugin": "lrc", "mapping": "_DD",
+                            "layers": '[["cDDDD",""]]'})
+    with pytest.raises(Exception, match="consumes"):
+        # layer consumes a derived position nothing produced
+        REG.factory("lrc", {"plugin": "lrc", "mapping": "_DD_",
+                            "layers": '[["cD_D",""]]'})
+    with pytest.raises(Exception, match="coding output over data"):
+        REG.factory("lrc", {"plugin": "lrc", "mapping": "_DD",
+                            "layers": '[["cDc",""]]'})
+
+
+def test_layered_layer_profile_override():
+    """Per-layer plugin/technique selection parses."""
+    c = REG.factory("lrc", {
+        "plugin": "lrc", "mapping": "DD_",
+        "layers": '[["DDc","plugin=jerasure technique=cauchy_good"]]'})
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, 2 * 64, dtype=np.uint8)
+    chunks = c.encode(set(range(3)), data)
+    out = c.decode({0}, {1: chunks[1], 2: chunks[2]}, len(chunks[0]))
+    assert np.array_equal(out[0], chunks[0])

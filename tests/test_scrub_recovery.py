@@ -198,3 +198,51 @@ def test_osd_out_triggers_backfill():
                         s, hobject_t(pool=pgid.pool, name=name)) is None:
                     missing += 1
         assert missing == 0, f"{missing} shards not backfilled"
+
+
+def test_background_scrub_auto_repairs_bitrot():
+    """osd_scrub_auto: the scheduler scrubs led PGs on an interval and
+    (with osd_scrub_auto_repair) heals bitrot without any operator
+    action (reference PG::sched_scrub + osd_scrub_auto_repair)."""
+    from ceph_tpu.osd.ec_transaction import shard_oid
+    from ceph_tpu.osd.types import spg_t
+    from ceph_tpu.tools.vstart import Cluster
+
+    with Cluster(n_osds=4, conf={
+            "osd_scrub_auto": True,
+            "osd_scrub_interval": 0.3,
+            "osd_deep_scrub_interval": 0.3,   # every pass is deep
+            "osd_scrub_auto_repair": True}) as c:
+        client = c.client()
+        client.set_ec_profile("bg", {"plugin": "jerasure", "k": "2",
+                                     "m": "1"})
+        client.create_pool("bgp", "erasure", erasure_code_profile="bg",
+                           pg_num=2)
+        io = client.open_ioctx("bgp")
+        rng = np.random.default_rng(9)
+        payload = rng.integers(0, 256, 4096, dtype=np.uint8).tobytes()
+        io.write_full("rotme", payload)
+        # flip bytes in one shard behind the cluster's back
+        pool = next(p for p in c.osds[0].osdmap.pools.values()
+                    if p.name == "bgp")
+        pgid = c.osds[0].osdmap.object_to_pg(pool.id, "rotme")
+        _, acting, _, primary = \
+            c.osds[0].osdmap.pg_to_up_acting_osds(pgid)
+        victim = c.osds[acting[1]]
+        spg = spg_t(pgid, 1)
+        goid = shard_oid(hobject_t(pool=pool.id, name="rotme"), 1)
+        data = bytearray(victim.store.read(spg, goid).tobytes())
+        data[3] ^= 0xFF
+        txn = Transaction()
+        txn.write(goid, 0, np.frombuffer(bytes(data), dtype=np.uint8))
+        victim.store.queue_transactions(spg, [txn])
+        # the background deep scrub must find and repair it
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            cur = victim.store.read(spg, goid).tobytes()
+            if cur != bytes(data):
+                break
+            time.sleep(0.3)
+        else:
+            raise AssertionError("background scrub never repaired rot")
+        assert io.read("rotme", len(payload)) == payload
