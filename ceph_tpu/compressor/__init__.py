@@ -26,6 +26,12 @@ class CompressorError(Exception):
     pass
 
 
+# Decompression output cap: peer-supplied compressed frames must not
+# amplify into unbounded allocations (a ~1MB lzma bomb expands to tens
+# of GB).  Frames larger than this are a protocol violation.
+MAX_DECOMPRESSED = 1 << 30
+
+
 class Compressor:
     """One codec (reference Compressor.h interface)."""
 
@@ -34,7 +40,28 @@ class Compressor:
     def compress(self, data: bytes) -> bytes:
         raise NotImplementedError
 
-    def decompress(self, data: bytes) -> bytes:
+    def decompress(self, data: bytes,
+                   max_out: int = MAX_DECOMPRESSED) -> bytes:
+        """Bounded streaming decompression shared by all codecs: ask
+        the decompressor for at most max_out+1 bytes; producing more
+        than max_out is rejected without materializing the bomb."""
+        d = self._decompressor()
+        try:
+            out = d.decompress(data, max_out + 1)
+        except Exception as e:  # noqa: BLE001 - codec-specific errors
+            raise CompressorError(str(e)) from e
+        if len(out) > max_out:
+            raise CompressorError(
+                f"decompressed output exceeds cap {max_out}")
+        # a stream that did not finish (truncated input) or left
+        # trailing bytes must fail loudly, not return partial data
+        if not d.eof:
+            raise CompressorError("truncated compressed stream")
+        if d.unused_data:
+            raise CompressorError("trailing garbage after stream")
+        return out
+
+    def _decompressor(self):
         raise NotImplementedError
 
 
@@ -47,11 +74,8 @@ class ZlibCompressor(Compressor):
     def compress(self, data: bytes) -> bytes:
         return zlib.compress(data, self.level)
 
-    def decompress(self, data: bytes) -> bytes:
-        try:
-            return zlib.decompress(data)
-        except zlib.error as e:
-            raise CompressorError(str(e)) from e
+    def _decompressor(self):
+        return zlib.decompressobj()
 
 
 class Bz2Compressor(Compressor):
@@ -60,11 +84,8 @@ class Bz2Compressor(Compressor):
     def compress(self, data: bytes) -> bytes:
         return bz2.compress(data, 1)
 
-    def decompress(self, data: bytes) -> bytes:
-        try:
-            return bz2.decompress(data)
-        except (OSError, ValueError) as e:
-            raise CompressorError(str(e)) from e
+    def _decompressor(self):
+        return bz2.BZ2Decompressor()
 
 
 class LzmaCompressor(Compressor):
@@ -73,11 +94,8 @@ class LzmaCompressor(Compressor):
     def compress(self, data: bytes) -> bytes:
         return lzma.compress(data, preset=0)
 
-    def decompress(self, data: bytes) -> bytes:
-        try:
-            return lzma.decompress(data)
-        except lzma.LZMAError as e:
-            raise CompressorError(str(e)) from e
+    def _decompressor(self):
+        return lzma.LZMADecompressor()
 
 
 _FACTORY = {

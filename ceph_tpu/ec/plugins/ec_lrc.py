@@ -166,8 +166,9 @@ class ErasureCodeLrc(ErasureCode):
         return out
 
     def decode(self, want_to_read, chunks, chunk_size):
+        self._unsolved = set()   # base may shortcut past decode_chunks
         out = super().decode(want_to_read, chunks, chunk_size)
-        bad = set(want_to_read) & getattr(self, "_unsolved", set())
+        bad = set(want_to_read) & self._unsolved
         if bad:
             raise ErasureCodeError(
                 errno.EIO,
@@ -331,8 +332,12 @@ class ErasureCodeLrcLayered(ErasureCode):
         return {i: [(0, 1)] for i in helpers}
 
     def decode(self, want_to_read, chunks, chunk_size):
+        # reset per call: the base class shortcuts past decode_chunks
+        # when everything wanted is present, which must not read a
+        # PREVIOUS failed decode's unsolved set
+        self._unsolved = set()
         out = super().decode(want_to_read, chunks, chunk_size)
-        bad = set(want_to_read) & getattr(self, "_unsolved", set())
+        bad = set(want_to_read) & self._unsolved
         if bad:
             raise ErasureCodeError(
                 errno.EIO,

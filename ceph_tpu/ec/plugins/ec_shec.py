@@ -181,9 +181,9 @@ class ErasureCodeShec(ErasureCode):
         return helpers
 
     def decode(self, want_to_read, chunks, chunk_size):
+        self._unsolved = set()   # base may shortcut past decode_chunks
         out = super().decode(want_to_read, chunks, chunk_size)
-        unsolved = getattr(self, "_unsolved", set())
-        bad = set(want_to_read) & unsolved
+        bad = set(want_to_read) & self._unsolved
         if bad:
             raise ErasureCodeError(
                 errno.EIO, f"shec: chunks {sorted(bad)} unrecoverable "

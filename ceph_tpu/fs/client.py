@@ -1,0 +1,230 @@
+"""CephFS client: libcephfs-role POSIX-ish surface.
+
+Re-expresses reference src/client/Client.cc + libcephfs.h at the
+surface a filesystem consumer needs: mount, open/create, pread/pwrite
+with block striping straight to the data pool (the MDS never sees file
+bytes — reference file I/O goes client->OSD under caps), mkdir,
+readdir, rename, unlink, rmdir, stat, truncate.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..msg import Messenger
+from ..msg import messages as M
+from .mds import data_oid
+
+
+class FSError(Exception):
+    def __init__(self, err: int, msg: str = ""):
+        super().__init__(f"[errno {err}] {msg}")
+        self.errno = err
+
+
+class CephFS:
+    def __init__(self, mon_addr, mds_addr, auth=None,
+                 secure: bool = False, name: str = "fsclient"):
+        from ..rados import RadosClient
+        self.messenger = Messenger(name, auth=auth, secure=secure)
+        self.messenger.add_dispatcher(self._dispatch)
+        self.mds_conn = self.messenger.connect(tuple(mds_addr))
+        self._lock = threading.Lock()
+        self._tid = 0
+        self._waiters: dict[int, dict] = {}
+        self.rados = RadosClient(mon_addr, name, auth=auth,
+                                 secure=secure).connect()
+        info = self._req("mount", {})
+        self.block_size = info["block_size"]
+        self.data = self.rados.open_ioctx(info["data_pool"])
+
+    def shutdown(self) -> None:
+        self.messenger.shutdown()
+        self.rados.shutdown()
+
+    # -- MDS RPC -------------------------------------------------------------
+
+    def _dispatch(self, conn, msg) -> None:
+        if isinstance(msg, M.MClientReply):
+            with self._lock:
+                w = self._waiters.pop(msg.tid, None)
+            if w is not None:
+                w["reply"] = msg
+                w["event"].set()
+
+    def _req(self, op: str, args: dict, timeout: float = 30.0) -> dict:
+        with self._lock:
+            self._tid += 1
+            tid = self._tid
+            w = {"event": threading.Event(), "reply": None}
+            self._waiters[tid] = w
+        self.mds_conn.send_message(M.MClientRequest(op, args, tid))
+        if not w["event"].wait(timeout):
+            raise FSError(110, f"mds request {op} timed out")
+        reply = w["reply"]
+        if reply.result != 0:
+            raise FSError(-reply.result,
+                          reply.out.get("error", op))
+        return reply.out
+
+    # -- namespace -----------------------------------------------------------
+
+    def stat(self, path: str) -> dict:
+        return self._req("stat", {"path": path})["ent"]
+
+    def mkdir(self, path: str) -> None:
+        self._req("mkdir", {"path": path})
+
+    def makedirs(self, path: str) -> None:
+        parts = [p for p in path.split("/") if p]
+        for i in range(1, len(parts) + 1):
+            try:
+                self.mkdir("/".join(parts[:i]))
+            except FSError as e:
+                if e.errno != 17:   # EEXIST
+                    raise
+
+    def readdir(self, path: str) -> list[tuple[str, dict]]:
+        out = self._req("readdir", {"path": path})
+        return [(k, m) for k, m in out["entries"]]
+
+    def unlink(self, path: str) -> None:
+        self._req("unlink", {"path": path})
+
+    def rmdir(self, path: str) -> None:
+        self._req("rmdir", {"path": path})
+
+    def rename(self, src: str, dst: str) -> None:
+        self._req("rename", {"src": src, "dst": dst})
+
+    # -- file I/O ------------------------------------------------------------
+
+    def open(self, path: str, mode: str = "r") -> "File":
+        if "w" in mode or "a" in mode or "+" in mode:
+            ent = self._req("create", {"path": path})["ent"]
+        else:
+            ent = self.stat(path)
+            from .mds import S_IFDIR
+            if ent["mode"] & S_IFDIR:
+                raise FSError(21, path)   # EISDIR
+        f = File(self, path, ent)
+        if "w" in mode and ent.get("size", 0):
+            f.truncate(0)
+        if "a" in mode:
+            f.pos = f.size
+        return f
+
+    def write_file(self, path: str, data: bytes) -> None:
+        with self.open(path, "w") as f:
+            f.write(data)
+
+    def read_file(self, path: str) -> bytes:
+        with self.open(path, "r") as f:
+            return f.read()
+
+
+class File:
+    """An open file handle (reference Fh): striped block I/O against
+    the data pool; size/mtime pushed to the MDS on flush/close."""
+
+    def __init__(self, fs: CephFS, path: str, ent: dict):
+        self.fs = fs
+        self.path = path
+        self.ino = ent["ino"]
+        self.size = ent.get("size", 0)
+        self.pos = 0
+        self._dirty = False
+
+    # -- striping ------------------------------------------------------------
+
+    def pwrite(self, data: bytes, offset: int) -> int:
+        bs = self.fs.block_size
+        off = offset
+        view = memoryview(data)
+        while view:
+            blk, in_blk = divmod(off, bs)
+            n = min(bs - in_blk, len(view))
+            self.fs.data.write(data_oid(self.ino, blk),
+                               bytes(view[:n]), offset=in_blk)
+            view = view[n:]
+            off += n
+        self.size = max(self.size, offset + len(data))
+        self._dirty = True
+        return len(data)
+
+    def pread(self, length: int, offset: int) -> bytes:
+        bs = self.fs.block_size
+        end = min(offset + length, self.size)
+        if end <= offset:
+            return b""
+        out = bytearray()
+        off = offset
+        while off < end:
+            blk, in_blk = divmod(off, bs)
+            n = min(bs - in_blk, end - off)
+            from ..rados.client import RadosError
+            try:
+                piece = self.fs.data.read(data_oid(self.ino, blk),
+                                          n, offset=in_blk)
+            except RadosError as e:
+                if e.errno != 2:   # only ENOENT is a sparse hole
+                    # a cluster fault must not read back as zeros
+                    raise FSError(e.errno, f"read {self.path}") from e
+                piece = b""
+            out += piece.ljust(n, b"\x00")
+            off += n
+        return bytes(out)
+
+    # -- posix-ish surface ---------------------------------------------------
+
+    def write(self, data: bytes) -> int:
+        n = self.pwrite(data, self.pos)
+        self.pos += n
+        return n
+
+    def read(self, length: int | None = None) -> bytes:
+        if length is None:
+            length = self.size - self.pos
+        out = self.pread(length, self.pos)
+        self.pos += len(out)
+        return out
+
+    def seek(self, pos: int) -> None:
+        self.pos = pos
+
+    def truncate(self, size: int) -> None:
+        bs = self.fs.block_size
+        from ..rados.client import RadosError
+        old_blocks = -(-max(self.size, 1) // bs)
+        keep_blocks = -(-size // bs) if size else 0
+        for b in range(keep_blocks, old_blocks):
+            try:
+                self.fs.data.remove(data_oid(self.ino, b))
+            except RadosError:
+                pass
+        if size and size % bs:
+            try:
+                self.fs.data.truncate(data_oid(self.ino,
+                                               keep_blocks - 1),
+                                      size % bs)
+            except RadosError:
+                pass
+        self.size = size
+        self._dirty = True
+
+    def flush(self) -> None:
+        if self._dirty:
+            self.fs._req("setattr", {"path": self.path,
+                                     "size": self.size,
+                                     "mtime": time.time()})
+            self._dirty = False
+
+    def close(self) -> None:
+        self.flush()
+
+    def __enter__(self) -> "File":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,320 @@
+"""MDS: the metadata server daemon.
+
+Re-expresses the reference src/mds/ at the fidelity the namespace
+needs (MDSRank dispatch of MClientRequest -> Server::handle_client_*,
+src/mds/Server.cc):
+
+- The namespace lives in a METADATA POOL: one directory object per
+  directory inode ("dir.<ino>"), entries maintained server-side by the
+  generic directory object class (reference CDir dirfrags as omap
+  objects; cls-side updates make each dentry mutation atomic).  Child
+  inode attributes are EMBEDDED in the parent's dentry (reference
+  stores inodes in dentries the same way — no separate inode objects
+  on the common path).
+- File data is NOT proxied: clients write striped blocks straight to
+  the data pool; the MDS only records size/mtime reported back by the
+  client (the reduced form of the reference's client-caps size
+  recall).
+- Inode numbers come from a persisted allocator object (reference
+  InoTable).
+
+Locking: one MDS owns the namespace (reference single-active rank 0);
+per-directory striped locks serialize multi-step ops (rename takes
+both directory locks in ino order).
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import threading
+import time
+
+from ..msg import Messenger
+from ..msg import messages as M
+from ..rados.client import RadosError
+
+META_POOL = "cephfs_metadata"
+DATA_POOL = "cephfs_data"
+ROOT_INO = 1
+INOTABLE_OBJ = "mds_inotable"
+
+S_IFDIR = 0o040000
+S_IFREG = 0o100000
+
+
+def data_oid(ino: int, block: int) -> str:
+    """reference file layout object naming: <ino hex>.<block hex>."""
+    return f"{ino:016x}.{block:08x}"
+
+
+class MDSDaemon:
+    def __init__(self, mon_addr, addr=("127.0.0.1", 0),
+                 block_size: int = 1 << 22, auth=None,
+                 secure: bool = False, ec_profile: str | None = None,
+                 pg_num: int = 8):
+        from ..rados import RadosClient
+        self.block_size = block_size
+        self.client = RadosClient(mon_addr, "mds", auth=auth,
+                                  secure=secure).connect()
+        self._ensure_pools(ec_profile, pg_num)
+        self.meta = self.client.open_ioctx(META_POOL)
+        self.data = self.client.open_ioctx(DATA_POOL)
+        self._locks = [threading.Lock() for _ in range(64)]
+        self._ino_lock = threading.Lock()
+        self._mkfs()
+        self.messenger = Messenger("mds", auth=auth, secure=secure)
+        self.messenger.add_dispatcher(self._dispatch)
+        self.addr = self.messenger.bind(addr)
+
+    def shutdown(self) -> None:
+        self.messenger.shutdown()
+        self.client.shutdown()
+
+    def _ensure_pools(self, ec_profile, pg_num) -> None:
+        for name, kind in ((META_POOL, "replicated"),
+                           (DATA_POOL,
+                            "erasure" if ec_profile else "replicated")):
+            try:
+                kw = {"pg_num": pg_num}
+                if kind == "erasure":
+                    kw["erasure_code_profile"] = ec_profile
+                else:
+                    kw["size"] = 2
+                self.client.create_pool(name, kind, **kw)
+            except RadosError as e:
+                if e.errno != errno.EEXIST:
+                    raise
+
+    def _mkfs(self) -> None:
+        """Create the root directory + inode table if absent."""
+        self.meta.execute(f"dir.{ROOT_INO:x}", "rgw", "dir_init", b"")
+        try:
+            raw = self.meta.read(INOTABLE_OBJ, 0)
+        except RadosError:
+            raw = b""
+        if raw:
+            self._next_ino = json.loads(raw.decode())["next"]
+        else:
+            self._next_ino = ROOT_INO + 1
+            self._persist_inotable()
+
+    def _persist_inotable(self) -> None:
+        self.meta.write_full(INOTABLE_OBJ, json.dumps(
+            {"next": self._next_ino}).encode())
+
+    def _alloc_ino(self) -> int:
+        with self._ino_lock:
+            ino = self._next_ino
+            self._next_ino += 1
+            self._persist_inotable()
+            return ino
+
+    # -- dir object helpers --------------------------------------------------
+
+    def _dir_lock(self, ino: int) -> threading.Lock:
+        return self._locks[ino % len(self._locks)]
+
+    def _dget(self, dino: int, name: str) -> dict | None:
+        try:
+            raw = self.meta.execute(
+                f"dir.{dino:x}", "rgw", "dir_get",
+                json.dumps({"key": name}).encode())
+        except RadosError as e:
+            if e.errno == errno.ENOENT:
+                return None
+            raise
+        return json.loads(raw.decode())
+
+    def _dset(self, dino: int, name: str, ent: dict) -> None:
+        self.meta.execute(f"dir.{dino:x}", "rgw", "dir_add",
+                          json.dumps({"key": name, "meta": ent}).encode())
+
+    def _drm(self, dino: int, name: str) -> None:
+        self.meta.execute(f"dir.{dino:x}", "rgw", "dir_rm",
+                          json.dumps({"key": name}).encode())
+
+    def _dlist(self, dino: int) -> list:
+        raw = self.meta.execute(
+            f"dir.{dino:x}", "rgw", "dir_list",
+            json.dumps({"max": 100000}).encode())
+        return json.loads(raw.decode())["entries"]
+
+    def _dcount(self, dino: int) -> int:
+        return int(self.meta.execute(f"dir.{dino:x}", "rgw",
+                                     "dir_count", b""))
+
+    # -- path walking (reference Server::rdlock_path_pin_ref) ---------------
+
+    def _resolve(self, path: str) -> tuple[int, dict]:
+        """Path -> (parent dir ino of the LAST component, entry dict of
+        the full path).  Root resolves to a synthetic dir entry."""
+        parts = [p for p in path.split("/") if p]
+        cur = {"ino": ROOT_INO, "mode": S_IFDIR, "size": 0, "mtime": 0}
+        dino = ROOT_INO
+        for i, name in enumerate(parts):
+            if not cur["mode"] & S_IFDIR:
+                raise _Err(errno.ENOTDIR, "/".join(parts[:i]))
+            dino = cur["ino"]
+            ent = self._dget(dino, name)
+            if ent is None:
+                raise _Err(errno.ENOENT, "/".join(parts[: i + 1]))
+            cur = ent
+        return dino, cur
+
+    def _split(self, path: str) -> tuple[int, str]:
+        """Path -> (parent dir ino, last component); parent must be an
+        existing directory."""
+        parts = [p for p in path.split("/") if p]
+        if not parts:
+            raise _Err(errno.EINVAL, "empty path")
+        _, parent = self._resolve("/".join(parts[:-1]))
+        if not parent["mode"] & S_IFDIR:
+            raise _Err(errno.ENOTDIR, path)
+        return parent["ino"], parts[-1]
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _dispatch(self, conn, msg) -> None:
+        if not isinstance(msg, M.MClientRequest):
+            return
+        try:
+            out = self._handle(msg.op, msg.args)
+            conn.send_message(M.MClientReply(msg.tid, 0, out))
+        except _Err as e:
+            conn.send_message(M.MClientReply(msg.tid, -e.errno,
+                                             {"error": str(e)}))
+        except RadosError as e:
+            conn.send_message(M.MClientReply(msg.tid, -e.errno,
+                                             {"error": str(e)}))
+        except Exception as e:  # noqa: BLE001 - daemon must not die
+            conn.send_message(M.MClientReply(
+                msg.tid, -errno.EIO, {"error": repr(e)}))
+
+    def _handle(self, op: str, a: dict) -> dict:
+        if op == "mount":
+            return {"block_size": self.block_size,
+                    "data_pool": DATA_POOL, "root": ROOT_INO}
+        if op == "stat":
+            _, ent = self._resolve(a["path"])
+            return {"ent": ent}
+        if op == "mkdir":
+            dino, name = self._split(a["path"])
+            with self._dir_lock(dino):
+                if self._dget(dino, name) is not None:
+                    raise _Err(errno.EEXIST, a["path"])
+                ino = self._alloc_ino()
+                self.meta.execute(f"dir.{ino:x}", "rgw", "dir_init", b"")
+                self._dset(dino, name, {
+                    "ino": ino, "mode": S_IFDIR | 0o755, "size": 0,
+                    "mtime": time.time()})
+            return {"ino": ino}
+        if op == "create":
+            dino, name = self._split(a["path"])
+            with self._dir_lock(dino):
+                ent = self._dget(dino, name)
+                if ent is not None:
+                    if ent["mode"] & S_IFDIR:
+                        raise _Err(errno.EISDIR, a["path"])
+                    if a.get("excl"):
+                        raise _Err(errno.EEXIST, a["path"])
+                    return {"ent": ent}
+                ino = self._alloc_ino()
+                ent = {"ino": ino, "mode": S_IFREG | 0o644, "size": 0,
+                       "mtime": time.time()}
+                self._dset(dino, name, ent)
+            return {"ent": ent}
+        if op == "readdir":
+            _, ent = self._resolve(a["path"])
+            if not ent["mode"] & S_IFDIR:
+                raise _Err(errno.ENOTDIR, a["path"])
+            return {"entries": self._dlist(ent["ino"])}
+        if op == "setattr":
+            # client reports size/mtime after data writes (the reduced
+            # form of cap recall; reference Server::handle_client_setattr)
+            dino, name = self._split(a["path"])
+            with self._dir_lock(dino):
+                ent = self._dget(dino, name)
+                if ent is None:
+                    raise _Err(errno.ENOENT, a["path"])
+                for k in ("size", "mtime"):
+                    if k in a:
+                        ent[k] = a[k]
+                self._dset(dino, name, ent)
+            return {"ent": ent}
+        if op == "unlink":
+            dino, name = self._split(a["path"])
+            with self._dir_lock(dino):
+                ent = self._dget(dino, name)
+                if ent is None:
+                    raise _Err(errno.ENOENT, a["path"])
+                if ent["mode"] & S_IFDIR:
+                    raise _Err(errno.EISDIR, a["path"])
+                self._drm(dino, name)
+            self._purge_data(ent)
+            return {}
+        if op == "rmdir":
+            dino, name = self._split(a["path"])
+            with self._dir_lock(dino):
+                ent = self._dget(dino, name)
+                if ent is None:
+                    raise _Err(errno.ENOENT, a["path"])
+                if not ent["mode"] & S_IFDIR:
+                    raise _Err(errno.ENOTDIR, a["path"])
+                if self._dcount(ent["ino"]) > 0:
+                    raise _Err(errno.ENOTEMPTY, a["path"])
+                self._drm(dino, name)
+                try:
+                    self.meta.remove(f"dir.{ent['ino']:x}")
+                except RadosError:
+                    pass
+            return {}
+        if op == "rename":
+            sdino, sname = self._split(a["src"])
+            ddino, dname = self._split(a["dst"])
+            # both directory locks in ino order (dedupe: same dir, or
+            # two inos striping onto the same lock object)
+            locks = []
+            for ino in sorted({sdino, ddino}):
+                lk = self._dir_lock(ino)
+                if not any(lk is have for have in locks):
+                    locks.append(lk)
+            for lk in locks:
+                lk.acquire()
+            replaced = None
+            try:
+                ent = self._dget(sdino, sname)
+                if ent is None:
+                    raise _Err(errno.ENOENT, a["src"])
+                existing = self._dget(ddino, dname)
+                if existing is not None:
+                    if existing["mode"] & S_IFDIR:
+                        raise _Err(errno.EISDIR, a["dst"])
+                    if existing["ino"] != ent["ino"]:
+                        replaced = existing
+                self._dset(ddino, dname, ent)
+                self._drm(sdino, sname)
+            finally:
+                for lk in locks:
+                    lk.release()
+            if replaced is not None:
+                # the displaced file's inode lost its last link: purge
+                # its data like unlink would (reference purge queue)
+                self._purge_data(replaced)
+            return {}
+        raise _Err(errno.EOPNOTSUPP, op)
+
+    def _purge_data(self, ent: dict) -> None:
+        """Remove a dead inode's data blocks (reference PurgeQueue)."""
+        nblocks = -(-max(ent.get("size", 0), 1) // self.block_size)
+        for b in range(nblocks):
+            try:
+                self.data.remove(data_oid(ent["ino"], b))
+            except RadosError:
+                pass
+
+
+class _Err(Exception):
+    def __init__(self, err: int, msg: str = ""):
+        super().__init__(msg)
+        self.errno = err

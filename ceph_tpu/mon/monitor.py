@@ -410,6 +410,19 @@ class Monitor:
                     self.osdmap.bump_epoch()
                     self._propose_current()
                 return 0, {"down": osd_id}
+            if prefix == "osd pool selfmanaged-snap-create":
+                # allocate one snap id (reference OSDMonitor
+                # prepare_pool_op SELFMANAGED_SNAP_CREATE)
+                name = cmd["pool"]
+                with self.lock:
+                    pool = self.osdmap.lookup_pool(name)
+                    if pool is None:
+                        return -errno.ENOENT, {"error": f"no pool {name}"}
+                    pool.snap_seq += 1
+                    snapid = pool.snap_seq
+                    self.osdmap.bump_epoch()
+                    self._propose_current()
+                return 0, {"snapid": snapid}
             if prefix == "status":
                 return self._cmd_status()
             if prefix == "osd tree":

@@ -150,15 +150,20 @@ class MOSDOp(Message):
     type_id = 42
 
     def __init__(self, pgid: spg_t, oid: hobject_t, ops: list,
-                 data: bytes = b"", tid: int = 0, epoch: int = 0):
+                 data: bytes = b"", tid: int = 0, epoch: int = 0,
+                 snapc: list | None = None):
         super().__init__()
         self.pgid, self.oid, self.ops = pgid, oid, ops
         self.data, self.tid, self.epoch = data, tid, epoch
+        # SnapContext [seq, [snap ids]] for self-managed snapshots
+        # (reference MOSDOp snap_seq + snaps)
+        self.snapc = snapc
 
     def to_meta(self):
         return {"pgid": spg_to_json(self.pgid),
                 "oid": hobj_to_json(self.oid),
-                "ops": self.ops, "tid": self.tid, "epoch": self.epoch}
+                "ops": self.ops, "tid": self.tid, "epoch": self.epoch,
+                "snapc": self.snapc}
 
     def data_segment(self):
         return self.data
@@ -168,6 +173,7 @@ class MOSDOp(Message):
         self.oid = hobj_from_json(meta["oid"])
         self.ops, self.tid = meta["ops"], meta["tid"]
         self.epoch = meta["epoch"]
+        self.snapc = meta.get("snapc")
         self.data = data
 
 
@@ -488,6 +494,45 @@ class MPGListReply(Message):
         self.pgid = spg_from_json(meta["pgid"])
         self.tid = meta["tid"]
         self.oids = meta["oids"]
+
+
+# -- cephfs (reference MClientRequest.h / MClientReply.h) --------------------
+
+@register_message
+class MClientRequest(Message):
+    """FS client -> MDS metadata op (reference MClientRequest: op code
+    + filepath + args; here op is a verb string and args a JSON dict)."""
+
+    type_id = 24
+
+    def __init__(self, op: str = "", args: dict | None = None,
+                 tid: int = 0):
+        super().__init__()
+        self.op, self.args, self.tid = op, args or {}, tid
+
+    def to_meta(self):
+        return {"op": self.op, "args": self.args, "tid": self.tid}
+
+    def decode_wire(self, meta, data):
+        self.op, self.args, self.tid = \
+            meta["op"], meta["args"], meta["tid"]
+
+
+@register_message
+class MClientReply(Message):
+    type_id = 25
+
+    def __init__(self, tid: int = 0, result: int = 0,
+                 out: dict | None = None):
+        super().__init__()
+        self.tid, self.result, self.out = tid, result, out or {}
+
+    def to_meta(self):
+        return {"tid": self.tid, "result": self.result, "out": self.out}
+
+    def decode_wire(self, meta, data):
+        self.tid, self.result, self.out = \
+            meta["tid"], meta["result"], meta["out"]
 
 
 # -- auth (reference MAuth.h / MAuthReply.h, cephx ticket exchange) ----------

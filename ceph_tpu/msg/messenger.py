@@ -53,10 +53,19 @@ UNACKED_HARD_CAP = 65536
 
 def _parse_raw(raw: bytes) -> tuple[int, int, bytes, bytes, int]:
     """Split one frame already in memory (the unwrapped payload of an
-    ENC/COMP envelope) into (tid, seq, meta_raw, data, pcrc)."""
-    tid, seq, meta_len, data_len = \
-        Message.parse_header(raw[:Message.HEADER_SIZE])
+    ENC/COMP envelope) into (tid, seq, meta_raw, data, pcrc).  A short
+    or mangled buffer raises ValueError so the read loop's corruption
+    path (session-preserving wire reset) handles it — struct.error
+    would kill the loop."""
+    import struct as _struct
+    try:
+        tid, seq, meta_len, data_len = \
+            Message.parse_header(raw[:Message.HEADER_SIZE])
+    except (_struct.error, ValueError) as e:
+        raise ValueError(f"bad inner frame: {e}") from e
     off = Message.HEADER_SIZE
+    if len(raw) < off + meta_len + data_len + 4:
+        raise ValueError("truncated inner frame")
     meta_raw = raw[off:off + meta_len]
     data = raw[off + meta_len:off + meta_len + data_len]
     pcrc = int.from_bytes(raw[-4:], "little")
@@ -358,9 +367,11 @@ class Connection:
             # on this outbound session are from a cluster daemon
             sess.auth_identity = {"entity": meta.get("entity"),
                                   "kind": "service", "caps": ""}
-        # compression: the server echoes the chosen algo (or none)
+        # compression: the server echoes the chosen algo — accept it
+        # only if it is exactly what we offered (a bogus echo must not
+        # crash the connect path or select an algo we lack)
         chosen = meta.get("compress")
-        if chosen and m.compress_algo:
+        if chosen and chosen == m.compress_algo:
             from ..compressor import create
             sess.comp = create(chosen)
             sess.comp_min = m.compress_min

@@ -240,6 +240,10 @@ class PGState:
         # serving (reference PeeringState: no ops until Active)
         self.needs_peer = True
         self.peer_lock = threading.Lock()
+        # head SnapSet seq cache: steady-state writes under an
+        # unchanged SnapContext skip the attrs fetch (only this
+        # primary mutates heads, so the cache is authoritative)
+        self.snap_seqs: dict = {}
 
     def next_version(self, epoch: int) -> eversion_t:
         with self.lock:
@@ -1134,6 +1138,9 @@ class OSDDaemon:
     def _do_client_op(self, conn, msg: M.MOSDOp, _t0: float) -> None:
         state = self._get_pg(msg.pgid.pgid)
         be = state.backend
+        if msg.oid.snap != 0:
+            self._do_snap_read(conn, msg, state)
+            return
         txn = PGTransaction()
         data_off = 0
         read_payload = b""
@@ -1227,6 +1234,12 @@ class OSDDaemon:
             result = -errno.EAGAIN
         elif result == 0 and txn.ops:
             self.perf.inc("op_w")
+            if msg.snapc and int(msg.snapc[0]) > 0:
+                # copy-on-write before the mutation lands (reference
+                # PrimaryLogPG::make_writeable)
+                for woid in list(txn.ops):
+                    self._maybe_cow(state, msg.pgid.pgid, woid,
+                                    int(msg.snapc[0]))
             done = threading.Event()
             version = state.next_version(self.osdmap.epoch)
             be.submit_transaction(txn, version, done.set)
@@ -1235,6 +1248,112 @@ class OSDDaemon:
         elif result == 0:
             self.perf.inc("op_r")
         self.perf.tinc("op_latency", time.perf_counter() - _t0)
+        conn.send_message(M.MOSDOpReply(msg.tid, result, read_payload,
+                                        self.osdmap.epoch))
+
+    # -- self-managed snapshots (reference SnapSet + make_writeable) --------
+
+    def _head_snapset(self, state: PGState, pgid: pg_t,
+                      head: hobject_t):
+        from .snapset import SS_KEY, SnapSet
+        be = state.backend
+        if state.kind == "ec":
+            for s in range(be.n):
+                attrs = be.shards.get_attrs(s, head)
+                if attrs is not None:
+                    return SnapSet.decode(attrs.get(SS_KEY)), True
+            return SnapSet(), False
+        # replicated: the primary holds a full local copy
+        goid = ghobject_t(head, shard=NO_SHARD)
+        cid = self._cid(spg_t(pgid, NO_SHARD))
+        try:
+            attrs = self.store.getattrs(cid, goid)
+        except KeyError:
+            return SnapSet(), False
+        return SnapSet.decode(attrs.get(SS_KEY)), True
+
+    def _maybe_cow(self, state: PGState, pgid: pg_t, oid: hobject_t,
+                   seq: int) -> None:
+        """Clone the head to <oid, snap=seq> when the op's SnapContext
+        is newer than what the head has seen."""
+        from dataclasses import replace
+        from .snapset import SS_KEY, SnapSet
+        be = state.backend
+        head = replace(oid, snap=0)
+        if state.snap_seqs.get(head, -1) >= seq:
+            return   # head already saw this snapc: no fetch, no COW
+        ss, exists = self._head_snapset(state, pgid, head)
+        if not exists:
+            # born under this snapc: snaps <= seq predate the object
+            ss = SnapSet(seq=seq, born=seq)
+            self._bcast_head_txn(state, pgid, head, None, ss)
+            state.snap_seqs[head] = seq
+            return
+        if not ss.needs_cow(seq):
+            state.snap_seqs[head] = ss.seq
+            return
+        ss.add_clone(seq)
+        self._bcast_head_txn(state, pgid, head,
+                             replace(head, snap=seq), ss)
+        state.snap_seqs[head] = ss.seq
+
+    def _bcast_head_txn(self, state: PGState, pgid: pg_t,
+                        head: hobject_t, clone_to: hobject_t | None,
+                        ss) -> None:
+        """Send clone+snapset (or snapset-only) transactions to every
+        shard/replica; session FIFO orders them before the write that
+        triggered the COW."""
+        from .snapset import SS_KEY
+        be = state.backend
+        if state.kind == "ec":
+            for s in range(be.n):
+                txn = Transaction()
+                if clone_to is not None:
+                    txn.clone(shard_oid(head, s), shard_oid(clone_to, s))
+                txn.setattr(shard_oid(head, s), SS_KEY, ss.encode())
+                be.shards.sub_write(s, txn, lambda _s: None)
+        else:
+            for r in range(be.replicas.n_replicas):
+                txn = Transaction()
+                hg = ghobject_t(head, shard=NO_SHARD)
+                if clone_to is not None:
+                    txn.clone(hg, ghobject_t(clone_to, shard=NO_SHARD))
+                txn.setattr(hg, SS_KEY, ss.encode())
+                be.replicas.rep_write(r, txn, lambda _r: None)
+
+    def _do_snap_read(self, conn, msg: M.MOSDOp, state: PGState) -> None:
+        """Serve read/stat at a snap id by resolving the SnapSet to the
+        covering clone (reference PrimaryLogPG::find_object_context
+        with a snapid)."""
+        from dataclasses import replace
+        be = state.backend
+        head = replace(msg.oid, snap=0)
+        ss, exists = self._head_snapset(state, msg.pgid.pgid, head)
+        target_snap = ss.resolve(msg.oid.snap) if exists else None
+        if target_snap is None:
+            conn.send_message(M.MOSDOpReply(
+                msg.tid, -errno.ENOENT, b"", self.osdmap.epoch))
+            return
+        roid = head if target_snap == 0 else \
+            replace(msg.oid, snap=target_snap)
+        read_payload = b""
+        result = 0
+        for op in msg.ops:
+            name = op[0]
+            if name == "read":
+                _, off, ln = op
+                try:
+                    data = be.read(roid, off, ln if ln > 0 else None)
+                    read_payload += data.tobytes() \
+                        if data is not None else b""
+                except ErasureCodeError as e:
+                    result = -e.errno
+                    break
+            elif name == "stat":
+                pass
+            else:
+                result = -errno.EROFS   # snapshots are read-only
+                break
         conn.send_message(M.MOSDOpReply(msg.tid, result, read_payload,
                                         self.osdmap.epoch))
 

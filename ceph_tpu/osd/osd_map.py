@@ -33,6 +33,10 @@ class PGPool:
     crush_rule: int
     erasure_code_profile: str = ""
     stripe_width: int = 0
+    # self-managed snapshot id allocator (reference pg_pool_t snap_seq
+    # for SNAP_MODE_SELFMANAGED; the mon allocates ids, clients carry
+    # them in per-op SnapContexts)
+    snap_seq: int = 0
 
     def is_erasure(self) -> bool:
         return self.type == PoolType.ERASURE
@@ -174,7 +178,7 @@ class OSDMap:
                      for o in self.osds.values()],
             "pools": [[p.id, p.name, int(p.type), p.size, p.min_size,
                        p.pg_num, p.crush_rule, p.erasure_code_profile,
-                       p.stripe_width]
+                       p.stripe_width, p.snap_seq]
                       for p in self.pools.values()],
             "pg_temp": [[pg.pool, pg.seed, osds]
                         for pg, osds in self.pg_temp.items()],
@@ -201,9 +205,12 @@ class OSDMap:
         for oid_, up, in_, w, addr in j["osds"]:
             m.osds[oid_] = OSDInfo(oid_, up, in_, w,
                                    tuple(addr) if addr else None)
-        for pid, name, t, size, msize, pgn, rule, prof, sw in j["pools"]:
+        for rec in j["pools"]:
+            pid, name, t, size, msize, pgn, rule, prof, sw = rec[:9]
+            snap_seq = rec[9] if len(rec) > 9 else 0
             m.pools[pid] = PGPool(pid, name, PoolType(t), size, msize,
-                                  pgn, rule, prof, sw)
+                                  pgn, rule, prof, sw,
+                                  snap_seq=snap_seq)
             m.pool_ids_by_name[name] = pid
         for pool, seed, osds in j.get("pg_temp", []):
             m.pg_temp[pg_t(pool, seed)] = osds

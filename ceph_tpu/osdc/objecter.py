@@ -163,7 +163,8 @@ class Objecter:
 
     def op_submit(self, pool_id: int, name: str, ops: list,
                   data: bytes = b"", timeout: float = 30.0,
-                  attempts: int = 3) -> M.MOSDOpReply:
+                  attempts: int = 3, snap: int = 0,
+                  snapc: list | None = None) -> M.MOSDOpReply:
         # an expired ticket would make every OSD reconnect fail
         # permanently; refresh before it lapses (reference
         # CephxTicketManager renewal)
@@ -173,7 +174,7 @@ class Objecter:
                 self._fetch_ticket()
             except Exception:  # noqa: BLE001 - mon may be electing
                 pass
-        oid = hobject_t(pool=pool_id, name=name)
+        oid = hobject_t(pool=pool_id, name=name, snap=snap)
         last_err = None
         for attempt in range(attempts):
             tgt = self._calc_target(pool_id, name)
@@ -194,7 +195,7 @@ class Objecter:
                 self._waiters[tid] = w
             conn = self.messenger.connect(tuple(info.addr))
             conn.send_message(M.MOSDOp(spg, oid, ops, data, tid,
-                                       self.osdmap.epoch))
+                                       self.osdmap.epoch, snapc=snapc))
             if w["event"].wait(timeout):
                 reply = w["reply"]
                 if reply.result == -errno.EAGAIN:

@@ -82,10 +82,32 @@ class IoCtx:
         self.client = client
         self.pool_id = pool_id
         self.pool_name = pool_name
+        # self-managed snapshots (reference rados_ioctx_selfmanaged_*):
+        # snapc rides every write; read_snap redirects reads to a clone
+        self.snapc: list | None = None     # [seq, [snap ids desc]]
+        self.read_snap: int = 0
 
-    def _submit(self, name: str, ops: list, data: bytes = b"") -> bytes:
+    def set_snap_context(self, seq: int, snaps: list[int]) -> None:
+        self.snapc = [int(seq), [int(s) for s in snaps]]
+
+    def set_read_snap(self, snap: int) -> None:
+        self.read_snap = int(snap)
+
+    def selfmanaged_snap_create(self) -> int:
+        """Allocate a snap id from the mon (reference
+        rados_ioctx_selfmanaged_snap_create)."""
+        r, out = self.client.mon_command({
+            "prefix": "osd pool selfmanaged-snap-create",
+            "pool": self.pool_name})
+        if r != 0:
+            raise RadosError(-r, out.get("error", "snap create"))
+        return int(out["snapid"])
+
+    def _submit(self, name: str, ops: list, data: bytes = b"",
+                snap: int = 0) -> bytes:
         reply = self.client.objecter.op_submit(
-            self.pool_id, name, ops, data)
+            self.pool_id, name, ops, data, snap=snap,
+            snapc=self.snapc)
         if reply.result != 0:
             raise RadosError(-reply.result, f"op on {name}")
         return reply.data
@@ -98,8 +120,11 @@ class IoCtx:
     def write(self, name: str, data: bytes, offset: int = 0) -> None:
         self._submit(name, [["write", offset, len(data)]], bytes(data))
 
-    def read(self, name: str, length: int = 0, offset: int = 0) -> bytes:
-        return self._submit(name, [["read", offset, length]])
+    def read(self, name: str, length: int = 0, offset: int = 0,
+             snap: int | None = None) -> bytes:
+        return self._submit(name, [["read", offset, length]],
+                            snap=self.read_snap if snap is None
+                            else snap)
 
     def stat(self, name: str) -> int:
         reply = self.client.objecter.op_submit(

@@ -2,12 +2,20 @@
 
 Re-expresses the core of reference src/librbd/ (ImageCtx + the
 ImageRequest -> ObjectRequest dispatch in io/): an image is a header
-object (`rbd_header.<name>`: JSON size/order) plus data objects
-`rbd_data.<name>.<block#>`, each 2^order bytes; block I/O at arbitrary
-offsets maps to per-object extents (reference Striper::file_to_extents
-role).  Snapshots are full-copy (`rbd_data.<name>@<snap>.<block#>`) —
-the layering/clone chain and journal-based mirroring of the reference
-are roadmap items, recorded in docs/PARITY.md.
+object (`rbd_header.<name>`: JSON size/order/snaps/parent) plus data
+objects `rbd_data.<name>.<block#>`, each 2^order bytes; block I/O at
+arbitrary offsets maps to per-object extents (reference
+Striper::file_to_extents role).
+
+Snapshots are RADOS self-managed snapshots (reference librbd snapshots
+over rados selfmanaged snap contexts): snap_create allocates a snap id
+from the mon and subsequent writes carry the image's SnapContext, so
+the OSD clones objects copy-on-write — no data is copied at snap time.
+Clones are layered images (reference parent/child layering): a child
+records (parent image, parent snap); reads fall through to the parent
+at that snap for blocks the child has never written, and the first
+child write to such a block pulls the parent content (COW pull,
+reference CopyupRequest).
 """
 
 from __future__ import annotations
@@ -21,7 +29,8 @@ DEFAULT_ORDER = 22  # 4 MiB objects, the reference default
 
 
 class RBD:
-    """Image management (reference librbd.h rbd_create/list/remove)."""
+    """Image management (reference librbd.h rbd_create/list/remove/
+    clone)."""
 
     def __init__(self, ioctx: IoCtx):
         self.io = ioctx
@@ -34,9 +43,29 @@ class RBD:
         except RadosError as e:
             if e.errno != errno.ENOENT:
                 raise
-        header = {"size": size, "order": order, "snaps": []}
+        header = {"size": size, "order": order, "snaps": [],
+                  "snap_ids": {}, "parent": None}
         self.io.write_full(_header(name), json.dumps(header).encode())
         self._dir_add(name)
+
+    def clone(self, parent: str, snap: str, child: str) -> None:
+        """Layered clone from a parent snapshot (reference rbd clone;
+        the snap plays the protected-snap role)."""
+        pimg = Image(self.io, parent)
+        if snap not in pimg._header.get("snap_ids", {}):
+            raise RadosError(errno.ENOENT,
+                             f"no snap {snap} on {parent}")
+        try:
+            self.io.read(_header(child), 1)
+            raise RadosError(errno.EEXIST, f"image {child} exists")
+        except RadosError as e:
+            if e.errno != errno.ENOENT:
+                raise
+        header = {"size": pimg.size(), "order": pimg._header["order"],
+                  "snaps": [], "snap_ids": {},
+                  "parent": [parent, pimg._header["snap_ids"][snap]]}
+        self.io.write_full(_header(child), json.dumps(header).encode())
+        self._dir_add(child)
 
     def list(self) -> list[str]:
         # images register in a directory object (reference rbd_directory)
@@ -74,19 +103,36 @@ def _header(name: str) -> str:
     return f"rbd_header.{name}"
 
 
-def _data(name: str, block: int, snap: str | None = None) -> str:
-    base = f"rbd_data.{name}" + (f"@{snap}" if snap else "")
-    return f"{base}.{block:016x}"
+def _data(name: str, block: int) -> str:
+    return f"rbd_data.{name}.{block:016x}"
+
+
+def _legacy_snap_data(name: str, snap: str, block: int) -> str:
+    """Pre-COW full-copy snapshot object naming (kept readable so
+    images snapshotted before the COW scheme still work)."""
+    return f"rbd_data.{name}@{snap}.{block:016x}"
 
 
 class Image:
     """Open image handle (reference ImageCtx + Image API)."""
 
     def __init__(self, ioctx: IoCtx, name: str):
-        self.io = ioctx
+        # private IoCtx: the image's SnapContext/read-snap must not
+        # leak onto other users of the caller's ioctx
+        self.io = IoCtx(ioctx.client, ioctx.pool_id, ioctx.pool_name)
         self.name = name
         self._header = json.loads(
             self.io.read(_header(name), 0).decode())
+        self._header.setdefault("snap_ids", {})
+        self._header.setdefault("parent", None)
+        # snapshots taken under the pre-COW scheme (full-copy objects,
+        # no rados snap id) remain usable through their own paths
+        self._legacy_snaps = {s for s in self._header["snaps"]
+                              if s not in self._header["snap_ids"]}
+        self._apply_snapc()
+        self._parent: Image | None = None
+        self._read_snap_id = 0
+        self._legacy_read: str | None = None
 
     @property
     def block_size(self) -> int:
@@ -99,6 +145,40 @@ class Image:
         self.io.write_full(_header(self.name),
                            json.dumps(self._header).encode())
 
+    def _apply_snapc(self) -> None:
+        ids = sorted(self._header["snap_ids"].values(), reverse=True)
+        self.io.snapc = [ids[0], ids] if ids else None
+
+    def _get_parent(self) -> "Image | None":
+        if self._header["parent"] is None:
+            return None
+        if self._parent is None:
+            pname, psnap = self._header["parent"]
+            self._parent = Image(self.io, pname)
+            self._parent._read_snap_id = psnap
+        return self._parent
+
+    def _read_block(self, block: int, boff: int, run: int) -> bytes:
+        """One block's bytes at this image's read context, falling
+        through to the parent for never-written clone blocks."""
+        try:
+            if self._legacy_read is not None:
+                piece = self.io.read(
+                    _legacy_snap_data(self.name, self._legacy_read,
+                                      block), run, boff, snap=0)
+            else:
+                piece = self.io.read(_data(self.name, block), run, boff,
+                                     snap=self._read_snap_id)
+            return piece + b"\0" * (run - len(piece))
+        except RadosError as e:
+            if e.errno != errno.ENOENT:
+                raise
+        parent = self._get_parent()
+        if parent is not None and \
+                block * self.block_size < parent.size():
+            return parent._read_block(block, boff, run)
+        return b"\0" * run
+
     # -- block I/O ----------------------------------------------------------
 
     def write(self, offset: int, data: bytes) -> int:
@@ -109,10 +189,28 @@ class Image:
         while pos < len(data):
             block, boff = divmod(offset + pos, bs)
             run = min(bs - boff, len(data) - pos)
+            if run < bs:
+                self._copyup(block)
             self.io.write(_data(self.name, block),
                           data[pos:pos + run], offset=boff)
             pos += run
         return len(data)
+
+    def _copyup(self, block: int) -> None:
+        """First partial write to a clone block pulls the parent's
+        content (reference CopyupRequest)."""
+        parent = self._get_parent()
+        if parent is None:
+            return
+        try:
+            self.io.read(_data(self.name, block), 1)
+            return                      # child block already exists
+        except RadosError as e:
+            if e.errno != errno.ENOENT:
+                raise
+        content = parent._read_block(block, 0, self.block_size)
+        if content.rstrip(b"\0"):
+            self.io.write_full(_data(self.name, block), content)
 
     def read(self, offset: int, length: int) -> bytes:
         length = max(0, min(length, self.size() - offset))
@@ -122,16 +220,7 @@ class Image:
         while pos < length:
             block, boff = divmod(offset + pos, bs)
             run = min(bs - boff, length - pos)
-            try:
-                piece = self.io.read(_data(self.name, block), run, boff)
-            except RadosError as e:
-                if e.errno == errno.ENOENT:
-                    piece = b""
-                else:
-                    raise
-            if len(piece) < run:                 # sparse: zero-fill
-                piece = piece + b"\0" * (run - len(piece))
-            out += piece
+            out += self._read_block(block, boff, run)
             pos += run
         return bytes(out)
 
@@ -146,36 +235,59 @@ class Image:
         self._header["size"] = new_size
         self._save_header()
 
-    # -- snapshots (full-copy) ----------------------------------------------
+    # -- snapshots (rados selfmanaged COW) -----------------------------------
 
     def snap_create(self, snap: str) -> None:
         if snap in self._header["snaps"]:
             raise RadosError(errno.EEXIST, f"snap {snap} exists")
-        nblocks = -(-self.size() // self.block_size)
-        for b in range(nblocks):
-            try:
-                data = self.io.read(_data(self.name, b), 0)
-            except RadosError:
-                continue
-            if data:
-                self.io.write_full(_data(self.name, b, snap), data)
+        snapid = self.io.selfmanaged_snap_create()
         self._header["snaps"].append(snap)
+        self._header["snap_ids"][snap] = snapid
         self._save_header()
+        self._apply_snapc()   # later writes COW against this snap
 
     def snap_list(self) -> list[str]:
         return list(self._header["snaps"])
 
+    def snap_set(self, snap: str | None) -> None:
+        """Route reads to a snapshot (reference rbd_snap_set); None
+        returns to the head."""
+        if snap is None:
+            self._read_snap_id = 0
+            self._legacy_read = None
+        elif snap in self._legacy_snaps:
+            self._legacy_read = snap
+            self._read_snap_id = 0
+        else:
+            if snap not in self._header["snap_ids"]:
+                raise RadosError(errno.ENOENT, f"no snap {snap}")
+            self._read_snap_id = self._header["snap_ids"][snap]
+            self._legacy_read = None
+
     def snap_rollback(self, snap: str) -> None:
-        if snap not in self._header["snaps"]:
+        if snap in self._legacy_snaps:
+            snapid = None
+        elif snap in self._header["snap_ids"]:
+            snapid = self._header["snap_ids"][snap]
+        else:
             raise RadosError(errno.ENOENT, f"no snap {snap}")
-        nblocks = -(-self.size() // self.block_size)
+        bs = self.block_size
+        nblocks = -(-self.size() // bs)
         for b in range(nblocks):
             try:
-                data = self.io.read(_data(self.name, b, snap), 0)
-            except RadosError:
+                if snapid is None:
+                    data = self.io.read(
+                        _legacy_snap_data(self.name, snap, b), 0)
+                else:
+                    data = self.io.read(_data(self.name, b), 0,
+                                        snap=snapid)
+            except RadosError as e:
+                if e.errno != errno.ENOENT:
+                    raise
                 data = b""
-            if data:
-                self.io.write_full(_data(self.name, b), data)
+            if data.rstrip(b"\0"):
+                self.io.write(_data(self.name, b),
+                              data.ljust(bs, b"\0")[:bs], offset=0)
             else:
                 try:
                     self.io.remove(_data(self.name, b))
@@ -183,13 +295,35 @@ class Image:
                     pass
 
     def snap_remove(self, snap: str) -> None:
-        if snap not in self._header["snaps"]:
+        if snap in self._legacy_snaps:
+            nblocks = -(-self.size() // self.block_size)
+            for b in range(nblocks):
+                try:
+                    self.io.remove(_legacy_snap_data(self.name, snap, b))
+                except RadosError:
+                    pass
+            self._legacy_snaps.discard(snap)
+            self._header["snaps"].remove(snap)
+            self._save_header()
+            return
+        if snap not in self._header["snap_ids"]:
             raise RadosError(errno.ENOENT, f"no snap {snap}")
+        self._header["snaps"].remove(snap)
+        del self._header["snap_ids"][snap]
+        self._save_header()
+        self._apply_snapc()
+        # clone trimming is deferred to scrub-time space reclaim
+        # (reference snap trimmer) — reads can no longer reach the snap
+
+    def flatten(self) -> None:
+        """Detach from the parent by copying up every missing block
+        (reference rbd flatten)."""
+        parent = self._get_parent()
+        if parent is None:
+            return
         nblocks = -(-self.size() // self.block_size)
         for b in range(nblocks):
-            try:
-                self.io.remove(_data(self.name, b, snap))
-            except RadosError:
-                pass
-        self._header["snaps"].remove(snap)
+            self._copyup(b)
+        self._header["parent"] = None
+        self._parent = None
         self._save_header()

@@ -118,3 +118,14 @@ def test_compression_composes_with_cluster(tmp_path):
             [conn.session for conn in osd.messenger._conns.values()])
         assert compressed >= 1, \
             "no daemon frame was ever compressed"
+
+
+def test_decompression_bomb_rejected():
+    """A small compressed payload expanding past the cap must fail
+    loudly instead of materializing gigabytes."""
+    c = compressor.create("zlib")
+    bomb = c.compress(b"\x00" * (1 << 22))
+    with pytest.raises(CompressorError, match="cap"):
+        c.decompress(bomb, max_out=1 << 20)
+    # under the cap it still works
+    assert c.decompress(bomb, max_out=1 << 23) == b"\x00" * (1 << 22)

@@ -1,0 +1,160 @@
+"""CephFS-role file service tests: namespace ops, striped file I/O,
+multi-client visibility, error semantics.
+
+Reference analogs: src/mds/Server.cc handle_client_* ops,
+src/client/Client.cc file I/O striping, and the fs qa suites'
+basic-op coverage (qa/workunits/fs/misc)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.fs import CephFS, FSError, MDSDaemon
+from ceph_tpu.tools.vstart import Cluster
+
+BS = 8192   # small blocks so tests cross stripe boundaries cheaply
+
+
+@pytest.fixture(scope="module")
+def fsenv():
+    with Cluster(n_osds=4) as c:
+        mds = MDSDaemon(c.mon_addrs, block_size=BS)
+        fs = CephFS(c.mon_addrs, mds.addr)
+        yield c, mds, fs
+        fs.shutdown()
+        mds.shutdown()
+
+
+def test_mkdir_readdir_stat(fsenv):
+    _, _, fs = fsenv
+    fs.mkdir("/a")
+    fs.mkdir("/a/b")
+    fs.makedirs("/a/c/d/e")
+    names = [n for n, _ in fs.readdir("/a")]
+    assert sorted(names) == ["b", "c"]
+    ent = fs.stat("/a/b")
+    assert ent["mode"] & 0o040000
+    with pytest.raises(FSError) as ei:
+        fs.stat("/a/nope")
+    assert ei.value.errno == 2            # ENOENT
+    with pytest.raises(FSError) as ei:
+        fs.mkdir("/a/b")
+    assert ei.value.errno == 17           # EEXIST
+
+
+def test_file_write_read_across_blocks(fsenv):
+    _, _, fs = fsenv
+    rng = np.random.default_rng(0)
+    payload = rng.integers(0, 256, BS * 3 + 777,
+                           dtype=np.uint8).tobytes()
+    fs.makedirs("/files")
+    fs.write_file("/files/big.bin", payload)
+    assert fs.read_file("/files/big.bin") == payload
+    assert fs.stat("/files/big.bin")["size"] == len(payload)
+    # partial reads + seeks
+    with fs.open("/files/big.bin") as f:
+        f.seek(BS - 10)
+        assert f.read(20) == payload[BS - 10:BS + 10]
+    # overwrite a range spanning a block boundary
+    with fs.open("/files/big.bin", "r+") as f:
+        f.pwrite(b"\xAA" * 100, BS * 2 - 50)
+    expect = bytearray(payload)
+    expect[BS * 2 - 50:BS * 2 + 50] = b"\xAA" * 100
+    assert fs.read_file("/files/big.bin") == bytes(expect)
+
+
+def test_append_and_truncate(fsenv):
+    _, _, fs = fsenv
+    fs.write_file("/files/log", b"line1\n")
+    with fs.open("/files/log", "a") as f:
+        f.write(b"line2\n")
+    assert fs.read_file("/files/log") == b"line1\nline2\n"
+    with fs.open("/files/log", "r+") as f:
+        f.truncate(5)
+    assert fs.read_file("/files/log") == b"line1"
+
+
+def test_rename_unlink_rmdir(fsenv):
+    _, _, fs = fsenv
+    fs.makedirs("/mv/src")
+    fs.write_file("/mv/src/f1", b"data")
+    fs.rename("/mv/src/f1", "/mv/f1_moved")
+    assert fs.read_file("/mv/f1_moved") == b"data"
+    with pytest.raises(FSError):
+        fs.stat("/mv/src/f1")
+    with pytest.raises(FSError) as ei:
+        fs.rmdir("/mv")                  # not empty
+    assert ei.value.errno == 39          # ENOTEMPTY
+    fs.unlink("/mv/f1_moved")
+    fs.rmdir("/mv/src")
+    fs.rmdir("/mv")
+    with pytest.raises(FSError):
+        fs.readdir("/mv")
+
+
+def test_second_client_sees_everything(fsenv):
+    c, mds, fs = fsenv
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, 2 * BS, dtype=np.uint8).tobytes()
+    fs.makedirs("/shared")
+    fs.write_file("/shared/doc", data)
+    other = CephFS(c.mon_addrs, mds.addr, name="fsclient2")
+    try:
+        assert other.read_file("/shared/doc") == data
+        assert other.stat("/shared/doc")["size"] == len(data)
+        other.write_file("/shared/reply", b"pong")
+        assert fs.read_file("/shared/reply") == b"pong"
+    finally:
+        other.shutdown()
+
+
+def test_namespace_survives_mds_restart(fsenv):
+    """The namespace is entirely in RADOS: a fresh MDS over the same
+    pools serves the same tree (reference MDS rejoin from the
+    metadata pool)."""
+    c, _, fs = fsenv
+    fs.makedirs("/persist")
+    fs.write_file("/persist/keep", b"still here")
+    mds2 = MDSDaemon(c.mon_addrs, block_size=BS)
+    fs2 = CephFS(c.mon_addrs, mds2.addr, name="fsclient3")
+    try:
+        assert fs2.read_file("/persist/keep") == b"still here"
+        names = [n for n, _ in fs2.readdir("/persist")]
+        assert names == ["keep"]
+        # allocator continuity: new inodes do not collide with old
+        fs2.write_file("/persist/new", b"n")
+        inos = {fs2.stat("/persist/keep")["ino"],
+                fs2.stat("/persist/new")["ino"]}
+        assert len(inos) == 2
+    finally:
+        fs2.shutdown()
+        mds2.shutdown()
+
+
+def test_unlink_purges_data_blocks(fsenv):
+    c, _, fs = fsenv
+    payload = b"q" * (2 * BS)
+    fs.write_file("/files/purge_me", payload)
+    ino = fs.stat("/files/purge_me")["ino"]
+    fs.unlink("/files/purge_me")
+    from ceph_tpu.fs.mds import data_oid
+    from ceph_tpu.rados.client import RadosError
+    with pytest.raises(RadosError):
+        fs.data.read(data_oid(ino, 0), 1)
+
+
+def test_same_dir_rename_and_rename_over_existing(fsenv):
+    """Rename within one directory (the common case) and rename over
+    an existing file, whose displaced inode's data must be purged."""
+    c, _, fs = fsenv
+    fs.makedirs("/rn")
+    fs.write_file("/rn/a", b"alpha")
+    fs.rename("/rn/a", "/rn/b")          # same-directory rename
+    assert fs.read_file("/rn/b") == b"alpha"
+    fs.write_file("/rn/victim", b"v" * BS)
+    vino = fs.stat("/rn/victim")["ino"]
+    fs.rename("/rn/b", "/rn/victim")     # replaces an existing file
+    assert fs.read_file("/rn/victim") == b"alpha"
+    from ceph_tpu.fs.mds import data_oid
+    from ceph_tpu.rados.client import RadosError
+    with pytest.raises(RadosError):      # displaced inode purged
+        fs.data.read(data_oid(vino, 0), 1)

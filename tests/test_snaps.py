@@ -1,0 +1,182 @@
+"""Self-managed snapshot tests: SnapSet resolution, COW on write,
+snap reads on EC and replicated pools.
+
+Reference analogs: src/osd/osd_types.h SnapSet,
+PrimaryLogPG::make_writeable (clone on newer snapc) and
+find_object_context (snapid read resolution),
+rados_ioctx_selfmanaged_snap_* client surface."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.osd.snapset import SnapSet
+from ceph_tpu.rados.client import RadosError
+from ceph_tpu.tools.vstart import Cluster
+
+
+# -- tier 1: SnapSet logic ---------------------------------------------------
+
+def test_snapset_resolution():
+    ss = SnapSet()
+    assert ss.resolve(1) == 0            # untouched object: head serves
+    ss.add_clone(3)                      # clone taken at seq 3
+    assert ss.resolve(2) == 3            # snap 2 covered by clone 3
+    assert ss.resolve(3) == 3
+    assert ss.resolve(4) == 0            # newer than any clone: head
+    ss.add_clone(7)
+    assert ss.resolve(5) == 7
+    born = SnapSet(seq=4, born=4)
+    assert born.resolve(3) is None       # predates creation
+    assert born.resolve(4) is None
+    assert born.resolve(5) == 0
+
+
+def test_snapset_roundtrip():
+    ss = SnapSet(seq=9, clones=[3, 7], born=1)
+    ss2 = SnapSet.decode(ss.encode())
+    assert (ss2.seq, ss2.clones, ss2.born) == (9, [3, 7], 1)
+
+
+# -- tier 3: cluster ---------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def snapenv():
+    with Cluster(n_osds=4) as c:
+        client = c.client()
+        client.set_ec_profile("sp", {"plugin": "jerasure", "k": "2",
+                                     "m": "1", "stripe_unit": "1024"})
+        client.create_pool("snap_ec", "erasure",
+                           erasure_code_profile="sp", pg_num=4)
+        client.create_pool("snap_rep", "replicated", size=2, pg_num=4)
+        yield c, client
+
+
+@pytest.mark.parametrize("pool", ["snap_ec", "snap_rep"])
+def test_cow_and_snap_reads(snapenv, pool):
+    _, client = snapenv
+    io = client.open_ioctx(pool)
+    rng = np.random.default_rng(0)
+    v1 = rng.integers(0, 256, 4096, dtype=np.uint8).tobytes()
+    io.write_full("obj", v1)
+    # snapshot s1, then overwrite under the new SnapContext
+    s1 = io.selfmanaged_snap_create()
+    io.set_snap_context(s1, [s1])
+    v2 = rng.integers(0, 256, 4096, dtype=np.uint8).tobytes()
+    io.write_full("obj", v2)
+    assert io.read("obj", len(v2)) == v2              # head = new
+    assert io.read("obj", len(v1), snap=s1) == v1     # snap = old
+    # second snapshot + partial overwrite
+    s2 = io.selfmanaged_snap_create()
+    io.set_snap_context(s2, [s2, s1])
+    io.write("obj", b"\xEE" * 100, offset=500)
+    v3 = v2[:500] + b"\xEE" * 100 + v2[600:]
+    assert io.read("obj", len(v3)) == v3
+    assert io.read("obj", len(v2), snap=s2) == v2
+    assert io.read("obj", len(v1), snap=s1) == v1
+    # repeated writes under the same snapc reuse one clone
+    io.write("obj", b"\x11" * 10, offset=0)
+    assert io.read("obj", len(v2), snap=s2) == v2
+
+
+@pytest.mark.parametrize("pool", ["snap_ec", "snap_rep"])
+def test_object_born_after_snap_is_absent_at_snap(snapenv, pool):
+    _, client = snapenv
+    io = client.open_ioctx(pool)
+    s = io.selfmanaged_snap_create()
+    io.set_snap_context(s, [s])
+    io.write_full(f"late_{pool}", b"new arrival")
+    with pytest.raises(RadosError) as ei:
+        io.read(f"late_{pool}", 10, snap=s)
+    assert ei.value.errno == 2            # ENOENT at the old snap
+    assert io.read(f"late_{pool}", 11) == b"new arrival"
+
+
+def test_snap_objects_are_read_only(snapenv):
+    _, client = snapenv
+    io = client.open_ioctx("snap_ec")
+    io.set_snap_context(0, [])
+    io.snapc = None
+    io.write_full("ro", b"base")
+    s = io.selfmanaged_snap_create()
+    io.set_snap_context(s, [s])
+    io.write_full("ro", b"next")
+    reply = client.objecter.op_submit(
+        io.pool_id, "ro", [["writefull", 3]], b"bad", snap=s)
+    assert reply.result == -30            # EROFS
+
+
+def test_unsnapped_pool_unaffected(snapenv):
+    """Objects written without a SnapContext behave exactly as before."""
+    _, client = snapenv
+    io = client.open_ioctx("snap_ec")
+    io.snapc = None
+    io.write_full("plain", b"plain data")
+    assert io.read("plain", 10) == b"plain data"
+
+
+# -- RBD layering over rados snapshots ---------------------------------------
+
+def test_rbd_cow_snapshots_and_clone(snapenv):
+    """Snap is O(1) (no data copy), reads-at-snap work, and a layered
+    clone falls through to the parent until written (reference librbd
+    layering + CopyupRequest)."""
+    from ceph_tpu.rbd import RBD, Image
+    _, client = snapenv
+    io = client.open_ioctx("snap_rep")
+    rbd = RBD(io)
+    rbd.create("base", size=1 << 18, order=14)   # 16 KiB blocks
+    img = Image(io, "base")
+    rng = np.random.default_rng(5)
+    v1 = rng.integers(0, 256, 40000, dtype=np.uint8).tobytes()
+    img.write(0, v1)
+    img.snap_create("gold")
+    v2 = rng.integers(0, 256, 8000, dtype=np.uint8).tobytes()
+    img.write(1000, v2)                          # COW under the snap
+    head = v1[:1000] + v2 + v1[9000:]
+    assert img.read(0, len(v1)) == head
+    img.snap_set("gold")
+    assert img.read(0, len(v1)) == v1            # time travel
+    img.snap_set(None)
+
+    # layered clone from the snapshot
+    rbd.clone("base", "gold", "child")
+    child = Image(io, "child")
+    assert child.read(0, len(v1)) == v1          # falls through
+    child.write(500, b"\xAB" * 100)              # copyup + child write
+    cv = v1[:500] + b"\xAB" * 100 + v1[600:]
+    assert child.read(0, len(v1)) == cv
+    # parent head and parent snap both untouched by the child
+    assert img.read(0, len(v1)) == head
+    img.snap_set("gold")
+    assert img.read(0, len(v1)) == v1
+    img.snap_set(None)
+    # parent writes don't leak into the clone (pinned to the snap)
+    img.write(600, b"\xCD" * 50)
+    assert child.read(0, len(v1)) == cv
+
+    # flatten: child becomes independent
+    child.flatten()
+    assert child._header["parent"] is None
+    assert child.read(0, len(v1)) == cv
+
+
+def test_rbd_rollback_after_multiple_snaps(snapenv):
+    from ceph_tpu.rbd import RBD, Image
+    _, client = snapenv
+    io = client.open_ioctx("snap_rep")
+    rbd = RBD(io)
+    rbd.create("multi", size=1 << 16, order=14)
+    img = Image(io, "multi")
+    img.write(0, b"state-A" * 100)
+    img.snap_create("a")
+    img.write(0, b"state-B" * 100)
+    img.snap_create("b")
+    img.write(0, b"state-C" * 100)
+    img.snap_set("a")
+    assert img.read(0, 7) == b"state-A"
+    img.snap_set("b")
+    assert img.read(0, 7) == b"state-B"
+    img.snap_set(None)
+    assert img.read(0, 7) == b"state-C"
+    img.snap_rollback("a")
+    assert img.read(0, 7) == b"state-A"
