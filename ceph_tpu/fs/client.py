@@ -101,9 +101,10 @@ class CephFS:
     # -- file I/O ------------------------------------------------------------
 
     def open(self, path: str, mode: str = "r") -> "File":
-        if "w" in mode or "a" in mode or "+" in mode:
+        if "w" in mode or "a" in mode:
             ent = self._req("create", {"path": path})["ent"]
         else:
+            # "r" and "r+" require the file to exist (POSIX)
             ent = self.stat(path)
             from .mds import S_IFDIR
             if ent["mode"] & S_IFDIR:

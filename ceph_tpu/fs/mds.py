@@ -255,7 +255,13 @@ class MDSDaemon:
             return {}
         if op == "rmdir":
             dino, name = self._split(a["path"])
-            with self._dir_lock(dino):
+            ent = self._dget(dino, name)
+            if ent is None:
+                raise _Err(errno.ENOENT, a["path"])
+            # lock BOTH the parent's stripe and the victim dir's own
+            # stripe: the emptiness check must exclude a concurrent
+            # create inside the victim (which holds the victim's lock)
+            with self._multi_lock(dino, ent["ino"]):
                 ent = self._dget(dino, name)
                 if ent is None:
                     raise _Err(errno.ENOENT, a["path"])
@@ -272,17 +278,12 @@ class MDSDaemon:
         if op == "rename":
             sdino, sname = self._split(a["src"])
             ddino, dname = self._split(a["dst"])
-            # both directory locks in ino order (dedupe: same dir, or
-            # two inos striping onto the same lock object)
-            locks = []
-            for ino in sorted({sdino, ddino}):
-                lk = self._dir_lock(ino)
-                if not any(lk is have for have in locks):
-                    locks.append(lk)
-            for lk in locks:
-                lk.acquire()
+            if (sdino, sname) == (ddino, dname):
+                if self._dget(sdino, sname) is None:
+                    raise _Err(errno.ENOENT, a["src"])
+                return {}   # POSIX: rename to itself is a no-op
             replaced = None
-            try:
+            with self._multi_lock(sdino, ddino):
                 ent = self._dget(sdino, sname)
                 if ent is None:
                     raise _Err(errno.ENOENT, a["src"])
@@ -294,15 +295,32 @@ class MDSDaemon:
                         replaced = existing
                 self._dset(ddino, dname, ent)
                 self._drm(sdino, sname)
-            finally:
-                for lk in locks:
-                    lk.release()
             if replaced is not None:
                 # the displaced file's inode lost its last link: purge
                 # its data like unlink would (reference purge queue)
                 self._purge_data(replaced)
             return {}
         raise _Err(errno.EOPNOTSUPP, op)
+
+    def _multi_lock(self, *inos: int):
+        """Acquire the stripe locks of several inodes deadlock-free:
+        ordered by STRIPE INDEX (two renames ordering by raw ino could
+        take aliased stripes in opposite order), deduplicated."""
+        import contextlib
+
+        idxs = sorted({ino % len(self._locks) for ino in inos})
+        locks = [self._locks[i] for i in idxs]
+
+        @contextlib.contextmanager
+        def _ctx():
+            for lk in locks:
+                lk.acquire()
+            try:
+                yield
+            finally:
+                for lk in reversed(locks):
+                    lk.release()
+        return _ctx()
 
     def _purge_data(self, ent: dict) -> None:
         """Remove a dead inode's data blocks (reference PurgeQueue)."""

@@ -1237,9 +1237,10 @@ class OSDDaemon:
             if msg.snapc and int(msg.snapc[0]) > 0:
                 # copy-on-write before the mutation lands (reference
                 # PrimaryLogPG::make_writeable)
-                for woid in list(txn.ops):
+                for woid, objop in list(txn.ops.items()):
                     self._maybe_cow(state, msg.pgid.pgid, woid,
-                                    int(msg.snapc[0]))
+                                    int(msg.snapc[0]),
+                                    is_delete=objop.delete)
             done = threading.Event()
             version = state.next_version(self.osdmap.epoch)
             be.submit_transaction(txn, version, done.set)
@@ -1273,63 +1274,95 @@ class OSDDaemon:
         return SnapSet.decode(attrs.get(SS_KEY)), True
 
     def _maybe_cow(self, state: PGState, pgid: pg_t, oid: hobject_t,
-                   seq: int) -> None:
+                   seq: int, is_delete: bool = False) -> None:
         """Clone the head to <oid, snap=seq> when the op's SnapContext
-        is newer than what the head has seen."""
+        is newer than what the head has seen.  A delete additionally
+        parks the SnapSet on the snapdir object so a later recreate
+        keeps the clone history (reference CEPH_SNAPDIR)."""
         from dataclasses import replace
-        from .snapset import SS_KEY, SnapSet
+        from .snapset import SNAPDIR, SS_KEY, SnapSet
         be = state.backend
         head = replace(oid, snap=0)
-        if state.snap_seqs.get(head, -1) >= seq:
+        snapdir = replace(oid, snap=SNAPDIR)
+        if not is_delete and state.snap_seqs.get(head, -1) >= seq:
             return   # head already saw this snapc: no fetch, no COW
         ss, exists = self._head_snapset(state, pgid, head)
         if not exists:
-            # born under this snapc: snaps <= seq predate the object
-            ss = SnapSet(seq=seq, born=seq)
+            # (re)born under this snapc: snaps <= seq predate this
+            # incarnation, but a snapdir left by a deleted predecessor
+            # carries clone history that must survive
+            prior, had_dir = self._head_snapset(state, pgid, snapdir)
+            ss = SnapSet(seq=seq, clones=prior.clones if had_dir else [],
+                         born=seq)
             self._bcast_head_txn(state, pgid, head, None, ss)
             state.snap_seqs[head] = seq
             return
-        if not ss.needs_cow(seq):
-            state.snap_seqs[head] = ss.seq
-            return
-        ss.add_clone(seq)
-        self._bcast_head_txn(state, pgid, head,
-                             replace(head, snap=seq), ss)
-        state.snap_seqs[head] = ss.seq
+        if ss.needs_cow(seq):
+            ss.add_clone(seq)
+            self._bcast_head_txn(state, pgid, head,
+                                 replace(head, snap=seq), ss)
+        state.snap_seqs[head] = max(ss.seq, seq)
+        if is_delete:
+            # park the SnapSet for the next incarnation
+            self._bcast_head_txn(state, pgid, snapdir, None, ss)
+            state.snap_seqs.pop(head, None)
 
     def _bcast_head_txn(self, state: PGState, pgid: pg_t,
                         head: hobject_t, clone_to: hobject_t | None,
-                        ss) -> None:
+                        ss, timeout: float = 15.0) -> None:
         """Send clone+snapset (or snapset-only) transactions to every
-        shard/replica; session FIFO orders them before the write that
-        triggered the COW."""
+        shard/replica and WAIT for the commits: a silently-failed clone
+        would lose snapshot history while the triggering write goes on
+        to succeed.  Session FIFO additionally orders these before the
+        write that triggered the COW."""
         from .snapset import SS_KEY
         be = state.backend
+        pending = {"n": 0}
+        done = threading.Event()
+
+        def on_commit(_sr) -> None:
+            pending["n"] -= 1
+            if pending["n"] <= 0:
+                done.set()
+
         if state.kind == "ec":
+            pending["n"] = be.n
             for s in range(be.n):
                 txn = Transaction()
                 if clone_to is not None:
                     txn.clone(shard_oid(head, s), shard_oid(clone_to, s))
                 txn.setattr(shard_oid(head, s), SS_KEY, ss.encode())
-                be.shards.sub_write(s, txn, lambda _s: None)
+                be.shards.sub_write(s, txn, on_commit)
         else:
+            pending["n"] = be.replicas.n_replicas
             for r in range(be.replicas.n_replicas):
                 txn = Transaction()
                 hg = ghobject_t(head, shard=NO_SHARD)
                 if clone_to is not None:
                     txn.clone(hg, ghobject_t(clone_to, shard=NO_SHARD))
                 txn.setattr(hg, SS_KEY, ss.encode())
-                be.replicas.rep_write(r, txn, lambda _r: None)
+                be.replicas.rep_write(r, txn, on_commit)
+        if not done.wait(timeout):
+            raise ErasureCodeError(
+                errno.EAGAIN,
+                f"snapshot COW of {head.name} did not commit everywhere")
 
     def _do_snap_read(self, conn, msg: M.MOSDOp, state: PGState) -> None:
         """Serve read/stat at a snap id by resolving the SnapSet to the
         covering clone (reference PrimaryLogPG::find_object_context
         with a snapid)."""
         from dataclasses import replace
+        from .snapset import SNAPDIR
         be = state.backend
         head = replace(msg.oid, snap=0)
         ss, exists = self._head_snapset(state, msg.pgid.pgid, head)
+        if not exists:
+            # deleted head: its clone history lives on the snapdir
+            ss, exists = self._head_snapset(
+                state, msg.pgid.pgid, replace(msg.oid, snap=SNAPDIR))
         target_snap = ss.resolve(msg.oid.snap) if exists else None
+        if target_snap == 0 and not self._object_exists(state, head):
+            target_snap = None      # resolved to a deleted head
         if target_snap is None:
             conn.send_message(M.MOSDOpReply(
                 msg.tid, -errno.ENOENT, b"", self.osdmap.epoch))

@@ -67,6 +67,11 @@ def scrub_object(backend: ECBackend, oid: hobject_t,
     present = [s for s in range(n) if sizes[s] is not None]
     if not present:
         return errors
+    if all(sizes[s] == 0 for s in present) and \
+            all(hinfos[s] is None for s in present):
+        # pure-metadata object (snapdir, SS-only head): no payload to
+        # checksum, attrs are replicated by the write path
+        return errors
     for s in range(n):
         if sizes[s] is None:
             errors.append(ScrubError(oid, s, "missing"))

@@ -20,6 +20,10 @@ from __future__ import annotations
 import json
 
 SS_KEY = "snapset"
+# When a head is deleted under a SnapContext its SnapSet moves to a
+# snapdir object (hobject.snap = SNAPDIR) so the clone history survives
+# a later recreate (reference CEPH_SNAPDIR).
+SNAPDIR = 1 << 62
 
 
 class SnapSet:
@@ -52,10 +56,15 @@ class SnapSet:
     def resolve(self, snap: int) -> int | None:
         """Which object serves a read at snap id `snap`?
         Returns the clone snap id, 0 for the head, or None when the
-        object did not exist at that snap."""
-        if snap <= self.born:
-            return None     # snap predates the object's creation
-        for cs in self.clones:
-            if cs >= snap:
-                return cs
-        return 0            # unchanged since the snap: head serves
+        object did not exist at that snap.
+
+        A clone older than `born` belongs to a previous incarnation
+        (the head was deleted and recreated; the clone history rode the
+        snapdir): it still serves its snaps.  A clone newer than `born`
+        only covers snaps after the (re)creation."""
+        c = next((cs for cs in self.clones if cs >= snap), None)
+        if c is not None:
+            if c <= self.born:
+                return c                 # prior-incarnation clone
+            return c if snap > self.born else None
+        return 0 if snap > self.born else None

@@ -133,6 +133,7 @@ class Image:
         self._parent: Image | None = None
         self._read_snap_id = 0
         self._legacy_read: str | None = None
+        self._present_blocks: set[int] = set()   # copyup probe cache
 
     @property
     def block_size(self) -> int:
@@ -198,12 +199,16 @@ class Image:
 
     def _copyup(self, block: int) -> None:
         """First partial write to a clone block pulls the parent's
-        content (reference CopyupRequest)."""
+        content (reference CopyupRequest).  A per-handle presence cache
+        keeps steady-state writes to one probe total per block."""
         parent = self._get_parent()
         if parent is None:
             return
+        if block in self._present_blocks:
+            return
         try:
             self.io.read(_data(self.name, block), 1)
+            self._present_blocks.add(block)
             return                      # child block already exists
         except RadosError as e:
             if e.errno != errno.ENOENT:
@@ -211,6 +216,7 @@ class Image:
         content = parent._read_block(block, 0, self.block_size)
         if content.rstrip(b"\0"):
             self.io.write_full(_data(self.name, block), content)
+        self._present_blocks.add(block)
 
     def read(self, offset: int, length: int) -> bytes:
         length = max(0, min(length, self.size() - offset))

@@ -180,3 +180,30 @@ def test_rbd_rollback_after_multiple_snaps(snapenv):
     assert img.read(0, 7) == b"state-C"
     img.snap_rollback("a")
     assert img.read(0, 7) == b"state-A"
+
+
+@pytest.mark.parametrize("pool", ["snap_ec", "snap_rep"])
+def test_delete_recreate_keeps_snap_history(snapenv, pool):
+    """Deleting a head parks its SnapSet on the snapdir; a recreate
+    under the same or newer SnapContext keeps old snaps readable and
+    reports the deleted interval as absent (reference CEPH_SNAPDIR)."""
+    _, client = snapenv
+    io = client.open_ioctx(pool)
+    io.snapc = None
+    name = f"dr_{pool}"
+    io.write_full(name, b"first life")
+    s1 = io.selfmanaged_snap_create()
+    io.set_snap_context(s1, [s1])
+    io.remove(name)                       # COW preserves v1 at s1
+    # while deleted: snap read still serves the clone
+    assert io.read(name, 10, snap=s1) == b"first life"
+    s2 = io.selfmanaged_snap_create()
+    io.set_snap_context(s2, [s2, s1])
+    io.write_full(name, b"second life")
+    assert io.read(name, 11) == b"second life"
+    assert io.read(name, 10, snap=s1) == b"first life"
+    # s2 was taken while the object was deleted
+    from ceph_tpu.rados.client import RadosError
+    with pytest.raises(RadosError) as ei:
+        io.read(name, 1, snap=s2)
+    assert ei.value.errno == 2
