@@ -255,26 +255,32 @@ class MDSDaemon:
             return {}
         if op == "rmdir":
             dino, name = self._split(a["path"])
-            ent = self._dget(dino, name)
-            if ent is None:
-                raise _Err(errno.ENOENT, a["path"])
             # lock BOTH the parent's stripe and the victim dir's own
             # stripe: the emptiness check must exclude a concurrent
-            # create inside the victim (which holds the victim's lock)
-            with self._multi_lock(dino, ent["ino"]):
+            # create inside the victim.  The ino is read before
+            # locking, so re-verify it under the locks (the dentry may
+            # have been replaced) and retry with the fresh ino.
+            for _ in range(8):
                 ent = self._dget(dino, name)
                 if ent is None:
                     raise _Err(errno.ENOENT, a["path"])
-                if not ent["mode"] & S_IFDIR:
-                    raise _Err(errno.ENOTDIR, a["path"])
-                if self._dcount(ent["ino"]) > 0:
-                    raise _Err(errno.ENOTEMPTY, a["path"])
-                self._drm(dino, name)
-                try:
-                    self.meta.remove(f"dir.{ent['ino']:x}")
-                except RadosError:
-                    pass
-            return {}
+                with self._multi_lock(dino, ent["ino"]):
+                    cur = self._dget(dino, name)
+                    if cur is None:
+                        raise _Err(errno.ENOENT, a["path"])
+                    if cur["ino"] != ent["ino"]:
+                        continue   # replaced meanwhile: retry
+                    if not cur["mode"] & S_IFDIR:
+                        raise _Err(errno.ENOTDIR, a["path"])
+                    if self._dcount(cur["ino"]) > 0:
+                        raise _Err(errno.ENOTEMPTY, a["path"])
+                    self._drm(dino, name)
+                    try:
+                        self.meta.remove(f"dir.{cur['ino']:x}")
+                    except RadosError:
+                        pass
+                return {}
+            raise _Err(errno.EAGAIN, a["path"])
         if op == "rename":
             sdino, sname = self._split(a["src"])
             ddino, dname = self._split(a["dst"])

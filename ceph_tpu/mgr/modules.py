@@ -1,0 +1,154 @@
+"""Built-in mgr modules: health, balancer, pg_autoscaler.
+
+Reference analogs: the mgr health aggregation (src/mgr/DaemonHealth*),
+pybind/mgr/balancer (upmap mode re-expressed over pg_temp, the map's
+explicit acting-set override), and pybind/mgr/pg_autoscaler (advisory
+here: pools do not split PGs, so the module recommends instead of
+mutating — surfaced through the health model).
+"""
+
+from __future__ import annotations
+
+from ..crush.map import CRUSH_ITEM_NONE
+from ..osd.types import pg_t
+from .daemon import MgrModule
+
+
+class HealthModule(MgrModule):
+    """Cluster health from the map: down/out OSDs, PGs below size."""
+
+    name = "health"
+    run_interval = 0.5
+
+    def tick(self) -> None:
+        m = self.get_osdmap()
+        warns: list[str] = []
+        errs: list[str] = []
+        down = [o.id for o in m.osds.values() if not o.up]
+        if down:
+            warns.append(f"{len(down)} osds down: {down}")
+        degraded = 0
+        unavailable = 0
+        for pool in m.pools.values():
+            for seed in range(pool.pg_num):
+                try:
+                    _, acting, _, _ = m.pg_to_up_acting_osds(
+                        pg_t(pool.id, seed))
+                except Exception:  # noqa: BLE001
+                    continue
+                live = sum(1 for o in acting
+                           if o != CRUSH_ITEM_NONE and m.is_up(o))
+                if live < pool.min_size:
+                    unavailable += 1
+                elif live < pool.size:
+                    degraded += 1
+        if degraded:
+            warns.append(f"{degraded} pgs degraded")
+        if unavailable:
+            errs.append(f"{unavailable} pgs below min_size")
+        status = "HEALTH_ERR" if errs else (
+            "HEALTH_WARN" if warns else "HEALTH_OK")
+        self.mgr.set_health(self.name, status, errs + warns)
+
+
+class BalancerModule(MgrModule):
+    """Even the PG->OSD distribution with pg_temp overrides (the upmap
+    balancer role).  Greedy: move one PG at a time from the most- to
+    the least-loaded OSD until the spread is within threshold."""
+
+    name = "balancer"
+    run_interval = 2.0
+    max_moves_per_tick = 4
+    threshold = 1          # max-min PG count gap considered balanced
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self.active = True
+        self.moves = 0
+
+    def compute_moves(self) -> list[tuple[pg_t, list[int]]]:
+        m = self.get_osdmap()
+        up_osds = [o.id for o in m.osds.values() if o.up and o.in_]
+        if len(up_osds) < 2:
+            return []
+        load: dict[int, int] = {o: 0 for o in up_osds}
+        placement: dict[pg_t, list[int]] = {}
+        for pool in m.pools.values():
+            for seed in range(pool.pg_num):
+                pgid = pg_t(pool.id, seed)
+                try:
+                    _, acting, _, _ = m.pg_to_up_acting_osds(pgid)
+                except Exception:  # noqa: BLE001
+                    continue
+                placement[pgid] = list(acting)
+                for o in acting:
+                    if o in load:
+                        load[o] += 1
+        moves: list[tuple[pg_t, list[int]]] = []
+        for _ in range(self.max_moves_per_tick):
+            hot = max(load, key=load.get)
+            cold = min(load, key=load.get)
+            if load[hot] - load[cold] <= self.threshold:
+                break
+            # one PG on `hot` whose acting set lacks `cold`
+            for pgid, acting in placement.items():
+                if hot in acting and cold not in acting:
+                    new_acting = [cold if o == hot else o
+                                  for o in acting]
+                    moves.append((pgid, new_acting))
+                    placement[pgid] = new_acting
+                    load[hot] -= 1
+                    load[cold] += 1
+                    break
+            else:
+                break
+        return moves
+
+    def tick(self) -> None:
+        if not self.active:
+            return
+        for pgid, acting in self.compute_moves():
+            r, _ = self.mon_command({
+                "prefix": "osd pg-temp",
+                "pgid": [pgid.pool, pgid.seed],
+                "osds": acting})
+            if r == 0:
+                self.moves += 1
+
+
+class PgAutoscalerModule(MgrModule):
+    """Recommend pg_num per pool (advisory; reference
+    pybind/mgr/pg_autoscaler): target ~quarter of the reference's 100
+    PGs per OSD, power of two, surfaced as a health warning when a
+    pool is far off."""
+
+    name = "pg_autoscaler"
+    run_interval = 2.0
+    target_pgs_per_osd = 32
+
+    def recommendations(self) -> dict[str, int]:
+        m = self.get_osdmap()
+        n_osds = sum(1 for o in m.osds.values() if o.up and o.in_)
+        if not n_osds or not m.pools:
+            return {}
+        budget = n_osds * self.target_pgs_per_osd
+        per_pool = max(1, budget // max(1, len(m.pools)))
+        rec = 1 << (per_pool.bit_length() - 1)   # floor power of two
+        return {p.name: rec for p in m.pools.values()}
+
+    def tick(self) -> None:
+        m = self.get_osdmap()
+        recs = self.recommendations()
+        warns = []
+        for p in m.pools.values():
+            want = recs.get(p.name, p.pg_num)
+            if want >= 4 * p.pg_num or p.pg_num >= 4 * want:
+                warns.append(
+                    f"pool {p.name!r} pg_num {p.pg_num} far from "
+                    f"recommended {want}")
+        self.mgr.set_health(
+            self.name,
+            "HEALTH_WARN" if warns else "HEALTH_OK", warns)
+
+
+DEFAULT_MODULES = [HealthModule, BalancerModule, PgAutoscalerModule]

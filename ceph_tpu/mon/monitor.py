@@ -28,7 +28,7 @@ from ..ec import ErasureCodeError, ErasureCodePluginRegistry, Profile
 from ..msg import Messenger
 from ..msg import messages as M
 from ..osd.osd_map import OSDMap
-from ..osd.types import PoolType
+from ..osd.types import PoolType, pg_t
 from .paxos import ElectionLogic, Paxos
 
 DEFAULT_EC_PROFILE = {"plugin": "jax", "k": "2", "m": "1",
@@ -410,6 +410,21 @@ class Monitor:
                     self.osdmap.bump_epoch()
                     self._propose_current()
                 return 0, {"down": osd_id}
+            if prefix == "osd pg-temp":
+                # explicit acting-set override (reference OSDMonitor
+                # pg-temp; the balancer's upmap-role lever)
+                pgid = pg_t(*cmd["pgid"])
+                osds = [int(o) for o in cmd["osds"]]
+                with self.lock:
+                    if pgid.pool not in self.osdmap.pools:
+                        return -errno.ENOENT, {"error": f"no pool {pgid.pool}"}
+                    if osds:
+                        self.osdmap.pg_temp[pgid] = osds
+                    else:
+                        self.osdmap.pg_temp.pop(pgid, None)
+                    self.osdmap.bump_epoch()
+                    self._propose_current()
+                return 0, {"pg_temp": [str(pgid), osds]}
             if prefix == "osd pool selfmanaged-snap-create":
                 # allocate one snap id (reference OSDMonitor
                 # prepare_pool_op SELFMANAGED_SNAP_CREATE)
@@ -423,6 +438,18 @@ class Monitor:
                     self.osdmap.bump_epoch()
                     self._propose_current()
                 return 0, {"snapid": snapid}
+            if prefix == "osd pool selfmanaged-snap-rm":
+                name = cmd["pool"]
+                snapid = int(cmd["snapid"])
+                with self.lock:
+                    pool = self.osdmap.lookup_pool(name)
+                    if pool is None:
+                        return -errno.ENOENT, {"error": f"no pool {name}"}
+                    if snapid not in pool.removed_snaps:
+                        pool.removed_snaps.append(snapid)
+                    self.osdmap.bump_epoch()
+                    self._propose_current()
+                return 0, {"removed": snapid}
             if prefix == "status":
                 return self._cmd_status()
             if prefix == "osd tree":

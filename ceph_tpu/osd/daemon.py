@@ -341,6 +341,11 @@ class OSDDaemon:
             self.messenger.compress_min = \
                 int(conf.get("ms_compress_min_size"))
         _apply_inject()
+        # recovery concurrency cap (reference osd_max_backfills
+        # reservations): bounds simultaneous per-object rebuilds
+        # across this daemon's recovery threads
+        self._recovery_sem = threading.BoundedSemaphore(
+            max(1, int(conf.get("osd_max_backfills"))))
         for _opt in ("ms_inject_socket_failures",
                      "ms_inject_delay_probability",
                      "ms_inject_delay_max", "ms_compress",
@@ -511,6 +516,9 @@ class OSDDaemon:
         primaries reconstruct the lost shards onto them."""
         import numpy as np
         from ..store.object_store import Transaction
+        # peers that time out once in this pass are not probed again:
+        # a dead-but-still-up OSD must not cost 3s per object/shard
+        unreachable: set[int] = set()
         for pool in list(self.osdmap.pools.values()):
             for seed in range(pool.pg_num):
                 pgid = pg_t(pool.id, seed)
@@ -522,11 +530,16 @@ class OSDDaemon:
                 if primary != self.osd_id:
                     continue
                 if pool.is_erasure():
-                    self._recover_ec_pg(pgid, acting)
+                    # one reservation per PG recovery (reference
+                    # osd_max_backfills: concurrent backfilling PGs)
+                    with self._recovery_sem:
+                        self._recover_ec_pg(pgid, acting, unreachable)
                 else:
-                    self._recover_replicated_pg(pgid, acting)
+                    with self._recovery_sem:
+                        self._recover_replicated_pg(pgid, acting)
 
-    def _pg_object_names(self, pgid: pg_t, acting, shard_ids) -> set:
+    def _pg_object_names(self, pgid: pg_t, acting, shard_ids,
+                         unreachable: set | None = None) -> set:
         names: set = set()
         for s in shard_ids:
             osd = acting[s] if s < len(acting) else None
@@ -535,8 +548,11 @@ class OSDDaemon:
             from ..crush.map import CRUSH_ITEM_NONE
             if osd == CRUSH_ITEM_NONE or not self.osdmap.is_up(osd):
                 continue
+            if unreachable is not None and osd in unreachable:
+                continue
             spg = spg_t(pgid, s if len(shard_ids) > 1 else NO_SHARD)
-            for oj in self._remote_list(osd, spg):
+            for oj in self._remote_list(osd, spg,
+                                        unreachable=unreachable):
                 names.add(M.hobj_from_json(oj))
         return names
 
@@ -557,9 +573,12 @@ class OSDDaemon:
             return []
 
     def _remote_list(self, osd: int, spg: spg_t,
-                     timeout: float = 10.0) -> list:
+                     timeout: float = 10.0,
+                     unreachable: set | None = None) -> list:
         if osd == self.osd_id:
             return self._list_pg_objects(spg)
+        if unreachable is not None and osd in unreachable:
+            return []
         with self.pg_lock:
             self._raw_tid += 1
             tid = self._raw_tid
@@ -571,7 +590,8 @@ class OSDDaemon:
             self.conn_to_osd(osd).send_message(M.MPGList(spg, tid))
         except Exception:  # noqa: BLE001
             return []
-        ev.wait(timeout)
+        if not ev.wait(timeout) and unreachable is not None:
+            unreachable.add(osd)
         return box.get("oids", [])
 
     def _make_recovery_push(self, pgid: pg_t, acting: list[int],
@@ -604,7 +624,8 @@ class OSDDaemon:
         return ev.wait(timeout)
 
     def _remote_read_full(self, osd: int, spg: spg_t, oid: hobject_t,
-                          timeout: float = 3.0):
+                          timeout: float = 3.0,
+                          unreachable: set | None = None):
         """(data, attrs) of a shard object on a specific OSD, or None.
         The backfill copy path: a moved shard is fetched from its old
         holder verbatim instead of being re-decoded."""
@@ -629,6 +650,8 @@ class OSDDaemon:
         except Exception:  # noqa: BLE001
             return None
         if not ev.wait(timeout):
+            if unreachable is not None:
+                unreachable.add(osd)
             return None
         stat = box["msg"]
         if stat.result != 0 or stat.size < 0:
@@ -647,7 +670,8 @@ class OSDDaemon:
         return (np.frombuffer(box2["msg"].data, dtype=np.uint8),
                 stat.attrs)
 
-    def _recover_ec_pg(self, pgid: pg_t, acting: list[int]) -> None:
+    def _recover_ec_pg(self, pgid: pg_t, acting: list[int],
+                       unreachable: set | None = None) -> None:
         from ..crush.map import CRUSH_ITEM_NONE
         from ..store.object_store import Transaction
         state = self._get_pg(pgid)
@@ -668,12 +692,18 @@ class OSDDaemon:
         # scan widens to every up OSD — a moved shard is findable
         # wherever CRUSH last put it.  Steady-state (acting == prev)
         # PGs skip the wide scan.
-        up_osds = [o.id for o in self.osdmap.osds.values() if o.up]
-        names = self._pg_object_names(pgid, acting, range(be.n))
+        unreachable = unreachable if unreachable is not None else set()
+        up_osds = [o.id for o in self.osdmap.osds.values()
+                   if o.up and o.id not in unreachable]
+        names = self._pg_object_names(pgid, acting, range(be.n),
+                                      unreachable=unreachable)
         if prev_acting:
             for s, osd in enumerate(prev_acting):
-                if osd != CRUSH_ITEM_NONE and self.osdmap.is_up(osd):
-                    for oj in self._remote_list(osd, spg_t(pgid, s)):
+                if osd != CRUSH_ITEM_NONE and self.osdmap.is_up(osd) \
+                        and osd not in unreachable:
+                    for oj in self._remote_list(
+                            osd, spg_t(pgid, s),
+                            unreachable=unreachable):
                         names.add(M.hobj_from_json(oj))
         # wide scan only for shards whose holder changed or is gone —
         # steady-state shards are already listed from acting above
@@ -707,78 +737,90 @@ class OSDDaemon:
                     missing.append(s)
             if not missing:
                 continue
-            # 1: backfill-by-copy from wherever the shard still lives
-            # (previous holder first, then any up OSD).  A leftover
-            # copy from an older interval could be stale, so candidates
-            # must match the authoritative hinfo's chunk crc when one
-            # is known (reference verifies pushed chunks the same way,
-            # ECBackend.cc:991).
-            from ..common import crc32c as _crc
-            auth_hinfo = be._fetch_hinfo(oid)
-            still_missing = []
-            for s in missing:
-                copied = False
-                candidates: list[int] = []
-                if prev_acting and s < len(prev_acting):
-                    old = prev_acting[s]
-                    if old != CRUSH_ITEM_NONE and old != acting[s] and \
-                            self.osdmap.is_up(old):
-                        candidates.append(old)
-                candidates.extend(o for o in up_osds
-                                  if o != acting[s] and
-                                  o not in candidates)
-                for old in candidates:
-                    got = self._remote_read_full(old, spg_t(pgid, s), oid)
-                    if got is None:
+            self._recover_object(pgid, acting, be, prev_acting,
+                                 up_osds, oid, missing, unreachable)
+
+    def _recover_object(self, pgid, acting, be, prev_acting, up_osds,
+                        oid, missing, unreachable=None) -> None:
+        """Rebuild one object's missing shards: backfill-by-copy from
+        any surviving holder, else reconstruct-from-k (runs under the
+        osd_max_backfills reservation)."""
+        # 1: backfill-by-copy from wherever the shard still lives
+        # (previous holder first, then any up OSD).  A leftover
+        # copy from an older interval could be stale, so candidates
+        # must match the authoritative hinfo's chunk crc when one
+        # is known (reference verifies pushed chunks the same way,
+        # ECBackend.cc:991).
+        from ..common import crc32c as _crc
+        from ..crush.map import CRUSH_ITEM_NONE
+        auth_hinfo = be._fetch_hinfo(oid)
+        still_missing = []
+        for s in missing:
+            copied = False
+            candidates: list[int] = []
+            if prev_acting and s < len(prev_acting):
+                old = prev_acting[s]
+                if old != CRUSH_ITEM_NONE and old != acting[s] and \
+                        self.osdmap.is_up(old):
+                    candidates.append(old)
+            candidates.extend(o for o in up_osds
+                              if o != acting[s] and
+                              o not in candidates)
+            for old in candidates:
+                if unreachable is not None and old in unreachable:
+                    continue
+                got = self._remote_read_full(old, spg_t(pgid, s), oid,
+                                             unreachable=unreachable)
+                if got is None:
+                    continue
+                data, attrs = got
+                if auth_hinfo is not None and (
+                        auth_hinfo.total_chunk_size != data.size or
+                        (auth_hinfo.crc_valid and
+                         _crc.crc32c(data.tobytes(), 0xFFFFFFFF) !=
+                         auth_hinfo.get_chunk_hash(s))):
+                    continue   # stale leftover from an older interval
+                if auth_hinfo is not None and \
+                        not auth_hinfo.crc_valid:
+                    # overwritten object: at least require the
+                    # candidate to match its own chunk_crc (bitrot)
+                    from .ec_util import CHUNK_CRC_KEY
+                    cc = (attrs or {}).get(CHUNK_CRC_KEY)
+                    if cc is not None and \
+                            int.from_bytes(cc, "little") != \
+                            _crc.crc32c(data.tobytes(), 0xFFFFFFFF):
                         continue
-                    data, attrs = got
-                    if auth_hinfo is not None and (
-                            auth_hinfo.total_chunk_size != data.size or
-                            (auth_hinfo.crc_valid and
-                             _crc.crc32c(data.tobytes(), 0xFFFFFFFF) !=
-                             auth_hinfo.get_chunk_hash(s))):
-                        continue   # stale leftover from an older interval
-                    if auth_hinfo is not None and \
-                            not auth_hinfo.crc_valid:
-                        # overwritten object: at least require the
-                        # candidate to match its own chunk_crc (bitrot)
-                        from .ec_util import CHUNK_CRC_KEY
-                        cc = (attrs or {}).get(CHUNK_CRC_KEY)
-                        if cc is not None and \
-                                int.from_bytes(cc, "little") != \
-                                _crc.crc32c(data.tobytes(), 0xFFFFFFFF):
-                            continue
-                    txn = Transaction()
-                    goid = shard_oid(oid, s)
-                    txn.write(goid, 0, data)
-                    if attrs:
-                        txn.setattrs(goid, attrs)
-                    self._push_shard_txn(acting[s], spg_t(pgid, s), txn)
-                    copied = True
-                    break
-                if not copied:
-                    still_missing.append(s)
-            if not still_missing:
-                self.cct.dout("osd", 5,
-                              f"backfilled {oid.name} shards {missing} "
-                              f"of pg {pgid} by copy")
-                continue
-            if len(still_missing) > be.m:
-                self.cct.dout("osd", 1,
-                              f"{oid.name}: {len(still_missing)} shards "
-                              f"unrecoverable in pg {pgid}")
-                continue
-            # 2: reconstruct-from-k via the EC decode path
-            try:
-                be.recover_shard(
-                    oid, still_missing,
-                    self._make_recovery_push(pgid, acting, oid))
-                self.cct.dout("osd", 5,
-                              f"recovered {oid.name} shards "
-                              f"{still_missing} of pg {pgid} by decode")
-            except Exception as e:  # noqa: BLE001
-                self.cct.dout("osd", 1,
-                              f"recovery of {oid.name} failed: {e!r}")
+                txn = Transaction()
+                goid = shard_oid(oid, s)
+                txn.write(goid, 0, data)
+                if attrs:
+                    txn.setattrs(goid, attrs)
+                self._push_shard_txn(acting[s], spg_t(pgid, s), txn)
+                copied = True
+                break
+            if not copied:
+                still_missing.append(s)
+        if not still_missing:
+            self.cct.dout("osd", 5,
+                          f"backfilled {oid.name} shards {missing} "
+                          f"of pg {pgid} by copy")
+            return
+        if len(still_missing) > be.m:
+            self.cct.dout("osd", 1,
+                          f"{oid.name}: {len(still_missing)} shards "
+                          f"unrecoverable in pg {pgid}")
+            return
+        # 2: reconstruct-from-k via the EC decode path
+        try:
+            be.recover_shard(
+                oid, still_missing,
+                self._make_recovery_push(pgid, acting, oid))
+            self.cct.dout("osd", 5,
+                          f"recovered {oid.name} shards "
+                          f"{still_missing} of pg {pgid} by decode")
+        except Exception as e:  # noqa: BLE001
+            self.cct.dout("osd", 1,
+                          f"recovery of {oid.name} failed: {e!r}")
 
     def _recover_replicated_pg(self, pgid: pg_t,
                                acting: list[int]) -> None:
@@ -1293,7 +1335,8 @@ class OSDDaemon:
             # carries clone history that must survive
             prior, had_dir = self._head_snapset(state, pgid, snapdir)
             ss = SnapSet(seq=seq, clones=prior.clones if had_dir else [],
-                         born=seq)
+                         born=seq,
+                         prior_born=prior.born if had_dir else 0)
             self._bcast_head_txn(state, pgid, head, None, ss)
             state.snap_seqs[head] = seq
             return
@@ -1318,12 +1361,14 @@ class OSDDaemon:
         from .snapset import SS_KEY
         be = state.backend
         pending = {"n": 0}
+        plock = threading.Lock()
         done = threading.Event()
 
         def on_commit(_sr) -> None:
-            pending["n"] -= 1
-            if pending["n"] <= 0:
-                done.set()
+            with plock:          # replies race on reader threads
+                pending["n"] -= 1
+                if pending["n"] <= 0:
+                    done.set()
 
         if state.kind == "ec":
             pending["n"] = be.n
@@ -1448,6 +1493,20 @@ class OSDDaemon:
         out = {}
         for pool in list(self.osdmap.pools.values()):
             if not pool.is_erasure():
+                # replicated pools: no EC scrub, but snap trim applies
+                for seed in range(pool.pg_num):
+                    pgid = pg_t(pool.id, seed)
+                    _, acting, _, primary = \
+                        self.osdmap.pg_to_up_acting_osds(pgid)
+                    if primary != self.osd_id:
+                        continue
+                    state = self._get_pg(pgid)
+                    names = self._pg_object_names(pgid, acting, [0])
+                    trimmed = self._trim_snaps(state, pgid, names)
+                    if trimmed:
+                        out[str(pgid)] = {"objects": len(names),
+                                          "errors": [], "repaired": 0,
+                                          "snaps_trimmed": trimmed}
                 continue
             for seed in range(pool.pg_num):
                 pgid = pg_t(pool.id, seed)
@@ -1461,17 +1520,76 @@ class OSDDaemon:
                     key=lambda o: o.name)
                 res = scrub_mod.scrub_pg(state.backend, names, deep=deep,
                                          repair=repair)
+                trimmed = self._trim_snaps(state, pgid, names)
                 out[str(pgid)] = {
                     "objects": res.objects,
                     "errors": [[e.oid.name, e.shard, e.kind, e.detail]
                                for e in res.errors],
                     "repaired": len(res.repaired),
+                    "snaps_trimmed": trimmed,
                 }
         return out
 
     def _asok_scrub(self, cmd: dict) -> dict:
         return self._scrub_led_pgs(deep=bool(cmd.get("deep", True)),
                                    repair=bool(cmd.get("repair", False)))
+
+    # -- snap trim (reference PrimaryLogPG SnapTrimmer / snap trim queue;
+    #    runs with scrub here: both walk the same object listing) ----------
+
+    def _trim_snaps(self, state: PGState, pgid: pg_t, names) -> int:
+        """Reclaim clones whose entire covered snap interval is in the
+        pool's removed_snaps.  Resolution means clone c serves snaps in
+        (max(prev_clone, born), c]; when every id in that window is
+        deleted, nothing can ever read the clone again."""
+        from dataclasses import replace
+        from .snapset import SNAPDIR, SnapSet
+        pool = self.osdmap.pools.get(pgid.pool)
+        if pool is None or not pool.removed_snaps:
+            return 0
+        removed = set(pool.removed_snaps)
+        be = state.backend
+        trimmed = 0
+        for head in {replace(o, snap=0) for o in names}:
+            src = head
+            ss, exists = self._head_snapset(state, pgid, src)
+            if not exists:
+                src = replace(head, snap=SNAPDIR)
+                ss, exists = self._head_snapset(state, pgid, src)
+                if not exists:
+                    continue
+            keep, lower, changed = [], 0, False
+            for c in sorted(ss.clones):
+                lo = max(lower, ss.born)
+                window = set(range(lo + 1, c + 1))
+                if window and window <= removed:
+                    clone_oid = replace(head, snap=c)
+                    if state.kind == "ec":
+                        for s in range(be.n):
+                            txn = Transaction()
+                            txn.remove(shard_oid(clone_oid, s))
+                            be.shards.sub_write(s, txn,
+                                                lambda _s: None)
+                    else:
+                        for r in range(be.replicas.n_replicas):
+                            txn = Transaction()
+                            txn.remove(ghobject_t(clone_oid,
+                                                  shard=NO_SHARD))
+                            be.replicas.rep_write(r, txn,
+                                                  lambda _r: None)
+                    trimmed += 1
+                    changed = True
+                else:
+                    keep.append(c)
+                lower = c
+            if changed:
+                ss.clones = keep
+                try:
+                    self._bcast_head_txn(state, pgid, src, None, ss)
+                except ErasureCodeError:
+                    pass   # next trim pass retries
+                state.snap_seqs.pop(head, None)
+        return trimmed
 
     def _scrub_loop(self) -> None:
         """Background scheduler (reference PG scrub scheduling with

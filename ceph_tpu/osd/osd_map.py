@@ -35,8 +35,10 @@ class PGPool:
     stripe_width: int = 0
     # self-managed snapshot id allocator (reference pg_pool_t snap_seq
     # for SNAP_MODE_SELFMANAGED; the mon allocates ids, clients carry
-    # them in per-op SnapContexts)
+    # them in per-op SnapContexts) + deleted ids awaiting trim
+    # (reference pg_pool_t removed_snaps interval set)
     snap_seq: int = 0
+    removed_snaps: list = field(default_factory=list)
 
     def is_erasure(self) -> bool:
         return self.type == PoolType.ERASURE
@@ -178,7 +180,8 @@ class OSDMap:
                      for o in self.osds.values()],
             "pools": [[p.id, p.name, int(p.type), p.size, p.min_size,
                        p.pg_num, p.crush_rule, p.erasure_code_profile,
-                       p.stripe_width, p.snap_seq]
+                       p.stripe_width, p.snap_seq,
+                       list(p.removed_snaps)]
                       for p in self.pools.values()],
             "pg_temp": [[pg.pool, pg.seed, osds]
                         for pg, osds in self.pg_temp.items()],
@@ -208,9 +211,11 @@ class OSDMap:
         for rec in j["pools"]:
             pid, name, t, size, msize, pgn, rule, prof, sw = rec[:9]
             snap_seq = rec[9] if len(rec) > 9 else 0
+            removed = list(rec[10]) if len(rec) > 10 else []
             m.pools[pid] = PGPool(pid, name, PoolType(t), size, msize,
                                   pgn, rule, prof, sw,
-                                  snap_seq=snap_seq)
+                                  snap_seq=snap_seq,
+                                  removed_snaps=removed)
             m.pool_ids_by_name[name] = pid
         for pool, seed, osds in j.get("pg_temp", []):
             m.pg_temp[pg_t(pool, seed)] = osds

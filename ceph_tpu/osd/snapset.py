@@ -28,14 +28,18 @@ SNAPDIR = 1 << 62
 
 class SnapSet:
     def __init__(self, seq: int = 0, clones: list[int] | None = None,
-                 born: int = 0):
+                 born: int = 0, prior_born: int = 0):
         self.seq = seq             # newest snap id this head has seen
         self.clones = clones or []  # clone snap ids, ascending
         self.born = born           # snap seq when the head was created
+        # birth seq of the PREVIOUS incarnation (delete+recreate):
+        # prior-incarnation clones never serve snaps older than it
+        self.prior_born = prior_born
 
     def encode(self) -> bytes:
         return json.dumps({"seq": self.seq, "clones": self.clones,
-                           "born": self.born}).encode()
+                           "born": self.born,
+                           "pborn": self.prior_born}).encode()
 
     @classmethod
     def decode(cls, raw: bytes | None) -> "SnapSet":
@@ -43,7 +47,7 @@ class SnapSet:
             return cls()
         j = json.loads(raw.decode())
         return cls(j.get("seq", 0), list(j.get("clones", [])),
-                   j.get("born", 0))
+                   j.get("born", 0), j.get("pborn", 0))
 
     def needs_cow(self, snapc_seq: int) -> bool:
         return snapc_seq > self.seq
@@ -65,6 +69,7 @@ class SnapSet:
         c = next((cs for cs in self.clones if cs >= snap), None)
         if c is not None:
             if c <= self.born:
-                return c                 # prior-incarnation clone
+                # prior-incarnation clone: still fenced by ITS birth
+                return c if snap > self.prior_born else None
             return c if snap > self.born else None
         return 0 if snap > self.born else None

@@ -103,6 +103,16 @@ class IoCtx:
             raise RadosError(-r, out.get("error", "snap create"))
         return int(out["snapid"])
 
+    def selfmanaged_snap_remove(self, snapid: int) -> None:
+        """Mark a snap id deleted; its clones are reclaimed by the
+        OSD snap trimmer (reference rados_ioctx_selfmanaged_snap_remove
+        + the snap trim queue)."""
+        r, out = self.client.mon_command({
+            "prefix": "osd pool selfmanaged-snap-rm",
+            "pool": self.pool_name, "snapid": snapid})
+        if r != 0:
+            raise RadosError(-r, out.get("error", "snap rm"))
+
     def _submit(self, name: str, ops: list, data: bytes = b"",
                 snap: int = 0) -> bytes:
         reply = self.client.objecter.op_submit(

@@ -238,6 +238,7 @@ class Image:
                 self.io.remove(_data(self.name, b))
             except RadosError:
                 pass
+            self._present_blocks.discard(b)
         self._header["size"] = new_size
         self._save_header()
 
@@ -299,6 +300,7 @@ class Image:
                     self.io.remove(_data(self.name, b))
                 except RadosError:
                     pass
+                self._present_blocks.discard(b)
 
     def snap_remove(self, snap: str) -> None:
         if snap in self._legacy_snaps:
@@ -314,12 +316,16 @@ class Image:
             return
         if snap not in self._header["snap_ids"]:
             raise RadosError(errno.ENOENT, f"no snap {snap}")
+        snapid = self._header["snap_ids"][snap]
         self._header["snaps"].remove(snap)
         del self._header["snap_ids"][snap]
         self._save_header()
         self._apply_snapc()
-        # clone trimming is deferred to scrub-time space reclaim
-        # (reference snap trimmer) — reads can no longer reach the snap
+        # report deletion so the OSD snap trimmer reclaims the clones
+        try:
+            self.io.selfmanaged_snap_remove(snapid)
+        except RadosError:
+            pass   # advisory; trim just won't run for this id yet
 
     def flatten(self) -> None:
         """Detach from the parent by copying up every missing block

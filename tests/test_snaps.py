@@ -6,6 +6,8 @@ PrimaryLogPG::make_writeable (clone on newer snapc) and
 find_object_context (snapid read resolution),
 rados_ioctx_selfmanaged_snap_* client surface."""
 
+import time
+
 import numpy as np
 import pytest
 
@@ -207,3 +209,43 @@ def test_delete_recreate_keeps_snap_history(snapenv, pool):
     with pytest.raises(RadosError) as ei:
         io.read(name, 1, snap=s2)
     assert ei.value.errno == 2
+
+
+def test_snap_trim_reclaims_clones(snapenv):
+    """Removing a snap lets the scrub-time trimmer delete clones whose
+    whole covered interval is gone, while clones still serving a live
+    snap survive (reference SnapTrimmer)."""
+    c, client = snapenv
+    io = client.open_ioctx("snap_ec")
+    io.snapc = None
+    io.write_full("trimme", b"v1" * 600)
+    s1 = io.selfmanaged_snap_create()
+    io.set_snap_context(s1, [s1])
+    io.write_full("trimme", b"v2" * 600)     # clone at s1
+    s2 = io.selfmanaged_snap_create()
+    io.set_snap_context(s2, [s2, s1])
+    io.write_full("trimme", b"v3" * 600)     # clone at s2
+    assert io.read("trimme", 4, snap=s1) == b"v1v1"
+    assert io.read("trimme", 4, snap=s2) == b"v2v2"
+    # remove only s2: its clone's window {s2} is fully deleted
+    io.selfmanaged_snap_remove(s2)
+    time.sleep(0.3)   # map propagation
+    total = {"n": 0}
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        for osd in c.osds:
+            if not osd.osdmap.is_up(osd.osd_id):
+                continue
+            try:
+                out = osd._asok_scrub({"deep": False})
+            except Exception:
+                continue
+            total["n"] += sum(r.get("snaps_trimmed", 0)
+                              for r in out.values())
+        if total["n"]:
+            break
+        time.sleep(0.5)
+    assert total["n"] >= 1, "trimmer never reclaimed the s2 clone"
+    # s1's clone survives (s1 still live), head unaffected
+    assert io.read("trimme", 4, snap=s1) == b"v1v1"
+    assert io.read("trimme", 4) == b"v3v3"
