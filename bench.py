@@ -47,11 +47,17 @@ def time_encode_cpu(codec, chunks, min_iters=5, min_time=2.0):
     return iters * SIZE / (time.perf_counter() - t0)
 
 
-def _slope_time(step, x0, rows, iters_lo=ITERS_LO, iters_hi=ITERS_HI):
+def _slope_time(step, x0, rows, iters_lo=ITERS_LO, iters_hi=ITERS_HI,
+                batch=BATCH):
     """Chained fori_loop slope timing: `step(x)` returns (rows, W); each
     iteration XORs the result back into x's first `rows` rows so no two
     iterations are identical (defeats runtime elision/caching — see
-    module docstring).  Returns bytes/sec over BATCH*SIZE per iter."""
+    module docstring).  Returns bytes/sec over batch*SIZE per iter.
+
+    On TPU, several independent slope estimates are taken from ONE
+    compiled pair of harnesses and the MEDIAN is reported: shared-
+    tunnel contention swings single estimates 2-3x, and a transient
+    non-positive pass is tolerated as long as any pass lands."""
     import jax
     from jax import lax
 
@@ -72,7 +78,10 @@ def _slope_time(step, x0, rows, iters_lo=ITERS_LO, iters_hi=ITERS_HI):
     variants = [jax.block_until_ready(x0 ^ (i + 1)) for i in range(reps)]
     jax.block_until_ready(f_lo(x0))                  # compile
     jax.block_until_ready(f_hi(x0))
-    for attempt in range(3):
+    passes = 3 if jax.default_backend() != "cpu" else 1
+    dts = []
+    last = (0.0, 0.0)
+    for _ in range(passes + 2):                      # +2 retry budget
         lo, hi = [], []
         for i in range(reps):
             t0 = time.perf_counter()
@@ -82,13 +91,19 @@ def _slope_time(step, x0, rows, iters_lo=ITERS_LO, iters_hi=ITERS_HI):
             jax.block_until_ready(f_hi(variants[i]))
             hi.append(time.perf_counter() - t0)
         dt = (min(hi) - min(lo)) / (iters_hi - iters_lo)
+        last = (min(lo), min(hi))
         if dt > 0:
-            return BATCH * SIZE / dt
-        # re-randomize and retry: transient tunnel jitter
+            dts.append(dt)
+            if len(dts) >= passes:
+                break
+        # fresh inputs for the next pass (or jitter retry)
         variants = [jax.block_until_ready(v ^ 0x5A) for v in variants]
-    raise RuntimeError(
-        f"non-positive slope dt={dt}: timing elided or too noisy "
-        f"(lo={min(lo):.4f}s hi={min(hi):.4f}s)")
+    if not dts:
+        raise RuntimeError(
+            f"non-positive slope: timing elided or too noisy "
+            f"(lo={last[0]:.4f}s hi={last[1]:.4f}s)")
+    dts.sort()
+    return batch * SIZE / dts[len(dts) // 2]
 
 
 def time_encode_jax(codec):
@@ -97,18 +112,22 @@ def time_encode_jax(codec):
     import jax.numpy as jnp
 
     on_tpu = jax.default_backend() != "cpu"
+    batch = BATCH if on_tpu else 2   # CPU smoke: small + fast
     k, m, n = K, M, SIZE // K
     rng = np.random.default_rng(0)
-    flat = rng.integers(0, 256, (k, BATCH * n), dtype=np.uint8)
+    flat = rng.integers(0, 256, (k, batch * n), dtype=np.uint8)
 
     if on_tpu:
         x0 = jnp.asarray(flat.view(np.int32))        # word-packed path
         enc = codec.encode_words
+        lo, hi = ITERS_LO, ITERS_HI
     else:
         x0 = jnp.asarray(flat)
         enc = codec.encode_chunks_device
+        lo, hi = 3, 9
     enc(x0)                                          # build bitmats eagerly
-    return _slope_time(enc, x0, m)
+    return _slope_time(enc, x0, m, iters_lo=lo, iters_hi=hi,
+                       batch=batch)
 
 
 def time_decode_jax(codec, erasures):
@@ -123,24 +142,28 @@ def time_decode_jax(codec, erasures):
     import jax.numpy as jnp
 
     on_tpu = jax.default_backend() != "cpu"
+    batch = BATCH if on_tpu else 2
     k, m, n = K, M, SIZE // K
     erased = tuple(range(erasures))
     survivors = tuple(i for i in range(k + m) if i not in erased)[:k]
     rng = np.random.default_rng(1)
-    flat = rng.integers(0, 256, (k, BATCH * n), dtype=np.uint8)
+    flat = rng.integers(0, 256, (k, batch * n), dtype=np.uint8)
 
     if on_tpu:
         x0 = jnp.asarray(flat.view(np.int32))
         def dec(x):
             return codec.decode_words(x, survivors, erased)
+        lo, hi = 50, 350
     else:
         x0 = jnp.asarray(flat)
         def dec(x):
             return codec.decode_chunks_device(x, survivors, erased)
+        lo, hi = 3, 9
     dec(x0)                                          # build decode plan
     # decode iterations are cheap relative to tunnel jitter: a wider
     # iteration spread keeps the slope's relative noise down
-    return _slope_time(dec, x0, erasures, iters_lo=50, iters_hi=350)
+    return _slope_time(dec, x0, erasures, iters_lo=lo, iters_hi=hi,
+                       batch=batch)
 
 
 def main():
