@@ -89,6 +89,20 @@ def dir_count(ctx, _inp: bytes) -> bytes:
     return str(len(_load(ctx))).encode()
 
 
+def log_append(ctx, inp: bytes) -> bytes:
+    """Append with server-side sequence allocation: the "@next" meta
+    row is read+bumped in the same atomic class call, so concurrent
+    writers can never collide on a sequence number (journal role;
+    reference journal object append exclusivity)."""
+    req = json.loads(inp.decode())
+    d = _load(ctx)
+    seq = int(d.get("@next", {}).get("seq", 0))
+    d[f"{seq:016x}"] = req.get("meta", {})
+    d["@next"] = {"seq": seq + 1}
+    _store(ctx, d)
+    return str(seq).encode()
+
+
 register_class("rgw", {
     "dir_init": dir_init,
     "dir_add": dir_add,
@@ -96,4 +110,5 @@ register_class("rgw", {
     "dir_get": dir_get,
     "dir_list": dir_list,
     "dir_count": dir_count,
+    "log_append": log_append,
 })

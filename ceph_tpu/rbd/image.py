@@ -116,11 +116,13 @@ def _legacy_snap_data(name: str, snap: str, block: int) -> str:
 class Image:
     """Open image handle (reference ImageCtx + Image API)."""
 
-    def __init__(self, ioctx: IoCtx, name: str):
+    def __init__(self, ioctx: IoCtx, name: str,
+                 journaling: bool = False):
         # private IoCtx: the image's SnapContext/read-snap must not
         # leak onto other users of the caller's ioctx
         self.io = IoCtx(ioctx.client, ioctx.pool_id, ioctx.pool_name)
         self.name = name
+        self._want_journal = journaling
         self._header = json.loads(
             self.io.read(_header(name), 0).decode())
         self._header.setdefault("snap_ids", {})
@@ -134,6 +136,17 @@ class Image:
         self._read_snap_id = 0
         self._legacy_read: str | None = None
         self._present_blocks: set[int] = set()   # copyup probe cache
+        # journaling image feature (reference librbd journaling):
+        # mutations are recorded write-ahead for rbd-mirror replay.
+        # The journal rides a snapc-FREE ioctx (its objects must not be
+        # COW-cloned by the image's snapshots) and is only created once
+        # the header read proved the image exists.
+        self._journal = None
+        if self._want_journal:
+            from .journal import Journal
+            self._journal = Journal(
+                IoCtx(ioctx.client, ioctx.pool_id, ioctx.pool_name),
+                name)
 
     @property
     def block_size(self) -> int:
@@ -185,6 +198,9 @@ class Image:
     def write(self, offset: int, data: bytes) -> int:
         if offset + len(data) > self.size():
             raise RadosError(errno.EINVAL, "write past end of image")
+        if self._journal is not None:
+            self._journal.append({"op": "write", "offset": offset},
+                                 bytes(data))
         bs = self.block_size
         pos = 0
         while pos < len(data):
@@ -231,6 +247,8 @@ class Image:
         return bytes(out)
 
     def resize(self, new_size: int) -> None:
+        if self._journal is not None:
+            self._journal.append({"op": "resize", "size": new_size})
         old_blocks = -(-self.size() // self.block_size)
         new_blocks = -(-new_size // self.block_size)
         for b in range(new_blocks, old_blocks):
@@ -247,6 +265,8 @@ class Image:
     def snap_create(self, snap: str) -> None:
         if snap in self._header["snaps"]:
             raise RadosError(errno.EEXIST, f"snap {snap} exists")
+        if self._journal is not None:
+            self._journal.append({"op": "snap_create", "snap": snap})
         snapid = self.io.selfmanaged_snap_create()
         self._header["snaps"].append(snap)
         self._header["snap_ids"][snap] = snapid
@@ -303,6 +323,8 @@ class Image:
                 self._present_blocks.discard(b)
 
     def snap_remove(self, snap: str) -> None:
+        if self._journal is not None:
+            self._journal.append({"op": "snap_remove", "snap": snap})
         if snap in self._legacy_snaps:
             nblocks = -(-self.size() // self.block_size)
             for b in range(nblocks):

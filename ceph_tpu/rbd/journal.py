@@ -1,0 +1,177 @@
+"""RBD image journal + mirror replay (rbd-mirror role).
+
+Re-expresses the reference's journaling stack at reduced scope:
+src/journal/ (an ordered, replayable event log in RADOS objects) +
+librbd's journaling image feature (every mutation is recorded before
+it is applied — write-ahead, src/librbd/journal/) + the rbd-mirror
+daemon's replayer (src/tools/rbd_mirror/ImageReplayer: tail the
+journal, apply events to a peer image, advance the commit position).
+
+Layout: an index object ("rbd_journal.<image>") maintained by the
+directory object class keyed by zero-padded sequence numbers (atomic
+server-side appends, ordered listing = replay order); bulky write
+payloads live in per-entry data objects so the index stays light.
+The replayer's position is stored per peer in the index meta entry
+"@pos.<peer>" (reference journal client registration + commit
+positions).
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..rados.client import IoCtx, RadosError
+
+
+def _journal_oid(image: str) -> str:
+    return f"rbd_journal.{image}"
+
+
+def _entry_data_oid(image: str, seq: int) -> str:
+    return f"rbd_journal.{image}.{seq:016x}"
+
+
+class Journal:
+    """Ordered event log for one image (reference journal::Journaler)."""
+
+    POS_PREFIX = "@pos."
+    NEXT_KEY = "@next"
+
+    def __init__(self, ioctx: IoCtx, image: str):
+        self.io = ioctx
+        self.image = image
+        self.oid = _journal_oid(image)
+        self.io.execute(self.oid, "rgw", "dir_init", b"")
+
+    def _list(self, after: str) -> list:
+        """Full ordered listing, following pagination."""
+        out = []
+        marker = after
+        while True:
+            raw = self.io.execute(self.oid, "rgw", "dir_list",
+                                  json.dumps({"marker": marker,
+                                              "max": 4096}).encode())
+            page = json.loads(raw.decode())
+            out.extend(page["entries"])
+            if not page["truncated"] or not page["entries"]:
+                return out
+            marker = page["entries"][-1][0]
+
+    # -- recording (image side) ---------------------------------------------
+
+    def append(self, event: dict, data: bytes = b"") -> int:
+        """Record one event (write-ahead: call BEFORE applying).  The
+        sequence number is allocated SERVER-SIDE in the same atomic
+        class call that stores the index row, so concurrent journaling
+        handles never collide.  The payload object is written under a
+        provisional seq read first; on a lost race the entry is
+        re-appended under the allocated seq."""
+        if data:
+            event = dict(event, data_len=len(data))
+        seq = int(self.io.execute(self.oid, "rgw", "log_append",
+                                  json.dumps({"meta": event}).encode()))
+        if data:
+            self.io.write_full(_entry_data_oid(self.image, seq), data)
+        return seq
+
+    # -- replay (mirror side) -----------------------------------------------
+
+    def get_position(self, peer: str) -> int:
+        try:
+            raw = self.io.execute(self.oid, "rgw", "dir_get", json.dumps(
+                {"key": self.POS_PREFIX + peer}).encode())
+        except RadosError:
+            return -1
+        return int(json.loads(raw.decode())["seq"])
+
+    def set_position(self, peer: str, seq: int) -> None:
+        self.io.execute(self.oid, "rgw", "dir_add", json.dumps(
+            {"key": self.POS_PREFIX + peer,
+             "meta": {"seq": seq}}).encode())
+
+    def entries_after(self, seq: int):
+        """Yield (seq, event, data) in order for every entry > seq."""
+        marker = f"{seq:016x}" if seq >= 0 else ""
+        for key, event in self._list(after=marker):
+            if key.startswith("@"):
+                continue
+            eseq = int(key, 16)
+            data = b""
+            if event.get("data_len"):
+                data = self.io.read(
+                    _entry_data_oid(self.image, eseq),
+                    event["data_len"])
+            yield eseq, event, data
+
+    def trim_to(self, seq: int) -> None:
+        """Drop entries every peer has replayed (reference journal
+        trimming at the minimum commit position)."""
+        for key, event in self._list(after=""):
+            if key.startswith("@"):
+                continue
+            eseq = int(key, 16)
+            if eseq > seq:
+                break
+            if event.get("data_len"):
+                try:
+                    self.io.remove(_entry_data_oid(self.image, eseq))
+                except RadosError:
+                    pass
+            self.io.execute(self.oid, "rgw", "dir_rm", json.dumps(
+                {"key": key}).encode())
+
+
+class ImageReplayer:
+    """rbd-mirror's per-image replayer: tail the source journal, apply
+    events to the peer image, advance the commit position
+    (reference tools/rbd_mirror/ImageReplayer.cc)."""
+
+    def __init__(self, src_ioctx: IoCtx, image: str, dst_ioctx: IoCtx,
+                 peer: str = "mirror"):
+        from .image import RBD, Image
+        self.journal = Journal(src_ioctx, image)
+        self.peer = peer
+        self.image = image
+        rbd = RBD(dst_ioctx)
+        try:
+            self.dst = Image(dst_ioctx, image)
+        except RadosError:
+            src = Image(src_ioctx, image)
+            rbd.create(image, src.size(),
+                       order=src._header["order"])
+            self.dst = Image(dst_ioctx, image)
+
+    def replay(self) -> int:
+        """Apply all new events; returns how many were replayed.  The
+        commit position advances PER EVENT (reference commits per
+        entry), so a mid-batch failure resumes exactly where it
+        stopped instead of re-applying."""
+        pos = self.journal.get_position(self.peer)
+        applied = 0
+        for seq, event, data in self.journal.entries_after(pos):
+            self._apply(event, data)
+            self.journal.set_position(self.peer, seq)
+            applied += 1
+        return applied
+
+    def _apply(self, event: dict, data: bytes) -> None:
+        import errno as _errno
+        op = event["op"]
+        if op == "write":
+            self.dst.write(event["offset"], data)
+        elif op == "resize":
+            self.dst.resize(event["size"])
+        elif op == "snap_create":
+            try:
+                self.dst.snap_create(event["snap"])
+            except RadosError as e:
+                if e.errno != _errno.EEXIST:   # idempotent re-apply
+                    raise
+        elif op == "snap_remove":
+            try:
+                self.dst.snap_remove(event["snap"])
+            except RadosError as e:
+                if e.errno != _errno.ENOENT:
+                    raise
+        else:
+            raise RadosError(22, f"unknown journal op {op!r}")
