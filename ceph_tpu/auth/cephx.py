@@ -211,7 +211,6 @@ class CephxAuth:
             del self._seen_nonces[k]
         if (entity, nonce) in self._seen_nonces:
             raise AuthError("authorizer replayed")
-        self._seen_nonces[(entity, nonce)] = now + FRESHNESS_WINDOW
         caps = "allow *"
         if kind == "service":
             if self.service_key is None:
@@ -236,6 +235,10 @@ class CephxAuth:
         want = sign(key, kind, entity, nonce, ts, secure)
         if not hmac.compare_digest(str(auth.get("hmac", "")), want):
             raise AuthError("bad authorizer hmac")
+        # Burn the nonce only AFTER the hmac verifies: a forged
+        # authorizer carrying a sniffed in-flight nonce (garbage hmac)
+        # must not poison the cache and DoS the legitimate handshake.
+        self._seen_nonces[(entity, nonce)] = now + FRESHNESS_WINDOW
         final = bool(server_secure) and secure
         reply = {"proof": sign(key, "server", nonce, final),
                  "secure": final}

@@ -160,6 +160,7 @@ class Session:
         because every HELLO derives a fresh key from a fresh nonce."""
         self.conn_key = key
         self._enc_ctr = 0
+        self._dec_ctr = 0
         self._enc_dir = direction
         if key is not None:
             from cryptography.hazmat.primitives.ciphers.aead import AESGCM
@@ -176,12 +177,27 @@ class Session:
         return encode_frame(CTRL_ENC, self._enc_ctr, {}, nonce + ct)
 
     def wire_decrypt(self, data: bytes) -> bytes:
+        # The nonce is implicit state, not attacker-controlled input: it
+        # must be exactly (peer direction byte, rx_counter+1).  Checking
+        # the frame's claimed nonce against our own counter rejects
+        # replayed or reordered ciphertext that would otherwise pass
+        # AEAD and poison the seq window (reference crypto_onwire.cc
+        # uses a strictly-incrementing implicit nonce for the same
+        # reason).
+        peer_dir = b"\x02" if self._enc_dir == b"\x01" else b"\x01"
+        expect = peer_dir * 4 + (self._dec_ctr + 1).to_bytes(8, "little")
+        if data[:12] != expect:
+            raise ValueError(
+                "secure frame rejected: nonce out of sequence "
+                "(replayed or reordered ciphertext)")
         try:
-            return self._aead.decrypt(data[:12], data[12:], b"")
+            pt = self._aead.decrypt(data[:12], data[12:], b"")
         except Exception as e:  # noqa: BLE001 - InvalidTag et al
             # surfaces as a session-preserving wire reset (same path as
             # a crc failure in plain mode)
             raise ValueError(f"secure frame rejected: {e}") from e
+        self._dec_ctr += 1
+        return pt
 
     def reset_epoch(self) -> None:
         """Abandon this session's delivery state and start a fresh epoch

@@ -463,11 +463,12 @@ class OSDDaemon:
             elif isinstance(msg, M.MOSDPing):
                 self._handle_ping(conn, msg)
         except Exception as e:  # noqa: BLE001 - daemon must not die
-            import traceback
-            traceback.print_exc()
+            eno = getattr(e, "errno", errno.EIO)
+            if eno != errno.EAGAIN:   # EAGAIN is routine (not-primary /
+                import traceback      # peering-incomplete backoff)
+                traceback.print_exc()
             if isinstance(msg, M.MOSDOp):
-                conn.send_message(M.MOSDOpReply(
-                    msg.tid, -getattr(e, "errno", errno.EIO)))
+                conn.send_message(M.MOSDOpReply(msg.tid, -eno))
 
     def _handle_map(self, msg: M.MMonMap) -> None:
         self._last_map_time = time.time()
@@ -529,14 +530,20 @@ class OSDDaemon:
                     continue
                 if primary != self.osd_id:
                     continue
-                if pool.is_erasure():
-                    # one reservation per PG recovery (reference
-                    # osd_max_backfills: concurrent backfilling PGs)
-                    with self._recovery_sem:
-                        self._recover_ec_pg(pgid, acting, unreachable)
-                else:
-                    with self._recovery_sem:
-                        self._recover_replicated_pg(pgid, acting)
+                try:
+                    if pool.is_erasure():
+                        # one reservation per PG recovery (reference
+                        # osd_max_backfills: concurrent backfilling PGs)
+                        with self._recovery_sem:
+                            self._recover_ec_pg(pgid, acting, unreachable)
+                    else:
+                        with self._recovery_sem:
+                            self._recover_replicated_pg(pgid, acting)
+                except ErasureCodeError as e:
+                    # peering-incomplete (EAGAIN) or similar on ONE PG
+                    # must not kill the recovery pass for the rest
+                    self.cct.dout("osd", 2,
+                                  f"recovery of {pgid} deferred: {e}")
 
     def _pg_object_names(self, pgid: pg_t, acting, shard_ids,
                          unreachable: set | None = None) -> set:
@@ -999,6 +1006,12 @@ class OSDDaemon:
                     # keeps needs_peer set: the next op retries until
                     # every live shard's log has been reconciled
                     state.needs_peer = not self._peer_pg(pgid, state)
+            if state.needs_peer:
+                # Never serve ops from an unpeered PG: a partial view
+                # could miss acked writes held by the silent shard.
+                raise ErasureCodeError(
+                    errno.EAGAIN,
+                    f"pg {pgid} peering incomplete; retry")
         return state
 
     # -- peering (reference PeeringState.cc GetInfo/GetLog/Activate:
@@ -1063,8 +1076,15 @@ class OSDDaemon:
                     replies[s] = (pg_info_t.from_json(m.info),
                                   [entry_from_wire(w) for w in m.entries])
         complete = set(replies) == set(live)
-        if not replies:
-            return False  # nothing to peer against; retry on next op
+        if not complete:
+            # A live shard didn't answer.  Its log may hold acked writes
+            # newer than anything we heard; rolling back / activating on
+            # the partial view could elect a stale shard as authority and
+            # lose acknowledged data.  Do nothing destructive — the caller
+            # keeps needs_peer set and refuses ops until a full round
+            # succeeds (reference PeeringState only activates after a
+            # complete GetInfo/GetLog round).
+            return False
         max_les = max(info.last_epoch_started for info, _ in
                       replies.values())
         current = {s for s, (info, _) in replies.items()
@@ -1500,7 +1520,10 @@ class OSDDaemon:
                         self.osdmap.pg_to_up_acting_osds(pgid)
                     if primary != self.osd_id:
                         continue
-                    state = self._get_pg(pgid)
+                    try:
+                        state = self._get_pg(pgid)
+                    except ErasureCodeError:
+                        continue   # unpeered PG: skip this round
                     names = self._pg_object_names(pgid, acting, [0])
                     trimmed = self._trim_snaps(state, pgid, names)
                     if trimmed:
@@ -1514,7 +1537,10 @@ class OSDDaemon:
                     self.osdmap.pg_to_up_acting_osds(pgid)
                 if primary != self.osd_id:
                     continue
-                state = self._get_pg(pgid)
+                try:
+                    state = self._get_pg(pgid)
+                except ErasureCodeError:
+                    continue   # unpeered PG: scrub it next round
                 names = sorted(self._pg_object_names(
                     pgid, acting, range(state.backend.n)),
                     key=lambda o: o.name)

@@ -18,7 +18,9 @@ positions).
 
 from __future__ import annotations
 
+import errno
 import json
+import uuid
 
 from ..rados.client import IoCtx, RadosError
 
@@ -29,6 +31,12 @@ def _journal_oid(image: str) -> str:
 
 def _entry_data_oid(image: str, seq: int) -> str:
     return f"rbd_journal.{image}.{seq:016x}"
+
+
+def _resolve_data_oid(image: str, event: dict, seq: int) -> str:
+    """Payload object for an entry: the uuid oid recorded in the index
+    row, or the legacy seq-derived name for pre-data_oid journals."""
+    return event.get("data_oid") or _entry_data_oid(image, seq)
 
 
 class Journal:
@@ -63,16 +71,32 @@ class Journal:
         """Record one event (write-ahead: call BEFORE applying).  The
         sequence number is allocated SERVER-SIDE in the same atomic
         class call that stores the index row, so concurrent journaling
-        handles never collide.  The payload object is written under a
-        provisional seq read first; on a lost race the entry is
-        re-appended under the allocated seq."""
-        if data:
-            event = dict(event, data_len=len(data))
-        seq = int(self.io.execute(self.oid, "rgw", "log_append",
-                                  json.dumps({"meta": event}).encode()))
-        if data:
-            self.io.write_full(_entry_data_oid(self.image, seq), data)
-        return seq
+        handles never collide.  The payload object is written FIRST,
+        under a unique provisional oid carried in the index row's meta
+        (``data_oid``): an index entry therefore always has its payload
+        durable before it becomes visible to replayers.  A crash between
+        the two writes leaves only an unreferenced data object (harmless
+        orphan; the event was never recorded, and write-ahead means the
+        image mutation never happened either)."""
+        if not data:
+            return int(self.io.execute(
+                self.oid, "rgw", "log_append",
+                json.dumps({"meta": event}).encode()))
+        doid = f"rbd_journal.{self.image}.data.{uuid.uuid4().hex}"
+        self.io.write_full(doid, data)
+        event = dict(event, data_len=len(data), data_oid=doid)
+        try:
+            return int(self.io.execute(
+                self.oid, "rgw", "log_append",
+                json.dumps({"meta": event}).encode()))
+        except Exception:
+            # index write failed but we're still alive: reclaim the
+            # would-be orphan (its random name is unreachable by trim)
+            try:
+                self.io.remove(doid)
+            except RadosError:
+                pass
+            raise
 
     # -- replay (mirror side) -----------------------------------------------
 
@@ -98,9 +122,20 @@ class Journal:
             eseq = int(key, 16)
             data = b""
             if event.get("data_len"):
-                data = self.io.read(
-                    _entry_data_oid(self.image, eseq),
-                    event["data_len"])
+                doid = _resolve_data_oid(self.image, event, eseq)
+                try:
+                    data = self.io.read(doid, event["data_len"])
+                except RadosError as e:
+                    if e.errno != errno.ENOENT:
+                        raise   # transient error: retry, don't skip
+                    # Payload object GONE (not unreadable): only
+                    # possible for an entry a concurrent trim is midway
+                    # through removing, or a pre-fix journal that
+                    # crashed in the old index-before-payload window.
+                    # Either way the entry is not replayable and never
+                    # will be — skip it rather than wedging every
+                    # future replay at this seq.
+                    continue
             yield eseq, event, data
 
     def trim_to(self, seq: int) -> None:
@@ -114,7 +149,8 @@ class Journal:
                 break
             if event.get("data_len"):
                 try:
-                    self.io.remove(_entry_data_oid(self.image, eseq))
+                    self.io.remove(
+                        _resolve_data_oid(self.image, event, eseq))
                 except RadosError:
                     pass
             self.io.execute(self.oid, "rgw", "dir_rm", json.dumps(

@@ -46,9 +46,15 @@ def canonical_request(method: str, path: str, query: str,
     canon_h = "".join(
         f"{h}:{' '.join(headers.get(h, '').split())}\n"
         for h in signed_headers)
+    # S3 canonical URI (AWS SigV4 spec, S3 variant: encode each path
+    # segment exactly ONCE, '/' left alone).  The wire path arrives
+    # already percent-encoded; decode it once first so keys containing
+    # encoded or reserved characters don't get double-encoded — the same
+    # normalization runs on sign and verify, matching real S3 SDKs.
+    canon_path = urllib.parse.quote(urllib.parse.unquote(path),
+                                    safe="/-_.~")
     return "\n".join([
-        method,
-        urllib.parse.quote(path, safe="/-_.~"),
+        method, canon_path,
         canon_q, canon_h, ";".join(signed_headers), payload_hash])
 
 

@@ -72,6 +72,25 @@ def test_authorizer_rejects_wrong_key_and_stale_ts():
         mon.verify_authorizer(stranger.build_authorizer())
 
 
+def test_forged_authorizer_does_not_burn_nonce():
+    """A forged authorizer carrying a sniffed in-flight nonce (garbage
+    hmac) must not poison the replay cache: the legitimate peer's
+    handshake with that nonce still succeeds afterwards."""
+    kr = Keyring()
+    ck = kr.gen_key("client.admin", "allow *")
+    mon = CephxAuth("mon", service_key=b"\x0a" * 16, keyring=kr)
+    client = CephxAuth("client.admin", key=ck)
+    auth = client.build_authorizer()
+    forged = dict(auth, hmac="00" * 32)
+    with pytest.raises(AuthError, match="hmac"):
+        mon.verify_authorizer(forged)
+    ident, _, _ = mon.verify_authorizer(auth)   # legit one still works
+    assert ident["entity"] == "client.admin"
+    # and a true replay of the verified authorizer is still rejected
+    with pytest.raises(AuthError, match="replayed"):
+        mon.verify_authorizer(auth)
+
+
 def test_service_and_ticket_authorizers():
     sk = b"\x08" * 16
     osd_a = CephxAuth("osd.0", service_key=sk)
@@ -84,6 +103,37 @@ def test_service_and_ticket_authorizers():
     cli.set_ticket(blob, skey)
     ident, _, _ = osd_a.verify_authorizer(cli.build_authorizer())
     assert ident["entity"] == "client.admin"
+
+
+def test_secure_frames_reject_replay_and_reorder():
+    """An active MITM replaying or reordering ciphertext frames must be
+    caught even though the AEAD tag verifies: the receiver tracks an
+    implicit strictly-incrementing nonce (reference crypto_onwire.cc)."""
+    from ceph_tpu.msg.messenger import Session, _parse_raw
+    key = b"\x0b" * 16
+    tx = Session()
+    tx.set_conn_key(key, b"\x01")   # connector side
+    rx = Session()
+    rx.set_conn_key(key, b"\x02")   # acceptor side
+
+    def payload(raw_frame):
+        _, _, _, data, _ = _parse_raw(raw_frame)
+        return data
+
+    f1 = payload(tx.wire_encrypt(b"frame-one"))
+    f2 = payload(tx.wire_encrypt(b"frame-two"))
+    f3 = payload(tx.wire_encrypt(b"frame-three"))
+    # reorder: deliver f2 before f1
+    with pytest.raises(ValueError, match="nonce out of sequence"):
+        rx.wire_decrypt(f2)
+    # in-order delivery succeeds
+    assert rx.wire_decrypt(f1) == b"frame-one"
+    assert rx.wire_decrypt(f2) == b"frame-two"
+    # replay of an already-delivered frame is rejected
+    with pytest.raises(ValueError, match="nonce out of sequence"):
+        rx.wire_decrypt(f2)
+    # and the stream still continues after a rejected attempt is dropped
+    assert rx.wire_decrypt(f3) == b"frame-three"
 
 
 # -- tier 3: authenticated cluster -------------------------------------------

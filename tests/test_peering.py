@@ -255,6 +255,52 @@ def test_divergent_shard_rolled_back_on_peering(fcluster):
     assert victim._shard_log(spg).info.last_update == head
 
 
+def test_incomplete_peering_refuses_ops_and_touches_nothing(fcluster):
+    """If a live shard doesn't answer the peering round, the primary
+    must neither roll anyone back nor activate — and must refuse ops
+    (EAGAIN) until a complete round succeeds.  Serving from a partial
+    view could elect a stale shard as sole authority and lose acked
+    writes (reference: PeeringState only activates after a complete
+    GetInfo/GetLog round)."""
+    import errno as _errno
+
+    from ceph_tpu.ec.interface import ErasureCodeError
+    cluster, client = fcluster
+    io = client.open_ioctx("peerpool")
+    rng = np.random.default_rng(12)
+    data = rng.integers(0, 256, 2048, dtype=np.uint8).tobytes()
+    io.write_full("inc", data)
+    pgid, acting, primary = _primary_of(cluster, "peerpool", "inc")
+    daemons = {o.osd_id: o for o in cluster.osds
+               if o.messenger is not None}
+    pdaemon = daemons[primary]
+    heads = {s: daemons[osd]._shard_log(spg_t(pgid, s)).info.last_update
+             for s, osd in enumerate(acting) if osd in daemons}
+    les = {s: daemons[osd]._shard_log(
+        spg_t(pgid, s)).info.last_epoch_started
+        for s, osd in enumerate(acting) if osd in daemons}
+    orig = pdaemon._peer_rpc
+    pdaemon._peer_rpc = lambda *a, **kw: None   # every remote times out
+    try:
+        state = pdaemon.pgs[pgid]
+        state.needs_peer = True
+        with pytest.raises(ErasureCodeError) as ei:
+            pdaemon._get_pg(pgid)
+        assert ei.value.errno == _errno.EAGAIN
+        assert state.needs_peer
+        # nothing rolled back, nothing activated on any shard
+        for s, osd in enumerate(acting):
+            if osd in daemons:
+                sl = daemons[osd]._shard_log(spg_t(pgid, s))
+                assert sl.info.last_update == heads[s]
+                assert sl.info.last_epoch_started == les[s]
+    finally:
+        pdaemon._peer_rpc = orig
+    # with RPCs restored the next op completes peering and serves
+    assert io.read("inc", len(data)) == data
+    assert not pdaemon.pgs[pgid].needs_peer
+
+
 def test_meta_object_hidden_from_listing(fcluster):
     """The per-PG log meta object must not leak into object
     enumeration (backfill/scrub would try to 'recover' it)."""

@@ -36,6 +36,57 @@ def test_journaling_records_before_apply(env):
     assert entries[1][1]["offset"] == 100
 
 
+def test_append_crash_window_never_wedges_replay(env):
+    """Payload-before-index ordering: a crash between the two append
+    writes leaves NO index entry (only an orphan data object), so the
+    journal stays replayable.  And if an index row's payload object is
+    somehow missing (concurrent trim race), replay skips it instead of
+    raising at that seq forever."""
+    _, _, src, dst = env
+    rbd = RBD(src)
+    rbd.create("crashimg", size=1 << 16, order=13)
+    img = Image(src, "crashimg", journaling=True)
+    img.write(0, b"before-crash")
+    j = Journal(src, "crashimg")
+
+    # simulate a crash after the payload write, before log_append:
+    # fail the class call once
+    orig_execute = src.execute
+
+    def failing_execute(oid, cls, method, data):
+        if method == "log_append":
+            raise RuntimeError("simulated crash before index write")
+        return orig_execute(oid, cls, method, data)
+
+    src.execute = failing_execute
+    try:
+        with pytest.raises(RuntimeError):
+            j.append({"op": "write", "offset": 50}, b"lost-write")
+    finally:
+        src.execute = orig_execute
+    # the half-appended event is invisible; the journal still works
+    entries = list(j.entries_after(-1))
+    assert [e[1]["op"] for e in entries] == ["write"]
+    assert entries[0][2] == b"before-crash"
+    img.write(64, b"after-crash")
+    rep = ImageReplayer(src, "crashimg", dst)
+    assert rep.replay() == 2
+    mirror = Image(dst, "crashimg")
+    assert mirror.read(0, 12) == b"before-crash"
+    assert mirror.read(64, 11) == b"after-crash"
+
+    # a missing payload object (trim race) is skipped, not fatal
+    img.write(200, b"doomed-payload")
+    entries = list(j.entries_after(-1))
+    doomed = entries[-1][1]
+    assert doomed.get("data_oid")
+    src.remove(doomed["data_oid"])
+    img.write(300, b"subsequent")
+    assert rep.replay() == 1          # doomed skipped, subsequent applied
+    mirror = Image(dst, "crashimg")
+    assert mirror.read(300, 10) == b"subsequent"
+
+
 def test_mirror_replays_and_is_incremental(env):
     _, _, src, dst = env
     rbd = RBD(src)

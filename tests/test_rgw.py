@@ -123,6 +123,22 @@ def test_bucket_not_empty_and_missing(s3):
     assert ei.value.code == 404
 
 
+def test_encoded_key_names_sign_correctly(s3):
+    """Keys with reserved / percent-encoded characters must canonicalize
+    per the SigV4 S3 rule (decode once, encode each segment once) —
+    real SDKs sign this way and would get SignatureDoesNotMatch against
+    a double-encoding gateway."""
+    import urllib.parse
+    s3.request("PUT", "/enckeys")
+    for key in ["a key with spaces", "pct%25literal", "uni-éß",
+                "semi;colon=and,comma", "tilde~ok"]:
+        wire = "/enckeys/" + urllib.parse.quote(key, safe="-_.~")
+        st, _, _ = s3.request("PUT", wire, body=b"v:" + key.encode())
+        assert st == 200
+        st, _, body = s3.request("GET", wire)
+        assert st == 200 and body == b"v:" + key.encode()
+
+
 def test_bad_signature_rejected(gw):
     bad = S3Client(gw.addr, secret="wrong")
     with pytest.raises(urllib.error.HTTPError) as ei:
