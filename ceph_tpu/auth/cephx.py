@@ -128,6 +128,8 @@ class CephxAuth:
         # of CVE-2018-1128, collapsed to a nonce cache so the handshake
         # stays one round trip)
         self._seen_nonces: dict[tuple[str, str], float] = {}
+        import threading
+        self._nonce_lock = threading.Lock()
 
     def set_ticket(self, blob: str, session_key: bytes,
                    expires: float = 0.0) -> None:
@@ -206,11 +208,12 @@ class CephxAuth:
         if abs(now - ts) > FRESHNESS_WINDOW:
             raise AuthError("authorizer outside freshness window")
         # replay fence: each (entity, nonce) authenticates exactly once
-        for k in [k for k, exp in self._seen_nonces.items()
-                  if exp < now]:
-            del self._seen_nonces[k]
-        if (entity, nonce) in self._seen_nonces:
-            raise AuthError("authorizer replayed")
+        with self._nonce_lock:
+            for k in [k for k, exp in self._seen_nonces.items()
+                      if exp < now]:
+                del self._seen_nonces[k]
+            if (entity, nonce) in self._seen_nonces:
+                raise AuthError("authorizer replayed")
         caps = "allow *"
         if kind == "service":
             if self.service_key is None:
@@ -238,7 +241,13 @@ class CephxAuth:
         # Burn the nonce only AFTER the hmac verifies: a forged
         # authorizer carrying a sniffed in-flight nonce (garbage hmac)
         # must not poison the cache and DoS the legitimate handshake.
-        self._seen_nonces[(entity, nonce)] = now + FRESHNESS_WINDOW
+        # Re-check under the lock: two concurrent replays of the same
+        # VALID authorizer both pass the early check (TOCTOU); exactly
+        # one may burn the nonce and proceed.
+        with self._nonce_lock:
+            if (entity, nonce) in self._seen_nonces:
+                raise AuthError("authorizer replayed")
+            self._seen_nonces[(entity, nonce)] = now + FRESHNESS_WINDOW
         final = bool(server_secure) and secure
         reply = {"proof": sign(key, "server", nonce, final),
                  "secure": final}

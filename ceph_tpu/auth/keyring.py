@@ -34,6 +34,27 @@ class Keyring:
     def __contains__(self, entity: str) -> bool:
         return entity in self._keys
 
+    # -- replication (AuthMonitor value; mon/monitor.py) ---------------------
+
+    def to_json(self) -> dict:
+        return {e: {"key": base64.b64encode(k).decode(),
+                    "caps": self.caps.get(e, "")}
+                for e, k in self._keys.items()}
+
+    def replace_from_json(self, j: dict) -> None:
+        """Adopt a committed auth map wholesale (the replicated value is
+        the full entity set, like the committed OSDMap is the full map)."""
+        self._keys = {e: base64.b64decode(rec["key"])
+                      for e, rec in j.items()}
+        self.caps = {e: rec.get("caps", "") for e, rec in j.items()}
+
+    def remove(self, entity: str) -> None:
+        self._keys.pop(entity, None)
+        self.caps.pop(entity, None)
+
+    def entities(self) -> list[str]:
+        return sorted(self._keys)
+
     # -- persistence ---------------------------------------------------------
 
     def save(self, path: str) -> None:

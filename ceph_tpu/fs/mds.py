@@ -52,9 +52,12 @@ class MDSDaemon:
     def __init__(self, mon_addr, addr=("127.0.0.1", 0),
                  block_size: int = 1 << 22, auth=None,
                  secure: bool = False, ec_profile: str | None = None,
-                 pg_num: int = 8):
+                 pg_num: int = 8, name: str = "a",
+                 fs_name: str = "cephfs"):
         from ..rados import RadosClient
         self.block_size = block_size
+        self.name = name
+        self.fs_name = fs_name
         self.client = RadosClient(mon_addr, "mds", auth=auth,
                                   secure=secure).connect()
         self._ensure_pools(ec_profile, pg_num)
@@ -66,6 +69,25 @@ class MDSDaemon:
         self.messenger = Messenger("mds", auth=auth, secure=secure)
         self.messenger.add_dispatcher(self._dispatch)
         self.addr = self.messenger.bind(addr)
+        self._register_fsmap()
+
+    def _register_fsmap(self) -> None:
+        """Put this filesystem + MDS into the mon's replicated fsmap
+        (reference MDSMonitor: an MDS exists only through the FSMap).
+        Best-effort: a mon predating the fs commands must not block
+        the data path."""
+        try:
+            r, _ = self.client.mon_command({
+                "prefix": "fs new", "name": self.fs_name,
+                "metadata_pool": META_POOL, "data_pool": DATA_POOL})
+            import errno as _e
+            if r not in (0, -_e.EEXIST):
+                return
+            self.client.mon_command({
+                "prefix": "mds boot", "name": self.name,
+                "fs": self.fs_name, "addr": list(self.addr)})
+        except Exception:  # noqa: BLE001
+            pass
 
     def shutdown(self) -> None:
         self.messenger.shutdown()

@@ -40,8 +40,9 @@ class MgrModule:
 
 class MgrDaemon:
     def __init__(self, mon_addr, modules: list[type] | None = None,
-                 auth=None, secure: bool = False):
+                 auth=None, secure: bool = False, name: str = "x"):
         from ..msg.addrs import normalize_mon_addrs
+        self.name = name
         self.mon_addrs = normalize_mon_addrs(mon_addr)
         self._mon_idx = 0
         self.messenger = Messenger("mgr", auth=auth, secure=secure)
@@ -67,6 +68,12 @@ class MgrDaemon:
             if not self.map_event.wait(1.0):
                 self._rotate_mon()
             self.map_event.clear()
+        try:
+            # join the replicated mgrmap (reference MgrMonitor beacon:
+            # first mgr becomes active, later ones standby)
+            self.mon_command({"prefix": "mgr boot", "name": self.name})
+        except Exception:  # noqa: BLE001 - registration is best-effort
+            pass
         for mod in self.modules:
             t = threading.Thread(target=self._run_module, args=(mod,),
                                  daemon=True, name=f"mgr.{mod.name}")

@@ -16,28 +16,46 @@ forward mutating traffic to the leader (reference Monitor::forward_
 request_leader, Monitor.cc:4583) and serve reads from committed state
 under the leader's lease.  A single-mon deployment runs the same code
 with a quorum of one.
+
+PaxosService family (reference src/mon/PaxosService.h: OSDMonitor,
+AuthMonitor, ConfigMonitor, MDSMonitor, MgrMonitor): the replicated
+value carries EVERY service's state — osdmap, auth entities, cluster
+config, fsmap, mgrmap — under one global version, so keyring changes
+and MDS/mgr registration ride the same commit path as map mutations.
+Durability: every committed value (plus the paxos promise/uncommitted
+protocol state) persists through MonitorStore (mon/store.py, the
+MonitorDBStore role) — a restarted mon, or a whole restarted quorum,
+comes back with full state.
 """
 
 from __future__ import annotations
 
+import copy
 import errno
 import threading
 import time
 
+from ..auth.keyring import Keyring
 from ..ec import ErasureCodeError, ErasureCodePluginRegistry, Profile
 from ..msg import Messenger
 from ..msg import messages as M
 from ..osd.osd_map import OSDMap
 from ..osd.types import PoolType, pg_t
 from .paxos import ElectionLogic, Paxos
+from .store import MonitorStore
 
 DEFAULT_EC_PROFILE = {"plugin": "jax", "k": "2", "m": "1",
                       "technique": "cauchy",
                       "crush-failure-domain": "host"}
 
+# NOTE: no "auth *" here — auth surfaces return entity keys, which a
+# read-only ("allow r") credential must never see (reference MonCap
+# treats auth read as a privileged grant)
 READONLY_COMMANDS = {
     "osd erasure-code-profile get", "osd erasure-code-profile ls",
     "osd pool ls", "status", "osd tree", "mon stat",
+    "config get", "config dump",
+    "fs ls", "fs dump", "mgr dump",
 }
 
 FWD_TID_BASE = 1 << 40
@@ -45,7 +63,9 @@ FWD_TID_BASE = 1 << 40
 
 class Monitor:
     def __init__(self, addr: tuple[str, int] = ("127.0.0.1", 0),
-                 failure_quorum: int = 2, auth=None, secure: bool = False):
+                 failure_quorum: int = 2, auth=None, secure: bool = False,
+                 data_dir: str | None = None):
+        self.store = MonitorStore(data_dir)
         self.osdmap = OSDMap()
         self.osdmap.ec_profiles["default"] = dict(DEFAULT_EC_PROFILE)
         self.lock = threading.RLock()
@@ -53,13 +73,24 @@ class Monitor:
         self._failure_reports: dict[int, set[int]] = {}
         self._subscribers: list = []
         self.auth = auth       # auth.CephxAuth with keyring (AuthMonitor)
+        # PaxosService state beyond the OSDMap (reference AuthMonitor /
+        # ConfigMonitor / MDSMonitor / MgrMonitor)
+        self.keyring = auth.keyring if auth is not None and \
+            auth.keyring is not None else Keyring()
+        self.config_db: dict[str, dict[str, str]] = {}
+        self.fsmap: dict = {"epoch": 0, "filesystems": {}}
+        self.mgrmap: dict = {"epoch": 0, "active": None, "standbys": []}
+        self.paxos_version = 0
+        committed = self.store.load_committed()
+        if committed is not None:
+            self._adopt_value(committed)          # restart: reload state
         self.messenger = Messenger("mon", auth=auth, secure=secure)
         self.messenger.add_dispatcher(self._dispatch)
         self.addr = self.messenger.bind(addr)
         # quorum state (filled by join(); defaults to standalone)
         self.rank = 0
         self.mon_addrs: list[tuple[str, int]] = [self.addr]
-        self._committed_json = self.osdmap.to_json()
+        self._committed_json = self._current_value()
         self._fwd_tid = FWD_TID_BASE
         self._fwd_waiters: dict[int, tuple] = {}
         self._stop = threading.Event()
@@ -70,6 +101,48 @@ class Monitor:
         self.paxos.role = "leader"
         self.paxos.leader = 0
         self.paxos.quorum = [0]
+
+    # -- the replicated multi-service value ---------------------------------
+
+    def _current_value(self) -> dict:
+        """Snapshot of every PaxosService's state under the global
+        version ("epoch" is the paxos version the protocol orders by;
+        the OSDMap keeps its own epoch inside)."""
+        return {
+            "epoch": self.paxos_version,
+            "osdmap": self.osdmap.to_json(),
+            "auth": self.keyring.to_json(),
+            "config": {s: dict(d) for s, d in self.config_db.items()},
+            "fsmap": copy.deepcopy(self.fsmap),
+            "mgrmap": copy.deepcopy(self.mgrmap),
+        }
+
+    def _adopt_value(self, value: dict, force: bool = False) -> None:
+        """Adopt a committed multi-service value into live state.
+
+        force=True (quorum-loss rollback) restores the committed map
+        UNCONDITIONALLY: the local osdmap may carry an uncommitted
+        mutation with a bumped epoch, which is exactly the state the
+        rollback must discard — the normal newer-epoch guard would
+        keep it."""
+        with self.lock:
+            if force:
+                self.paxos_version = value.get("epoch", 0)
+            else:
+                self.paxos_version = max(self.paxos_version,
+                                         value.get("epoch", 0))
+            om = value.get("osdmap")
+            if om is not None and (
+                    force or om.get("epoch", 0) >= self.osdmap.epoch):
+                self.osdmap = OSDMap.from_json(om)
+            if value.get("auth") is not None:
+                self.keyring.replace_from_json(value["auth"])
+            self.config_db = {s: dict(d) for s, d in
+                              value.get("config", {}).items()}
+            self.fsmap = copy.deepcopy(value.get(
+                "fsmap", {"epoch": 0, "filesystems": {}}))
+            self.mgrmap = copy.deepcopy(value.get(
+                "mgrmap", {"epoch": 0, "active": None, "standbys": []}))
 
     # -- quorum wiring -------------------------------------------------------
 
@@ -84,7 +157,7 @@ class Monitor:
             rank, n, self._send_paxos, self._on_win, self._on_defeat)
         self.paxos = Paxos(rank, n, self._send_paxos, self._apply_commit,
                            lambda: self._committed_json,
-                           self._on_quorum_loss)
+                           self._on_quorum_loss, store=self.store)
         if self._maint is None:
             self._maint = threading.Thread(
                 target=self._maintenance_loop, daemon=True,
@@ -109,18 +182,21 @@ class Monitor:
         self.paxos.defeat(leader, epoch, quorum)
 
     def _on_quorum_loss(self) -> None:
-        # restore the last committed map (an uncommitted local mutation
-        # must not leak) and go back to the polls
+        # restore the last committed state (an uncommitted local
+        # mutation must not leak) and go back to the polls
         with self.lock:
-            self.osdmap = OSDMap.from_json(self._committed_json)
+            self._adopt_value(self._committed_json, force=True)
         if len(self.mon_addrs) > 1:
             self.election.start()
 
     def _apply_commit(self, value: dict) -> None:
-        """A paxos value committed: adopt + publish (every quorum mon)."""
+        """A paxos value committed: persist, adopt, publish (every
+        quorum mon).  The store write comes FIRST — a committed value
+        the cluster acted on must survive this mon's restart
+        (MonitorDBStore contract)."""
+        self.store.save_committed(value)
         with self.lock:
-            if value.get("epoch", 0) >= self.osdmap.epoch:
-                self.osdmap = OSDMap.from_json(value)
+            self._adopt_value(value)
             self._committed_json = value
         self._publish()
 
@@ -180,20 +256,23 @@ class Monitor:
         with self.paxos.lock:
             self.paxos.role = "down"   # wait_for_leader must skip us
         self.messenger.shutdown()
+        self.store.close()
 
     # -- commit / publish ----------------------------------------------------
 
     def _propose_current(self) -> bool:
-        """Leader-only: replicate the locally-mutated map.  On failure
+        """Leader-only: replicate the locally-mutated state.  On failure
         the mutation is rolled back (quorum-loss path)."""
-        value = self.osdmap.to_json()
+        with self.lock:
+            self.paxos_version += 1
+            value = self._current_value()
         ok = self.paxos.propose(value)
         return ok
 
     def _publish(self) -> None:
         """Push the committed map to every subscriber (reference OSDMap
         epoch share; subscribers are daemons and clients)."""
-        j = self._committed_json
+        j = self._committed_json.get("osdmap", {})
         for conn in list(self._subscribers):
             try:
                 conn.send_message(M.MMonMap(j))
@@ -244,7 +323,8 @@ class Monitor:
             # makes daemons/clients hunt to a live mon (reference
             # Paxos::is_lease_valid gating on reads)
             if self._lease_ok():
-                conn.send_message(M.MMonMap(self._committed_json))
+                conn.send_message(M.MMonMap(
+                    self._committed_json.get("osdmap", {})))
         elif isinstance(msg, M.MOSDBoot):
             if self.is_leader:
                 self._handle_boot(msg)
@@ -456,11 +536,189 @@ class Monitor:
                 return self._cmd_tree()
             if prefix == "mon stat":
                 return 0, self.quorum_status()
+            if prefix.startswith("auth "):
+                return self._cmd_auth(prefix, cmd)
+            if prefix.startswith("config "):
+                return self._cmd_config(prefix, cmd)
+            if prefix.startswith("fs ") or prefix == "mds boot":
+                return self._cmd_fs(prefix, cmd)
+            if prefix.startswith("mgr "):
+                return self._cmd_mgr(prefix, cmd)
             return -errno.EINVAL, {"error": f"unknown command {prefix!r}"}
         except ErasureCodeError as e:
             return -e.errno, {"error": str(e)}
         except KeyError as e:
             return -errno.EINVAL, {"error": f"missing arg {e}"}
+
+    # -- PaxosService command surfaces (auth/config/fs/mgr) -----------------
+
+    def _cmd_auth(self, prefix: str, cmd: dict) -> tuple[int, dict]:
+        """AuthMonitor role (reference src/mon/AuthMonitor.cc): entity
+        create/list/remove ride Paxos so every mon serves the same
+        keyring and it survives restarts."""
+        import base64
+        if prefix == "auth get-or-create":
+            entity = cmd["entity"]
+            caps = cmd.get("caps", "allow *")
+            with self.lock:
+                key = self.keyring.get(entity)
+                if key is None:
+                    key = self.keyring.gen_key(entity, caps)
+                    self._propose_current()
+                elif caps != self.keyring.caps.get(entity):
+                    self.keyring.caps[entity] = caps
+                    self._propose_current()
+            return 0, {"entity": entity,
+                       "key": base64.b64encode(key).decode(),
+                       "caps": self.keyring.caps.get(entity, "")}
+        if prefix == "auth get":
+            entity = cmd["entity"]
+            key = self.keyring.get(entity)
+            if key is None:
+                return -errno.ENOENT, {"error": f"no entity {entity}"}
+            return 0, {"entity": entity,
+                       "key": base64.b64encode(key).decode(),
+                       "caps": self.keyring.caps.get(entity, "")}
+        if prefix == "auth ls":
+            return 0, {"entities": [
+                {"entity": e, "caps": self.keyring.caps.get(e, "")}
+                for e in self.keyring.entities()]}
+        if prefix == "auth rm":
+            entity = cmd["entity"]
+            with self.lock:
+                if entity not in self.keyring:
+                    return -errno.ENOENT, {"error": f"no entity {entity}"}
+                self.keyring.remove(entity)
+                self._propose_current()
+            return 0, {"removed": entity}
+        return -errno.EINVAL, {"error": f"unknown command {prefix!r}"}
+
+    def _cmd_config(self, prefix: str, cmd: dict) -> tuple[int, dict]:
+        """ConfigMonitor role (reference src/mon/ConfigMonitor.cc): a
+        replicated cluster config DB keyed section/name ('global',
+        'osd', 'osd.3', ... like the reference's config tree)."""
+        if prefix == "config set":
+            sec, name = cmd["section"], cmd["name"]
+            with self.lock:
+                self.config_db.setdefault(sec, {})[name] = \
+                    str(cmd["value"])
+                self._propose_current()
+            return 0, {"set": [sec, name]}
+        if prefix == "config rm":
+            sec, name = cmd["section"], cmd["name"]
+            with self.lock:
+                if self.config_db.get(sec, {}).pop(name, None) is None:
+                    return -errno.ENOENT, {"error": f"no {sec}/{name}"}
+                if not self.config_db[sec]:
+                    del self.config_db[sec]
+                self._propose_current()
+            return 0, {"removed": [sec, name]}
+        if prefix == "config get":
+            sec = cmd["section"]
+            name = cmd.get("name")
+            d = self.config_db.get(sec, {})
+            if name is not None:
+                if name not in d:
+                    return -errno.ENOENT, {"error": f"no {sec}/{name}"}
+                return 0, {"value": d[name]}
+            return 0, {"config": dict(d)}
+        if prefix == "config dump":
+            return 0, {"config": {s: dict(d)
+                                  for s, d in self.config_db.items()}}
+        return -errno.EINVAL, {"error": f"unknown command {prefix!r}"}
+
+    def _cmd_fs(self, prefix: str, cmd: dict) -> tuple[int, dict]:
+        """MDSMonitor role (reference src/mon/MDSMonitor.cc + FSMap):
+        filesystems and their MDS ranks live in a replicated fsmap."""
+        if prefix == "fs new":
+            name = cmd["name"]
+            meta, data = cmd["metadata_pool"], cmd["data_pool"]
+            with self.lock:
+                if name in self.fsmap["filesystems"]:
+                    return -errno.EEXIST, {"error": f"fs {name} exists"}
+                for p in (meta, data):
+                    if self.osdmap.lookup_pool(p) is None:
+                        return -errno.ENOENT, {"error": f"no pool {p}"}
+                self.fsmap["filesystems"][name] = {
+                    "metadata_pool": meta, "data_pool": data, "mds": {}}
+                self.fsmap["epoch"] += 1
+                self._propose_current()
+            return 0, {"fs": name}
+        if prefix == "fs rm":
+            name = cmd["name"]
+            with self.lock:
+                if name not in self.fsmap["filesystems"]:
+                    return -errno.ENOENT, {"error": f"no fs {name}"}
+                del self.fsmap["filesystems"][name]
+                self.fsmap["epoch"] += 1
+                self._propose_current()
+            return 0, {"removed": name}
+        if prefix == "fs ls":
+            return 0, {"filesystems":
+                       sorted(self.fsmap["filesystems"])}
+        if prefix == "fs dump":
+            return 0, copy.deepcopy(self.fsmap)
+        if prefix == "mds boot":
+            mds_name = cmd["name"]
+            fs_name = cmd.get("fs")
+            with self.lock:
+                fss = self.fsmap["filesystems"]
+                if fs_name is None and len(fss) == 1:
+                    fs_name = next(iter(fss))
+                if fs_name not in fss:
+                    return -errno.ENOENT, {"error": f"no fs {fs_name}"}
+                # active iff no OTHER active exists: a restarting sole
+                # MDS keeps (re-takes) active; a new MDS joining a
+                # filesystem with a live active becomes standby
+                others_active = any(
+                    e["state"] == "active"
+                    for n, e in fss[fs_name]["mds"].items()
+                    if n != mds_name)
+                fss[fs_name]["mds"][mds_name] = {
+                    "addr": list(cmd.get("addr") or ()),
+                    "state": "standby" if others_active else "active"}
+                self.fsmap["epoch"] += 1
+                self._propose_current()
+            return 0, {"fs": fs_name,
+                       "state": fss[fs_name]["mds"][mds_name]["state"]}
+        return -errno.EINVAL, {"error": f"unknown command {prefix!r}"}
+
+    def _cmd_mgr(self, prefix: str, cmd: dict) -> tuple[int, dict]:
+        """MgrMonitor role (reference src/mon/MgrMonitor.cc): active/
+        standby mgr tracking in a replicated mgrmap."""
+        if prefix == "mgr boot":
+            name = cmd["name"]
+            with self.lock:
+                if self.mgrmap["active"] is None:
+                    self.mgrmap["active"] = name
+                elif self.mgrmap["active"] != name and \
+                        name not in self.mgrmap["standbys"]:
+                    self.mgrmap["standbys"].append(name)
+                else:
+                    return 0, self._mgr_role(name)   # idempotent re-boot
+                self.mgrmap["epoch"] += 1
+                self._propose_current()
+            return 0, self._mgr_role(name)
+        if prefix == "mgr fail":
+            with self.lock:
+                if self.mgrmap["active"] is None:
+                    return -errno.ENOENT, {"error": "no active mgr"}
+                failed = self.mgrmap["active"]
+                self.mgrmap["active"] = (self.mgrmap["standbys"].pop(0)
+                                         if self.mgrmap["standbys"]
+                                         else None)
+                self.mgrmap["epoch"] += 1
+                self._propose_current()
+            return 0, {"failed": failed,
+                       "active": self.mgrmap["active"]}
+        if prefix == "mgr dump":
+            return 0, copy.deepcopy(self.mgrmap)
+        return -errno.EINVAL, {"error": f"unknown command {prefix!r}"}
+
+    def _mgr_role(self, name: str) -> dict:
+        return {"name": name,
+                "role": "active" if self.mgrmap["active"] == name
+                else "standby"}
 
     def _cmd_profile_set(self, cmd: dict) -> tuple[int, dict]:
         """Validate + normalize via the plugin itself (reference

@@ -160,13 +160,19 @@ class Paxos:
     def __init__(self, rank: int, n_mons: int, send: Callable,
                  on_commit: Callable[[dict], None],
                  get_committed: Callable[[], dict],
-                 on_quorum_loss: Callable[[], None]):
+                 on_quorum_loss: Callable[[], None],
+                 store=None):
         self.rank = rank
         self.n = n_mons
         self.send = send
         self.on_commit = on_commit          # apply a committed value
         self.get_committed = get_committed  # current committed value
         self.on_quorum_loss = on_quorum_loss
+        # MonitorStore (mon/store.py): protocol state a restart must
+        # not forget — the promise fences stale proposers across
+        # restarts, and an accepted-uncommitted value must survive to
+        # be surfaced to the next leader's collect
+        self.store = store
         self.lock = threading.RLock()
         self.role = "electing"              # electing | leader | peon
         self.leader = -1
@@ -174,6 +180,9 @@ class Paxos:
         self.pn = 0                         # proposal number (leader)
         self.promised = 0                   # highest pn promised (peon)
         self.uncommitted: tuple | None = None   # (pn, value)
+        if store is not None:
+            self.promised = store.load_promised()
+            self.uncommitted = store.load_uncommitted()
         self.lease_expire = 0.0             # peon-side lease
         self.lease_acks: dict[int, float] = {}   # leader-side liveness
         self._round = None                  # in-flight round state
@@ -257,6 +266,10 @@ class Paxos:
             with self.lock:
                 self._round = rnd
                 self.uncommitted = (self.pn, value)
+                if self.store is not None:
+                    # survives a leader crash mid-round; cleared
+                    # atomically when the commit lands (save_committed)
+                    self.store.save_uncommitted(self.pn, value)
             for peer in range(self.n):
                 if peer != self.rank:
                     self.send(peer, op="begin", pn=self.pn, value=value)
@@ -293,6 +306,8 @@ class Paxos:
             with self.lock:
                 if pn > self.promised:
                     self.promised = pn
+                    if self.store is not None:
+                        self.store.save_promised(pn)
                 unc = list(self.uncommitted) if self.uncommitted else None
             self.send(from_rank, op="last", pn=pn,
                       committed=self.get_committed(), uncommitted=unc)
@@ -322,6 +337,11 @@ class Paxos:
                     return          # stale proposer; ignore
                 self.promised = pn
                 self.uncommitted = (pn, value)
+                if self.store is not None:
+                    # accept is a durability promise: the value must
+                    # survive our restart until committed or superseded
+                    self.store.save_promised(pn)
+                    self.store.save_uncommitted(pn, value)
                 self.lease_expire = time.monotonic() + \
                     3 * self.LEASE_INTERVAL
             self.send(from_rank, op="accept", pn=pn)
@@ -336,6 +356,8 @@ class Paxos:
         elif op == "commit":
             with self.lock:
                 self.uncommitted = None
+                if self.store is not None:
+                    self.store.clear_uncommitted()
                 self.lease_expire = time.monotonic() + \
                     3 * self.LEASE_INTERVAL
             if value and value.get("epoch", 0) > \

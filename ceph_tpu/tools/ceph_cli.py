@@ -75,6 +75,40 @@ def main(argv=None) -> int:
         elif words[:2] in (["osd", "out"], ["osd", "in"],
                            ["osd", "down"]) and len(words) == 3:
             cmd = {"prefix": f"osd {words[1]}", "id": int(words[2])}
+        elif words[:2] == ["auth", "get-or-create"] and len(words) >= 3:
+            cmd = {"prefix": "auth get-or-create", "entity": words[2],
+                   "caps": " ".join(words[3:]) or "allow *"}
+        elif words[:2] == ["auth", "get"] and len(words) == 3:
+            cmd = {"prefix": "auth get", "entity": words[2]}
+        elif words == ["auth", "ls"]:
+            cmd = {"prefix": "auth ls"}
+        elif words[:2] == ["auth", "rm"] and len(words) == 3:
+            cmd = {"prefix": "auth rm", "entity": words[2]}
+        elif words[:2] == ["config", "set"] and len(words) == 5:
+            cmd = {"prefix": "config set", "section": words[2],
+                   "name": words[3], "value": words[4]}
+        elif words[:2] == ["config", "get"] and len(words) in (3, 4):
+            cmd = {"prefix": "config get", "section": words[2]}
+            if len(words) == 4:
+                cmd["name"] = words[3]
+        elif words[:2] == ["config", "rm"] and len(words) == 4:
+            cmd = {"prefix": "config rm", "section": words[2],
+                   "name": words[3]}
+        elif words == ["config", "dump"]:
+            cmd = {"prefix": "config dump"}
+        elif words[:2] == ["fs", "new"] and len(words) == 5:
+            cmd = {"prefix": "fs new", "name": words[2],
+                   "metadata_pool": words[3], "data_pool": words[4]}
+        elif words[:2] == ["fs", "rm"] and len(words) == 3:
+            cmd = {"prefix": "fs rm", "name": words[2]}
+        elif words == ["fs", "ls"]:
+            cmd = {"prefix": "fs ls"}
+        elif words == ["fs", "dump"]:
+            cmd = {"prefix": "fs dump"}
+        elif words == ["mgr", "dump"]:
+            cmd = {"prefix": "mgr dump"}
+        elif words == ["mgr", "fail"]:
+            cmd = {"prefix": "mgr fail"}
         if cmd is None:
             print(f"ceph: unknown command {' '.join(words)!r}",
                   file=sys.stderr)

@@ -49,7 +49,9 @@ class Cluster:
                                    keyring=self.keyring)
                          for _ in range(n_mons)]
         self.mons = [Monitor(failure_quorum=failure_quorum,
-                             auth=mon_auths[i], secure=secure)
+                             auth=mon_auths[i], secure=secure,
+                             data_dir=(f"{data_dir}/mon.{i}"
+                                       if data_dir else None))
                      for i in range(n_mons)]
         self.mon_addrs = [m.addr for m in self.mons]
         if n_mons > 1:

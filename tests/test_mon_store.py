@@ -1,0 +1,285 @@
+"""Durable monitor store + PaxosService family tests.
+
+Reference analogs: src/mon/MonitorDBStore.h:37 (every Paxos transaction
+persisted; mons restart with full state), src/mon/PaxosService.h and
+the AuthMonitor/ConfigMonitor/MDSMonitor/MgrMonitor services, and the
+qa mon-store recovery scenarios (kill and restart the full quorum;
+state survives)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.mon import Monitor
+from ceph_tpu.tools.vstart import Cluster
+
+
+def _mk_state(mon: Monitor) -> None:
+    """Mutate every PaxosService through the command surface."""
+    r, out = mon.handle_command({
+        "prefix": "osd erasure-code-profile set", "name": "p1",
+        "profile": {"plugin": "jerasure", "k": "2", "m": "1"}})
+    assert r == 0, out
+    # a pool needs OSDs in the crush tree for rule creation
+    for i in range(3):
+        mon.osdmap.add_osd(i, f"host{i}")
+    mon.osdmap.bump_epoch()
+    mon._propose_current()
+    r, out = mon.handle_command({
+        "prefix": "osd pool create", "name": "ecp", "type": "erasure",
+        "erasure_code_profile": "p1", "pg_num": 4})
+    assert r == 0, out
+    r, out = mon.handle_command({
+        "prefix": "auth get-or-create", "entity": "client.app",
+        "caps": "allow rw"})
+    assert r == 0, out
+    r, out = mon.handle_command({
+        "prefix": "config set", "section": "osd",
+        "name": "osd_max_backfills", "value": "7"})
+    assert r == 0, out
+    r, out = mon.handle_command({
+        "prefix": "osd pool create", "name": "meta", "pg_num": 4,
+        "size": 2})
+    assert r == 0, out
+    r, out = mon.handle_command({
+        "prefix": "fs new", "name": "fsx", "metadata_pool": "meta",
+        "data_pool": "meta"})
+    assert r == 0, out
+    r, out = mon.handle_command({
+        "prefix": "mds boot", "name": "a", "fs": "fsx"})
+    assert r == 0, out
+    r, out = mon.handle_command({"prefix": "mgr boot", "name": "mx"})
+    assert r == 0, out
+
+
+def _assert_state(mon: Monitor) -> None:
+    assert "p1" in mon.osdmap.ec_profiles
+    assert mon.osdmap.lookup_pool("ecp") is not None
+    assert mon.keyring.get("client.app") is not None
+    assert mon.keyring.caps["client.app"] == "allow rw"
+    assert mon.config_db["osd"]["osd_max_backfills"] == "7"
+    assert "fsx" in mon.fsmap["filesystems"]
+    assert mon.fsmap["filesystems"]["fsx"]["mds"]["a"]["state"] == \
+        "active"
+    assert mon.mgrmap["active"] == "mx"
+
+
+def test_standalone_mon_state_survives_restart(tmp_path):
+    """Kill a standalone mon; a fresh process (same data dir) restarts
+    with pools, EC profiles, auth entities, config, fsmap, mgrmap, and
+    the epoch history intact (MonitorDBStore contract)."""
+    d = str(tmp_path / "mon.0")
+    mon = Monitor(data_dir=d)
+    _mk_state(mon)
+    epoch_before = mon.osdmap.epoch
+    version_before = mon.paxos_version
+    mon.shutdown()
+
+    mon2 = Monitor(data_dir=d)
+    try:
+        _assert_state(mon2)
+        assert mon2.osdmap.epoch == epoch_before      # history, not reset
+        assert mon2.paxos_version == version_before
+        # and it keeps working: further mutations commit on top
+        r, _ = mon2.handle_command({
+            "prefix": "config set", "section": "global",
+            "name": "x", "value": "1"})
+        assert r == 0
+        assert mon2.paxos_version == version_before + 1
+    finally:
+        mon2.shutdown()
+
+
+def test_full_quorum_restart_survives(tmp_path):
+    """Kill ALL three mons; restart them on the same stores: quorum
+    reforms with every service's state intact and accepts mutations."""
+    dirs = [str(tmp_path / f"mon.{i}") for i in range(3)]
+    mons = [Monitor(data_dir=dirs[i]) for i in range(3)]
+    addrs = [m.addr for m in mons]
+    for i, m in enumerate(mons):
+        m.join(addrs, i)
+    deadline = time.time() + 10
+    while not any(m.is_leader for m in mons) and time.time() < deadline:
+        time.sleep(0.05)
+    leader = next(m for m in mons if m.is_leader)
+    _mk_state(leader)
+    # let commits reach the peons
+    deadline = time.time() + 5
+    while time.time() < deadline and not all(
+            m.paxos_version >= leader.paxos_version for m in mons):
+        time.sleep(0.05)
+    version = leader.paxos_version
+    for m in mons:
+        m.shutdown()
+
+    mons2 = [Monitor(data_dir=dirs[i]) for i in range(3)]
+    try:
+        addrs2 = [m.addr for m in mons2]
+        for i, m in enumerate(mons2):
+            m.join(addrs2, i)
+        deadline = time.time() + 10
+        while not any(m.is_leader for m in mons2) and \
+                time.time() < deadline:
+            time.sleep(0.05)
+        leader2 = next(m for m in mons2 if m.is_leader)
+        _assert_state(leader2)
+        assert leader2.paxos_version >= version
+        r, _ = leader2.handle_command({
+            "prefix": "auth get-or-create", "entity": "client.new"})
+        assert r == 0
+    finally:
+        for m in mons2:
+            m.shutdown()
+
+
+def test_lagging_mon_catches_up_from_quorum(tmp_path):
+    """A mon that was down while the others committed restarts from its
+    stale store and catches up through the collect phase."""
+    dirs = [str(tmp_path / f"mon.{i}") for i in range(3)]
+    mons = [Monitor(data_dir=dirs[i]) for i in range(3)]
+    addrs = [m.addr for m in mons]
+    for i, m in enumerate(mons):
+        m.join(addrs, i)
+    deadline = time.time() + 10
+    while not any(m.is_leader for m in mons) and time.time() < deadline:
+        time.sleep(0.05)
+    # rank 2 goes down; leader keeps committing
+    mons[2].shutdown()
+    leader = next(m for m in mons[:2] if m.is_leader)
+    _mk_state(leader)
+    # rank 2 comes back on its stale store, same address
+    back = Monitor(addr=addrs[2], data_dir=dirs[2])
+    mons[2] = back
+    back.join(addrs, 2)
+    assert back.paxos_version < leader.paxos_version   # stale at boot
+    # an election brings it up to date (leader collect -> commit flow);
+    # force one via the existing maintenance machinery
+    back.election.start()
+    deadline = time.time() + 10
+    try:
+        while time.time() < deadline and \
+                back.paxos_version < leader.paxos_version:
+            time.sleep(0.1)
+        _assert_state(back)
+    finally:
+        for m in mons:
+            m.shutdown()
+
+
+def test_cluster_data_survives_mon_quorum_restart(tmp_path):
+    """End-to-end: a cluster whose full mon set restarts keeps serving
+    — OSDs re-subscribe, the restored map still routes to the data."""
+    with Cluster(n_osds=4, data_dir=str(tmp_path)) as c:
+        client = c.client()
+        client.set_ec_profile("sp", {"plugin": "jerasure", "k": "2",
+                                     "m": "1", "stripe_unit": "1024"})
+        client.create_pool("spool", "erasure",
+                           erasure_code_profile="sp", pg_num=4)
+        io = client.open_ioctx("spool")
+        rng = np.random.default_rng(0)
+        blob = rng.integers(0, 256, 5000, dtype=np.uint8).tobytes()
+        io.write_full("obj", blob)
+
+        old = c.mons[0]
+        epoch = old.osdmap.epoch
+        old.shutdown()
+        new = Monitor(addr=old.addr,
+                      data_dir=f"{tmp_path}/mon.0")
+        c.mons[0] = c.mon = new
+        assert new.osdmap.epoch == epoch
+        assert new.osdmap.lookup_pool("spool") is not None
+        assert "sp" in new.osdmap.ec_profiles
+        # the restored mon keeps serving: reads still work and new
+        # writes commit through it
+        assert io.read("obj", len(blob)) == blob
+        blob2 = rng.integers(0, 256, 3000, dtype=np.uint8).tobytes()
+        io.write_full("obj2", blob2)
+        assert io.read("obj2", len(blob2)) == blob2
+
+
+def test_quorum_loss_rolls_back_uncommitted_mutation(tmp_path):
+    """An uncommitted local mutation (bumped epoch) must not survive
+    the quorum-loss rollback: force-adopting the committed value
+    restores the map even though its epoch is lower."""
+    mon = Monitor(data_dir=str(tmp_path / "m"))
+    try:
+        r, _ = mon.handle_command({
+            "prefix": "osd pool create", "name": "keep", "pg_num": 4,
+            "size": 1})
+        assert r == 0
+        committed = mon._committed_json
+        # locally mutate WITHOUT commit (as if propose failed mid-way)
+        mon.osdmap.create_pool("phantom", 1, size=1, pg_num=4,
+                               crush_rule=0)
+        mon.osdmap.bump_epoch()
+        assert mon.osdmap.lookup_pool("phantom") is not None
+        mon._adopt_value(committed, force=True)   # the rollback path
+        assert mon.osdmap.lookup_pool("phantom") is None
+        assert mon.osdmap.lookup_pool("keep") is not None
+    finally:
+        mon.shutdown()
+
+
+def test_mds_reboot_keeps_active(tmp_path):
+    """A restarting sole MDS re-takes active (idempotent boot); a
+    second MDS joining becomes standby."""
+    mon = Monitor(data_dir=str(tmp_path / "m"))
+    try:
+        mon.handle_command({"prefix": "osd pool create", "name": "mp",
+                            "pg_num": 4, "size": 1})
+        r, _ = mon.handle_command({
+            "prefix": "fs new", "name": "f", "metadata_pool": "mp",
+            "data_pool": "mp"})
+        assert r == 0
+        r, out = mon.handle_command({
+            "prefix": "mds boot", "name": "a", "fs": "f"})
+        assert out["state"] == "active"
+        r, out = mon.handle_command({
+            "prefix": "mds boot", "name": "a", "fs": "f"})   # restart
+        assert out["state"] == "active"                      # not demoted
+        r, out = mon.handle_command({
+            "prefix": "mds boot", "name": "b", "fs": "f"})
+        assert out["state"] == "standby"
+    finally:
+        mon.shutdown()
+
+
+def test_auth_surfaces_not_readable_with_readonly_caps():
+    """'auth get' returns secret keys, so it must NOT be in the
+    read-only command set a lease-holding peon serves to 'allow r'
+    credentials (privilege escalation otherwise)."""
+    from ceph_tpu.mon.monitor import READONLY_COMMANDS
+    assert "auth get" not in READONLY_COMMANDS
+    assert "auth ls" not in READONLY_COMMANDS
+    assert "auth get-or-create" not in READONLY_COMMANDS
+
+
+def test_auth_entity_replicates_to_peons(tmp_path):
+    """AuthMonitor behavior: an entity created at the leader is
+    readable from a peon's committed state."""
+    mons = [Monitor(data_dir=str(tmp_path / f"m{i}")) for i in range(3)]
+    addrs = [m.addr for m in mons]
+    try:
+        for i, m in enumerate(mons):
+            m.join(addrs, i)
+        deadline = time.time() + 10
+        while not any(m.is_leader for m in mons) and \
+                time.time() < deadline:
+            time.sleep(0.05)
+        leader = next(m for m in mons if m.is_leader)
+        r, out = leader.handle_command({
+            "prefix": "auth get-or-create", "entity": "client.rep",
+            "caps": "allow r"})
+        assert r == 0
+        peon = next(m for m in mons if not m.is_leader)
+        deadline = time.time() + 5
+        while time.time() < deadline and \
+                peon.keyring.get("client.rep") is None:
+            time.sleep(0.05)
+        assert peon.keyring.get("client.rep") == \
+            leader.keyring.get("client.rep")
+        assert peon.keyring.caps["client.rep"] == "allow r"
+    finally:
+        for m in mons:
+            m.shutdown()
