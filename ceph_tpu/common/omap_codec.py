@@ -1,0 +1,61 @@
+"""Omap payload framing for client<->OSD op payloads.
+
+The reference encodes omap kv maps with ceph::encode into the op's
+bufferlist (osd/osd_types wire maps consumed by the OMAP cases of
+PrimaryLogPG::do_osd_ops, PrimaryLogPG.cc:5643).  Here the equivalent
+is a minimal length-prefixed binary form shared by librados and the
+OSD: u32 count, then per entry u32 klen + key [+ u32 vlen + value].
+"""
+
+from __future__ import annotations
+
+import struct
+
+_U32 = struct.Struct("<I")
+
+
+def encode_kv(kv: dict[bytes, bytes]) -> bytes:
+    out = [_U32.pack(len(kv))]
+    for k, v in kv.items():
+        out.append(_U32.pack(len(k)))
+        out.append(k)
+        out.append(_U32.pack(len(v)))
+        out.append(v)
+    return b"".join(out)
+
+
+def decode_kv(buf: bytes, off: int = 0) -> tuple[dict[bytes, bytes], int]:
+    (n,) = _U32.unpack_from(buf, off)
+    off += 4
+    kv: dict[bytes, bytes] = {}
+    for _ in range(n):
+        (kl,) = _U32.unpack_from(buf, off)
+        off += 4
+        k = bytes(buf[off:off + kl])
+        off += kl
+        (vl,) = _U32.unpack_from(buf, off)
+        off += 4
+        kv[k] = bytes(buf[off:off + vl])
+        off += vl
+    return kv, off
+
+
+def encode_keys(keys) -> bytes:
+    keys = list(keys)
+    out = [_U32.pack(len(keys))]
+    for k in keys:
+        out.append(_U32.pack(len(k)))
+        out.append(bytes(k))
+    return b"".join(out)
+
+
+def decode_keys(buf: bytes, off: int = 0) -> tuple[list[bytes], int]:
+    (n,) = _U32.unpack_from(buf, off)
+    off += 4
+    keys: list[bytes] = []
+    for _ in range(n):
+        (kl,) = _U32.unpack_from(buf, off)
+        off += 4
+        keys.append(bytes(buf[off:off + kl]))
+        off += kl
+    return keys, off

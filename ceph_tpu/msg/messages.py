@@ -90,6 +90,8 @@ def txn_to_wire(txn: Transaction) -> tuple[list, bytes]:
             ops.append(["omaprm", g2j(op.oid), [put(k) for k in op.keys]])
         elif isinstance(op, os_.OpOmapClear):
             ops.append(["omapclear", g2j(op.oid)])
+        elif isinstance(op, os_.OpOmapSetHeader):
+            ops.append(["omaphdr", g2j(op.oid), put(op.data)])
         else:
             raise TypeError(f"cannot serialize {op!r}")
     return ops, bytes(blob)
@@ -134,6 +136,8 @@ def txn_from_wire(ops: list, blob: bytes) -> Transaction:
             t.omap_rmkeys(j2g(rec[1]), [get(k) for k in rec[2]])
         elif kind == "omapclear":
             t.omap_clear(j2g(rec[1]))
+        elif kind == "omaphdr":
+            t.omap_setheader(j2g(rec[1]), get(rec[2]))
         else:
             raise ValueError(f"unknown wire op {kind}")
     return t

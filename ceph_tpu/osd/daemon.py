@@ -522,6 +522,8 @@ class OSDDaemon:
         unreachable: set[int] = set()
         for pool in list(self.osdmap.pools.values()):
             for seed in range(pool.pg_num):
+                if self._hb_stop.is_set():   # daemon shut down mid-pass
+                    return
                 pgid = pg_t(pool.id, seed)
                 try:
                     up, acting, _, primary = \
@@ -736,6 +738,8 @@ class OSDDaemon:
                 for oj in self._remote_list(osd, spg, timeout=3.0):
                     names.add(M.hobj_from_json(oj))
         for oid in names:
+            if self._hb_stop.is_set():
+                return
             missing = []
             for s, osd in enumerate(acting):
                 if osd == CRUSH_ITEM_NONE or not self.osdmap.is_up(osd):
@@ -840,6 +844,8 @@ class OSDDaemon:
                 for oj in self._remote_list(osd, spg):
                     names.add(M.hobj_from_json(oj))
         for oid in names:
+            if self._hb_stop.is_set():
+                return
             goid = ghobject_t(oid, shard=NO_SHARD)
             src = None
             for osd in acting:
@@ -854,6 +860,8 @@ class OSDDaemon:
                 continue  # remote-source replication is via EC path
             data = self.store.read(self._cid(spg), goid)
             attrs = self.store.getattrs(self._cid(spg), goid)
+            omap = self.store.omap_get(self._cid(spg), goid)
+            omap_hdr = self.store.omap_get_header(self._cid(spg), goid)
             for osd in acting:
                 if osd == self.osd_id or not self.osdmap.is_up(osd):
                     continue
@@ -861,6 +869,10 @@ class OSDDaemon:
                 txn.write(goid, 0, data)
                 if attrs:
                     txn.setattrs(goid, attrs)
+                if omap:
+                    txn.omap_setkeys(goid, omap)
+                if omap_hdr:
+                    txn.omap_setheader(goid, omap_hdr)
                 self._push_shard_txn(osd, spg, txn)
 
     # -- shard-side ops (any OSD) ------------------------------------------
@@ -1155,7 +1167,8 @@ class OSDDaemon:
         return complete
 
     WRITE_OPS = {"write", "writefull", "truncate", "delete", "setxattr",
-                 "call", "notify", "watch", "unwatch"}
+                 "call", "notify", "watch", "unwatch",
+                 "omapsetkeys", "omaprmkeys", "omapclear", "omapsetheader"}
 
     @staticmethod
     def _caps_can_write(caps: str) -> bool:
@@ -1271,6 +1284,73 @@ class OSDDaemon:
                     txn.truncate(msg.oid, off_w + len(data_w))
                 for k, v in ctx._pending_attrs.items():
                     txn.setattr(msg.oid, k, v)
+            elif name.startswith("omap"):
+                # reference PrimaryLogPG.cc:5643 OMAP op cases; omap is
+                # replicated-pool-only (EC pools lack omap support in
+                # the reference too: pool SUPPORTS_OMAP flag)
+                if state.kind == "ec":
+                    result = -errno.EOPNOTSUPP
+                    break
+                from ..common import omap_codec as oc
+                cid = self._cid(spg_t(msg.pgid.pgid, NO_SHARD))
+                goid = ghobject_t(msg.oid, shard=NO_SHARD)
+                if name == "omapsetkeys":
+                    _, ln = op
+                    kv, _end = oc.decode_kv(msg.data[data_off:
+                                                     data_off + ln])
+                    data_off += ln
+                    txn.omap_setkeys(msg.oid, kv)
+                elif name == "omaprmkeys":
+                    _, ln = op
+                    keys, _end = oc.decode_keys(msg.data[data_off:
+                                                         data_off + ln])
+                    data_off += ln
+                    txn.omap_rmkeys(msg.oid, keys)
+                elif name == "omapclear":
+                    txn.omap_clear(msg.oid)
+                elif name == "omapsetheader":
+                    _, ln = op
+                    txn.omap_setheader(
+                        msg.oid, bytes(msg.data[data_off:data_off + ln]))
+                    data_off += ln
+                elif name in ("omapgetkeys", "omapgetvals"):
+                    _, saln, maxret = op
+                    (starts, _e) = oc.decode_keys(
+                        msg.data[data_off:data_off + saln])
+                    data_off += saln
+                    start_after = starts[0] if starts else None
+                    if not self._object_exists(state, msg.oid):
+                        result = -errno.ENOENT
+                        break
+                    omap = self.store.omap_get(cid, goid)
+                    ks = sorted(k for k in omap
+                                if start_after is None or k > start_after)
+                    if maxret > 0:
+                        ks = ks[:maxret]
+                    if name == "omapgetkeys":
+                        read_payload += oc.encode_keys(ks)
+                    else:
+                        read_payload += oc.encode_kv(
+                            {k: omap[k] for k in ks})
+                elif name == "omapgetvalsbykeys":
+                    _, ln = op
+                    keys, _e = oc.decode_keys(
+                        msg.data[data_off:data_off + ln])
+                    data_off += ln
+                    if not self._object_exists(state, msg.oid):
+                        result = -errno.ENOENT
+                        break
+                    omap = self.store.omap_get(cid, goid)
+                    read_payload += oc.encode_kv(
+                        {k: omap[k] for k in keys if k in omap})
+                elif name == "omapgetheader":
+                    if not self._object_exists(state, msg.oid):
+                        result = -errno.ENOENT
+                        break
+                    read_payload += self.store.omap_get_header(cid, goid)
+                else:
+                    result = -errno.EOPNOTSUPP
+                    break
             elif name == "watch":
                 _, cookie = op
                 key = (msg.pgid.pgid.pool, msg.oid.name)

@@ -52,6 +52,11 @@ class PGObjectOp:
     truncate_to: int | None = None
     delete: bool = False
     attrs: dict[str, bytes | None] = field(default_factory=dict)
+    # omap (replicated pools only — the reference rejects omap on EC
+    # pools via the SUPPORTS_OMAP pool flag, and so does the OSD op
+    # switch here).  Mutations keep their op-vector order: rm-then-set
+    # and set-then-clear must commit different final states.
+    omap_ops: list[tuple] = field(default_factory=list)
 
 
 class PGTransaction:
@@ -72,6 +77,18 @@ class PGTransaction:
 
     def setattr(self, oid: hobject_t, name: str, value: bytes | None) -> None:
         self.obj(oid).attrs[name] = value
+
+    def omap_setkeys(self, oid: hobject_t, kv: dict[bytes, bytes]) -> None:
+        self.obj(oid).omap_ops.append(("set", dict(kv)))
+
+    def omap_rmkeys(self, oid: hobject_t, keys) -> None:
+        self.obj(oid).omap_ops.append(("rm", list(keys)))
+
+    def omap_clear(self, oid: hobject_t) -> None:
+        self.obj(oid).omap_ops.append(("clear",))
+
+    def omap_setheader(self, oid: hobject_t, data: bytes) -> None:
+        self.obj(oid).omap_ops.append(("header", bytes(data)))
 
 
 # -- plan --------------------------------------------------------------------

@@ -97,6 +97,17 @@ class ReplicatedBackend:
                 t.setattrs(goid, sets)
             for k in (k for k, v in op.attrs.items() if v is None):
                 t.rmattr(goid, k)
+            if op.omap_ops:
+                t.touch(goid)   # omap mutation creates the object
+            for mop in op.omap_ops:
+                if mop[0] == "set":
+                    t.omap_setkeys(goid, mop[1])
+                elif mop[0] == "rm":
+                    t.omap_rmkeys(goid, mop[1])
+                elif mop[0] == "clear":
+                    t.omap_clear(goid)
+                elif mop[0] == "header":
+                    t.omap_setheader(goid, mop[1])
         return t
 
     def read(self, oid: hobject_t, off: int = 0,

@@ -152,6 +152,55 @@ class IoCtx:
     def setxattr(self, name: str, key: str, value: bytes) -> None:
         self._submit(name, [["setxattr", key, len(value)]], bytes(value))
 
+    # -- omap (reference rados_omap_* / ObjectWriteOperation omap ops;
+    #    OSD-side: the OMAP cases of PrimaryLogPG::do_osd_ops) ---------------
+
+    def omap_set(self, name: str, kv: dict[bytes, bytes]) -> None:
+        from ..common import omap_codec as oc
+        payload = oc.encode_kv(kv)
+        self._submit(name, [["omapsetkeys", len(payload)]], payload)
+
+    def omap_rm_keys(self, name: str, keys) -> None:
+        from ..common import omap_codec as oc
+        payload = oc.encode_keys(keys)
+        self._submit(name, [["omaprmkeys", len(payload)]], payload)
+
+    def omap_clear(self, name: str) -> None:
+        self._submit(name, [["omapclear"]])
+
+    def omap_set_header(self, name: str, data: bytes) -> None:
+        self._submit(name, [["omapsetheader", len(data)]], bytes(data))
+
+    def omap_get_header(self, name: str) -> bytes:
+        return self._submit(name, [["omapgetheader"]])
+
+    def omap_get_keys(self, name: str, start_after: bytes | None = None,
+                      max_return: int = 0) -> list[bytes]:
+        from ..common import omap_codec as oc
+        sa = oc.encode_keys([start_after] if start_after else [])
+        out = self._submit(
+            name, [["omapgetkeys", len(sa), max_return]], sa)
+        keys, _ = oc.decode_keys(out)
+        return keys
+
+    def omap_get_vals(self, name: str, start_after: bytes | None = None,
+                      max_return: int = 0) -> dict[bytes, bytes]:
+        from ..common import omap_codec as oc
+        sa = oc.encode_keys([start_after] if start_after else [])
+        out = self._submit(
+            name, [["omapgetvals", len(sa), max_return]], sa)
+        kv, _ = oc.decode_kv(out)
+        return kv
+
+    def omap_get_vals_by_keys(self, name: str,
+                              keys) -> dict[bytes, bytes]:
+        from ..common import omap_codec as oc
+        payload = oc.encode_keys(keys)
+        out = self._submit(
+            name, [["omapgetvalsbykeys", len(payload)]], payload)
+        kv, _ = oc.decode_kv(out)
+        return kv
+
     # -- cls / watch-notify --------------------------------------------------
 
     def execute(self, name: str, cls: str, method: str,

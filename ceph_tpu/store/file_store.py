@@ -198,6 +198,7 @@ class FileStore(ObjectStore):
             if path.exists():
                 path.unlink()
             b.rm(self._okey(cid, op.oid, "S"))
+            b.rm(self._okey(cid, op.oid, "H"))
             for k, _ in list(self.kv.iterate(
                     self._okey(cid, op.oid, "A"))):
                 b.rm(k)
@@ -219,10 +220,14 @@ class FileStore(ObjectStore):
                     src.read_bytes())
                 self._set_size(b, cid, op.dst,
                                self._size(cid, op.src) or 0)
-                for k, v in list(self.kv.iterate(
-                        self._okey(cid, op.src, "A"))):
-                    suffix = k.decode().rsplit("/", 1)[-1]
-                    b.set(self._okey(cid, op.dst, "A", suffix), v)
+                for kind in ("A", "O"):
+                    for k, v in list(self.kv.iterate(
+                            self._okey(cid, op.src, kind))):
+                        suffix = k.decode().rsplit("/", 1)[-1]
+                        b.set(self._okey(cid, op.dst, kind, suffix), v)
+                hdr = self.kv.get(self._okey(cid, op.src, "H"))
+                if hdr is not None:
+                    b.set(self._okey(cid, op.dst, "H"), hdr)
         elif isinstance(op, os_.OpRename):
             src = self._data_path(cid, op.src)
             if src.exists():
@@ -238,6 +243,10 @@ class FileStore(ObjectStore):
                         suffix = k.decode().rsplit("/", 1)[-1]
                         b.set(self._okey(cid, op.dst, kind, suffix), v)
                         b.rm(k)
+                hdr = self.kv.get(self._okey(cid, op.src, "H"))
+                if hdr is not None:
+                    b.set(self._okey(cid, op.dst, "H"), hdr)
+                    b.rm(self._okey(cid, op.src, "H"))
         elif isinstance(op, os_.OpOmapSet):
             for k, v in op.kv.items():
                 b.set(self._okey(cid, op.oid, "O", k.hex()), v)
@@ -248,6 +257,9 @@ class FileStore(ObjectStore):
             for k, _ in list(self.kv.iterate(
                     self._okey(cid, op.oid, "O"))):
                 b.rm(k)
+            b.rm(self._okey(cid, op.oid, "H"))
+        elif isinstance(op, os_.OpOmapSetHeader):
+            b.set(self._okey(cid, op.oid, "H"), op.data)
         else:
             raise TypeError(f"unknown transaction op {op!r}")
         if b.ops:
@@ -313,6 +325,9 @@ class FileStore(ObjectStore):
         for k, v in self.kv.iterate(prefix):
             out[bytes.fromhex(k.decode()[len(prefix.decode()):])] = v
         return out
+
+    def omap_get_header(self, cid, oid) -> bytes:
+        return self.kv.get(self._okey(cid, oid, "H")) or b""
 
     def list_objects(self, cid) -> list[ghobject_t]:
         out = []

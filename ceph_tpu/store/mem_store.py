@@ -23,10 +23,11 @@ class _Object:
     data: bytearray = field(default_factory=bytearray)
     xattrs: dict[str, bytes] = field(default_factory=dict)
     omap: dict[bytes, bytes] = field(default_factory=dict)
+    omap_header: bytes = b""
 
     def clone(self) -> "_Object":
         return _Object(bytearray(self.data), dict(self.xattrs),
-                       dict(self.omap))
+                       dict(self.omap), self.omap_header)
 
 
 class MemStore(ObjectStore):
@@ -125,7 +126,11 @@ class MemStore(ObjectStore):
             for k in op.keys:
                 o.omap.pop(k, None)
         elif isinstance(op, os_.OpOmapClear):
-            self._obj(coll, op.oid).omap.clear()
+            o = self._obj(coll, op.oid)
+            o.omap.clear()
+            o.omap_header = b""
+        elif isinstance(op, os_.OpOmapSetHeader):
+            self._obj(coll, op.oid).omap_header = op.data
         else:
             raise TypeError(f"unknown transaction op {op!r}")
 
@@ -167,6 +172,10 @@ class MemStore(ObjectStore):
     def omap_get(self, cid, oid) -> dict[bytes, bytes]:
         with self._lock:
             return dict(self._get(cid, oid).omap)
+
+    def omap_get_header(self, cid, oid) -> bytes:
+        with self._lock:
+            return self._get(cid, oid).omap_header
 
     def list_objects(self, cid) -> list[ghobject_t]:
         with self._lock:

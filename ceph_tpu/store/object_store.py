@@ -100,6 +100,12 @@ class OpOmapClear:
     oid: ghobject_t
 
 
+@dataclass
+class OpOmapSetHeader:
+    oid: ghobject_t
+    data: bytes
+
+
 class Transaction:
     """Ordered op batch + commit callbacks (reference Transaction.h)."""
 
@@ -128,6 +134,7 @@ class Transaction:
     def omap_setkeys(self, oid, kv): self.ops.append(OpOmapSet(oid, dict(kv)))
     def omap_rmkeys(self, oid, ks):  self.ops.append(OpOmapRmKeys(oid, list(ks)))
     def omap_clear(self, oid):       self.ops.append(OpOmapClear(oid))
+    def omap_setheader(self, oid, d): self.ops.append(OpOmapSetHeader(oid, bytes(d)))
 
     def register_on_commit(self, cb: Callable[[], None]) -> None:
         self.on_commit.append(cb)
@@ -185,6 +192,11 @@ class ObjectStore(abc.ABC):
 
     @abc.abstractmethod
     def omap_get(self, cid: spg_t, oid: ghobject_t) -> dict[bytes, bytes]: ...
+
+    def omap_get_header(self, cid: spg_t, oid: ghobject_t) -> bytes:
+        """Omap header blob (reference ObjectStore omap_get_header);
+        empty when never set."""
+        return b""
 
     @abc.abstractmethod
     def list_objects(self, cid: spg_t) -> list[ghobject_t]: ...

@@ -49,7 +49,10 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="rados")
     ap.add_argument("-m", "--mon", required=True, help="mon HOST:PORT")
     ap.add_argument("-p", "--pool", required=True)
-    ap.add_argument("command", choices=("put", "get", "rm", "bench"))
+    ap.add_argument("command", choices=(
+        "put", "get", "rm", "bench", "listomapkeys", "listomapvals",
+        "getomapval", "setomapval", "rmomapkey", "getomapheader",
+        "setomapheader"))
     ap.add_argument("args", nargs="*")
     ap.add_argument("-b", "--block-size", type=int, default=1 << 20)
     add_auth_args(ap)
@@ -79,6 +82,38 @@ def main(argv=None) -> int:
         elif args.command == "rm":
             io.remove(args.args[0])
             print(f"removed {args.args[0]}")
+        elif args.command == "listomapkeys":
+            for k in io.omap_get_keys(args.args[0]):
+                print(k.decode(errors="replace"))
+        elif args.command == "listomapvals":
+            for k, v in sorted(io.omap_get_vals(args.args[0]).items()):
+                print(f"{k.decode(errors='replace')}")
+                print(f"value ({len(v)} bytes) :")
+                print(v.decode(errors="replace"))
+        elif args.command == "getomapval":
+            name, key = args.args[:2]
+            kv = io.omap_get_vals_by_keys(name, [key.encode()])
+            if key.encode() not in kv:
+                print(f"error getting omap value {key}: no such key")
+                return 1
+            sys.stdout.flush()
+            sys.stdout.buffer.write(kv[key.encode()] + b"\n")
+            sys.stdout.buffer.flush()
+        elif args.command == "setomapval":
+            name, key, val = args.args[:3]
+            io.omap_set(name, {key.encode(): val.encode()})
+        elif args.command == "rmomapkey":
+            name, key = args.args[:2]
+            io.omap_rm_keys(name, [key.encode()])
+        elif args.command == "getomapheader":
+            hdr = io.omap_get_header(args.args[0])
+            print(f"header ({len(hdr)} bytes) :")
+            sys.stdout.flush()
+            sys.stdout.buffer.write(hdr + b"\n")
+            sys.stdout.buffer.flush()
+        elif args.command == "setomapheader":
+            name, val = args.args[:2]
+            io.omap_set_header(name, val.encode())
         elif args.command == "bench":
             seconds = float(args.args[0]) if args.args else 5.0
             payload = np.random.default_rng(0).integers(

@@ -1,0 +1,177 @@
+"""Client-facing omap op surface (reference: the CEPH_OSD_OP_OMAP*
+cases of PrimaryLogPG::do_osd_ops, PrimaryLogPG.cc:5643, surfaced via
+librados rados_omap_* and the `rados` CLI omap commands)."""
+
+import pytest
+
+from ceph_tpu.rados.client import RadosError
+from ceph_tpu.tools.vstart import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with Cluster(n_osds=3) as c:
+        client = c.client()
+        client.create_pool("omappool", "replicated", pg_num=4)
+        client.set_ec_profile("om_ec", {
+            "plugin": "jerasure", "k": "2", "m": "1",
+            "stripe_unit": "1024"})
+        client.create_pool("omapec", "erasure",
+                           erasure_code_profile="om_ec", pg_num=4)
+        yield c, client
+
+
+def test_omap_set_get_roundtrip(cluster):
+    _, client = cluster
+    io = client.open_ioctx("omappool")
+    kv = {b"alpha": b"1", b"beta": b"two", b"gamma": b"\x00\xffbin"}
+    io.omap_set("obj1", kv)
+    assert io.omap_get_vals("obj1") == kv
+    assert io.omap_get_keys("obj1") == sorted(kv)
+    # object was created by the omap write alone
+    assert io.read("obj1") == b""
+
+
+def test_omap_get_vals_by_keys_and_rm(cluster):
+    _, client = cluster
+    io = client.open_ioctx("omappool")
+    io.omap_set("obj2", {b"a": b"1", b"b": b"2", b"c": b"3"})
+    got = io.omap_get_vals_by_keys("obj2", [b"a", b"c", b"nope"])
+    assert got == {b"a": b"1", b"c": b"3"}
+    io.omap_rm_keys("obj2", [b"b"])
+    assert io.omap_get_keys("obj2") == [b"a", b"c"]
+
+
+def test_omap_pagination(cluster):
+    _, client = cluster
+    io = client.open_ioctx("omappool")
+    kv = {f"k{i:03d}".encode(): str(i).encode() for i in range(20)}
+    io.omap_set("obj3", kv)
+    page1 = io.omap_get_keys("obj3", max_return=7)
+    assert page1 == sorted(kv)[:7]
+    page2 = io.omap_get_keys("obj3", start_after=page1[-1], max_return=7)
+    assert page2 == sorted(kv)[7:14]
+    vals = io.omap_get_vals("obj3", start_after=b"k017")
+    assert vals == {b"k018": b"18", b"k019": b"19"}
+
+
+def test_omap_header(cluster):
+    _, client = cluster
+    io = client.open_ioctx("omappool")
+    io.omap_set_header("obj4", b"header-blob\x01\x02")
+    assert io.omap_get_header("obj4") == b"header-blob\x01\x02"
+    io.omap_set("obj4", {b"k": b"v"})     # kv doesn't clobber header
+    assert io.omap_get_header("obj4") == b"header-blob\x01\x02"
+
+
+def test_omap_clear(cluster):
+    _, client = cluster
+    io = client.open_ioctx("omappool")
+    io.omap_set("obj5", {b"x": b"1"})
+    io.omap_set_header("obj5", b"hh")
+    io.omap_clear("obj5")
+    assert io.omap_get_vals("obj5") == {}
+    assert io.omap_get_header("obj5") == b""
+
+
+def test_omap_enoent(cluster):
+    _, client = cluster
+    io = client.open_ioctx("omappool")
+    with pytest.raises(RadosError):
+        io.omap_get_keys("never-written")
+
+
+def test_omap_rejected_on_ec_pool(cluster):
+    """Reference EC pools lack omap support (SUPPORTS_OMAP pool flag);
+    the op must fail cleanly, not corrupt shards."""
+    _, client = cluster
+    io = client.open_ioctx("omapec")
+    with pytest.raises(RadosError):
+        io.omap_set("eobj", {b"k": b"v"})
+    with pytest.raises(RadosError):
+        io.omap_get_vals("eobj")
+
+
+def test_omap_survives_delete_recreate(cluster):
+    _, client = cluster
+    io = client.open_ioctx("omappool")
+    io.omap_set("obj6", {b"old": b"1"})
+    io.remove("obj6")
+    io.omap_set("obj6", {b"new": b"2"})
+    assert io.omap_get_vals("obj6") == {b"new": b"2"}
+
+
+def test_omap_op_vector_order(cluster):
+    """rm-then-set and set-then-clear in ONE op vector must apply in
+    order (the reference executes do_osd_ops sequentially)."""
+    _, client = cluster
+    from ceph_tpu.common import omap_codec as oc
+    io = client.open_ioctx("omappool")
+    io.omap_set("ord", {b"k": b"old"})
+    # [rm k, set k=new] -> final value must be "new"
+    rm = oc.encode_keys([b"k"])
+    st = oc.encode_kv({b"k": b"new"})
+    io._submit("ord", [["omaprmkeys", len(rm)],
+                       ["omapsetkeys", len(st)]], rm + st)
+    assert io.omap_get_vals("ord") == {b"k": b"new"}
+    # [set j=v, clear] -> final map must be empty
+    st2 = oc.encode_kv({b"j": b"v"})
+    io._submit("ord", [["omapsetkeys", len(st2)], ["omapclear"]], st2)
+    assert io.omap_get_vals("ord") == {}
+
+
+def test_omap_recovery_carries_omap():
+    """A rebuilt replica must receive omap keys and header, not just
+    data+xattrs (silent-loss regression guard)."""
+    import time
+
+    from ceph_tpu.osd.types import NO_SHARD, ghobject_t, hobject_t, spg_t
+    from ceph_tpu.store import create_store
+    with Cluster(n_osds=3, heartbeat_interval=0.25) as c:
+        client = c.client()
+        client.create_pool("omrec", "replicated", pg_num=4)
+        io = client.open_ioctx("omrec")
+        io.omap_set("robj", {b"k1": b"v1", b"k2": b"v2"})
+        io.omap_set_header("robj", b"hdr")
+        d = next(o for o in c.osds if o.messenger is not None)
+        pool = next(p for p in d.osdmap.pools.values()
+                    if p.name == "omrec")
+        pgid = d.osdmap.object_to_pg(pool.id, "robj")
+        _, acting, _, primary = d.osdmap.pg_to_up_acting_osds(pgid)
+        victim = next(o for o in acting if o != primary)
+        # lose the replica's disk entirely, then revive on a blank store
+        c.kill_osd(victim)
+        c.mark_osd_down(victim)
+        c.osds[victim].store = create_store("memstore", None)
+        c.osds[victim].store.mount()
+        c.revive_osd(victim)
+        goid = ghobject_t(hobject_t(pool=pool.id, name="robj"),
+                          shard=NO_SHARD)
+        cid = spg_t(pgid, NO_SHARD)
+        deadline = time.time() + 30
+        got = {}
+        while time.time() < deadline:
+            try:
+                got = c.osds[victim].store.omap_get(cid, goid)
+                if got:
+                    break
+            except KeyError:
+                pass
+            time.sleep(0.5)
+        assert got == {b"k1": b"v1", b"k2": b"v2"}, \
+            f"recovered replica lost omap: {got}"
+        assert c.osds[victim].store.omap_get_header(cid, goid) == b"hdr"
+
+
+def test_rados_cli_omap(cluster):
+    c, client = cluster
+    from ceph_tpu.tools import rados_cli
+    mon = f"{c.mon.addr[0]}:{c.mon.addr[1]}"
+    base = ["-m", mon, "-p", "omappool"]
+    assert rados_cli.main(base + ["setomapval", "cliobj", "k1", "v1"]) == 0
+    assert rados_cli.main(base + ["setomapval", "cliobj", "k2", "v2"]) == 0
+    assert rados_cli.main(base + ["listomapkeys", "cliobj"]) == 0
+    assert rados_cli.main(base + ["getomapval", "cliobj", "k1"]) == 0
+    assert rados_cli.main(base + ["rmomapkey", "cliobj", "k1"]) == 0
+    io = client.open_ioctx("omappool")
+    assert io.omap_get_keys("cliobj") == [b"k2"]
