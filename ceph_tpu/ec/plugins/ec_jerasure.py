@@ -13,9 +13,13 @@ vendored submodules.  Techniques:
   cauchy_good    - Cauchy matrix applied via its GF(2) bitmatrix expansion
                    (the CPU twin of the TPU kernel; reference :265,353 use
                    jerasure bitmatrix "schedules" — same math, dense here)
-  liberation / blaum_roth / liber8tion - accepted as aliases of
-                   cauchy_good (the reference's minimal-density bitmatrix
-                   codes; same interface contract, m<=2)
+  liberation / blaum_roth / liber8tion - real minimal-density RAID-6
+                   bitmatrix codes (XOR-only, w-bit packets) built in
+                   ceph_tpu/ec/bitmatrix.py (reference
+                   ErasureCodeJerasure.h:198-246; same m=2 and w
+                   parameter contracts).  liber8tion's search-derived
+                   matrix is a documented deviation from the jerasure
+                   table (see bitmatrix.py docstring).
 
 Default profile k=2 m=1 technique=reed_sol_van mirrors the reference
 plugin defaults (src/erasure-code/jerasure/ErasureCodePluginJerasure.cc).
@@ -45,16 +49,44 @@ class ErasureCodeJerasure(ErasureCode):
 
     technique = "reed_sol_van"
 
+    MINIMAL_DENSITY = ("liberation", "blaum_roth", "liber8tion")
+
     def __init__(self, technique: str = "reed_sol_van"):
         super().__init__()
         self.technique = technique
         self.matrix: np.ndarray | None = None      # (k+m, k) over GF(2^8)
         self.bitmatrix: np.ndarray | None = None   # (8(k+m), 8k) over GF(2)
+        self.w = 8                                 # word size (bitmatrix)
+        self._md_coding: np.ndarray | None = None  # (2w, kw) minimal-density
+        self._md_gen: np.ndarray | None = None
 
     # -- setup --------------------------------------------------------------
 
     def init(self, profile: Profile) -> None:
         self.k = profile.to_int("k", 2)
+        if self.k < 1:
+            raise ErasureCodeError(errno.EINVAL, f"k={self.k} invalid")
+        if self.technique in self.MINIMAL_DENSITY:
+            # reference defaults: m=2 mandatory, w=7 (liberation/
+            # blaum_roth) or 8 (liber8tion), packetsize accepted
+            # (ErasureCodeJerasure.cc:429-513)
+            self.m = profile.to_int("m", 2)
+            # defaults: liberation w=7 (prime, reference DEFAULT_W);
+            # blaum_roth w=6 (w+1=7 prime — the reference's legacy
+            # default 7 is not double-erasure decodable, see
+            # bitmatrix.blaum_roth_x); liber8tion w=8 fixed
+            self.w = profile.to_int(
+                "w", {"liber8tion": 8, "blaum_roth": 6}.get(
+                    self.technique, 7))
+            if self.m != 2:
+                raise ErasureCodeError(
+                    errno.EINVAL, f"{self.technique} requires m=2")
+            from .. import bitmatrix as bm
+            self._md_coding = bm.coding_matrix(self.technique,
+                                               self.k, self.w)
+            self._md_gen = bm.generator(self.technique, self.k, self.w)
+            super().init(profile)
+            return
         self.m = profile.to_int("m", 1)
         if self.k < 1 or self.m < 1:
             raise ErasureCodeError(errno.EINVAL, f"k={self.k} m={self.m} invalid")
@@ -63,14 +95,17 @@ class ErasureCodeJerasure(ErasureCode):
                 errno.EINVAL, f"k+m={self.k + self.m} > {gf.GF_SIZE}")
         if self.technique == "reed_sol_r6_op" and self.m != 2:
             raise ErasureCodeError(errno.EINVAL, "reed_sol_r6_op requires m=2")
-        if self.technique in ("liberation", "blaum_roth", "liber8tion") \
-                and self.m > 2:
-            raise ErasureCodeError(
-                errno.EINVAL, f"{self.technique} requires m<=2")
         self.matrix = self._build_matrix()
         if self._use_bitmatrix():
             self.bitmatrix = gf.expand_to_bitmatrix(self.matrix[self.k:])
         super().init(profile)
+
+    def get_alignment(self) -> int:
+        # minimal-density chunks are w packets: chunk_size % w == 0
+        from ..base import SIMD_ALIGN
+        if self.technique in self.MINIMAL_DENSITY:
+            return SIMD_ALIGN * self.w
+        return SIMD_ALIGN
 
     def _build_matrix(self) -> np.ndarray:
         if self.technique == "reed_sol_van":
@@ -81,16 +116,18 @@ class ErasureCodeJerasure(ErasureCode):
             g[self.k, :] = 1                                   # P: XOR
             g[self.k + 1, :] = [gf.gf_pow(2, j) for j in range(self.k)]  # Q
             return g
-        # cauchy_* and the minimal-density aliases
+        # cauchy_*
         return gf.cauchy_rs_matrix(self.k, self.m)
 
     def _use_bitmatrix(self) -> bool:
-        return self.technique in (
-            "cauchy_good", "liberation", "blaum_roth", "liber8tion")
+        return self.technique == "cauchy_good"
 
     # -- encode / decode ----------------------------------------------------
 
     def encode_chunks(self, chunks: np.ndarray) -> np.ndarray:
+        if self.technique in self.MINIMAL_DENSITY:
+            from .. import bitmatrix as bm
+            return bm.encode(self._md_coding, chunks, self.w)
         # The bitmatrix (kept for oracle tests of the TPU layout) computes
         # identical bytes; the LUT/native-SIMD path is the fast CPU route
         # even for the bitmatrix techniques.
@@ -103,6 +140,9 @@ class ErasureCodeJerasure(ErasureCode):
         generator G, invert the kxk matrix G[R], then erased chunk i =
         G[i] @ inv @ surviving-chunks (reference ErasureCodeJerasure.cc:195).
         """
+        if self.technique in self.MINIMAL_DENSITY:
+            from .. import bitmatrix as bm
+            return bm.decode(self._md_gen, dense, erasures, self.k, self.w)
         n = self.get_chunk_count()
         erased = set(erasures)
         survivors = [i for i in range(n) if i not in erased][: self.k]

@@ -195,8 +195,15 @@ class MessengerReplicaBackend(ReplicaBackend):
         self._pending: dict[int, tuple] = {}
 
     def rep_write(self, replica, txn, on_commit):
+        from ..crush.map import CRUSH_ITEM_NONE
         osd = self.acting[replica]
         spg = spg_t(self.pgid, NO_SHARD)
+        if osd == CRUSH_ITEM_NONE or not self.daemon.osdmap.is_up(osd):
+            # down/unplaced replica: not a write target this interval
+            # (recovery re-syncs it on return; min_size gating already
+            # guaranteed enough live copies before we got here)
+            on_commit(replica)
+            return
         if osd == self.daemon.osd_id:
             self.daemon.apply_shard_txn(spg, txn)
             on_commit(replica)
@@ -497,6 +504,9 @@ class OSDDaemon:
                             state.kind == "ec":
                         state.needs_peer = True
                     shards.acting = list(acting)
+                    if state.kind != "ec":
+                        # replicated width follows the acting set
+                        shards.n_replicas = len(shards.acting)
                 if primary != self.osd_id:
                     self.pgs.pop(pgid, None)  # primary moved away
         self.map_event.set()
@@ -869,6 +879,9 @@ class OSDDaemon:
                 txn.write(goid, 0, data)
                 if attrs:
                     txn.setattrs(goid, attrs)
+                # full omap sync: clear first so keys/headers deleted
+                # on the primary don't survive on a diverged replica
+                txn.omap_clear(goid)
                 if omap:
                     txn.omap_setkeys(goid, omap)
                 if omap_hdr:

@@ -73,7 +73,15 @@ class PGTransaction:
         self.obj(oid).truncate_to = size
 
     def delete(self, oid: hobject_t) -> None:
-        self.obj(oid).delete = True
+        # delete supersedes anything staged before it in this op
+        # vector; mutations staged AFTER it recreate the object
+        # (reference do_osd_ops applies the vector sequentially)
+        op = self.obj(oid)
+        op.writes.clear()
+        op.attrs.clear()
+        op.omap_ops.clear()
+        op.truncate_to = None
+        op.delete = True
 
     def setattr(self, oid: hobject_t, name: str, value: bytes | None) -> None:
         self.obj(oid).attrs[name] = value

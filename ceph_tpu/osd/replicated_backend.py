@@ -87,7 +87,10 @@ class ReplicatedBackend:
             goid = self._whole_oid(oid)
             if op.delete:
                 t.remove(goid)
-                continue
+                if not (op.writes or op.attrs or op.omap_ops or
+                        op.truncate_to is not None):
+                    continue
+                # mutations staged after the delete recreate the object
             for w in op.writes:
                 t.write(goid, w.offset, w.data)
             if op.truncate_to is not None:

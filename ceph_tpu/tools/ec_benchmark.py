@@ -238,10 +238,14 @@ def main(argv=None) -> int:
         print(f"plugin={args.plugin} k={codec.get_data_chunk_count()} "
               f"m={codec.get_coding_chunk_count()} size={args.size} "
               f"iterations={args.iterations}", file=sys.stderr)
-    if args.workload == "encode":
-        elapsed, iters = run_encode(codec, args)
-    else:
-        elapsed, iters = run_decode(codec, args)
+    try:
+        if args.workload == "encode":
+            elapsed, iters = run_encode(codec, args)
+        else:
+            elapsed, iters = run_decode(codec, args)
+    except ErasureCodeError as e:
+        print(f"ec_benchmark: {e}", file=sys.stderr)
+        return 1
     total_kib = iters * (args.size // 1024)
     print(f"{elapsed:.6f}\t{total_kib}")
     if args.gbps:

@@ -30,6 +30,11 @@ CASES = [
     ("jerasure", {"k": "4", "m": "2", "technique": "reed_sol_van"}),
     ("jerasure", {"k": "4", "m": "2", "technique": "cauchy_good"}),
     ("jerasure", {"k": "6", "m": "3", "technique": "reed_sol_van"}),
+    ("jerasure", {"k": "4", "m": "2", "technique": "liberation",
+                  "w": "7"}),
+    ("jerasure", {"k": "4", "m": "2", "technique": "blaum_roth",
+                  "w": "6"}),
+    ("jerasure", {"k": "4", "m": "2", "technique": "liber8tion"}),
     ("isa", {"k": "4", "m": "2"}),
     ("jax", {"k": "4", "m": "2", "technique": "cauchy"}),
     ("jax", {"k": "2", "m": "1", "technique": "cauchy"}),

@@ -120,6 +120,24 @@ def test_omap_op_vector_order(cluster):
     assert io.omap_get_vals("ord") == {}
 
 
+def test_omap_delete_then_set_one_vector(cluster):
+    """delete + omapsetkeys in ONE op vector recreates the object with
+    the keys (sequential do_osd_ops semantics), and mutations staged
+    BEFORE a delete die with it."""
+    _, client = cluster
+    from ceph_tpu.common import omap_codec as oc
+    io = client.open_ioctx("omappool")
+    io.omap_set("dv", {b"old": b"x"})
+    st = oc.encode_kv({b"fresh": b"y"})
+    io._submit("dv", [["delete"], ["omapsetkeys", len(st)]], st)
+    assert io.omap_get_vals("dv") == {b"fresh": b"y"}
+    # set-then-delete: the set is superseded; object is gone
+    st2 = oc.encode_kv({b"gone": b"z"})
+    io._submit("dv", [["omapsetkeys", len(st2)], ["delete"]], st2)
+    with pytest.raises(RadosError):
+        io.omap_get_keys("dv")
+
+
 def test_omap_recovery_carries_omap():
     """A rebuilt replica must receive omap keys and header, not just
     data+xattrs (silent-loss regression guard)."""
@@ -161,6 +179,22 @@ def test_omap_recovery_carries_omap():
         assert got == {b"k1": b"v1", b"k2": b"v2"}, \
             f"recovered replica lost omap: {got}"
         assert c.osds[victim].store.omap_get_header(cid, goid) == b"hdr"
+        # stale-key scenario: replica down while keys are removed on
+        # the primary; recovery must CLEAR before re-pushing, or the
+        # deleted keys resurrect on failover
+        c.kill_osd(victim)
+        c.mark_osd_down(victim)
+        io.omap_rm_keys("robj", [b"k2"])
+        io.omap_set("robj", {b"k3": b"v3"})
+        c.revive_osd(victim)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            got = c.osds[victim].store.omap_get(cid, goid)
+            if got == {b"k1": b"v1", b"k3": b"v3"}:
+                break
+            time.sleep(0.5)
+        assert got == {b"k1": b"v1", b"k3": b"v3"}, \
+            f"stale omap survived recovery: {got}"
 
 
 def test_rados_cli_omap(cluster):
