@@ -10,9 +10,17 @@ S3 dialect subset a librados-backed object store needs —
   DELETE /b             delete bucket (409 BucketNotEmpty)
   GET  /b?list-type=2   ListBucketResult v2 (prefix/start-after/max-keys)
   PUT  /b/k             put object (ETag = md5)
+  PUT  /b/k  + x-amz-copy-source
+                        server-side CopyObject (CopyObjectResult)
   GET  /b/k             get object
   HEAD /b/k             object metadata
   DELETE /b/k           delete object
+  POST /b/k?uploads     InitiateMultipartUpload (UploadId)
+  PUT  /b/k?partNumber=N&uploadId=U   UploadPart (ETag)
+  GET  /b/k?uploadId=U  ListParts
+  POST /b/k?uploadId=U  CompleteMultipartUpload (XML part list body)
+  DELETE /b/k?uploadId=U  AbortMultipartUpload
+  GET  /b?uploads       ListMultipartUploads
 
 Requests authenticate with AWS SigV4 (sigv4.py) unless the gateway is
 constructed without credentials.
@@ -74,9 +82,15 @@ class _Handler(BaseHTTPRequestHandler):
         body = self._read_body()
         if self.gw.creds is not None:
             try:
-                sigv4.verify_request(
+                auth = sigv4.verify_request(
                     self.command, parsed.path, parsed.query,
                     dict(self.headers), body, self.gw.creds)
+                if auth["streaming"]:
+                    # aws-chunked body: strip the framing after
+                    # verifying each chunk's rolling signature
+                    body = sigv4.decode_streaming_body(
+                        body, auth["secret"], auth["amzdate"],
+                        auth["datestamp"], auth["seed_sig"])
             except sigv4.SigError as e:
                 self._reply(403, _xml_error("SignatureDoesNotMatch",
                                             str(e)))
@@ -92,13 +106,13 @@ class _Handler(BaseHTTPRequestHandler):
             elif key is None or key == "":
                 self._bucket_op(bucket, query, body)
             else:
-                self._object_op(bucket, key, body)
+                self._object_op(bucket, key, query, body)
         except RGWError as e:
             self._fail(e)
         except Exception as e:  # noqa: BLE001 - surface as 500
             self._reply(500, _xml_error("InternalError", repr(e)))
 
-    do_GET = do_PUT = do_DELETE = do_HEAD = _route
+    do_GET = do_PUT = do_DELETE = do_HEAD = do_POST = _route
 
     # -- service -------------------------------------------------------------
 
@@ -132,6 +146,19 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     self._reply(404, _xml_error("NoSuchBucket", bucket))
                 return
+            if "uploads" in query:
+                rows = "".join(
+                    "<Upload>"
+                    f"<Key>{escape(k)}</Key>"
+                    f"<UploadId>{escape(uid)}</UploadId>"
+                    "</Upload>"
+                    for k, uid, _m in st.list_multipart_uploads(bucket))
+                self._reply(200, (
+                    '<?xml version="1.0" encoding="UTF-8"?>'
+                    "<ListMultipartUploadsResult>"
+                    f"<Bucket>{escape(bucket)}</Bucket>{rows}"
+                    "</ListMultipartUploadsResult>").encode())
+                return
             prefix = query.get("prefix", "")
             marker = query.get("start-after",
                                query.get("continuation-token", ""))
@@ -161,11 +188,76 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- objects -------------------------------------------------------------
 
-    def _object_op(self, bucket: str, key: str, body: bytes) -> None:
+    def _object_op(self, bucket: str, key: str, query: dict,
+                   body: bytes) -> None:
         st = self.gw.store
-        if self.command == "PUT":
+        if self.command == "PUT" and "partNumber" in query:
+            try:
+                part_num = int(query["partNumber"])
+            except ValueError:
+                raise RGWError(400, "InvalidArgument",
+                               f"partNumber {query['partNumber']!r}")
+            etag = st.upload_part(bucket, key, query.get("uploadId", ""),
+                                  part_num, body)
+            self._reply(200, extra={"ETag": f'"{etag}"'})
+        elif self.command == "PUT" and \
+                self.headers.get("x-amz-copy-source"):
+            src = urllib.parse.unquote(
+                self.headers["x-amz-copy-source"]).lstrip("/")
+            src_bucket, _, src_key = src.partition("/")
+            if not src_key:
+                raise RGWError(400, "InvalidArgument",
+                               "x-amz-copy-source must be /bucket/key")
+            out = st.copy_object(src_bucket, src_key, bucket, key)
+            import datetime
+            lm = datetime.datetime.fromtimestamp(
+                out["mtime"], datetime.timezone.utc).strftime(
+                "%Y-%m-%dT%H:%M:%S.000Z")
+            self._reply(200, (
+                '<?xml version="1.0" encoding="UTF-8"?>'
+                "<CopyObjectResult>"
+                f"<ETag>&quot;{out['etag']}&quot;</ETag>"
+                f"<LastModified>{lm}</LastModified>"
+                "</CopyObjectResult>").encode())
+        elif self.command == "PUT":
             etag = st.put_object(bucket, key, body)
             self._reply(200, extra={"ETag": f'"{etag}"'})
+        elif self.command == "POST" and "uploads" in query:
+            upload_id = st.init_multipart(bucket, key)
+            self._reply(200, (
+                '<?xml version="1.0" encoding="UTF-8"?>'
+                "<InitiateMultipartUploadResult>"
+                f"<Bucket>{escape(bucket)}</Bucket>"
+                f"<Key>{escape(key)}</Key>"
+                f"<UploadId>{upload_id}</UploadId>"
+                "</InitiateMultipartUploadResult>").encode())
+        elif self.command == "POST" and "uploadId" in query:
+            parts = _parse_complete_body(body)
+            etag = st.complete_multipart(bucket, key, query["uploadId"],
+                                         parts)
+            self._reply(200, (
+                '<?xml version="1.0" encoding="UTF-8"?>'
+                "<CompleteMultipartUploadResult>"
+                f"<Bucket>{escape(bucket)}</Bucket>"
+                f"<Key>{escape(key)}</Key>"
+                f"<ETag>&quot;{etag}&quot;</ETag>"
+                "</CompleteMultipartUploadResult>").encode())
+        elif self.command == "GET" and "uploadId" in query:
+            rows = "".join(
+                "<Part>"
+                f"<PartNumber>{num}</PartNumber>"
+                f"<ETag>&quot;{m['etag']}&quot;</ETag>"
+                f"<Size>{m['size']}</Size>"
+                "</Part>"
+                for num, m in st.list_parts(bucket, key,
+                                            query["uploadId"]))
+            self._reply(200, (
+                '<?xml version="1.0" encoding="UTF-8"?>'
+                "<ListPartsResult>"
+                f"<Bucket>{escape(bucket)}</Bucket>"
+                f"<Key>{escape(key)}</Key>"
+                f"<UploadId>{query['uploadId']}</UploadId>{rows}"
+                "</ListPartsResult>").encode())
         elif self.command == "GET":
             data, meta = st.get_object(bucket, key)
             self._reply(200, data, "application/octet-stream",
@@ -176,11 +268,43 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(meta["size"]))
             self.send_header("ETag", f'"{meta["etag"]}"')
             self.end_headers()
+        elif self.command == "DELETE" and "uploadId" in query:
+            st.abort_multipart(bucket, key, query["uploadId"])
+            self._reply(204)
         elif self.command == "DELETE":
             st.delete_object(bucket, key)
             self._reply(204)
         else:
             self._reply(405, _xml_error("MethodNotAllowed", self.command))
+
+
+def _parse_complete_body(body: bytes) -> list[tuple[int, str]]:
+    """CompleteMultipartUpload XML -> [(part_num, etag), ...]."""
+    import xml.etree.ElementTree as ET
+    try:
+        root = ET.fromstring(body.decode())
+    except Exception as e:  # noqa: BLE001
+        raise RGWError(400, "MalformedXML", str(e)) from e
+    parts = []
+    for part in root.iter():
+        if part.tag.rpartition("}")[2] != "Part":
+            continue
+        num = etag = None
+        for child in part:
+            tag = child.tag.rpartition("}")[2]
+            if tag == "PartNumber":
+                try:
+                    num = int(child.text)
+                except (TypeError, ValueError) as e:
+                    raise RGWError(400, "MalformedXML",
+                                   f"PartNumber {child.text!r}") from e
+            elif tag == "ETag":
+                etag = (child.text or "").strip().strip('"')
+        if num is None or etag is None:
+            raise RGWError(400, "MalformedXML",
+                           "Part needs PartNumber and ETag")
+        parts.append((num, etag))
+    return parts
 
 
 class S3Gateway:

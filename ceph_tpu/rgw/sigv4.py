@@ -4,8 +4,10 @@ Re-expresses the reference's SigV4 support (src/rgw/rgw_auth_s3.cc
 canonical request assembly + signing-key derivation) as the standard
 algorithm: both halves live here so the gateway verifies exactly what
 the test/CLI client signs.  Payloads are authenticated via the
-x-amz-content-sha256 header (UNSIGNED-PAYLOAD honored like the
-reference does for streaming clients).
+x-amz-content-sha256 header; STREAMING-AWS4-HMAC-SHA256-PAYLOAD
+(aws-chunked bodies, the default for large PUTs in real SDKs) is
+verified chunk-by-chunk against the rolling signature chain, matching
+the reference's AWSv4ComplSingle/AWSv4ComplMulti completers.
 """
 
 from __future__ import annotations
@@ -18,6 +20,7 @@ import urllib.parse
 ALGO = "AWS4-HMAC-SHA256"
 REGION = "default"
 SERVICE = "s3"
+STREAMING_PAYLOAD = "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"
 
 
 def _sha256(b: bytes) -> str:
@@ -74,9 +77,10 @@ def sign_request(method: str, path: str, query: str, headers: dict,
     hdrs = {k.lower(): v for k, v in headers.items()}
     hdrs["x-amz-date"] = amzdate
     hdrs["x-amz-content-sha256"] = payload_hash
-    signed = sorted({"host", "x-amz-date", "x-amz-content-sha256"} &
-                    set(hdrs) | {"x-amz-date", "x-amz-content-sha256",
-                                 "host"})
+    # sign host + every x-amz-* header present (the SDK convention —
+    # x-amz-copy-source etc. must be tamper-proof)
+    signed = sorted({"host"} |
+                    {k for k in hdrs if k.startswith("x-amz-")})
     creq = canonical_request(method, path, query, hdrs, signed,
                              payload_hash)
     sts = string_to_sign(amzdate, datestamp, creq)
@@ -96,10 +100,89 @@ class SigError(Exception):
     pass
 
 
+# -- aws-chunked streaming payloads ------------------------------------------
+
+def _chunk_sts(amzdate: str, datestamp: str, prev_sig: str,
+               data: bytes) -> str:
+    scope = f"{datestamp}/{REGION}/{SERVICE}/aws4_request"
+    return "\n".join([
+        f"{ALGO}-PAYLOAD", amzdate, scope, prev_sig,
+        _sha256(b""), _sha256(data)])
+
+
+def sign_chunk(secret: str, amzdate: str, datestamp: str,
+               prev_sig: str, data: bytes) -> str:
+    return hmac.new(signing_key(secret, datestamp),
+                    _chunk_sts(amzdate, datestamp, prev_sig,
+                               data).encode(),
+                    hashlib.sha256).hexdigest()
+
+
+def encode_streaming_body(payload: bytes, secret: str, amzdate: str,
+                          datestamp: str, seed_sig: str,
+                          chunk_size: int = 64 * 1024) -> bytes:
+    """Client side: wrap a payload in aws-chunked framing with a
+    signature chain seeded by the request signature."""
+    out = bytearray()
+    prev = seed_sig
+    offs = list(range(0, len(payload), chunk_size)) or [0]
+    for off in offs:
+        data = payload[off:off + chunk_size]
+        sig = sign_chunk(secret, amzdate, datestamp, prev, data)
+        out += (f"{len(data):x};chunk-signature={sig}\r\n").encode()
+        out += data + b"\r\n"
+        prev = sig
+    final = sign_chunk(secret, amzdate, datestamp, prev, b"")
+    out += (f"0;chunk-signature={final}\r\n\r\n").encode()
+    return bytes(out)
+
+
+def decode_streaming_body(body: bytes, secret: str, amzdate: str,
+                          datestamp: str, seed_sig: str) -> bytes:
+    """Server side: unwrap aws-chunked framing, verifying every chunk
+    signature against the rolling chain (reference AWSv4ComplMulti).
+    Raises SigError on any tamper or truncation."""
+    out = bytearray()
+    prev = seed_sig
+    pos = 0
+    saw_final = False
+    while pos < len(body):
+        nl = body.find(b"\r\n", pos)
+        if nl < 0:
+            raise SigError("truncated chunk header")
+        header = body[pos:nl].decode(errors="replace")
+        size_hex, _, sigpart = header.partition(";")
+        if not sigpart.startswith("chunk-signature="):
+            raise SigError("missing chunk-signature")
+        got_sig = sigpart[len("chunk-signature="):]
+        try:
+            size = int(size_hex, 16)
+        except ValueError as e:
+            raise SigError(f"bad chunk size {size_hex!r}") from e
+        data = body[nl + 2:nl + 2 + size]
+        if len(data) != size:
+            raise SigError("truncated chunk data")
+        want = sign_chunk(secret, amzdate, datestamp, prev, data)
+        if not hmac.compare_digest(got_sig, want):
+            raise SigError("chunk signature mismatch")
+        prev = got_sig
+        out += data
+        pos = nl + 2 + size + 2      # skip trailing \r\n
+        if size == 0:
+            saw_final = True
+            break
+    if not saw_final:
+        raise SigError("missing final zero-length chunk")
+    return bytes(out)
+
+
 def verify_request(method: str, path: str, query: str, headers: dict,
-                   payload: bytes, creds: dict[str, str]) -> str:
+                   payload: bytes, creds: dict[str, str]) -> dict:
     """Server side: validates the Authorization header against `creds`
-    (access_key -> secret); returns the authenticated access key."""
+    (access_key -> secret); returns the auth context — access_key plus,
+    for STREAMING-AWS4-HMAC-SHA256-PAYLOAD requests, what
+    decode_streaming_body needs (streaming=True, secret, amzdate,
+    datestamp, seed_sig)."""
     hdrs = {k.lower(): v for k, v in headers.items()}
     auth = hdrs.get("authorization", "")
     if not auth.startswith(ALGO):
@@ -117,6 +200,13 @@ def verify_request(method: str, path: str, query: str, headers: dict,
     secret = creds.get(access_key)
     if secret is None:
         raise SigError(f"unknown access key {access_key!r}")
+    # every x-amz-* header present must be signed (AWS SigV4 rule) —
+    # otherwise an injected unsigned header (e.g. x-amz-copy-source)
+    # changes gateway behavior while the signature still verifies
+    signed_set = set(signed)
+    for h in hdrs:
+        if h.startswith("x-amz-") and h not in signed_set:
+            raise SigError(f"header {h} present but not signed")
     amzdate = hdrs.get("x-amz-date", "")
     # freshness: a captured signed request must not replay forever
     # (reference rgw_auth_s3 enforces a 15-minute skew window)
@@ -132,7 +222,7 @@ def verify_request(method: str, path: str, query: str, headers: dict,
     if not amzdate.startswith(datestamp):
         raise SigError("x-amz-date does not match credential scope date")
     payload_hash = hdrs.get("x-amz-content-sha256", "UNSIGNED-PAYLOAD")
-    if payload_hash not in ("UNSIGNED-PAYLOAD",) and \
+    if payload_hash not in ("UNSIGNED-PAYLOAD", STREAMING_PAYLOAD) and \
             payload_hash != _sha256(payload):
         raise SigError("payload hash mismatch")
     creq = canonical_request(method, path, query, hdrs, signed,
@@ -142,4 +232,7 @@ def verify_request(method: str, path: str, query: str, headers: dict,
                     hashlib.sha256).hexdigest()
     if not hmac.compare_digest(got_sig, want):
         raise SigError("signature mismatch")
-    return access_key
+    return {"access_key": access_key,
+            "streaming": payload_hash == STREAMING_PAYLOAD,
+            "secret": secret, "amzdate": amzdate,
+            "datestamp": datestamp, "seed_sig": got_sig}

@@ -11,6 +11,13 @@ fidelity the S3 surface needs:
 - object data: one rados object per S3 object in the data pool, named
   with a length-prefixed bucket separator so keys may contain any
   character (reference rgw_obj raw-object naming)
+- multipart uploads (reference rgw_op.h:1716-1754 RGWInitMultipart /
+  RGWListMultipart / RGWCompleteMultipart / RGWAbortMultipart and the
+  RGWUploadPartInfo manifest model): each part is its own RADOS object
+  in the data pool; the completed S3 object's index entry carries a
+  parts manifest instead of data, and GET stitches the parts —
+  completing a 5 TB upload moves no data, exactly like the reference's
+  manifest-based RGWObjManifest.
 
 The data pool may be erasure-coded (pass an EC profile); the meta pool
 is replicated, matching the reference's constraint that index pools be
@@ -40,6 +47,13 @@ class RGWError(Exception):
 
 def _data_oid(bucket: str, key: str) -> str:
     return f"{len(bucket)}_{bucket}_{key}"
+
+
+def _part_oid(bucket: str, upload_id: str, part_num: int) -> str:
+    # distinct namespace from _data_oid (which always starts with a
+    # digit): a user key can never collide with a part object
+    # (reference uses the __multipart_ shadow-object namespace)
+    return f"mp_{len(bucket)}_{bucket}_{upload_id}.{part_num}"
 
 
 class RGWStore:
@@ -102,11 +116,18 @@ class RGWStore:
         count = int(self._cls(self.meta, f"index.{bucket}", "dir_count"))
         if count:
             raise RGWError(409, "BucketNotEmpty", bucket)
+        # in-flight multipart uploads also block deletion (S3
+        # semantics); otherwise their parts leak in the data pool and
+        # the upload record resurrects on bucket recreation
+        if self.list_multipart_uploads(bucket):
+            raise RGWError(409, "BucketNotEmpty",
+                           f"{bucket}: multipart uploads in progress")
         self._cls(self.meta, BUCKETS_OBJ, "dir_rm", {"key": bucket})
-        try:
-            self.meta.remove(f"index.{bucket}")
-        except RadosError:
-            pass
+        for obj in (f"index.{bucket}", f"uploads.{bucket}"):
+            try:
+                self.meta.remove(obj)
+            except RadosError:
+                pass
 
     def list_buckets(self) -> list[tuple[str, dict]]:
         out = json.loads(self._cls(self.meta, BUCKETS_OBJ, "dir_list",
@@ -122,12 +143,36 @@ class RGWStore:
     def put_object(self, bucket: str, key: str, body: bytes) -> str:
         """Returns the ETag (md5 hex, S3 semantics)."""
         self._require_bucket(bucket)
+        old_manifest = self._manifest_of(bucket, key)
         etag = hashlib.md5(body).hexdigest()
         self.data.write_full(_data_oid(bucket, key), body)
         self._cls(self.meta, f"index.{bucket}", "dir_add", {
             "key": key, "meta": {"size": len(body), "etag": etag,
                                  "mtime": time.time()}})
+        self._reap_manifest(bucket, old_manifest)
         return etag
+
+    def _manifest_of(self, bucket: str, key: str) -> dict | None:
+        """The parts manifest of an existing multipart object, or None."""
+        try:
+            raw = self._cls(self.meta, f"index.{bucket}", "dir_get",
+                            {"key": key})
+        except RadosError as e:
+            self._not_found(e)
+            return None
+        return json.loads(raw.decode()).get("multipart")
+
+    def _reap_manifest(self, bucket: str, manifest: dict | None) -> None:
+        """Remove the part objects an overwritten/deleted manifest
+        referenced (reference RGWRados gc of multipart parts)."""
+        if not manifest:
+            return
+        for num, _size in manifest["parts"]:
+            try:
+                self.data.remove(
+                    _part_oid(bucket, manifest["upload_id"], num))
+            except RadosError:
+                pass
 
     def head_object(self, bucket: str, key: str) -> dict:
         self._require_bucket(bucket)
@@ -141,19 +186,172 @@ class RGWStore:
 
     def get_object(self, bucket: str, key: str) -> tuple[bytes, dict]:
         meta = self.head_object(bucket, key)
+        manifest = meta.get("multipart")
+        if manifest:
+            # stitch parts in part-number order (reference RGWGetObj
+            # iterating the RGWObjManifest)
+            body = b"".join(
+                bytes(self.data.read(
+                    _part_oid(bucket, manifest["upload_id"], num), size))
+                for num, size in manifest["parts"])
+            return body, meta
         body = self.data.read(_data_oid(bucket, key), meta["size"])
         return body, meta
 
     def delete_object(self, bucket: str, key: str) -> None:
         self._require_bucket(bucket)
+        manifest = self._manifest_of(bucket, key)
         try:
             self._cls(self.meta, f"index.{bucket}", "dir_rm",
                       {"key": key})
         except RadosError as e:
             self._not_found(e)
             raise RGWError(404, "NoSuchKey", key) from e
+        if manifest:
+            self._reap_manifest(bucket, manifest)
+            return
         try:
             self.data.remove(_data_oid(bucket, key))
+        except RadosError:
+            pass
+
+    def copy_object(self, src_bucket: str, src_key: str,
+                    dst_bucket: str, dst_key: str) -> dict:
+        """Server-side copy (reference RGWCopyObj, rgw_op.h:1500s):
+        the client never sees the bytes.  A multipart source is
+        materialized into a plain destination object (the reference
+        copies manifests tail-first; one data object is the honest
+        equivalent at this scale)."""
+        body, _meta = self.get_object(src_bucket, src_key)
+        etag = self.put_object(dst_bucket, dst_key, bytes(body))
+        return {"etag": etag, "mtime": time.time()}
+
+    # -- multipart uploads (reference rgw_op.h:1716-1754) -------------------
+
+    def init_multipart(self, bucket: str, key: str) -> str:
+        self._require_bucket(bucket)
+        import os
+        upload_id = os.urandom(16).hex()
+        self._cls(self.meta, f"uploads.{bucket}", "dir_add", {
+            "key": f"{key}\x00{upload_id}",
+            "meta": {"key": key, "initiated": time.time()}})
+        self._cls(self.meta, f"parts.{bucket}.{upload_id}", "dir_init")
+        return upload_id
+
+    def _require_upload(self, bucket: str, key: str,
+                        upload_id: str) -> None:
+        try:
+            self._cls(self.meta, f"uploads.{bucket}", "dir_get",
+                      {"key": f"{key}\x00{upload_id}"})
+        except RadosError as e:
+            self._not_found(e)
+            raise RGWError(404, "NoSuchUpload", upload_id) from e
+
+    def upload_part(self, bucket: str, key: str, upload_id: str,
+                    part_num: int, body: bytes) -> str:
+        if not 1 <= part_num <= 10000:
+            raise RGWError(400, "InvalidArgument",
+                           f"partNumber {part_num} not in 1..10000")
+        self._require_upload(bucket, key, upload_id)
+        etag = hashlib.md5(body).hexdigest()
+        self.data.write_full(_part_oid(bucket, upload_id, part_num), body)
+        self._cls(self.meta, f"parts.{bucket}.{upload_id}", "dir_add", {
+            "key": f"{part_num:05d}",
+            "meta": {"size": len(body), "etag": etag,
+                     "mtime": time.time()}})
+        return etag
+
+    def list_parts(self, bucket: str, key: str, upload_id: str
+                   ) -> list[tuple[int, dict]]:
+        self._require_upload(bucket, key, upload_id)
+        out = json.loads(self._cls(
+            self.meta, f"parts.{bucket}.{upload_id}", "dir_list",
+            {"max": 10000}).decode())
+        return [(int(k), m) for k, m in out["entries"]]
+
+    def list_multipart_uploads(self, bucket: str
+                               ) -> list[tuple[str, str, dict]]:
+        self._require_bucket(bucket)
+        try:
+            out = json.loads(self._cls(
+                self.meta, f"uploads.{bucket}", "dir_list",
+                {"max": 10000}).decode())
+        except RadosError as e:
+            self._not_found(e)
+            return []
+        rows = []
+        for k, m in out["entries"]:
+            key, _, upload_id = k.rpartition("\x00")
+            rows.append((key, upload_id, m))
+        return rows
+
+    def complete_multipart(self, bucket: str, key: str, upload_id: str,
+                           parts: list[tuple[int, str]]) -> str:
+        """parts = [(part_num, etag), ...] from the client's
+        CompleteMultipartUpload body.  Validates against what was
+        uploaded (reference RGWCompleteMultipart::execute), writes the
+        manifest index entry, reaps the upload bookkeeping.  The
+        combined ETag is md5-of-binary-part-md5s + "-N" (S3
+        convention)."""
+        self._require_upload(bucket, key, upload_id)
+        if not parts:
+            raise RGWError(400, "MalformedXML", "no parts listed")
+        have = dict(self.list_parts(bucket, key, upload_id))
+        last = 0
+        md5cat = b""
+        manifest = []
+        total = 0
+        for num, etag in parts:
+            if num <= last:
+                raise RGWError(400, "InvalidPartOrder",
+                               f"part {num} after {last}")
+            last = num
+            meta = have.get(num)
+            if meta is None or meta["etag"] != etag.strip('"'):
+                raise RGWError(400, "InvalidPart",
+                               f"part {num} not uploaded or etag "
+                               f"mismatch")
+            md5cat += bytes.fromhex(meta["etag"])
+            manifest.append([num, meta["size"]])
+            total += meta["size"]
+        old_manifest = self._manifest_of(bucket, key)
+        etag = f"{hashlib.md5(md5cat).hexdigest()}-{len(parts)}"
+        self._cls(self.meta, f"index.{bucket}", "dir_add", {
+            "key": key, "meta": {
+                "size": total, "etag": etag, "mtime": time.time(),
+                "multipart": {"upload_id": upload_id,
+                              "parts": manifest}}})
+        self._reap_manifest(bucket, old_manifest)
+        # unreferenced parts (uploaded but not listed in the complete)
+        listed = {num for num, _ in parts}
+        for num in have:
+            if num not in listed:
+                try:
+                    self.data.remove(_part_oid(bucket, upload_id, num))
+                except RadosError:
+                    pass
+        self._rm_upload_bookkeeping(bucket, key, upload_id)
+        return etag
+
+    def abort_multipart(self, bucket: str, key: str,
+                        upload_id: str) -> None:
+        self._require_upload(bucket, key, upload_id)
+        for num, _meta in self.list_parts(bucket, key, upload_id):
+            try:
+                self.data.remove(_part_oid(bucket, upload_id, num))
+            except RadosError:
+                pass
+        self._rm_upload_bookkeeping(bucket, key, upload_id)
+
+    def _rm_upload_bookkeeping(self, bucket: str, key: str,
+                               upload_id: str) -> None:
+        try:
+            self._cls(self.meta, f"uploads.{bucket}", "dir_rm",
+                      {"key": f"{key}\x00{upload_id}"})
+        except RadosError:
+            pass
+        try:
+            self.meta.remove(f"parts.{bucket}.{upload_id}")
         except RadosError:
             pass
 
