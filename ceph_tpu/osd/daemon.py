@@ -1217,7 +1217,18 @@ class OSDDaemon:
         # handler would deadlock against the stripe (the reference
         # drops the obc lock around the ack wait too).
         if {op[0] for op in msg.ops} <= {"watch", "unwatch", "notify"}:
-            self._do_client_op(conn, msg, _t0)
+            if any(op[0] == "notify" for op in msg.ops):
+                # notify blocks on watcher acks; a watcher sharing the
+                # notifier's connection would ack on the very reader
+                # thread this handler is occupying — run async
+                # (reference: notifies complete via a Context, not
+                # inline in the dispatch thread)
+                threading.Thread(
+                    target=self._do_client_op, args=(conn, msg, _t0),
+                    daemon=True,
+                    name=f"osd.{self.osd_id}.notify").start()
+            else:
+                self._do_client_op(conn, msg, _t0)
             return
         key = (msg.pgid.pgid.pool, msg.oid.name)
         with self._obj_locks[hash(key) % len(self._obj_locks)]:
@@ -1364,6 +1375,22 @@ class OSDDaemon:
                 else:
                     result = -errno.EOPNOTSUPP
                     break
+            elif name == "listwatchers":
+                # reference CEPH_OSD_OP_LIST_WATCHERS (librados
+                # rados_watchers_list).  Disconnected watchers are
+                # FILTERED from the reply (a crashed lock owner must
+                # not look alive) but stay registered — a lossless
+                # session mid-reconnect gets its frames replayed on
+                # resume, and deregistering it here would break that
+                # delivery guarantee.
+                import json as _json
+                key = (msg.pgid.pgid.pool, msg.oid.name)
+                with self.pg_lock:
+                    live = sorted(
+                        ck for ck, c in
+                        self.watchers.get(key, {}).items()
+                        if c.is_connected())
+                read_payload += _json.dumps(live).encode()
             elif name == "watch":
                 _, cookie = op
                 key = (msg.pgid.pgid.pool, msg.oid.name)
@@ -1580,7 +1607,13 @@ class OSDDaemon:
                    payload: bytes, timeout: float = 5.0) -> None:
         key = (pgid.pool, oid.name)
         with self.pg_lock:
-            targets = dict(self.watchers.get(key, {}))
+            # skip (but keep registered) disconnected watchers: waiting
+            # the full ack timeout on a dead connection stalls every
+            # notify, but a lossless session mid-reconnect must keep
+            # its registration for replay delivery
+            targets = {ck: c for ck, c in
+                       self.watchers.get(key, {}).items()
+                       if c.is_connected()}
             self._notify_id += 1
             nid = self._notify_id
         if not targets:

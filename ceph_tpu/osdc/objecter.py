@@ -216,9 +216,15 @@ class Objecter:
     def watch(self, pool_id: int, name: str, callback) -> int:
         """Register a watch; returns the cookie (reference
         IoCtxImpl::watch via linger ops)."""
+        # globally unique cookie: per-client counters collide across
+        # processes (two fresh clients would both register cookie 1 on
+        # one object, clobbering each other's watch — fatal for
+        # watcher-liveness protocols like the RBD exclusive lock)
+        import os as _os
         with self._lock:
-            self._next_cookie += 1
-            cookie = self._next_cookie
+            cookie = int.from_bytes(_os.urandom(8), "little") | 1
+            while cookie in self._watch_cbs:
+                cookie = int.from_bytes(_os.urandom(8), "little") | 1
             self._watch_cbs[cookie] = callback
         self.op_submit(pool_id, name, [["watch", cookie]])
         return cookie

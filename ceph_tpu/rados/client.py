@@ -213,6 +213,11 @@ class IoCtx:
         """callback(oid_name, payload) fires on each notify."""
         return self.client.objecter.watch(self.pool_id, name, callback)
 
+    def list_watchers(self, name: str) -> list[int]:
+        """Cookies of live watchers (reference rados_watchers_list)."""
+        import json
+        return json.loads(self._submit(name, [["listwatchers"]]).decode())
+
     def unwatch(self, name: str, cookie: int) -> None:
         self.client.objecter.unwatch(self.pool_id, name, cookie)
 

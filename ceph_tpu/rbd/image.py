@@ -89,12 +89,17 @@ class RBD:
 
     def remove(self, name: str) -> None:
         img = Image(self.io, name)
-        nblocks = -(-img.size() // img.block_size)
+        nblocks = img._nblocks()
         for b in range(nblocks):
             try:
                 self.io.remove(_data(name, b))
             except RadosError:
                 pass
+        from .object_map import _map_oid
+        try:
+            self.io.remove(_map_oid(name))
+        except RadosError:
+            pass
         self.io.remove(_header(name))
         self._dir_rm(name)
 
@@ -114,10 +119,19 @@ def _legacy_snap_data(name: str, snap: str, block: int) -> str:
 
 
 class Image:
-    """Open image handle (reference ImageCtx + Image API)."""
+    """Open image handle (reference ImageCtx + Image API).
+
+    exclusive=True acquires the RBD exclusive lock on open (reference
+    librbd/ExclusiveLock.h over cls_lock) and maintains the object map
+    (reference ObjectMap.h): required for safe concurrent access — two
+    lockless writers on one image corrupt it, exactly like the
+    reference with the exclusive-lock feature disabled.  steal=True
+    fences a live previous owner (its handle raises ESHUTDOWN on every
+    later mutation)."""
 
     def __init__(self, ioctx: IoCtx, name: str,
-                 journaling: bool = False):
+                 journaling: bool = False, exclusive: bool = False,
+                 steal: bool = False):
         # private IoCtx: the image's SnapContext/read-snap must not
         # leak onto other users of the caller's ioctx
         self.io = IoCtx(ioctx.client, ioctx.pool_id, ioctx.pool_name)
@@ -136,6 +150,21 @@ class Image:
         self._read_snap_id = 0
         self._legacy_read: str | None = None
         self._present_blocks: set[int] = set()   # copyup probe cache
+        # exclusive lock + object map ride a snapc-free ioctx (their
+        # objects must not be COW-cloned by image snapshots; the
+        # reference keeps per-snap object maps — head-only here)
+        self._lock = None
+        self._omap = None
+        self._closed = False
+        self._lockless_checked = False
+        if exclusive:
+            from .exclusive_lock import ExclusiveLock
+            from .object_map import ObjectMap
+            aux_io = IoCtx(ioctx.client, ioctx.pool_id, ioctx.pool_name)
+            self._lock = ExclusiveLock(aux_io, _header(name), name)
+            self._lock.acquire(steal=steal)
+            self._omap = ObjectMap(aux_io, name, self._nblocks())
+            self._omap.load(self._probe_block)
         # journaling image feature (reference librbd journaling):
         # mutations are recorded write-ahead for rbd-mirror replay.
         # The journal rides a snapc-FREE ioctx (its objects must not be
@@ -147,6 +176,88 @@ class Image:
             self._journal = Journal(
                 IoCtx(ioctx.client, ioctx.pool_id, ioctx.pool_name),
                 name)
+
+    def _nblocks(self) -> int:
+        return -(-self.size() // self.block_size)
+
+    def _probe_block(self, block: int) -> bool:
+        try:
+            self.io.read(_data(self.name, block), 1, snap=0)
+            return True
+        except RadosError as e:
+            if e.errno != errno.ENOENT:
+                raise
+            return False
+
+    def _live_omap(self):
+        """The object map, but only while this handle legitimately
+        owns it: a fenced handle consulting its stale map would
+        fabricate zeros for blocks the new owner wrote."""
+        if self._omap is None or self._lock is None:
+            return None
+        return self._omap if (self._lock.acquired and
+                              not self._lock.lost) else None
+
+    def _writable(self) -> None:
+        """Mutation gate.  Exclusive handles: closed or fenced fail.
+        Lockless handles (legacy clients): refused while a LIVE owner
+        holds the lock (the reference blocks lockless writes when the
+        exclusive-lock feature is on), and their first write flags the
+        object map invalid so the next owner rebuilds instead of
+        trusting stale state (reference FLAG_OBJECT_MAP_INVALID).
+        The lock-presence probe runs once per handle — a lock taken
+        AFTER this handle's first write is not seen, a documented gap
+        vs the reference's dynamic lock acquisition."""
+        if self._closed:
+            raise RadosError(errno.EBADF,
+                             f"image {self.name}: handle closed")
+        if self._lock is not None:
+            self._lock.check()
+            if not self._lock.acquired:
+                self._lock.acquire()
+            return
+        if self._lockless_checked:
+            return
+        from .exclusive_lock import ExclusiveLock
+        from .object_map import invalidate
+        aux = IoCtx(self.io.client, self.io.pool_id, self.io.pool_name)
+        probe = ExclusiveLock(aux, _header(self.name), self.name)
+        if probe.lockers() and aux.list_watchers(_header(self.name)):
+            raise RadosError(
+                errno.EBUSY,
+                f"image {self.name} is exclusively locked; open with "
+                f"exclusive=True")
+        invalidate(aux, self.name)
+        self._lockless_checked = True
+
+    def close(self) -> None:
+        self._closed = True
+        if self._lock is not None:
+            self._lock.release()
+
+    def __enter__(self) -> "Image":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def du(self) -> int:
+        """Bytes of allocated blocks (reference `rbd du` fast-diff):
+        O(1) from the object map under the lock, probe otherwise."""
+        omap = self._live_omap()
+        if omap is not None:
+            return omap.used_bytes(self.block_size)
+        return sum(self.block_size for b in range(self._nblocks())
+                   if self._probe_block(b))
+
+    def lock_owners(self) -> list[str]:
+        from .exclusive_lock import ExclusiveLock
+        lk = self._lock
+        if lk is None:
+            aux = IoCtx(self.io.client, self.io.pool_id,
+                        self.io.pool_name)
+            lk = ExclusiveLock(aux, _header(self.name), self.name)
+        return sorted(lk.lockers())
 
     @property
     def block_size(self) -> int:
@@ -175,7 +286,16 @@ class Image:
     def _read_block(self, block: int, boff: int, run: int) -> bytes:
         """One block's bytes at this image's read context, falling
         through to the parent for never-written clone blocks."""
+        # head reads under the lock skip the OSD round-trip for blocks
+        # the object map knows are absent (reference ObjectMap-aware
+        # ObjectReadRequest)
+        omap = self._live_omap()
+        skip_probe = (omap is not None and self._read_snap_id == 0
+                      and self._legacy_read is None and
+                      not omap.object_may_exist(block))
         try:
+            if skip_probe:
+                raise RadosError(errno.ENOENT, "object map: absent")
             if self._legacy_read is not None:
                 piece = self.io.read(
                     _legacy_snap_data(self.name, self._legacy_read,
@@ -198,6 +318,7 @@ class Image:
     def write(self, offset: int, data: bytes) -> int:
         if offset + len(data) > self.size():
             raise RadosError(errno.EINVAL, "write past end of image")
+        self._writable()
         if self._journal is not None:
             self._journal.append({"op": "write", "offset": offset},
                                  bytes(data))
@@ -208,6 +329,8 @@ class Image:
             run = min(bs - boff, len(data) - pos)
             if run < bs:
                 self._copyup(block)
+            if self._omap is not None:
+                self._omap.ensure_exists(block)   # write-ahead
             self.io.write(_data(self.name, block),
                           data[pos:pos + run], offset=boff)
             pos += run
@@ -222,15 +345,21 @@ class Image:
             return
         if block in self._present_blocks:
             return
-        try:
-            self.io.read(_data(self.name, block), 1)
-            self._present_blocks.add(block)
-            return                      # child block already exists
-        except RadosError as e:
-            if e.errno != errno.ENOENT:
-                raise
+        omap = self._live_omap()
+        if omap is not None and not omap.object_may_exist(block):
+            pass                        # map says absent: skip probe
+        else:
+            try:
+                self.io.read(_data(self.name, block), 1)
+                self._present_blocks.add(block)
+                return                  # child block already exists
+            except RadosError as e:
+                if e.errno != errno.ENOENT:
+                    raise
         content = parent._read_block(block, 0, self.block_size)
         if content.rstrip(b"\0"):
+            if self._omap is not None:
+                self._omap.ensure_exists(block)
             self.io.write_full(_data(self.name, block), content)
         self._present_blocks.add(block)
 
@@ -247,9 +376,10 @@ class Image:
         return bytes(out)
 
     def resize(self, new_size: int) -> None:
+        self._writable()
         if self._journal is not None:
             self._journal.append({"op": "resize", "size": new_size})
-        old_blocks = -(-self.size() // self.block_size)
+        old_blocks = self._nblocks()
         new_blocks = -(-new_size // self.block_size)
         for b in range(new_blocks, old_blocks):
             try:
@@ -259,12 +389,15 @@ class Image:
             self._present_blocks.discard(b)
         self._header["size"] = new_size
         self._save_header()
+        if self._omap is not None:
+            self._omap.resize(new_blocks)
 
     # -- snapshots (rados selfmanaged COW) -----------------------------------
 
     def snap_create(self, snap: str) -> None:
         if snap in self._header["snaps"]:
             raise RadosError(errno.EEXIST, f"snap {snap} exists")
+        self._writable()
         if self._journal is not None:
             self._journal.append({"op": "snap_create", "snap": snap})
         snapid = self.io.selfmanaged_snap_create()
@@ -292,6 +425,7 @@ class Image:
             self._legacy_read = None
 
     def snap_rollback(self, snap: str) -> None:
+        self._writable()
         if snap in self._legacy_snaps:
             snapid = None
         elif snap in self._header["snap_ids"]:
@@ -299,7 +433,7 @@ class Image:
         else:
             raise RadosError(errno.ENOENT, f"no snap {snap}")
         bs = self.block_size
-        nblocks = -(-self.size() // bs)
+        nblocks = self._nblocks()
         for b in range(nblocks):
             try:
                 if snapid is None:
@@ -313,6 +447,8 @@ class Image:
                     raise
                 data = b""
             if data.rstrip(b"\0"):
+                if self._omap is not None:
+                    self._omap.ensure_exists(b)
                 self.io.write(_data(self.name, b),
                               data.ljust(bs, b"\0")[:bs], offset=0)
             else:
@@ -320,13 +456,16 @@ class Image:
                     self.io.remove(_data(self.name, b))
                 except RadosError:
                     pass
+                if self._omap is not None:
+                    self._omap.mark_removed(b)
                 self._present_blocks.discard(b)
 
     def snap_remove(self, snap: str) -> None:
+        self._writable()
         if self._journal is not None:
             self._journal.append({"op": "snap_remove", "snap": snap})
         if snap in self._legacy_snaps:
-            nblocks = -(-self.size() // self.block_size)
+            nblocks = self._nblocks()
             for b in range(nblocks):
                 try:
                     self.io.remove(_legacy_snap_data(self.name, snap, b))
@@ -355,8 +494,8 @@ class Image:
         parent = self._get_parent()
         if parent is None:
             return
-        nblocks = -(-self.size() // self.block_size)
-        for b in range(nblocks):
+        self._writable()
+        for b in range(self._nblocks()):
             self._copyup(b)
         self._header["parent"] = None
         self._parent = None

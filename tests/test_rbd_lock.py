@@ -1,0 +1,214 @@
+"""RBD exclusive lock + object map (reference librbd/ExclusiveLock.h,
+ObjectMap.h, cls/lock): single-writer enforcement, steal fencing,
+dead-owner break, object-map-backed du and copyup."""
+
+import errno
+
+import pytest
+
+from ceph_tpu.rados.client import RadosError
+from ceph_tpu.rbd import RBD, Image
+from ceph_tpu.rbd.exclusive_lock import LockLost
+from ceph_tpu.tools.vstart import Cluster
+
+MB = 1 << 20
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with Cluster(n_osds=3) as c:
+        client = c.client()
+        client.create_pool("rbdlk", "replicated", pg_num=4)
+        yield c, client
+
+
+def _io(cluster):
+    _, client = cluster
+    return client.open_ioctx("rbdlk")
+
+
+def test_second_writer_blocked(cluster):
+    io = _io(cluster)
+    RBD(io).create("img1", 8 * MB, order=20)
+    img1 = Image(io, "img1", exclusive=True)
+    img1.write(0, b"owner-one")
+    with pytest.raises(RadosError) as ei:
+        Image(io, "img1", exclusive=True)
+    assert ei.value.errno == errno.EBUSY
+    assert len(img1.lock_owners()) == 1
+    img1.close()
+    # after release a new writer gets the lock
+    img2 = Image(io, "img1", exclusive=True)
+    img2.write(0, b"owner-two")
+    img2.close()
+
+
+def test_steal_fences_old_owner(cluster):
+    io = _io(cluster)
+    RBD(io).create("img2", 8 * MB, order=20)
+    old = Image(io, "img2", exclusive=True)
+    old.write(0, b"A" * 4096)
+    thief = Image(io, "img2", exclusive=True, steal=True)
+    thief.write(4096, b"B" * 4096)
+    # the fenced handle must refuse every further mutation
+    with pytest.raises(LockLost):
+        old.write(8192, b"C" * 4096)
+    with pytest.raises(LockLost):
+        old.resize(4 * MB)
+    with pytest.raises(LockLost):
+        old.snap_create("s")
+    # no interleaved corruption: thief's view is consistent
+    assert thief.read(0, 8192) == b"A" * 4096 + b"B" * 4096
+    thief.close()
+
+
+def test_dead_owner_lock_broken(cluster):
+    c, _ = cluster
+    # the owner uses its OWN rados client; shutting it down severs the
+    # watch, which is how a contender detects owner death
+    owner_client = c.client()
+    oio = owner_client.open_ioctx("rbdlk")
+    RBD(oio).create("img3", 8 * MB, order=20)
+    owner = Image(oio, "img3", exclusive=True)
+    owner.write(0, b"last words")
+    owner_client.shutdown()            # crash: no unlock, no unwatch
+    io = _io(cluster)
+    successor = Image(io, "img3", exclusive=True)   # breaks dead lock
+    assert successor.read(0, 10) == b"last words"
+    successor.write(0, b"new owner!")
+    successor.close()
+
+
+def test_object_map_du_and_persistence(cluster):
+    io = _io(cluster)
+    RBD(io).create("img4", 16 * MB, order=20)   # 16 blocks of 1 MiB
+    img = Image(io, "img4", exclusive=True)
+    assert img.du() == 0
+    img.write(0, b"x" * MB)               # block 0
+    img.write(5 * MB, b"y" * 100)         # block 5
+    assert img.du() == 2 * MB
+    img.close()
+    # map persists: a fresh handle loads it without probing
+    img = Image(io, "img4", exclusive=True)
+    assert img.du() == 2 * MB
+    assert img.read(0, 4) == b"xxxx"
+    assert img.read(5 * MB, 4) == b"yyyy"
+    assert img.read(9 * MB, 4) == b"\0" * 4   # map says absent
+    # shrink drops blocks from the map
+    img.resize(4 * MB)
+    assert img.du() == MB
+    img.close()
+
+
+def test_lockless_write_invalidates_map(cluster):
+    io = _io(cluster)
+    RBD(io).create("img5", 8 * MB, order=20)
+    img = Image(io, "img5", exclusive=True)
+    img.write(0, b"z" * MB)
+    assert img.du() == MB
+    img.close()
+    # a lockless writer appears (legacy client): map must not be
+    # trusted afterwards
+    lockless = Image(io, "img5")
+    lockless.write(3 * MB, b"w" * MB)
+    # next lock owner rebuilds by probing and sees both blocks
+    img = Image(io, "img5", exclusive=True)
+    assert img.du() == 2 * MB
+    assert img.read(3 * MB, 4) == b"wwww"
+    img.close()
+
+
+def test_object_map_with_clone_copyup(cluster):
+    io = _io(cluster)
+    RBD(io).create("parent1", 8 * MB, order=20)
+    pimg = Image(io, "parent1")
+    pimg.write(0, b"P" * MB)
+    pimg.snap_create("base")
+    RBD(io).clone("parent1", "base", "child1")
+    child = Image(io, "child1", exclusive=True)
+    # partial write to parent-backed block triggers copyup; map
+    # records the block
+    child.write(100, b"c" * 10)
+    assert child.du() == MB
+    got = child.read(0, 200)
+    assert got[:100] == b"P" * 100
+    assert got[100:110] == b"c" * 10
+    child.close()
+
+
+def test_cross_client_lock_respected(cluster):
+    """Two SEPARATE rados clients (fresh watch-cookie spaces): the
+    second must see the first as a live owner — a per-client cookie
+    counter would collide and let it break the lock."""
+    c, _ = cluster
+    client_a, client_b = c.client(), c.client()
+    try:
+        io_a = client_a.open_ioctx("rbdlk")
+        io_b = client_b.open_ioctx("rbdlk")
+        RBD(io_a).create("imgx", 8 * MB, order=20)
+        owner = Image(io_a, "imgx", exclusive=True)
+        owner.write(0, b"mine")
+        with pytest.raises(RadosError) as ei:
+            Image(io_b, "imgx", exclusive=True)
+        assert ei.value.errno == errno.EBUSY
+        # owner is NOT fenced: it can still write
+        owner.write(4, b"still")
+        owner.close()
+    finally:
+        client_a.shutdown()
+        client_b.shutdown()
+
+
+def test_lockless_write_blocked_by_live_owner(cluster):
+    io = _io(cluster)
+    RBD(io).create("img7", 8 * MB, order=20)
+    owner = Image(io, "img7", exclusive=True)
+    owner.write(0, b"locked")
+    lockless = Image(io, "img7")
+    with pytest.raises(RadosError) as ei:
+        lockless.write(MB, b"intruder")
+    assert ei.value.errno == errno.EBUSY
+    owner.close()
+
+
+def test_closed_handle_rejects_writes(cluster):
+    io = _io(cluster)
+    RBD(io).create("img8", 8 * MB, order=20)
+    img = Image(io, "img8", exclusive=True)
+    img.write(0, b"before")
+    img.close()
+    with pytest.raises(RadosError) as ei:
+        img.write(0, b"after close")
+    assert ei.value.errno == errno.EBADF
+    # and the lock is actually free for the next opener
+    nxt = Image(io, "img8", exclusive=True)
+    nxt.write(0, b"next owner")
+    nxt.close()
+
+
+def test_fenced_reads_bypass_stale_map(cluster):
+    """A fenced handle must not serve zeros from its stale object map
+    for blocks the thief wrote."""
+    io = _io(cluster)
+    RBD(io).create("img9", 8 * MB, order=20)
+    old = Image(io, "img9", exclusive=True)    # map: all absent
+    thief = Image(io, "img9", exclusive=True, steal=True)
+    thief.write(2 * MB, b"T" * 16)
+    assert old.read(2 * MB, 16) == b"T" * 16   # probes, no stale map
+    thief.close()
+
+
+def test_fenced_handle_cannot_corrupt_journal(cluster):
+    """Journaled image: the fenced owner's append must not land."""
+    io = _io(cluster)
+    RBD(io).create("img6", 8 * MB, order=20)
+    old = Image(io, "img6", exclusive=True, journaling=True)
+    old.write(0, b"ok")
+    thief = Image(io, "img6", exclusive=True, steal=True,
+                  journaling=True)
+    with pytest.raises(LockLost):
+        old.write(0, b"evil")
+    entries = thief._journal.entries_after(-1)
+    ops = [e[1]["op"] for e in entries]
+    assert ops.count("write") == 1     # only the pre-steal write
+    thief.close()
