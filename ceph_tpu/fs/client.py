@@ -5,16 +5,27 @@ surface a filesystem consumer needs: mount, open/create, pread/pwrite
 with block striping straight to the data pool (the MDS never sees file
 bytes — reference file I/O goes client->OSD under caps), mkdir,
 readdir, rename, unlink, rmdir, stat, truncate.
+
+Capabilities (reference client cap handling, reduced): open() asks the
+MDS for caps.  A sole opener gets "rwc" — the "c" cap is the right to
+cache stat results (dentry-lease role) and defer the size/mtime
+writeback to close().  When another client opens the same inode the
+MDS revokes "c": this client flushes dirty attrs immediately, drops
+its stat cache, and acks — after which every write is written through
+(attr flush per write) so contenders observe each other.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 
 from ..msg import Messenger
 from ..msg import messages as M
 from .mds import data_oid
+
+LEASE_TTL = 5.0      # stat-cache lifetime under the "c" cap
 
 
 class FSError(Exception):
@@ -27,15 +38,20 @@ class CephFS:
     def __init__(self, mon_addr, mds_addr, auth=None,
                  secure: bool = False, name: str = "fsclient"):
         from ..rados import RadosClient
+        self.client_id = f"{name}.{os.urandom(6).hex()}"
         self.messenger = Messenger(name, auth=auth, secure=secure)
         self.messenger.add_dispatcher(self._dispatch)
         self.mds_conn = self.messenger.connect(tuple(mds_addr))
         self._lock = threading.Lock()
         self._tid = 0
         self._waiters: dict[int, dict] = {}
+        self._caps: dict[int, str] = {}              # ino -> caps held
+        self._files: dict[int, list] = {}            # ino -> open Files
+        self._stat_cache: dict[str, tuple] = {}      # path -> (ent, exp)
+        self.revokes_seen = 0      # observability (tests/metrics)
         self.rados = RadosClient(mon_addr, name, auth=auth,
                                  secure=secure).connect()
-        info = self._req("mount", {})
+        info = self._req("mount", {"client": self.client_id})
         self.block_size = info["block_size"]
         self.data = self.rados.open_ioctx(info["data_pool"])
 
@@ -52,6 +68,38 @@ class CephFS:
             if w is not None:
                 w["reply"] = msg
                 w["event"].set()
+        elif isinstance(msg, M.MClientCaps) and msg.op == "revoke":
+            # flush + ack on a worker: this runs on the mds_conn reader
+            # thread, and the flush's own RPC reply must be readable
+            threading.Thread(target=self._handle_revoke, args=(msg,),
+                             daemon=True, name="fs-cap-revoke").start()
+
+    def _handle_revoke(self, msg: M.MClientCaps) -> None:
+        """MDS took our cache cap: write back dirty state, drop caches,
+        ack with the reduced cap set (reference Client::handle_caps
+        CEPH_CAP_OP_REVOKE)."""
+        self.revokes_seen += 1
+        with self._lock:
+            self._caps[msg.ino] = msg.caps
+            files = list(self._files.get(msg.ino, ()))
+            self._stat_cache = {p: v for p, v in
+                                self._stat_cache.items()
+                                if v[0].get("ino") != msg.ino}
+        flush = {"ino": msg.ino, "seq": msg.seq, "caps": msg.caps,
+                 "client": self.client_id}
+        dirty = [f for f in files if f._dirty]
+        if dirty:
+            # several handles on one inode: the file's logical size is
+            # the furthest any handle wrote
+            flush["path"] = dirty[0].path
+            flush["size"] = max(f.size for f in dirty)
+            flush["mtime"] = time.time()
+        try:
+            self._req("cap_flush", flush)
+        except FSError:
+            return   # MDS drops our caps on timeout; keep _dirty set
+        for f in dirty:
+            f._dirty = False
 
     def _req(self, op: str, args: dict, timeout: float = 30.0) -> dict:
         with self._lock:
@@ -71,7 +119,21 @@ class CephFS:
     # -- namespace -----------------------------------------------------------
 
     def stat(self, path: str) -> dict:
-        return self._req("stat", {"path": path})["ent"]
+        norm = "/" + "/".join(p for p in path.split("/") if p)
+        with self._lock:
+            hit = self._stat_cache.get(norm)
+            if hit is not None and hit[1] > time.time():
+                return dict(hit[0])
+        ent = self._req("stat", {"path": path})["ent"]
+        # cache only under the "c" cap, RE-checked under the lock at
+        # insert time: a revoke landing between the RPC and here has
+        # already purged the cache and must not be undone by a stale
+        # re-insert
+        with self._lock:
+            if "c" in self._caps.get(ent.get("ino"), ""):
+                self._stat_cache[norm] = (dict(ent),
+                                          time.time() + LEASE_TTL)
+        return ent
 
     def mkdir(self, path: str) -> None:
         self._req("mkdir", {"path": path})
@@ -101,15 +163,18 @@ class CephFS:
     # -- file I/O ------------------------------------------------------------
 
     def open(self, path: str, mode: str = "r") -> "File":
-        if "w" in mode or "a" in mode:
-            ent = self._req("create", {"path": path})["ent"]
-        else:
-            # "r" and "r+" require the file to exist (POSIX)
-            ent = self.stat(path)
-            from .mds import S_IFDIR
-            if ent["mode"] & S_IFDIR:
-                raise FSError(21, path)   # EISDIR
+        writing = "w" in mode or "a" in mode or "+" in mode
+        # POSIX fopen: w/w+/a/a+ create; r/r+ require existence
+        out = self._req("open", {
+            "path": path, "client": self.client_id,
+            "want": "rw" if writing else "r",
+            "create": "w" in mode or "a" in mode})
+        ent, caps = out["ent"], out.get("caps", "")
+        with self._lock:
+            self._caps[ent["ino"]] = caps
         f = File(self, path, ent)
+        with self._lock:
+            self._files.setdefault(ent["ino"], []).append(f)
         if "w" in mode and ent.get("size", 0):
             f.truncate(0)
         if "a" in mode:
@@ -152,6 +217,10 @@ class File:
             off += n
         self.size = max(self.size, offset + len(data))
         self._dirty = True
+        # without the "c" cap another client holds caps on this inode:
+        # write attrs through so it observes our size promptly
+        if "c" not in self.fs._caps.get(self.ino, ""):
+            self.flush()
         return len(data)
 
     def pread(self, length: int, offset: int) -> bytes:
@@ -220,9 +289,25 @@ class File:
                                      "size": self.size,
                                      "mtime": time.time()})
             self._dirty = False
+            with self.fs._lock:
+                self.fs._stat_cache.pop(
+                    "/" + "/".join(p for p in self.path.split("/")
+                                   if p), None)
 
     def close(self) -> None:
         self.flush()
+        with self.fs._lock:
+            files = self.fs._files.get(self.ino, [])
+            if self in files:
+                files.remove(self)
+            last = not files
+        if last:
+            try:
+                self.fs._req("cap_release", {
+                    "ino": self.ino, "client": self.fs.client_id})
+            except FSError:
+                pass
+            self.fs._caps.pop(self.ino, None)
 
     def __enter__(self) -> "File":
         return self

@@ -17,6 +17,18 @@ src/mds/Server.cc):
   recall).
 - Inode numbers come from a persisted allocator object (reference
   InoTable).
+- Multi-step mutations (mkdir/unlink/rmdir/rename) journal a redo
+  INTENT to the MDLog before touching directory objects and replay it
+  on restart (reference MDLog + journal/; see mdlog.py) — an MDS
+  killed mid-rename comes back to a consistent namespace.
+- File capabilities (reference Locker.h / Capability.h, reduced):
+  open grants caps per (ino, session) — "r"ead, "w"rite, and "c"ache
+  (the right to cache attrs and buffer size updates client-side,
+  granted only to a SOLE opener).  A second client opening the same
+  inode triggers revocation: the MDS sends MClientCaps revoke, the
+  holder flushes dirty size/mtime and acks (op cap_flush), and only
+  then is the new open granted — so contending clients always observe
+  each other's flushed state.
 
 Locking: one MDS owns the namespace (reference single-active rank 0);
 per-directory striped locks serialize multi-step ops (rename takes
@@ -66,6 +78,15 @@ class MDSDaemon:
         self._locks = [threading.Lock() for _ in range(64)]
         self._ino_lock = threading.Lock()
         self._mkfs()
+        from .mdlog import MDLog
+        self.mdlog = MDLog(self.meta)
+        self._replay_mdlog()
+        # capability state (reference Locker/Capability, reduced)
+        self._sessions: dict[str, object] = {}      # client id -> conn
+        self._caps: dict[int, dict[str, str]] = {}  # ino -> {sess: caps}
+        self._cap_lock = threading.Lock()
+        self._cap_seq = 0
+        self._flush_waiters: dict[tuple, threading.Event] = {}
         self.messenger = Messenger("mds", auth=auth, secure=secure)
         self.messenger.add_dispatcher(self._dispatch)
         self.addr = self.messenger.bind(addr)
@@ -201,7 +222,7 @@ class MDSDaemon:
         if not isinstance(msg, M.MClientRequest):
             return
         try:
-            out = self._handle(msg.op, msg.args)
+            out = self._handle(msg.op, msg.args, conn)
             conn.send_message(M.MClientReply(msg.tid, 0, out))
         except _Err as e:
             conn.send_message(M.MClientReply(msg.tid, -e.errno,
@@ -213,10 +234,25 @@ class MDSDaemon:
             conn.send_message(M.MClientReply(
                 msg.tid, -errno.EIO, {"error": repr(e)}))
 
-    def _handle(self, op: str, a: dict) -> dict:
+    def _handle(self, op: str, a: dict, conn=None) -> dict:
         if op == "mount":
+            sess = a.get("client")
+            if sess:
+                with self._cap_lock:
+                    self._sessions[sess] = conn
             return {"block_size": self.block_size,
                     "data_pool": DATA_POOL, "root": ROOT_INO}
+        if op == "open":
+            return self._handle_open(a)
+        if op == "cap_flush":
+            return self._handle_cap_flush(a)
+        if op == "cap_release":
+            with self._cap_lock:
+                holders = self._caps.get(a["ino"], {})
+                holders.pop(a.get("client", ""), None)
+                if not holders:
+                    self._caps.pop(a["ino"], None)
+            return {}
         if op == "stat":
             _, ent = self._resolve(a["path"])
             return {"ent": ent}
@@ -226,10 +262,13 @@ class MDSDaemon:
                 if self._dget(dino, name) is not None:
                     raise _Err(errno.EEXIST, a["path"])
                 ino = self._alloc_ino()
+                ent = {"ino": ino, "mode": S_IFDIR | 0o755, "size": 0,
+                       "mtime": time.time()}
+                seq = self.mdlog.append({"op": "mkdir", "dino": dino,
+                                         "name": name, "ent": ent})
                 self.meta.execute(f"dir.{ino:x}", "rgw", "dir_init", b"")
-                self._dset(dino, name, {
-                    "ino": ino, "mode": S_IFDIR | 0o755, "size": 0,
-                    "mtime": time.time()})
+                self._dset(dino, name, ent)
+                self.mdlog.mark_done(seq)
             return {"ino": ino}
         if op == "create":
             dino, name = self._split(a["path"])
@@ -272,8 +311,11 @@ class MDSDaemon:
                     raise _Err(errno.ENOENT, a["path"])
                 if ent["mode"] & S_IFDIR:
                     raise _Err(errno.EISDIR, a["path"])
+                seq = self.mdlog.append({"op": "unlink", "dino": dino,
+                                         "name": name, "ent": ent})
                 self._drm(dino, name)
             self._purge_data(ent)
+            self.mdlog.mark_done(seq)
             return {}
         if op == "rmdir":
             dino, name = self._split(a["path"])
@@ -296,11 +338,15 @@ class MDSDaemon:
                         raise _Err(errno.ENOTDIR, a["path"])
                     if self._dcount(cur["ino"]) > 0:
                         raise _Err(errno.ENOTEMPTY, a["path"])
+                    seq = self.mdlog.append({
+                        "op": "rmdir", "dino": dino, "name": name,
+                        "ino": cur["ino"]})
                     self._drm(dino, name)
                     try:
                         self.meta.remove(f"dir.{cur['ino']:x}")
                     except RadosError:
                         pass
+                    self.mdlog.mark_done(seq)
                 return {}
             raise _Err(errno.EAGAIN, a["path"])
         if op == "rename":
@@ -321,14 +367,153 @@ class MDSDaemon:
                         raise _Err(errno.EISDIR, a["dst"])
                     if existing["ino"] != ent["ino"]:
                         replaced = existing
+                seq = self.mdlog.append({
+                    "op": "rename", "sdino": sdino, "sname": sname,
+                    "ddino": ddino, "dname": dname, "ent": ent,
+                    "replaced": replaced})
                 self._dset(ddino, dname, ent)
                 self._drm(sdino, sname)
             if replaced is not None:
                 # the displaced file's inode lost its last link: purge
                 # its data like unlink would (reference purge queue)
                 self._purge_data(replaced)
+            self.mdlog.mark_done(seq)
             return {}
         raise _Err(errno.EOPNOTSUPP, op)
+
+    # -- capabilities (reference Locker::issue_caps / revoke) ---------------
+
+    def _handle_open(self, a: dict) -> dict:
+        """Open with caps: create if asked, then grant "rwc" to a sole
+        opener or shared "rw" (revoking other holders' cache cap
+        first, waiting for their flush ack)."""
+        sess = a.get("client", "")
+        want = a.get("want", "r")
+        dino, name = self._split(a["path"])
+        with self._dir_lock(dino):
+            ent = self._dget(dino, name)
+            if ent is None:
+                if not a.get("create"):
+                    raise _Err(errno.ENOENT, a["path"])
+                ino = self._alloc_ino()
+                ent = {"ino": ino, "mode": S_IFREG | 0o644, "size": 0,
+                       "mtime": time.time()}
+                seq = self.mdlog.append({"op": "create", "dino": dino,
+                                         "name": name, "ent": ent})
+                self._dset(dino, name, ent)
+                self.mdlog.mark_done(seq)
+            elif ent["mode"] & S_IFDIR:
+                raise _Err(errno.EISDIR, a["path"])
+            elif a.get("excl"):
+                raise _Err(errno.EEXIST, a["path"])
+        ino = ent["ino"]
+        # grant outside the dir lock: revocation blocks on other
+        # clients' acks
+        to_revoke: list[tuple] = []
+        with self._cap_lock:
+            holders = self._caps.setdefault(ino, {})
+            others = [s for s in holders if s != sess]
+            grant = want + ("c" if not others else "")
+            for s in others:
+                if "c" in holders[s]:
+                    # drop the cache right: holder must flush first
+                    self._cap_seq += 1
+                    to_revoke.append((s, holders[s].replace("c", ""),
+                                      self._cap_seq))
+            holders[sess] = grant
+        for s, newcaps, seq in to_revoke:
+            self._revoke(s, ino, newcaps, seq)
+        # re-read: the flush may have updated size/mtime
+        ent = self._dget(dino, name) or ent
+        return {"ent": ent, "caps": grant}
+
+    def _revoke(self, sess: str, ino: int, newcaps: str,
+                seq: int, timeout: float = 10.0) -> None:
+        with self._cap_lock:
+            conn = self._sessions.get(sess)
+        if conn is None:
+            with self._cap_lock:
+                self._caps.get(ino, {}).pop(sess, None)
+            return
+        ev = threading.Event()
+        self._flush_waiters[(sess, ino, seq)] = ev
+        try:
+            conn.send_message(M.MClientCaps("revoke", ino, newcaps, seq))
+        except Exception:  # noqa: BLE001 - dead session
+            self._flush_waiters.pop((sess, ino, seq), None)
+            with self._cap_lock:
+                self._caps.get(ino, {}).pop(sess, None)
+            return
+        if not ev.wait(timeout):
+            # unresponsive holder: drop its caps (reference session
+            # autoclose on cap revoke timeout)
+            with self._cap_lock:
+                self._caps.get(ino, {}).pop(sess, None)
+        self._flush_waiters.pop((sess, ino, seq), None)
+
+    def _handle_cap_flush(self, a: dict) -> dict:
+        """Holder's answer to a revoke (or a voluntary writeback):
+        apply flushed attrs, record the reduced caps, wake the
+        revoker."""
+        if "path" in a and ("size" in a or "mtime" in a):
+            try:
+                dino, name = self._split(a["path"])
+                with self._dir_lock(dino):
+                    ent = self._dget(dino, name)
+                    if ent is not None and ent["ino"] == a["ino"]:
+                        for k in ("size", "mtime"):
+                            if k in a:
+                                ent[k] = a[k]
+                        self._dset(dino, name, ent)
+            except _Err:
+                pass   # path raced away; the flush is advisory now
+        sess = a.get("client", "")
+        with self._cap_lock:
+            if a.get("caps"):
+                self._caps.setdefault(a["ino"], {})[sess] = a["caps"]
+            else:
+                self._caps.get(a["ino"], {}).pop(sess, None)
+        ev = self._flush_waiters.get((sess, a["ino"], a.get("seq", 0)))
+        if ev is not None:
+            ev.set()
+        return {}
+
+    # -- mdlog replay (reference MDLog::replay) ------------------------------
+
+    def _replay_mdlog(self) -> None:
+        """Redo half-applied multi-step mutations; every handler checks
+        current state first so re-applying is idempotent."""
+        for seq, ev in self.mdlog.pending():
+            op = ev["op"]
+            if op in ("create", "mkdir"):
+                if op == "mkdir":
+                    self.meta.execute(f"dir.{ev['ent']['ino']:x}",
+                                      "rgw", "dir_init", b"")
+                if self._dget(ev["dino"], ev["name"]) is None:
+                    self._dset(ev["dino"], ev["name"], ev["ent"])
+            elif op == "unlink":
+                cur = self._dget(ev["dino"], ev["name"])
+                if cur is not None and cur["ino"] == ev["ent"]["ino"]:
+                    self._drm(ev["dino"], ev["name"])
+                self._purge_data(ev["ent"])
+            elif op == "rmdir":
+                cur = self._dget(ev["dino"], ev["name"])
+                if cur is not None and cur["ino"] == ev["ino"]:
+                    self._drm(ev["dino"], ev["name"])
+                try:
+                    self.meta.remove(f"dir.{ev['ino']:x}")
+                except RadosError:
+                    pass
+            elif op == "rename":
+                dst = self._dget(ev["ddino"], ev["dname"])
+                if dst is None or dst["ino"] != ev["ent"]["ino"]:
+                    self._dset(ev["ddino"], ev["dname"], ev["ent"])
+                src = self._dget(ev["sdino"], ev["sname"])
+                if src is not None and src["ino"] == ev["ent"]["ino"]:
+                    self._drm(ev["sdino"], ev["sname"])
+                if ev.get("replaced"):
+                    self._purge_data(ev["replaced"])
+            self.mdlog.mark_done(seq)
 
     def _multi_lock(self, *inos: int):
         """Acquire the stripe locks of several inodes deadlock-free:

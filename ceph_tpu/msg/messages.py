@@ -523,6 +523,28 @@ class MClientRequest(Message):
 
 
 @register_message
+class MClientCaps(Message):
+    """MDS -> client capability message (reference MClientCaps:
+    grant/revoke of file caps).  caps is a string subset of "rwc"
+    (read / write / cache-and-buffer)."""
+
+    type_id = 26
+
+    def __init__(self, op: str = "", ino: int = 0, caps: str = "",
+                 seq: int = 0):
+        super().__init__()
+        self.op, self.ino, self.caps, self.seq = op, ino, caps, seq
+
+    def to_meta(self):
+        return {"op": self.op, "ino": self.ino, "caps": self.caps,
+                "seq": self.seq}
+
+    def decode_wire(self, meta, data):
+        self.op, self.ino, self.caps, self.seq = \
+            meta["op"], meta["ino"], meta["caps"], meta["seq"]
+
+
+@register_message
 class MClientReply(Message):
     type_id = 25
 

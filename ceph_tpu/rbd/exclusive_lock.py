@@ -84,21 +84,27 @@ class ExclusiveLock:
         req = {"name": LOCK_NAME, "owner": self.owner_id,
                "type": "exclusive"}
         try:
-            self._cls("lock", req)
-        except RadosError as e:
-            if e.errno != errno.EBUSY:
-                raise
-            # EBUSY: is the current owner alive?  Watchers other than
-            # our own cookie count as the owner's presence.
-            watchers = set(self.io.list_watchers(self.header_oid))
-            watchers.discard(self._watch_cookie)
-            if watchers and not steal:
-                raise RadosError(
-                    errno.EBUSY,
-                    f"image {self.image_name} is locked by a live "
-                    f"client (steal to take over)") from e
-            self._cls("break_lock", {})
-            self._cls("lock", req)
+            try:
+                self._cls("lock", req)
+            except RadosError as e:
+                if e.errno != errno.EBUSY:
+                    raise
+                # EBUSY: is the current owner alive?  Watchers other
+                # than our own cookie count as the owner's presence.
+                watchers = set(self.io.list_watchers(self.header_oid))
+                watchers.discard(self._watch_cookie)
+                if watchers and not steal:
+                    raise RadosError(
+                        errno.EBUSY,
+                        f"image {self.image_name} is locked by a live "
+                        f"client (steal to take over)") from e
+                self._cls("break_lock", {})
+                self._cls("lock", req)
+        except Exception:
+            # failed acquire must not leave our watcher behind: a
+            # contender would count it as a live owner forever
+            self.release()
+            raise
         self.acquired = True
         # fence any previous owner's handle
         self.io.notify(self.header_oid, json.dumps(

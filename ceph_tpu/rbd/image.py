@@ -95,11 +95,12 @@ class RBD:
                 self.io.remove(_data(name, b))
             except RadosError:
                 pass
-        from .object_map import _map_oid
-        try:
-            self.io.remove(_map_oid(name))
-        except RadosError:
-            pass
+        from .object_map import _inval_oid, _map_oid
+        for aux in (_map_oid(name), _inval_oid(name)):
+            try:
+                self.io.remove(aux)
+            except RadosError:
+                pass
         self.io.remove(_header(name))
         self._dir_rm(name)
 
