@@ -28,6 +28,12 @@ from .mds import data_oid
 LEASE_TTL = 5.0      # stat-cache lifetime under the "c" cap
 
 
+def _norm(path: str) -> str:
+    """One normalization for every _stat_cache key: insert and
+    invalidate must never disagree on the spelling of a path."""
+    return "/" + "/".join(p for p in path.split("/") if p)
+
+
 class FSError(Exception):
     def __init__(self, err: int, msg: str = ""):
         super().__init__(f"[errno {err}] {msg}")
@@ -46,6 +52,8 @@ class CephFS:
         self._tid = 0
         self._waiters: dict[int, dict] = {}
         self._caps: dict[int, str] = {}              # ino -> caps held
+        self._cap_seqs: dict[int, int] = {}          # ino -> last seq
+        self._attr_tick = 0      # per-client attr-update order stamp
         self._files: dict[int, list] = {}            # ino -> open Files
         self._stat_cache: dict[str, tuple] = {}      # path -> (ent, exp)
         self.revokes_seen = 0      # observability (tests/metrics)
@@ -79,21 +87,29 @@ class CephFS:
         ack with the reduced cap set (reference Client::handle_caps
         CEPH_CAP_OP_REVOKE)."""
         self.revokes_seen += 1
+        flush = {"ino": msg.ino, "seq": msg.seq, "caps": msg.caps,
+                 "client": self.client_id}
         with self._lock:
             self._caps[msg.ino] = msg.caps
+            self._cap_seqs[msg.ino] = max(
+                msg.seq, self._cap_seqs.get(msg.ino, 0))
             files = list(self._files.get(msg.ino, ()))
             self._stat_cache = {p: v for p, v in
                                 self._stat_cache.items()
                                 if v[0].get("ino") != msg.ino}
-        flush = {"ino": msg.ino, "seq": msg.seq, "caps": msg.caps,
-                 "client": self.client_id}
-        dirty = [f for f in files if f._dirty]
-        if dirty:
-            # several handles on one inode: the file's logical size is
-            # the furthest any handle wrote
-            flush["path"] = dirty[0].path
-            flush["size"] = max(f.size for f in dirty)
-            flush["mtime"] = time.time()
+            # size snapshot + tick must be ONE atomic step under the
+            # lock: a concurrent write-through flush snapshots under
+            # the same lock, so tick order == snapshot order and the
+            # MDS can safely drop the older of the two
+            dirty = [f for f in files if f._dirty]
+            if dirty:
+                # several handles on one inode: the file's logical
+                # size is the furthest any handle wrote
+                flush["path"] = dirty[0].path
+                flush["size"] = max(f.size for f in dirty)
+                flush["mtime"] = time.time()
+                self._attr_tick += 1
+                flush["tick"] = self._attr_tick
         try:
             self._req("cap_flush", flush)
         except FSError:
@@ -119,7 +135,7 @@ class CephFS:
     # -- namespace -----------------------------------------------------------
 
     def stat(self, path: str) -> dict:
-        norm = "/" + "/".join(p for p in path.split("/") if p)
+        norm = _norm(path)
         with self._lock:
             hit = self._stat_cache.get(norm)
             if hit is not None and hit[1] > time.time():
@@ -151,14 +167,24 @@ class CephFS:
         out = self._req("readdir", {"path": path})
         return [(k, m) for k, m in out["entries"]]
 
+    def _uncache(self, *paths: str) -> None:
+        """Our own namespace mutations invalidate the lease cache: no
+        revoke arrives for them (we ARE the holder)."""
+        with self._lock:
+            for p in paths:
+                self._stat_cache.pop(_norm(p), None)
+
     def unlink(self, path: str) -> None:
         self._req("unlink", {"path": path})
+        self._uncache(path)
 
     def rmdir(self, path: str) -> None:
         self._req("rmdir", {"path": path})
+        self._uncache(path)
 
     def rename(self, src: str, dst: str) -> None:
         self._req("rename", {"src": src, "dst": dst})
+        self._uncache(src, dst)
 
     # -- file I/O ------------------------------------------------------------
 
@@ -171,7 +197,12 @@ class CephFS:
             "create": "w" in mode or "a" in mode})
         ent, caps = out["ent"], out.get("caps", "")
         with self._lock:
-            self._caps[ent["ino"]] = caps
+            # a revoke that raced in after the MDS granted (higher
+            # seq) must not be clobbered by this stale grant
+            seq = out.get("cap_seq", 0)
+            if seq >= self._cap_seqs.get(ent["ino"], 0):
+                self._caps[ent["ino"]] = caps
+                self._cap_seqs[ent["ino"]] = seq
         f = File(self, path, ent)
         with self._lock:
             self._files.setdefault(ent["ino"], []).append(f)
@@ -282,17 +313,23 @@ class File:
                 pass
         self.size = size
         self._dirty = True
+        # same shared-mode write-through rule as pwrite: contenders
+        # must observe the truncated size promptly
+        if "c" not in self.fs._caps.get(self.ino, ""):
+            self.flush()
 
     def flush(self) -> None:
         if self._dirty:
-            self.fs._req("setattr", {"path": self.path,
-                                     "size": self.size,
-                                     "mtime": time.time()})
+            with self.fs._lock:    # atomic (size, tick) snapshot
+                self.fs._attr_tick += 1
+                args = {"path": self.path, "size": self.size,
+                        "mtime": time.time(),
+                        "client": self.fs.client_id,
+                        "tick": self.fs._attr_tick}
+            self.fs._req("setattr", args)
             self._dirty = False
             with self.fs._lock:
-                self.fs._stat_cache.pop(
-                    "/" + "/".join(p for p in self.path.split("/")
-                                   if p), None)
+                self.fs._stat_cache.pop(_norm(self.path), None)
 
     def close(self) -> None:
         self.flush()
@@ -307,7 +344,13 @@ class File:
                     "ino": self.ino, "client": self.fs.client_id})
             except FSError:
                 pass
-            self.fs._caps.pop(self.ino, None)
+            with self.fs._lock:
+                self.fs._caps.pop(self.ino, None)
+                # no caps -> no right to serve cached stats: another
+                # client can now mutate without any revoke reaching us
+                self.fs._stat_cache = {
+                    p: v for p, v in self.fs._stat_cache.items()
+                    if v[0].get("ino") != self.ino}
 
     def __enter__(self) -> "File":
         return self

@@ -23,12 +23,12 @@ import json
 import threading
 
 
-def _log_oid(rank: int) -> str:
+def _log_oid(rank) -> str:
     return f"mds_log.{rank}"
 
 
 class MDLog:
-    def __init__(self, meta_ioctx, rank: int = 0):
+    def __init__(self, meta_ioctx, rank="0"):
         self.io = meta_ioctx
         self.rank = rank
         self._seq = 0

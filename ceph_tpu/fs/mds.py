@@ -79,7 +79,12 @@ class MDSDaemon:
         self._ino_lock = threading.Lock()
         self._mkfs()
         from .mdlog import MDLog
-        self.mdlog = MDLog(self.meta)
+        # log keyed by MDS name: a restart under the same name replays
+        # its own intents; a concurrently-booted second MDS must NOT
+        # replay (and delete) a live peer's in-flight intents.  Rank
+        # takeover of a dead peer's log (reference standby-replay) is
+        # out of scope — single active MDS.
+        self.mdlog = MDLog(self.meta, rank=name)
         self._replay_mdlog()
         # capability state (reference Locker/Capability, reduced)
         self._sessions: dict[str, object] = {}      # client id -> conn
@@ -264,10 +269,16 @@ class MDSDaemon:
                 ino = self._alloc_ino()
                 ent = {"ino": ino, "mode": S_IFDIR | 0o755, "size": 0,
                        "mtime": time.time()}
-                seq = self.mdlog.append({"op": "mkdir", "dino": dino,
-                                         "name": name, "ent": ent})
-                self.meta.execute(f"dir.{ino:x}", "rgw", "dir_init", b"")
-                self._dset(dino, name, ent)
+                ev = {"op": "mkdir", "dino": dino, "name": name,
+                      "ent": ent}
+                seq = self.mdlog.append(ev)
+                try:
+                    self.meta.execute(f"dir.{ino:x}", "rgw",
+                                      "dir_init", b"")
+                    self._dset(dino, name, ent)
+                except Exception:
+                    self._finish_event(seq, ev)
+                    raise
                 self.mdlog.mark_done(seq)
             return {"ino": ino}
         if op == "create":
@@ -298,9 +309,8 @@ class MDSDaemon:
                 ent = self._dget(dino, name)
                 if ent is None:
                     raise _Err(errno.ENOENT, a["path"])
-                for k in ("size", "mtime"):
-                    if k in a:
-                        ent[k] = a[k]
+                if not self._attr_apply(ent, a):
+                    return {"ent": ent}
                 self._dset(dino, name, ent)
             return {"ent": ent}
         if op == "unlink":
@@ -311,9 +321,14 @@ class MDSDaemon:
                     raise _Err(errno.ENOENT, a["path"])
                 if ent["mode"] & S_IFDIR:
                     raise _Err(errno.EISDIR, a["path"])
-                seq = self.mdlog.append({"op": "unlink", "dino": dino,
-                                         "name": name, "ent": ent})
-                self._drm(dino, name)
+                ev = {"op": "unlink", "dino": dino, "name": name,
+                      "ent": ent}
+                seq = self.mdlog.append(ev)
+                try:
+                    self._drm(dino, name)
+                except Exception:
+                    self._finish_event(seq, ev)
+                    raise
             self._purge_data(ent)
             self.mdlog.mark_done(seq)
             return {}
@@ -338,14 +353,18 @@ class MDSDaemon:
                         raise _Err(errno.ENOTDIR, a["path"])
                     if self._dcount(cur["ino"]) > 0:
                         raise _Err(errno.ENOTEMPTY, a["path"])
-                    seq = self.mdlog.append({
-                        "op": "rmdir", "dino": dino, "name": name,
-                        "ino": cur["ino"]})
-                    self._drm(dino, name)
+                    ev = {"op": "rmdir", "dino": dino, "name": name,
+                          "ino": cur["ino"]}
+                    seq = self.mdlog.append(ev)
                     try:
-                        self.meta.remove(f"dir.{cur['ino']:x}")
-                    except RadosError:
-                        pass
+                        self._drm(dino, name)
+                        try:
+                            self.meta.remove(f"dir.{cur['ino']:x}")
+                        except RadosError:
+                            pass
+                    except Exception:
+                        self._finish_event(seq, ev)
+                        raise
                     self.mdlog.mark_done(seq)
                 return {}
             raise _Err(errno.EAGAIN, a["path"])
@@ -367,12 +386,16 @@ class MDSDaemon:
                         raise _Err(errno.EISDIR, a["dst"])
                     if existing["ino"] != ent["ino"]:
                         replaced = existing
-                seq = self.mdlog.append({
-                    "op": "rename", "sdino": sdino, "sname": sname,
-                    "ddino": ddino, "dname": dname, "ent": ent,
-                    "replaced": replaced})
-                self._dset(ddino, dname, ent)
-                self._drm(sdino, sname)
+                ev = {"op": "rename", "sdino": sdino, "sname": sname,
+                      "ddino": ddino, "dname": dname, "ent": ent,
+                      "replaced": replaced}
+                seq = self.mdlog.append(ev)
+                try:
+                    self._dset(ddino, dname, ent)
+                    self._drm(sdino, sname)
+                except Exception:
+                    self._finish_event(seq, ev)
+                    raise
             if replaced is not None:
                 # the displaced file's inode lost its last link: purge
                 # its data like unlink would (reference purge queue)
@@ -398,9 +421,14 @@ class MDSDaemon:
                 ino = self._alloc_ino()
                 ent = {"ino": ino, "mode": S_IFREG | 0o644, "size": 0,
                        "mtime": time.time()}
-                seq = self.mdlog.append({"op": "create", "dino": dino,
-                                         "name": name, "ent": ent})
-                self._dset(dino, name, ent)
+                ev = {"op": "create", "dino": dino, "name": name,
+                      "ent": ent}
+                seq = self.mdlog.append(ev)
+                try:
+                    self._dset(dino, name, ent)
+                except Exception:
+                    self._finish_event(seq, ev)
+                    raise
                 self.mdlog.mark_done(seq)
             elif ent["mode"] & S_IFDIR:
                 raise _Err(errno.EISDIR, a["path"])
@@ -421,11 +449,20 @@ class MDSDaemon:
                     to_revoke.append((s, holders[s].replace("c", ""),
                                       self._cap_seq))
             holders[sess] = grant
+            self._cap_seq += 1
+            grant_seq = self._cap_seq
         for s, newcaps, seq in to_revoke:
             self._revoke(s, ino, newcaps, seq)
-        # re-read: the flush may have updated size/mtime
-        ent = self._dget(dino, name) or ent
-        return {"ent": ent, "caps": grant}
+        # re-read: the flush may have updated size/mtime.  A rename/
+        # unlink racing in after the grant means the path no longer
+        # names this inode — tell the opener rather than hand back a
+        # stale pre-flush size
+        ent = self._dget(dino, name)
+        if ent is None or ent["ino"] != ino:
+            with self._cap_lock:
+                self._caps.get(ino, {}).pop(sess, None)
+            raise _Err(errno.ENOENT, a["path"])
+        return {"ent": ent, "caps": grant, "cap_seq": grant_seq}
 
     def _revoke(self, sess: str, ino: int, newcaps: str,
                 seq: int, timeout: float = 10.0) -> None:
@@ -460,10 +497,8 @@ class MDSDaemon:
                 dino, name = self._split(a["path"])
                 with self._dir_lock(dino):
                     ent = self._dget(dino, name)
-                    if ent is not None and ent["ino"] == a["ino"]:
-                        for k in ("size", "mtime"):
-                            if k in a:
-                                ent[k] = a[k]
+                    if ent is not None and ent["ino"] == a["ino"] and \
+                            self._attr_apply(ent, a):
                         self._dset(dino, name, ent)
             except _Err:
                 pass   # path raced away; the flush is advisory now
@@ -478,42 +513,76 @@ class MDSDaemon:
             ev.set()
         return {}
 
+    @staticmethod
+    def _attr_apply(ent: dict, a: dict) -> bool:
+        """Ordered attr update: each client stamps its setattr/cap_flush
+        with a per-client monotonically increasing tick, and an update
+        ordered BEFORE the entry's last update from the SAME client is
+        dropped (a revoke-time flush racing that client's own later
+        write-through).  Wall clocks are never compared across clients
+        — different machines' clocks carry no ordering."""
+        src = a.get("client")
+        tick = a.get("tick")
+        if src is not None and tick is not None:
+            last = ent.get("attr_src")
+            if last and last[0] == src and last[1] >= tick:
+                return False
+            ent["attr_src"] = [src, tick]
+        for k in ("size", "mtime"):
+            if k in a:
+                ent[k] = a[k]
+        return True
+
     # -- mdlog replay (reference MDLog::replay) ------------------------------
 
+    def _apply_event(self, ev: dict) -> None:
+        """Redo one journaled mutation; checks current state first so
+        re-applying is idempotent."""
+        op = ev["op"]
+        if op in ("create", "mkdir"):
+            if op == "mkdir":
+                self.meta.execute(f"dir.{ev['ent']['ino']:x}",
+                                  "rgw", "dir_init", b"")
+            if self._dget(ev["dino"], ev["name"]) is None:
+                self._dset(ev["dino"], ev["name"], ev["ent"])
+        elif op == "unlink":
+            cur = self._dget(ev["dino"], ev["name"])
+            if cur is not None and cur["ino"] == ev["ent"]["ino"]:
+                self._drm(ev["dino"], ev["name"])
+            self._purge_data(ev["ent"])
+        elif op == "rmdir":
+            cur = self._dget(ev["dino"], ev["name"])
+            if cur is not None and cur["ino"] == ev["ino"]:
+                self._drm(ev["dino"], ev["name"])
+            try:
+                self.meta.remove(f"dir.{ev['ino']:x}")
+            except RadosError:
+                pass
+        elif op == "rename":
+            dst = self._dget(ev["ddino"], ev["dname"])
+            if dst is None or dst["ino"] != ev["ent"]["ino"]:
+                self._dset(ev["ddino"], ev["dname"], ev["ent"])
+            src = self._dget(ev["sdino"], ev["sname"])
+            if src is not None and src["ino"] == ev["ent"]["ino"]:
+                self._drm(ev["sdino"], ev["sname"])
+            if ev.get("replaced"):
+                self._purge_data(ev["replaced"])
+
     def _replay_mdlog(self) -> None:
-        """Redo half-applied multi-step mutations; every handler checks
-        current state first so re-applying is idempotent."""
         for seq, ev in self.mdlog.pending():
-            op = ev["op"]
-            if op in ("create", "mkdir"):
-                if op == "mkdir":
-                    self.meta.execute(f"dir.{ev['ent']['ino']:x}",
-                                      "rgw", "dir_init", b"")
-                if self._dget(ev["dino"], ev["name"]) is None:
-                    self._dset(ev["dino"], ev["name"], ev["ent"])
-            elif op == "unlink":
-                cur = self._dget(ev["dino"], ev["name"])
-                if cur is not None and cur["ino"] == ev["ent"]["ino"]:
-                    self._drm(ev["dino"], ev["name"])
-                self._purge_data(ev["ent"])
-            elif op == "rmdir":
-                cur = self._dget(ev["dino"], ev["name"])
-                if cur is not None and cur["ino"] == ev["ino"]:
-                    self._drm(ev["dino"], ev["name"])
-                try:
-                    self.meta.remove(f"dir.{ev['ino']:x}")
-                except RadosError:
-                    pass
-            elif op == "rename":
-                dst = self._dget(ev["ddino"], ev["dname"])
-                if dst is None or dst["ino"] != ev["ent"]["ino"]:
-                    self._dset(ev["ddino"], ev["dname"], ev["ent"])
-                src = self._dget(ev["sdino"], ev["sname"])
-                if src is not None and src["ino"] == ev["ent"]["ino"]:
-                    self._drm(ev["sdino"], ev["sname"])
-                if ev.get("replaced"):
-                    self._purge_data(ev["replaced"])
+            self._apply_event(ev)
             self.mdlog.mark_done(seq)
+
+    def _finish_event(self, seq: int, ev: dict) -> None:
+        """Error path after an intent was journaled: an intent must not
+        linger while the MDS keeps serving — hours later a restart
+        would replay it over NEWER state (clobbering a file created at
+        dst since, or deleting a file the client was told still
+        exists).  Drive the redo to completion NOW via the idempotent
+        replay handler; only if that also fails does the intent stay
+        pending for the (imminent) restart to finish."""
+        self._apply_event(ev)
+        self.mdlog.mark_done(seq)
 
     def _multi_lock(self, *inos: int):
         """Acquire the stripe locks of several inodes deadlock-free:

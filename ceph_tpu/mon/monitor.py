@@ -313,7 +313,8 @@ class Monitor:
                 self.paxos.handle(msg.rank, msg.op, pn=msg.pn,
                                   value=msg.value,
                                   committed=msg.committed,
-                                  uncommitted=msg.uncommitted)
+                                  uncommitted=msg.uncommitted,
+                                  epoch=msg.epoch)
         elif isinstance(msg, M.MMonGetMap):
             with self.lock:
                 if conn not in self._subscribers:

@@ -292,16 +292,21 @@ class Paxos:
     def grant_lease(self) -> None:
         if self.role != "leader":
             return
+        # the lease advertises the committed version: a peon that
+        # rejoined after a partition detects staleness and requests
+        # catch-up instead of serving old state under a fresh lease
+        epoch = self.get_committed().get("epoch", 0)
         for peer in range(self.n):
             if peer != self.rank:
-                self.send(peer, op="lease")
+                self.send(peer, op="lease", epoch=epoch)
 
     # -- message handling ---------------------------------------------------
 
     def handle(self, from_rank: int, op: str, pn: int = 0,
                value: dict | None = None,
                committed: dict | None = None,
-               uncommitted: list | None = None) -> None:
+               uncommitted: list | None = None,
+               epoch: int = 0) -> None:
         if op == "collect":
             with self.lock:
                 if pn > self.promised:
@@ -355,9 +360,17 @@ class Paxos:
                     rnd["event"].set()
         elif op == "commit":
             with self.lock:
-                self.uncommitted = None
-                if self.store is not None:
-                    self.store.clear_uncommitted()
+                # a stale commit (catchup reply racing a newer begin)
+                # must not clear a NEWER durable accepted value: that
+                # value may already be chosen, and erasing it here
+                # could roll back a client-acked round on leader crash
+                keep = (self.uncommitted is not None and value and
+                        self.uncommitted[1].get("epoch", 0) >
+                        value.get("epoch", 0))
+                if not keep:
+                    self.uncommitted = None
+                    if self.store is not None:
+                        self.store.clear_uncommitted()
                 self.lease_expire = time.monotonic() + \
                     3 * self.LEASE_INTERVAL
             if value and value.get("epoch", 0) > \
@@ -372,7 +385,19 @@ class Paxos:
                     return
                 self.lease_expire = time.monotonic() + \
                     3 * self.LEASE_INTERVAL
+                stale = epoch > self.get_committed().get("epoch", 0)
             self.send(from_rank, op="lease_ack")
+            if stale:
+                # we missed commits while partitioned: pull the value
+                # (reference Paxos peon sync on lease/commit gap)
+                self.send(from_rank, op="catchup")
+        elif op == "catchup":
+            with self.lock:
+                if self.role != "leader":
+                    return
+                value = self.get_committed()
+                pn = self.pn
+            self.send(from_rank, op="commit", pn=pn, value=value)
         elif op == "lease_ack":
             with self.lock:
                 if self.role == "leader":

@@ -478,6 +478,9 @@ class Messenger:
         self.compress_algo: str | None = None
         self.compress_min = 4096
         self.dispatcher: Dispatcher | None = None
+        # test hook: drop received messages matching a predicate
+        # (message-loss partitions without killing processes)
+        self.recv_filter = None
         self.my_addr: tuple[str, int] | None = None
         self._server: asyncio.AbstractServer | None = None
         self._conns: dict[tuple[str, int], Connection] = {}
@@ -728,6 +731,13 @@ class Messenger:
                     continue
                 msg = Message.decode(tid, seq, meta_raw, data, pcrc)
                 sess.in_seq = seq
+                if self.recv_filter is not None and \
+                        self.recv_filter(msg):
+                    # injected receive-side loss (partition testing):
+                    # the frame is consumed and acked but never reaches
+                    # the dispatcher — indistinguishable, to the
+                    # protocol above, from a network that ate it
+                    continue
                 if self.dispatcher is not None:
                     # dispatch off-reactor so handlers may send synchronously
                     await asyncio.get_event_loop().run_in_executor(

@@ -169,6 +169,81 @@ class MessengerShardBackend(ShardBackend):
         raw = reply.attrs.get(HINFO_KEY)
         return HashInfo.decode(raw) if raw else None
 
+    def probe(self, oid, n):
+        """(hinfo, shard size) in ONE metadata round: the local shard
+        answers without touching the wire (hinfo rides every shard, so
+        steady-state writes cost ZERO metadata RPCs), and only a miss
+        fans out to the remaining shards CONCURRENTLY — one RTT where
+        the sequential sweep cost n (the dominant per-op latency in
+        the end-to-end write path)."""
+        hinfo = None
+        size = None
+        remote = []
+        for s in range(n):
+            osd = self._osd_for(s)
+            if osd is None:
+                continue
+            if osd == self.daemon.osd_id:
+                reply = self.daemon.stat_shard(spg_t(self.pgid, s),
+                                               oid, True)
+                if reply.result == 0:
+                    raw = reply.attrs.get(HINFO_KEY)
+                    if raw:
+                        hinfo = HashInfo.decode(raw)
+                    if reply.size >= 0:
+                        size = reply.size
+            else:
+                remote.append((s, osd))
+        if hinfo is not None or not remote:
+            return hinfo, size
+        box: dict = {}
+        ev = threading.Event()
+        pending = {"n": len(remote)}
+        issued: list[tuple] = []
+        for s, osd in remote:
+            spg = spg_t(self.pgid, s)
+            tid = self._next_tid()
+
+            def mk(s=s):
+                def cb(msg):
+                    with self.lock:   # box is read under this lock
+                        box[s] = msg
+                        pending["n"] -= 1
+                        fire = pending["n"] <= 0
+                    if fire:
+                        ev.set()
+                return cb
+
+            with self.lock:
+                self.daemon.raw_read_waiters[(spg, tid)] = mk()
+            issued.append((spg, tid))
+            try:
+                self.daemon.conn_to_osd(osd).send_message(
+                    M.MOSDECSubOpRead(spg, tid, oid, 0, 0,
+                                      want_attrs=True))
+            except Exception:  # noqa: BLE001 - unreachable peer
+                with self.lock:
+                    pending["n"] -= 1
+                    fire = pending["n"] <= 0
+                if fire:
+                    ev.set()
+        ev.wait(self.RPC_TIMEOUT)
+        with self.lock:
+            for spg, tid in issued:
+                self.daemon.raw_read_waiters.pop((spg, tid), None)
+            replies = dict(box)   # late callbacks mutate box concurrently
+        for s in sorted(replies):
+            msg = replies[s]
+            if msg.result != 0:
+                continue
+            if hinfo is None:
+                raw = msg.attrs.get(HINFO_KEY)
+                if raw:
+                    hinfo = HashInfo.decode(raw)
+            if size is None and msg.size >= 0:
+                size = msg.size
+        return hinfo, size
+
     def get_attrs(self, shard, oid):
         reply = self._stat_rpc(shard, oid, want_attrs=True)
         if reply is None or reply.result != 0:
@@ -242,7 +317,7 @@ class PGState:
         self.backend = backend
         self.kind = kind  # "ec" | "replicated"
         self.version = 0
-        self.lock = threading.Lock()
+        self.lock = threading.RLock()   # held across alloc+submit
         # peering: a fresh primary must collect shard logs before
         # serving (reference PeeringState: no ops until Active)
         self.needs_peer = True
@@ -313,6 +388,13 @@ class OSDDaemon:
         self.map_event = threading.Event()
         self.pgs: dict[pg_t, PGState] = {}
         self.pg_lock = threading.RLock()
+        self._batch_armed: dict[int, bool] = {}   # backend -> window armed
+        from concurrent.futures import ThreadPoolExecutor
+        self._op_pool = ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix=f"osd.{osd_id}.op")
+        # PGs whose last recovery pass failed: the steady-state skip
+        # must not strand them until an unrelated acting change
+        self._pgs_needing_recovery: set = set()
         self.raw_read_waiters: dict = {}
         # shard-resident replicated PG logs (reference: pglog omap keys
         # in the pg meta collection) + peering RPC plumbing
@@ -390,6 +472,7 @@ class OSDDaemon:
 
     def shutdown(self) -> None:
         self._hb_stop.set()
+        self._op_pool.shutdown(wait=False)
         self.messenger.shutdown()
         self.store.umount()
         self.cct.shutdown()
@@ -417,7 +500,14 @@ class OSDDaemon:
             if isinstance(msg, M.MMonMap):
                 self._handle_map(msg)
             elif isinstance(msg, M.MOSDOp):
-                self._handle_client_op(conn, msg)
+                # client ops run on the sharded op pool (reference
+                # ShardedOpWQ): the messenger awaits each dispatch per
+                # connection, so handling inline would serialize every
+                # op of a client behind the previous op's COMMIT —
+                # no pipelining, and the batch window could never see
+                # two ops.  Per-object ordering still comes from the
+                # stripe locks in _handle_client_op.
+                self._op_pool.submit(self._handle_client_op, conn, msg)
             elif isinstance(msg, M.MOSDECSubOpWrite):
                 self.perf.inc("subop_w")
                 self.apply_sub_write(msg.pgid, msg.txn, msg.log_entries,
@@ -513,14 +603,19 @@ class OSDDaemon:
         if self.recovery_enabled and newmap.pools and \
                 newmap.epoch not in self._recovered_epochs:
             self._recovered_epochs.add(newmap.epoch)
+            # snapshot the previous map NOW: by the time the thread
+            # runs, self.prev_osdmap may already be a newer epoch and
+            # the changed-acting comparison would look at the wrong
+            # interval
             threading.Thread(target=self._recover_epoch,
-                             args=(newmap.epoch,), daemon=True,
+                             args=(newmap.epoch, self.prev_osdmap),
+                             daemon=True,
                              name=f"osd.{self.osd_id}.recovery").start()
 
     # -- recovery / backfill (reference PeeringState -> Recovering /
     #    Backfilling; ECBackend::continue_recovery_op :570) ----------------
 
-    def _recover_epoch(self, epoch: int) -> None:
+    def _recover_epoch(self, epoch: int, prevmap=None) -> None:
         """After a map change, rebuild any shard the new acting set is
         missing, for every PG this OSD leads.  This is the elastic part
         of the system: mark an OSD out -> CRUSH picks replacements ->
@@ -547,13 +642,17 @@ class OSDDaemon:
                         # one reservation per PG recovery (reference
                         # osd_max_backfills: concurrent backfilling PGs)
                         with self._recovery_sem:
-                            self._recover_ec_pg(pgid, acting, unreachable)
+                            self._recover_ec_pg(pgid, acting,
+                                                unreachable, prevmap)
                     else:
                         with self._recovery_sem:
-                            self._recover_replicated_pg(pgid, acting)
+                            self._recover_replicated_pg(pgid, acting,
+                                                        prevmap)
                 except ErasureCodeError as e:
                     # peering-incomplete (EAGAIN) or similar on ONE PG
-                    # must not kill the recovery pass for the rest
+                    # must not kill the recovery pass for the rest —
+                    # but a later steady-state epoch must retry it
+                    self._pgs_needing_recovery.add(pgid)
                     self.cct.dout("osd", 2,
                                   f"recovery of {pgid} deferred: {e}")
 
@@ -594,6 +693,8 @@ class OSDDaemon:
     def _remote_list(self, osd: int, spg: spg_t,
                      timeout: float = 10.0,
                      unreachable: set | None = None) -> list:
+        if self._hb_stop.is_set():
+            return []          # daemon shut down: no more RPC waits
         if osd == self.osd_id:
             return self._list_pg_objects(spg)
         if unreachable is not None and osd in unreachable:
@@ -630,6 +731,8 @@ class OSDDaemon:
 
     def _push_shard_txn(self, osd: int, spg: spg_t, txn,
                         timeout: float = 20.0) -> bool:
+        if self._hb_stop.is_set():
+            return False
         if osd == self.osd_id:
             self.apply_shard_txn(spg, txn)
             return True
@@ -645,6 +748,8 @@ class OSDDaemon:
     def _remote_read_full(self, osd: int, spg: spg_t, oid: hobject_t,
                           timeout: float = 3.0,
                           unreachable: set | None = None):
+        if self._hb_stop.is_set():
+            return None
         """(data, attrs) of a shard object on a specific OSD, or None.
         The backfill copy path: a moved shard is fetched from its old
         holder verbatim instead of being re-decoded."""
@@ -690,19 +795,20 @@ class OSDDaemon:
                 stat.attrs)
 
     def _recover_ec_pg(self, pgid: pg_t, acting: list[int],
-                       unreachable: set | None = None) -> None:
+                       unreachable: set | None = None,
+                       prevmap=None) -> None:
         from ..crush.map import CRUSH_ITEM_NONE
         from ..store.object_store import Transaction
         state = self._get_pg(pgid)
         if state.kind != "ec":
             return
         be = state.backend
+        prevmap = prevmap if prevmap is not None else self.prev_osdmap
         prev_acting = None
-        if self.prev_osdmap is not None and \
-                pgid.pool in self.prev_osdmap.pools:
+        if prevmap is not None and pgid.pool in prevmap.pools:
             try:
                 _, prev_acting, _, _ = \
-                    self.prev_osdmap.pg_to_up_acting_osds(pgid)
+                    prevmap.pg_to_up_acting_osds(pgid)
             except Exception:  # noqa: BLE001
                 prev_acting = None
         # objects may live on old holders only: list those too.  Map
@@ -712,6 +818,17 @@ class OSDDaemon:
         # wherever CRUSH last put it.  Steady-state (acting == prev)
         # PGs skip the wide scan.
         unreachable = unreachable if unreachable is not None else set()
+        if prev_acting is not None and \
+                list(prev_acting) == list(acting) and \
+                pgid not in self._pgs_needing_recovery and \
+                all(o != CRUSH_ITEM_NONE and self.osdmap.is_up(o)
+                    for o in acting):
+            # steady state: this PG didn't move and every member is
+            # up — writes maintain shards synchronously, so there is
+            # nothing to recover.  Skipping saves n_shards remote
+            # listings per PG per epoch (a map bump for an unrelated
+            # pool was costing every OSD a full listing sweep).
+            return
         up_osds = [o.id for o in self.osdmap.osds.values()
                    if o.up and o.id not in unreachable]
         names = self._pg_object_names(pgid, acting, range(be.n),
@@ -747,6 +864,7 @@ class OSDDaemon:
                     continue
                 for oj in self._remote_list(osd, spg, timeout=3.0):
                     names.add(M.hobj_from_json(oj))
+        all_ok = True
         for oid in names:
             if self._hb_stop.is_set():
                 return
@@ -758,11 +876,17 @@ class OSDDaemon:
                     missing.append(s)
             if not missing:
                 continue
-            self._recover_object(pgid, acting, be, prev_acting,
-                                 up_osds, oid, missing, unreachable)
+            if not self._recover_object(pgid, acting, be, prev_acting,
+                                        up_osds, oid, missing,
+                                        unreachable):
+                all_ok = False
+        if all_ok:
+            self._pgs_needing_recovery.discard(pgid)
+        else:
+            self._pgs_needing_recovery.add(pgid)
 
     def _recover_object(self, pgid, acting, be, prev_acting, up_osds,
-                        oid, missing, unreachable=None) -> None:
+                        oid, missing, unreachable=None) -> bool:
         """Rebuild one object's missing shards: backfill-by-copy from
         any surviving holder, else reconstruct-from-k (runs under the
         osd_max_backfills reservation)."""
@@ -816,21 +940,25 @@ class OSDDaemon:
                 txn.write(goid, 0, data)
                 if attrs:
                     txn.setattrs(goid, attrs)
-                self._push_shard_txn(acting[s], spg_t(pgid, s), txn)
-                copied = True
-                break
+                # a timed-out push is NOT a recovery: reporting it
+                # copied would let the steady-state skip strand the
+                # shard until an unrelated acting change
+                copied = self._push_shard_txn(acting[s],
+                                              spg_t(pgid, s), txn)
+                if copied:
+                    break
             if not copied:
                 still_missing.append(s)
         if not still_missing:
             self.cct.dout("osd", 5,
                           f"backfilled {oid.name} shards {missing} "
                           f"of pg {pgid} by copy")
-            return
+            return True
         if len(still_missing) > be.m:
             self.cct.dout("osd", 1,
                           f"{oid.name}: {len(still_missing)} shards "
                           f"unrecoverable in pg {pgid}")
-            return
+            return False
         # 2: reconstruct-from-k via the EC decode path
         try:
             be.recover_shard(
@@ -839,13 +967,27 @@ class OSDDaemon:
             self.cct.dout("osd", 5,
                           f"recovered {oid.name} shards "
                           f"{still_missing} of pg {pgid} by decode")
+            return True
         except Exception as e:  # noqa: BLE001
             self.cct.dout("osd", 1,
                           f"recovery of {oid.name} failed: {e!r}")
+            return False
 
     def _recover_replicated_pg(self, pgid: pg_t,
-                               acting: list[int]) -> None:
+                               acting: list[int],
+                               prevmap=None) -> None:
         from ..store.object_store import Transaction
+        prevmap = prevmap if prevmap is not None else self.prev_osdmap
+        if prevmap is not None and pgid.pool in prevmap.pools:
+            try:
+                _, prev_acting, _, _ = \
+                    prevmap.pg_to_up_acting_osds(pgid)
+                if list(prev_acting) == list(acting) and \
+                        pgid not in self._pgs_needing_recovery and \
+                        all(self.osdmap.is_up(o) for o in acting):
+                    return   # steady state: nothing moved
+            except Exception:  # noqa: BLE001
+                pass
         spg = spg_t(pgid, NO_SHARD)
         names = self._pg_object_names(pgid, acting, [0])
         # union over all replicas so a primary that lost data also heals
@@ -853,6 +995,7 @@ class OSDDaemon:
             if osd != self.osd_id and self.osdmap.is_up(osd):
                 for oj in self._remote_list(osd, spg):
                     names.add(M.hobj_from_json(oj))
+        all_ok = True
         for oid in names:
             if self._hb_stop.is_set():
                 return
@@ -886,7 +1029,12 @@ class OSDDaemon:
                     txn.omap_setkeys(goid, omap)
                 if omap_hdr:
                     txn.omap_setheader(goid, omap_hdr)
-                self._push_shard_txn(osd, spg, txn)
+                if not self._push_shard_txn(osd, spg, txn):
+                    all_ok = False
+        if all_ok:
+            self._pgs_needing_recovery.discard(pgid)
+        else:
+            self._pgs_needing_recovery.add(pgid)
 
     # -- shard-side ops (any OSD) ------------------------------------------
 
@@ -1424,8 +1572,29 @@ class OSDDaemon:
                                     int(msg.snapc[0]),
                                     is_delete=objop.delete)
             done = threading.Event()
-            version = state.next_version(self.osdmap.epoch)
-            be.submit_transaction(txn, version, done.set)
+            window = float(self.cct.conf.get("tpu_batch_window_ms")
+                           or 0)
+            # version allocation and pipeline entry must be ATOMIC:
+            # with ops running concurrently (sharded op pool), a later
+            # version entering the FIFO pipeline first would commit out
+            # of order and violate the PG log's monotonicity.  The
+            # blocking metadata prefetch runs BEFORE the lock.
+            staged = be.make_op(txn, done.set) if state.kind == "ec" \
+                else None
+            if window > 0 and state.kind == "ec":
+                # dynamic batch window (SURVEY section 7 "hard parts",
+                # BlueStore-deferred style): hold the pipeline drain
+                # briefly so concurrent client ops encode in ONE codec
+                # launch instead of one launch each.  Armed AFTER the
+                # prefetch: the window must cover enqueue, not the
+                # metadata RPCs.
+                self._arm_batch_drain(be, window)
+            with state.lock:
+                version = state.next_version(self.osdmap.epoch)
+                if staged is not None:
+                    be.enqueue(staged, version)
+                else:
+                    be.submit_transaction(txn, version, done.set)
             if not done.wait(30):
                 result = -errno.ETIMEDOUT
         elif result == 0:
@@ -1433,6 +1602,33 @@ class OSDDaemon:
         self.perf.tinc("op_latency", time.perf_counter() - _t0)
         conn.send_message(M.MOSDOpReply(msg.tid, result, read_payload,
                                         self.osdmap.epoch))
+
+    def _arm_batch_drain(self, be, window_ms: float) -> None:
+        """One timer per backend per window: the first op entering an
+        idle window holds the drain and schedules the release; ops
+        arriving meanwhile pile into waiting_reads and flush together."""
+        with self.pg_lock:
+            armed = self._batch_armed.get(id(be))
+            if armed:
+                return
+            self._batch_armed[id(be)] = True
+        with be.lock:
+            be._hold += 1
+
+        def _release():
+            with self.pg_lock:
+                self._batch_armed[id(be)] = False
+            # check_ops must run UNDER be.lock (the batch() context
+            # manager's form): an unlocked drain races a concurrent
+            # locked check_ops and double-plans the head op
+            with be.lock:
+                be._hold -= 1
+                if be._hold == 0:
+                    be.check_ops()
+
+        t = threading.Timer(window_ms / 1000.0, _release)
+        t.daemon = True
+        t.start()
 
     # -- self-managed snapshots (reference SnapSet + make_writeable) --------
 

@@ -74,6 +74,23 @@ class ShardBackend:
     def stat(self, shard: int, oid: hobject_t) -> int | None:
         raise NotImplementedError
 
+    def probe(self, oid: hobject_t, n: int
+              ) -> tuple["HashInfo | None", int | None]:
+        """One metadata sweep: (hinfo, shard size).  hinfo is
+        replicated on every shard, so transports override this to ask
+        their LOCAL shard first and the rest in parallel — the
+        sequential per-shard fallback here is for local stores."""
+        hinfo = None
+        size = None
+        for s in range(n):
+            if hinfo is None:
+                hinfo = self.get_hinfo(s, oid)
+                if hinfo is not None:
+                    return hinfo, size
+            if size is None:
+                size = self.stat(s, oid)
+        return hinfo, size
+
 
 class LocalShardBackend(ShardBackend):
     """All shards in one local ObjectStore, per-shard collections —
@@ -147,6 +164,11 @@ class ECOp:
     version: eversion_t
     on_commit: Callable[[], None]
     plan: WritePlan | None = None
+    # metadata prefetched OUTSIDE the pipeline lock (oid -> probe
+    # result): the probe is a blocking RPC fan-out, and running it
+    # under be.lock starves every other op AND the dispatch threads
+    # that must deliver its replies
+    meta: dict = field(default_factory=dict)
     pending_reads: int = 0
     read_data: dict[tuple[hobject_t, int], np.ndarray] = field(
         default_factory=dict)
@@ -223,13 +245,9 @@ class ECBackend:
     # -- object metadata helpers -------------------------------------------
 
     def _fetch_hinfo(self, oid: hobject_t) -> HashInfo | None:
-        """hinfo is replicated on every shard; first live one, None if
-        the object doesn't exist anywhere."""
-        for s in range(self.n):
-            h = self.shards.get_hinfo(s, oid)
-            if h is not None:
-                return h
-        return None
+        """hinfo is replicated on every shard; one probe sweep (local
+        shard first, rest in parallel — see ShardBackend.probe)."""
+        return self.shards.probe(oid, self.n)[0]
 
     def _get_hinfo(self, oid: hobject_t) -> HashInfo:
         return self._fetch_hinfo(oid) or HashInfo.make(self.n)
@@ -237,31 +255,44 @@ class ECBackend:
     def _get_size(self, oid: hobject_t) -> int:
         """True (unpadded) object size from the hinfo xattr; falls back
         to the stripe-derived size for objects without one."""
-        h = self._fetch_hinfo(oid)
-        if h is not None:
-            return h.logical_size
-        for s in range(self.n):
-            chunk = self.shards.stat(s, oid)
-            if chunk is not None:
-                return self.sinfo.aligned_chunk_offset_to_logical_offset(
-                    chunk)
+        hinfo, chunk = self.shards.probe(oid, self.n)
+        if hinfo is not None:
+            return hinfo.logical_size
+        if chunk is not None:
+            return self.sinfo.aligned_chunk_offset_to_logical_offset(
+                chunk)
         return 0
 
     def exists(self, oid: hobject_t) -> bool:
-        if self._fetch_hinfo(oid) is not None:
-            return True
-        return any(self.shards.stat(s, oid) is not None
-                   for s in range(self.n))
+        hinfo, chunk = self.shards.probe(oid, self.n)
+        return hinfo is not None or chunk is not None
 
     # -- entry (reference submit_transaction :1483 / start_rmw :1839) ------
 
-    def submit_transaction(self, txn: PGTransaction, version: eversion_t,
-                           on_commit: Callable[[], None]) -> ECOp:
-        op = ECOp(txn, version, on_commit)
+    def make_op(self, txn: PGTransaction,
+                on_commit: Callable[[], None]) -> ECOp:
+        """Stage an op WITHOUT entering the pipeline: prefetches object
+        metadata (a blocking RPC fan-out) so no lock is held during it.
+        The racy peek at _projected is benign: the plan re-checks it
+        under the lock and falls back to a locked probe on a miss."""
+        op = ECOp(txn, eversion_t(), on_commit)
+        for oid in txn.ops:
+            if oid not in self._projected:
+                op.meta[oid] = self.shards.probe(oid, self.n)
+        return op
+
+    def enqueue(self, op: ECOp, version: eversion_t) -> ECOp:
+        """Enter the pipeline; the caller serializes version allocation
+        with this call (versions must enter the FIFO in order)."""
+        op.version = version
         with self.lock:
             self.waiting_state.append(op)
             self.check_ops()
         return op
+
+    def submit_transaction(self, txn: PGTransaction, version: eversion_t,
+                           on_commit: Callable[[], None]) -> ECOp:
+        return self.enqueue(self.make_op(txn, on_commit), version)
 
     # -- pipeline (reference check_ops :2151) -------------------------------
 
@@ -282,16 +313,20 @@ class ECBackend:
             cache: dict = {}
 
             def fetch(oid):
-                # projected (in-flight) state first, then the store
+                """(hinfo|None, shard_size|None): projected (in-flight)
+                state first, then the op's prefetched probe, then (rare
+                race fallback) a probe under the lock."""
                 proj = self._projected.get(oid)
                 if proj is not None:
-                    return proj["hinfo"]
+                    return proj["hinfo"], None
+                if oid in op.meta:
+                    return op.meta[oid]
                 if oid not in cache:
-                    cache[oid] = self._fetch_hinfo(oid)
+                    cache[oid] = self.shards.probe(oid, self.n)
                 return cache[oid]
 
             def get_hinfo(oid):
-                h = fetch(oid)
+                h, _sz = fetch(oid)
                 if h is None:
                     h = HashInfo.make(self.n)
                 # later queued ops must chain off this same instance
@@ -301,15 +336,13 @@ class ECBackend:
                 return proj["hinfo"]
 
             def get_size(oid):
-                h = fetch(oid)
+                h, chunk = fetch(oid)
                 if h is not None:
                     return h.logical_size
-                for s in range(self.n):
-                    chunk = self.shards.stat(s, oid)
-                    if chunk is not None:
-                        return (self.sinfo
-                                .aligned_chunk_offset_to_logical_offset(
-                                    chunk))
+                if chunk is not None:
+                    return (self.sinfo
+                            .aligned_chunk_offset_to_logical_offset(
+                                chunk))
                 return 0
 
             op.plan = ect.get_write_plan(

@@ -1,0 +1,142 @@
+"""End-to-end cluster throughput bench (reference `rados bench`,
+src/tools/rados/rados.cc + qa/tasks/radosbench.py).
+
+Measures the FULL system tier no codec-level number covers: client ->
+objecter -> messenger -> OSD dispatch -> EC/replication pipeline ->
+store commit -> ack, with concurrent writers, on an in-process vstart
+cluster.  Rows (one JSON line each):
+
+  python -m ceph_tpu.tools.cluster_bench            # default matrix
+  python -m ceph_tpu.tools.cluster_bench --seconds 5 --threads 8
+
+Matrix: replicated x3, EC k=2 m=1, EC k=8 m=3 (the reference's
+canonical profile) — each on MemStore; EC additionally with the
+dynamic batch window on vs off (tpu_batch_window_ms) to quantify the
+cross-transaction batching the TPU pipeline exists for.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+
+def bench_pool(cluster, client, pool: str, seconds: float,
+               threads: int, size: int) -> dict:
+    io = client.open_ioctx(pool)
+    payload = np.random.default_rng(7).integers(
+        0, 256, size, dtype=np.uint8).tobytes()
+    stop = time.time() + seconds
+    counts = [0] * threads
+    errors = [0] * threads
+
+    def writer(t: int) -> None:
+        i = 0
+        myio = client.open_ioctx(pool)
+        while time.time() < stop:
+            try:
+                myio.write_full(f"b_{t}_{i}", payload)
+                counts[t] += 1
+            except Exception:  # noqa: BLE001
+                errors[t] += 1
+            i += 1
+
+    ts = [threading.Thread(target=writer, args=(t,)) for t in
+          range(threads)]
+    t0 = time.time()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    elapsed = time.time() - t0
+    wrote = sum(counts)
+    # read-back verification pass (sequential, first writer's objects)
+    r0 = time.time()
+    rn = 0
+    for i in range(min(counts[0], 64)):
+        got = io.read(f"b_0_{i}", size)
+        assert got == payload, "read-back mismatch"
+        rn += 1
+    relapsed = time.time() - r0
+    return {
+        "write_mb_s": round(wrote * size / elapsed / 1e6, 2),
+        "write_iops": round(wrote / elapsed, 1),
+        "ops": wrote,
+        "errors": sum(errors),
+        "read_mb_s": round(rn * size / relapsed / 1e6, 2)
+        if relapsed > 0 and rn else None,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="cluster_bench")
+    ap.add_argument("--seconds", type=float, default=5.0)
+    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--size", type=int, default=1 << 20)
+    ap.add_argument("--osds", type=int, default=12)
+    ap.add_argument("--objectstore", default="memstore")
+    ap.add_argument("--window-ms", type=float, default=4.0,
+                    help="batch window for the windowed EC rows")
+    ap.add_argument("--quick", action="store_true",
+                    help="small matrix (replicated + one EC profile)")
+    args = ap.parse_args(argv)
+
+    from ..tools.vstart import Cluster
+
+    rows = []
+    import tempfile
+    data_dir = tempfile.mkdtemp(prefix="cbench_") \
+        if args.objectstore == "filestore" else None
+    with Cluster(n_osds=args.osds, objectstore=args.objectstore,
+                 data_dir=data_dir) as c:
+        client = c.client()
+        client.set_ec_profile("cb21", {
+            "plugin": "jerasure", "k": "2", "m": "1",
+            "stripe_unit": "4096"})
+        client.set_ec_profile("cb83", {
+            "plugin": "jerasure", "k": "8", "m": "3",
+            "stripe_unit": "4096"})
+        matrix = [("replicated", None, 0.0)]
+        if not args.quick:
+            matrix.append(("ec_k2m1", "cb21", 0.0))
+        matrix += [("ec_k8m3", "cb83", 0.0),
+                   ("ec_k8m3_batched", "cb83", args.window_ms)]
+        for name, profile, window in matrix:
+            pool = f"pool_{name}"
+            if profile:
+                client.create_pool(pool, "erasure",
+                                   erasure_code_profile=profile,
+                                   pg_num=16)
+            else:
+                client.create_pool(pool, "replicated", size=3,
+                                   pg_num=16)
+            for osd in c.osds:
+                osd.cct.conf.set("tpu_batch_window_ms", window)
+            res = bench_pool(c, client, pool, args.seconds,
+                             args.threads, args.size)
+            launches = sum(
+                getattr(st.backend, "batched_launches", 0)
+                for osd in c.osds
+                for st in getattr(osd, "pgs", {}).values())
+            extents = sum(
+                getattr(st.backend, "batched_extents", 0)
+                for osd in c.osds
+                for st in getattr(osd, "pgs", {}).values())
+            row = {"config": name, "objectstore": args.objectstore,
+                   "threads": args.threads,
+                   "obj_size": args.size,
+                   "batch_window_ms": window, **res,
+                   "codec_launches": launches,
+                   "codec_extents": extents}
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -157,7 +157,9 @@ def test_mdlog_replays_half_applied_unlink(cluster):
     mds2.mdlog.append({"op": "unlink", "dino": root,
                        "name": "doomed.txt", "ent": ent})
     mds2.shutdown()
-    mds3 = MDSDaemon(c.mon_addrs[0], name="c")
+    # restart under the SAME name: the MDLog is per-MDS-name and a
+    # differently-named daemon must not replay a peer's intents
+    mds3 = MDSDaemon(c.mon_addrs[0], name="b")
     try:
         fs3 = CephFS(c.mon_addrs[0], mds3.addr, name="ul2")
         names = [k for k, _ in fs3.readdir("/")]

@@ -143,3 +143,125 @@ def test_single_mon_is_its_own_quorum():
         client = c.client()
         r, out = client.mon_command({"prefix": "mon stat"})
         assert r == 0 and out["role"] == "leader"
+
+
+# -- partitions via message loss (no process death) --------------------------
+# (reference Elector/ElectionLogic partition handling; the recv_filter
+# hook models a network that eats mon<->mon frames while the processes
+# stay up)
+
+from ceph_tpu.msg import messages as M
+
+
+def _isolate(mon, from_ranks):
+    """Drop all paxos/election traffic this mon RECEIVES from the given
+    ranks.  Client traffic (MMonCommand etc.) is untouched."""
+    ranks = set(from_ranks)
+    mon.messenger.recv_filter = (
+        lambda msg: isinstance(msg, M.MMonPaxos) and msg.rank in ranks)
+
+
+def _heal(*mons):
+    for m in mons:
+        m.messenger.recv_filter = None
+
+
+def test_symmetric_partition_minority_leader_demotes():
+    """Cut the leader off from both peons (both directions): the
+    majority elects a new leader and keeps serving writes; the old
+    leader demotes on lease silence and refuses reads; healing
+    converges the old leader onto the majority's state."""
+    with Cluster(n_osds=3, n_mons=3) as c:
+        old = c.wait_for_leader()
+        assert old.rank == 0
+        peons = [m for m in c.mons if m.rank != 0]
+        _isolate(old, [1, 2])
+        for p in peons:
+            _isolate(p, [0])
+        # majority re-elects among themselves
+        assert wait_until(lambda: any(p.is_leader for p in peons),
+                          timeout=15)
+        new_leader = next(p for p in peons if p.is_leader)
+        assert new_leader.rank == 1      # lowest rank in the majority
+        # the majority serves writes
+        client = RadosClient(new_leader.addr).connect()
+        try:
+            r, _ = client.mon_command({
+                "prefix": "osd erasure-code-profile set",
+                "name": "part_p",
+                "profile": {"plugin": "jerasure", "k": "2", "m": "1"}})
+            assert r == 0
+        finally:
+            client.shutdown()
+        # the minority ex-leader demotes and stops serving: without a
+        # lease it won't even hand out the osdmap, so a client bound
+        # to it alone cannot bootstrap
+        assert wait_until(lambda: not old.is_leader, timeout=15)
+        from ceph_tpu.osdc.objecter import TimedOut
+        with pytest.raises(TimedOut):
+            RadosClient(old.addr).connect()
+        # heal: the ex-leader rejoins and catches up on the profile
+        # committed while it was cut off
+        _heal(old, *peons)
+        assert wait_until(
+            lambda: "part_p" in old.osdmap.ec_profiles, timeout=20)
+        assert wait_until(
+            lambda: sum(m.is_leader for m in c.mons) == 1, timeout=15)
+
+
+def test_partitioned_peon_stops_serving_reads():
+    """Cut one peon off: its lease expires and lease-gated reads are
+    refused (stale reads would violate the paxos read contract); the
+    majority keeps working; healing lets it catch up."""
+    with Cluster(n_osds=3, n_mons=3) as c:
+        leader = c.wait_for_leader()
+        victim = next(m for m in c.mons if m.rank == 2)
+        _isolate(victim, [0, 1])
+        for m in c.mons:
+            if m.rank != 2:
+                _isolate(m, [2])
+        # wait for the victim's lease to lapse
+        assert wait_until(lambda: victim.paxos.lease_expired(),
+                          timeout=15)
+        # majority still commits
+        client = RadosClient(leader.addr).connect()
+        try:
+            r, _ = client.mon_command({
+                "prefix": "osd erasure-code-profile set",
+                "name": "peon_cut",
+                "profile": {"plugin": "jerasure", "k": "2", "m": "1"}})
+            assert r == 0
+        finally:
+            client.shutdown()
+        assert "peon_cut" not in victim.osdmap.ec_profiles
+        _heal(*c.mons)
+        assert wait_until(
+            lambda: "peon_cut" in victim.osdmap.ec_profiles, timeout=20)
+
+
+def test_asymmetric_partition_converges():
+    """One-directional loss: a peon hears nothing from the leader (so
+    its lease lapses and it agitates for election) while the leader
+    still hears the peon.  The cluster must not livelock: it converges
+    to exactly one leader and keeps accepting writes."""
+    with Cluster(n_osds=3, n_mons=3) as c:
+        c.wait_for_leader()
+        victim = next(m for m in c.mons if m.rank == 1)
+        _isolate(victim, [0])      # victim deaf to the leader only
+        time.sleep(3)              # let elections churn under the loss
+        _heal(victim)
+        assert wait_until(
+            lambda: sum(m.is_leader for m in c.mons) == 1, timeout=20)
+        leader = next(m for m in c.mons if m.is_leader)
+        client = RadosClient(leader.addr).connect()
+        try:
+            r, _ = client.mon_command({
+                "prefix": "osd erasure-code-profile set",
+                "name": "asym_p",
+                "profile": {"plugin": "jerasure", "k": "2", "m": "1"}})
+            assert r == 0
+        finally:
+            client.shutdown()
+        assert wait_until(
+            lambda: all("asym_p" in m.osdmap.ec_profiles
+                        for m in c.mons), timeout=20)

@@ -168,15 +168,32 @@ def test_osd_out_triggers_backfill():
         r, _ = client.mon_command({"prefix": "osd out", "id": 2})
         assert r == 0
         c.mark_osd_down(2)
-        # wait for recovery threads to settle
+        # wait for recovery to settle: reads must be correct AND every
+        # replacement shard rebuilt (reads alone succeed early via
+        # degraded decode, long before backfill finishes)
+        def shards_complete() -> bool:
+            for name in blobs:
+                pgid = c.mon.osdmap.object_to_pg(
+                    c.mon.osdmap.lookup_pool("ecp").id, name)
+                _, acting, _, primary = \
+                    c.mon.osdmap.pg_to_up_acting_osds(pgid)
+                if 2 in acting:
+                    return False
+                state = c.osds[primary]._get_pg(pgid)
+                for s in range(5):
+                    if state.backend.shards.stat(
+                            s, hobject_t(pool=pgid.pool,
+                                         name=name)) is None:
+                        return False
+            return True
+
         deadline = time.time() + 45
         while time.time() < deadline:
             time.sleep(0.5)
-            # every live PG mapping should now exclude osd 2 and the
-            # replacement shards should exist: verify via reads
             try:
                 ok = all(io.read(nm, len(d)) == d
-                         for nm, d in blobs.items())
+                         for nm, d in blobs.items()) and \
+                    shards_complete()
             except Exception:  # noqa: BLE001 - transient during backfill
                 ok = False
             if ok:

@@ -91,8 +91,9 @@ def test_thrash_osds_no_acked_data_loss():
             f"workload too small to be meaningful: {len(acked)} acked"
 
         # every acked write must be readable and bit-identical once the
-        # cluster settles (recovery + backfill converging)
-        deadline = time.time() + 60
+        # cluster settles (recovery + backfill converging; generous
+        # deadline — the full suite loads the host heavily)
+        deadline = time.time() + 120
         missing = dict(acked)
         last_err = None
         while missing and time.time() < deadline:
