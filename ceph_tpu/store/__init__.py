@@ -13,6 +13,12 @@ def create_store(kind: str, path: str | None = None) -> ObjectStore:
         from .file_store import FileStore
         assert path, "filestore needs a path"
         return FileStore(path)
+    if kind.startswith("bluestore"):
+        from .blue_store import BlueStore
+        assert path, "bluestore needs a path"
+        # "bluestore" or "bluestore-<compressor>" (zlib/bz2/lzma)
+        return BlueStore(path,
+                         compression=kind.partition("-")[2] or None)
     raise ValueError(f"unknown objectstore {kind!r}")
 
 

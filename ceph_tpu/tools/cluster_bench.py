@@ -91,7 +91,7 @@ def main(argv=None) -> int:
     rows = []
     import tempfile
     data_dir = tempfile.mkdtemp(prefix="cbench_") \
-        if args.objectstore == "filestore" else None
+        if args.objectstore != "memstore" else None
     with Cluster(n_osds=args.osds, objectstore=args.objectstore,
                  data_dir=data_dir) as c:
         client = c.client()

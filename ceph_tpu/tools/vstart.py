@@ -169,16 +169,23 @@ def main(argv=None) -> int:
     ap.add_argument("--osds", type=int, default=6)
     ap.add_argument("--mons", type=int, default=1)
     ap.add_argument("--heartbeat", type=float, default=1.0)
-    ap.add_argument("--objectstore", choices=("memstore", "filestore"),
+    ap.add_argument("--objectstore",
+                    choices=("memstore", "filestore", "bluestore",
+                             "bluestore-zlib"),
                     default="memstore")
     ap.add_argument("--data-dir", default=None,
-                    help="store root (filestore)")
+                    help="store root (filestore/bluestore; a temp dir "
+                         "is created when omitted)")
     ap.add_argument("--asok-dir", default=None)
     ap.add_argument("--auth", choices=("none", "cephx"), default="none")
     ap.add_argument("--secure", action="store_true")
     ap.add_argument("--keyring-out", default=None,
                     help="write the client keyring here (cephx)")
     args = ap.parse_args(argv)
+    if args.objectstore != "memstore" and not args.data_dir:
+        import tempfile
+        args.data_dir = tempfile.mkdtemp(prefix="vstart_")
+        print(f"data dir: {args.data_dir}", flush=True)
     cluster = Cluster(args.osds, heartbeat_interval=args.heartbeat,
                       asok_dir=args.asok_dir,
                       objectstore=args.objectstore,
