@@ -54,6 +54,9 @@ class CephFS:
         self._caps: dict[int, str] = {}              # ino -> caps held
         self._cap_seqs: dict[int, int] = {}          # ino -> last seq
         self._attr_tick = 0      # per-client attr-update order stamp
+        self._snap_epoch = -1    # last applied snapc epoch
+        self._early_snapc = None  # broadcast that beat mount()
+        self.data = None
         self._files: dict[int, list] = {}            # ino -> open Files
         self._stat_cache: dict[str, tuple] = {}      # path -> (ent, exp)
         self.revokes_seen = 0      # observability (tests/metrics)
@@ -62,6 +65,15 @@ class CephFS:
         info = self._req("mount", {"client": self.client_id})
         self.block_size = info["block_size"]
         self.data = self.rados.open_ioctx(info["data_pool"])
+        self._apply_snapc(info.get("snapc"),
+                          info.get("snap_epoch", 0))
+        # a snapc broadcast may have raced ahead of self.data existing;
+        # apply the buffered one if it is newer than the mount's
+        with self._lock:
+            early = self._early_snapc
+            self._early_snapc = None
+        if early is not None:
+            self._apply_snapc(early[1], early[0])
 
     def shutdown(self) -> None:
         self.messenger.shutdown()
@@ -76,6 +88,23 @@ class CephFS:
             if w is not None:
                 w["reply"] = msg
                 w["event"].set()
+        elif isinstance(msg, M.MClientCaps) and msg.op == "snapc":
+            # a snapshot was created/removed: writes must carry the
+            # new SnapContext so the OSDs COW data objects.  msg.seq
+            # carries the MDS's snap epoch (ordering across racing
+            # broadcasts and the mount reply).
+            import json as _json
+            try:
+                snapc = _json.loads(msg.caps)
+            except ValueError:
+                return
+            with self._lock:
+                pre_mount = self.data is None
+                if pre_mount and (self._early_snapc is None or
+                                  msg.seq > self._early_snapc[0]):
+                    self._early_snapc = (msg.seq, snapc)
+            if not pre_mount:
+                self._apply_snapc(snapc, msg.seq)
         elif isinstance(msg, M.MClientCaps) and msg.op == "revoke":
             # flush + ack on a worker: this runs on the mds_conn reader
             # thread, and the flush's own RPC reply must be readable
@@ -135,6 +164,11 @@ class CephFS:
     # -- namespace -----------------------------------------------------------
 
     def stat(self, path: str) -> dict:
+        snap = self._split_snap(path)
+        if snap is not None:
+            dirpath, name, rel = snap
+            return self._req("snap_resolve", {
+                "path": dirpath, "name": name, "rel": rel})["ent"]
         norm = _norm(path)
         with self._lock:
             hit = self._stat_cache.get(norm)
@@ -164,8 +198,53 @@ class CephFS:
                     raise
 
     def readdir(self, path: str) -> list[tuple[str, dict]]:
+        snap = self._split_snap(path)
+        if snap is not None:
+            dirpath, name, rel = snap
+            out = self._req("snap_resolve", {
+                "path": dirpath, "name": name, "rel": rel})
+            return [(k, m) for k, m in out.get("entries", [])]
         out = self._req("readdir", {"path": path})
         return [(k, m) for k, m in out["entries"]]
+
+    def _apply_snapc(self, snapc, epoch: int = 0) -> None:
+        """Route the fs SnapContext onto the data ioctx (reference
+        client snap realm update): [seq, [ids desc]] or None.  Epochs
+        order racing updates — an older broadcast must not clobber a
+        newer one."""
+        with self._lock:
+            if epoch < self._snap_epoch:
+                return
+            self._snap_epoch = epoch
+            if snapc and snapc[1]:
+                self.data.snapc = [int(snapc[0]),
+                                   [int(s) for s in snapc[1]]]
+            else:
+                self.data.snapc = None
+
+    @staticmethod
+    def _split_snap(path: str):
+        """path/.snap/<name>/<rel> -> (dirpath, name, rel) or None."""
+        parts = [p for p in path.split("/") if p]
+        if ".snap" not in parts:
+            return None
+        i = parts.index(".snap")
+        if i + 1 >= len(parts):
+            return None
+        return ("/" + "/".join(parts[:i]), parts[i + 1],
+                "/".join(parts[i + 2:]))
+
+    def snap_create(self, dirpath: str, name: str) -> None:
+        """Snapshot a directory subtree (reference mkdir .snap/<name>)."""
+        out = self._req("snap_create", {"path": dirpath, "name": name})
+        self._apply_snapc(out.get("snapc"))
+
+    def snap_rm(self, dirpath: str, name: str) -> None:
+        out = self._req("snap_rm", {"path": dirpath, "name": name})
+        self._apply_snapc(out.get("snapc"))
+
+    def snap_list(self, dirpath: str) -> list[str]:
+        return self._req("snap_list", {"path": dirpath})["snaps"]
 
     def _uncache(self, *paths: str) -> None:
         """Our own namespace mutations invalidate the lease cache: no
@@ -189,6 +268,17 @@ class CephFS:
     # -- file I/O ------------------------------------------------------------
 
     def open(self, path: str, mode: str = "r") -> "File":
+        snap = self._split_snap(path)
+        if snap is not None:
+            if "w" in mode or "a" in mode or "+" in mode:
+                raise FSError(30, f"{path}: snapshots are read-only")
+            dirpath, name, rel = snap
+            out = self._req("snap_resolve", {
+                "path": dirpath, "name": name, "rel": rel})
+            from .mds import S_IFDIR
+            if out["ent"]["mode"] & S_IFDIR:
+                raise FSError(21, path)
+            return File(self, path, out["ent"], snap_id=out["snapid"])
         writing = "w" in mode or "a" in mode or "+" in mode
         # POSIX fopen: w/w+/a/a+ create; r/r+ require existence
         out = self._req("open", {
@@ -225,17 +315,21 @@ class File:
     """An open file handle (reference Fh): striped block I/O against
     the data pool; size/mtime pushed to the MDS on flush/close."""
 
-    def __init__(self, fs: CephFS, path: str, ent: dict):
+    def __init__(self, fs: CephFS, path: str, ent: dict,
+                 snap_id: int = 0):
         self.fs = fs
         self.path = path
         self.ino = ent["ino"]
         self.size = ent.get("size", 0)
         self.pos = 0
+        self.snap_id = snap_id      # >0: read-only snapshot view
         self._dirty = False
 
     # -- striping ------------------------------------------------------------
 
     def pwrite(self, data: bytes, offset: int) -> int:
+        if self.snap_id:
+            raise FSError(30, f"{self.path}: snapshot is read-only")
         bs = self.fs.block_size
         off = offset
         view = memoryview(data)
@@ -267,7 +361,8 @@ class File:
             from ..rados.client import RadosError
             try:
                 piece = self.fs.data.read(data_oid(self.ino, blk),
-                                          n, offset=in_blk)
+                                          n, offset=in_blk,
+                                          snap=self.snap_id)
             except RadosError as e:
                 if e.errno != 2:   # only ENOENT is a sparse hole
                     # a cluster fault must not read back as zeros
@@ -295,6 +390,8 @@ class File:
         self.pos = pos
 
     def truncate(self, size: int) -> None:
+        if self.snap_id:
+            raise FSError(30, f"{self.path}: snapshot is read-only")
         bs = self.fs.block_size
         from ..rados.client import RadosError
         old_blocks = -(-max(self.size, 1) // bs)

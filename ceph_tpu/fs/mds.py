@@ -21,6 +21,17 @@ src/mds/Server.cc):
   INTENT to the MDLog before touching directory objects and replay it
   on restart (reference MDLog + journal/; see mdlog.py) — an MDS
   killed mid-rename comes back to a consistent namespace.
+- FS snapshots (reference SnapServer + the .snap virtual directory,
+  reduced): snapshotting a directory allocates a RADOS selfmanaged
+  snap id for the data pool — file DATA is COW'd by the OSDs at zero
+  copy cost, exactly like RBD snapshots — and records an EAGER copy of
+  the subtree namespace in a snap registry object (the reference COWs
+  dentries lazily; eager manifest is the reduced form, O(subtree) at
+  snap time).  Clients learn the new SnapContext through an MClientCaps
+  "snapc" broadcast so subsequent writes clone; reads under
+  path/.snap/<name>/... resolve against the manifest at the recorded
+  snap id.  In-flight writes racing the broadcast land pre-snap
+  (documented reduction of the reference's cap-revoke quiesce).
 - File capabilities (reference Locker.h / Capability.h, reduced):
   open grants caps per (ino, session) — "r"ead, "w"rite, and "c"ache
   (the right to cache attrs and buffer size updates client-side,
@@ -50,6 +61,7 @@ META_POOL = "cephfs_metadata"
 DATA_POOL = "cephfs_data"
 ROOT_INO = 1
 INOTABLE_OBJ = "mds_inotable"
+SNAP_REGISTRY = "mds_snaptable"
 
 S_IFDIR = 0o040000
 S_IFREG = 0o100000
@@ -78,6 +90,16 @@ class MDSDaemon:
         self._locks = [threading.Lock() for _ in range(64)]
         self._ino_lock = threading.Lock()
         self._mkfs()
+        # capability + snapshot state first: mdlog replay may purge
+        # data, which consults the snapc (reference Locker/Capability,
+        # SnapServer — both reduced)
+        self._sessions: dict[str, object] = {}      # client id -> conn
+        self._caps: dict[int, dict[str, str]] = {}  # ino -> {sess: caps}
+        self._cap_lock = threading.Lock()
+        self._cap_seq = 0
+        self._snapc_cache: list | None = None
+        self._snap_epoch = 0
+        self._flush_waiters: dict[tuple, threading.Event] = {}
         from .mdlog import MDLog
         # log keyed by MDS name: a restart under the same name replays
         # its own intents; a concurrently-booted second MDS must NOT
@@ -86,12 +108,6 @@ class MDSDaemon:
         # out of scope — single active MDS.
         self.mdlog = MDLog(self.meta, rank=name)
         self._replay_mdlog()
-        # capability state (reference Locker/Capability, reduced)
-        self._sessions: dict[str, object] = {}      # client id -> conn
-        self._caps: dict[int, dict[str, str]] = {}  # ino -> {sess: caps}
-        self._cap_lock = threading.Lock()
-        self._cap_seq = 0
-        self._flush_waiters: dict[tuple, threading.Event] = {}
         self.messenger = Messenger("mds", auth=auth, secure=secure)
         self.messenger.add_dispatcher(self._dispatch)
         self.addr = self.messenger.bind(addr)
@@ -245,10 +261,23 @@ class MDSDaemon:
             if sess:
                 with self._cap_lock:
                     self._sessions[sess] = conn
+            with self._cap_lock:
+                epoch = self._snap_epoch
             return {"block_size": self.block_size,
-                    "data_pool": DATA_POOL, "root": ROOT_INO}
+                    "data_pool": DATA_POOL, "root": ROOT_INO,
+                    "snapc": self._fs_snapc(), "snap_epoch": epoch}
         if op == "open":
             return self._handle_open(a)
+        if op == "snap_create":
+            return self._handle_snap_create(a)
+        if op == "snap_rm":
+            return self._handle_snap_rm(a)
+        if op == "snap_list":
+            _, ent = self._resolve(a["path"])
+            rows = self._snap_rows(ent["ino"])
+            return {"snaps": sorted(rows)}
+        if op == "snap_resolve":
+            return self._handle_snap_resolve(a)
         if op == "cap_flush":
             return self._handle_cap_flush(a)
         if op == "cap_release":
@@ -403,6 +432,152 @@ class MDSDaemon:
             self.mdlog.mark_done(seq)
             return {}
         raise _Err(errno.EOPNOTSUPP, op)
+
+    # -- FS snapshots (reference SnapServer / .snap, reduced) ---------------
+
+    def _snap_rows(self, dino: int) -> dict[str, dict]:
+        """Registry rows for one directory: small (snapid/created),
+        the manifest lives in its own object."""
+        try:
+            raw = self.meta.execute(
+                SNAP_REGISTRY, "rgw", "dir_list",
+                json.dumps({"prefix": f"{dino:x}/",
+                            "max": 10000}).encode())
+        except RadosError as e:
+            if e.errno == errno.ENOENT:
+                return {}        # registry never created: no snaps
+            raise                # cluster fault != "no snapshots"
+        out = json.loads(raw.decode())
+        return {k.split("/", 1)[1]: m for k, m in out["entries"]}
+
+    @staticmethod
+    def _manifest_oid(dino: int, name: str) -> str:
+        return f"snapmanifest.{dino:x}.{name}"
+
+    def _collect_subtree(self, dino: int, rel: str = "") -> dict:
+        """Eager namespace manifest: relpath -> entry, recursively."""
+        manifest: dict[str, dict] = {}
+        for name, ent in self._dlist(dino):
+            path = f"{rel}{name}"
+            manifest[path] = ent
+            if ent["mode"] & S_IFDIR:
+                manifest.update(
+                    self._collect_subtree(ent["ino"], f"{path}/"))
+        return manifest
+
+    def _fs_snapc(self) -> list:
+        """[seq, [ids desc]] across every live snapshot (one data pool
+        -> one SnapContext, like the reference's global snap realm).
+        Cached; snap_create/rm invalidate.  A registry READ FAULT must
+        raise, never degrade to "no snapshots" — a purge under an
+        empty snapc destroys snapshot data."""
+        with self._cap_lock:
+            if self._snapc_cache is not None:
+                return list(self._snapc_cache)
+        ids = []
+        try:
+            raw = self.meta.execute(
+                SNAP_REGISTRY, "rgw", "dir_list",
+                json.dumps({"max": 10000}).encode())
+            for _k, m in json.loads(raw.decode())["entries"]:
+                ids.append(int(m["snapid"]))
+        except RadosError as e:
+            if e.errno != errno.ENOENT:
+                raise
+        ids.sort(reverse=True)
+        snapc = [ids[0] if ids else 0, ids]
+        with self._cap_lock:
+            self._snapc_cache = list(snapc)
+        return snapc
+
+    def _snap_mutated(self) -> list:
+        """Invalidate + recompute the snapc and bump the epoch clients
+        order their updates by; returns the fresh snapc."""
+        with self._cap_lock:
+            self._snapc_cache = None
+            self._snap_epoch += 1
+            epoch = self._snap_epoch
+        snapc = self._fs_snapc()
+        self._broadcast_snapc(snapc, epoch)
+        return snapc
+
+    def _broadcast_snapc(self, snapc: list, epoch: int) -> None:
+        payload = json.dumps(snapc)
+        with self._cap_lock:
+            conns = list(self._sessions.values())
+        for conn in conns:
+            try:
+                conn.send_message(
+                    M.MClientCaps("snapc", 0, payload, epoch))
+            except Exception:  # noqa: BLE001 - dead session
+                pass
+
+    def _handle_snap_create(self, a: dict) -> dict:
+        _, ent = self._resolve(a["path"])
+        if not ent["mode"] & S_IFDIR:
+            raise _Err(errno.ENOTDIR, a["path"])
+        dino = ent["ino"]
+        if a["name"] in self._snap_rows(dino):
+            raise _Err(errno.EEXIST, a["name"])
+        snapid = self.data.selfmanaged_snap_create()
+        manifest = self._collect_subtree(dino)
+        # manifest first (its own object: registry rows stay tiny),
+        # then the registry row that makes the snapshot visible
+        self.meta.write_full(
+            self._manifest_oid(dino, a["name"]),
+            json.dumps(manifest, separators=(",", ":")).encode())
+        self.meta.execute(SNAP_REGISTRY, "rgw", "dir_add", json.dumps({
+            "key": f"{dino:x}/{a['name']}",
+            "meta": {"snapid": snapid,
+                     "created": time.time()}}).encode())
+        snapc = self._snap_mutated()
+        return {"snapid": snapid, "snapc": snapc}
+
+    def _handle_snap_rm(self, a: dict) -> dict:
+        _, ent = self._resolve(a["path"])
+        rows = self._snap_rows(ent["ino"])
+        row = rows.get(a["name"])
+        if row is None:
+            raise _Err(errno.ENOENT, a["name"])
+        self.meta.execute(SNAP_REGISTRY, "rgw", "dir_rm", json.dumps({
+            "key": f"{ent['ino']:x}/{a['name']}"}).encode())
+        try:
+            self.meta.remove(self._manifest_oid(ent["ino"], a["name"]))
+        except RadosError:
+            pass
+        # let the OSD snap trimmer reclaim the clones
+        try:
+            self.data.selfmanaged_snap_remove(int(row["snapid"]))
+        except RadosError:
+            pass   # advisory; trim just won't run for this id yet
+        return {"snapc": self._snap_mutated()}
+
+    def _handle_snap_resolve(self, a: dict) -> dict:
+        """path/.snap/<name>/<rel> -> (ent at snap time, snapid).
+        rel='' names the snapshotted dir itself; 'entries' lists one
+        level of the manifest for readdir."""
+        _, ent = self._resolve(a["path"])
+        rows = self._snap_rows(ent["ino"])
+        row = rows.get(a["name"])
+        if row is None:
+            raise _Err(errno.ENOENT, f".snap/{a['name']}")
+        rel = a.get("rel", "").strip("/")
+        manifest = json.loads(self.meta.read(
+            self._manifest_oid(ent["ino"], a["name"]), 0).decode())
+        if rel:
+            target = manifest.get(rel)
+            if target is None:
+                raise _Err(errno.ENOENT, rel)
+        else:
+            target = {"ino": ent["ino"], "mode": S_IFDIR, "size": 0,
+                      "mtime": row["created"]}
+        out = {"ent": target, "snapid": int(row["snapid"])}
+        if target["mode"] & S_IFDIR:
+            pfx = f"{rel}/" if rel else ""
+            out["entries"] = sorted(
+                (p[len(pfx):], e) for p, e in manifest.items()
+                if p.startswith(pfx) and "/" not in p[len(pfx):])
+        return out
 
     # -- capabilities (reference Locker::issue_caps / revoke) ---------------
 
@@ -605,7 +780,12 @@ class MDSDaemon:
         return _ctx()
 
     def _purge_data(self, ent: dict) -> None:
-        """Remove a dead inode's data blocks (reference PurgeQueue)."""
+        """Remove a dead inode's data blocks (reference PurgeQueue).
+        The removal carries the fs SnapContext: blocks referenced by a
+        live snapshot are COW-preserved by the OSD (delete clones +
+        snapdir), not destroyed."""
+        snapc = self._fs_snapc()
+        self.data.snapc = snapc if snapc[1] else None
         nblocks = -(-max(ent.get("size", 0), 1) // self.block_size)
         for b in range(nblocks):
             try:

@@ -163,23 +163,27 @@ class _Handler(BaseHTTPRequestHandler):
             marker = query.get("start-after",
                                query.get("continuation-token", ""))
             max_keys = int(query.get("max-keys", 1000))
-            entries, truncated = st.list_objects(
-                bucket, prefix, marker, max_keys)
+            delimiter = query.get("delimiter", "")
+            entries, cps, truncated, next_marker = st.list_objects(
+                bucket, prefix, marker, max_keys, delimiter)
             rows = "".join(
                 "<Contents>"
                 f"<Key>{escape(k)}</Key>"
                 f"<Size>{m['size']}</Size>"
                 f"<ETag>&quot;{m['etag']}&quot;</ETag>"
                 "</Contents>" for k, m in entries)
-            nct = (f"<NextContinuationToken>{escape(entries[-1][0])}"
+            rows += "".join(
+                f"<CommonPrefixes><Prefix>{escape(cp)}</Prefix>"
+                f"</CommonPrefixes>" for cp in cps)
+            nct = (f"<NextContinuationToken>{escape(next_marker)}"
                    f"</NextContinuationToken>"
-                   if truncated and entries else "")
+                   if truncated and next_marker else "")
             self._reply(200, (
                 '<?xml version="1.0" encoding="UTF-8"?>'
                 "<ListBucketResult>"
                 f"<Name>{escape(bucket)}</Name>"
                 f"<Prefix>{escape(prefix)}</Prefix>"
-                f"<KeyCount>{len(entries)}</KeyCount>"
+                f"<KeyCount>{len(entries) + len(cps)}</KeyCount>"
                 f"<IsTruncated>{'true' if truncated else 'false'}"
                 f"</IsTruncated>{nct}{rows}"
                 "</ListBucketResult>").encode())

@@ -356,11 +356,65 @@ class RGWStore:
             pass
 
     def list_objects(self, bucket: str, prefix: str = "",
-                     marker: str = "", max_keys: int = 1000
-                     ) -> tuple[list[tuple[str, dict]], bool]:
+                     marker: str = "", max_keys: int = 1000,
+                     delimiter: str = ""
+                     ) -> tuple[list, list[str], bool, str]:
+        """(contents, common_prefixes, truncated, next_marker).  With a
+        delimiter, keys sharing prefix+...+delimiter roll up into one
+        CommonPrefixes entry (reference RGWListBucket delimiter
+        handling — what `aws s3 ls` folder listings are made of).
+        next_marker is the resume point for the continuation token —
+        past the last emitted key OR past a whole rolled-up folder."""
         self._require_bucket(bucket)
-        out = json.loads(self._cls(
-            self.meta, f"index.{bucket}", "dir_list",
-            {"prefix": prefix, "marker": marker,
-             "max": max_keys}).decode())
-        return [(k, m) for k, m in out["entries"]], out["truncated"]
+        if not delimiter:
+            out = json.loads(self._cls(
+                self.meta, f"index.{bucket}", "dir_list",
+                {"prefix": prefix, "marker": marker,
+                 "max": max_keys}).decode())
+            entries = [(k, m) for k, m in out["entries"]]
+            nm = entries[-1][0] if entries else ""
+            return entries, [], out["truncated"], nm
+        # SEEK-PAST sentinel: after rolling keys into a CommonPrefix,
+        # resume AFTER the whole folder — both so a 1M-key folder costs
+        # one index probe instead of 1M walks, and so the continuation
+        # marker can never land back on the same prefix (pagination
+        # livelock)
+        after = "\U0010ffff"
+        contents: list[tuple[str, dict]] = []
+        prefixes: list[str] = []
+        cur = marker
+        truncated = False
+        while len(contents) + len(prefixes) < max_keys:
+            out = json.loads(self._cls(
+                self.meta, f"index.{bucket}", "dir_list",
+                {"prefix": prefix, "marker": cur,
+                 "max": max_keys}).decode())
+            if not out["entries"]:
+                truncated = False
+                break
+            for k, m in out["entries"]:
+                rest = k[len(prefix):]
+                d = rest.find(delimiter)
+                if d >= 0:
+                    cp = prefix + rest[: d + len(delimiter)]
+                    if len(contents) + len(prefixes) >= max_keys:
+                        return contents, prefixes, True, cur
+                    prefixes.append(cp)
+                    cur = cp + after          # skip the whole folder
+                    break                     # re-probe past it
+                if len(contents) + len(prefixes) >= max_keys:
+                    return contents, prefixes, True, cur
+                contents.append((k, m))
+                cur = k
+            else:
+                truncated = out["truncated"]
+                if not truncated:
+                    break
+        else:
+            # budget exhausted at a roll-up boundary: anything left
+            # past the marker means the listing IS truncated
+            probe = json.loads(self._cls(
+                self.meta, f"index.{bucket}", "dir_list",
+                {"prefix": prefix, "marker": cur, "max": 1}).decode())
+            truncated = bool(probe["entries"])
+        return contents, prefixes, truncated, cur

@@ -1,0 +1,108 @@
+"""CephFS snapshots (reference SnapServer + the .snap virtual
+directory, reduced per mds.py docstring): data COW via rados
+selfmanaged snaps, eager namespace manifest, read-only .snap views,
+snapc propagation to other clients through the caps channel."""
+
+import time
+
+import pytest
+
+from ceph_tpu.fs import CephFS, MDSDaemon
+from ceph_tpu.fs.client import FSError
+from ceph_tpu.tools.vstart import Cluster
+
+
+@pytest.fixture(scope="module")
+def fs_env():
+    with Cluster(n_osds=3) as c:
+        mds = MDSDaemon(c.mon_addrs[0])
+        fs = CephFS(c.mon_addrs[0], mds.addr, name="snapc1")
+        yield c, mds, fs
+        fs.shutdown()
+        mds.shutdown()
+
+
+def test_snapshot_preserves_data_and_namespace(fs_env):
+    _, _, fs = fs_env
+    fs.makedirs("/proj/sub")
+    fs.write_file("/proj/a.txt", b"version-one")
+    fs.write_file("/proj/sub/b.txt", b"bee")
+    fs.snap_create("/proj", "s1")
+    assert fs.snap_list("/proj") == ["s1"]
+    # mutate everything after the snap
+    fs.write_file("/proj/a.txt", b"version-TWO!")
+    fs.unlink("/proj/sub/b.txt")
+    fs.write_file("/proj/new.txt", b"post-snap")
+    # live view
+    assert fs.read_file("/proj/a.txt") == b"version-TWO!"
+    # snapshot view: old data, old namespace
+    assert fs.read_file("/proj/.snap/s1/a.txt") == b"version-one"
+    assert fs.read_file("/proj/.snap/s1/sub/b.txt") == b"bee"
+    names = [k for k, _ in fs.readdir("/proj/.snap/s1")]
+    assert sorted(names) == ["a.txt", "sub"]
+    assert [k for k, _ in fs.readdir("/proj/.snap/s1/sub")] == ["b.txt"]
+    ent = fs.stat("/proj/.snap/s1/a.txt")
+    assert ent["size"] == len(b"version-one")
+
+
+def test_snapshot_views_are_read_only(fs_env):
+    _, _, fs = fs_env
+    fs.makedirs("/ro")
+    fs.write_file("/ro/f", b"x")
+    fs.snap_create("/ro", "locked")
+    with pytest.raises(FSError):
+        fs.open("/ro/.snap/locked/f", "w")
+    f = fs.open("/ro/.snap/locked/f", "r")
+    with pytest.raises(FSError):
+        f.pwrite(b"nope", 0)
+    with pytest.raises(FSError):
+        f.truncate(0)
+
+
+def test_second_client_writes_cow_after_snap(fs_env):
+    """A snapshot taken by client A must make client B's (already
+    mounted) writes COW — the snapc broadcast via the caps channel."""
+    c, mds, fs_a = fs_env
+    fs_b = CephFS(c.mon_addrs[0], mds.addr, name="snapc2")
+    try:
+        fs_a.makedirs("/shared2")
+        fs_b.write_file("/shared2/data", b"original-content")
+        fs_a.snap_create("/shared2", "before")
+        time.sleep(0.3)     # broadcast delivery
+        fs_b.write_file("/shared2/data", b"OVERWRITTEN BY B")
+        assert fs_a.read_file("/shared2/.snap/before/data") == \
+            b"original-content"
+        assert fs_a.read_file("/shared2/data") == b"OVERWRITTEN BY B"
+    finally:
+        fs_b.shutdown()
+
+
+def test_snap_rm(fs_env):
+    _, _, fs = fs_env
+    fs.makedirs("/rmme")
+    fs.write_file("/rmme/f", b"z")
+    fs.snap_create("/rmme", "gone")
+    fs.snap_rm("/rmme", "gone")
+    assert fs.snap_list("/rmme") == []
+    with pytest.raises(FSError):
+        fs.read_file("/rmme/.snap/gone/f")
+    # duplicate names rejected while live
+    fs.snap_create("/rmme", "fresh")
+    with pytest.raises(FSError):
+        fs.snap_create("/rmme", "fresh")
+
+
+def test_snapshots_survive_mds_restart(fs_env):
+    c, mds, fs = fs_env
+    fs.makedirs("/dur")
+    fs.write_file("/dur/f", b"keep-me")
+    fs.snap_create("/dur", "perm")
+    fs.write_file("/dur/f", b"changed")
+    mds2 = MDSDaemon(c.mon_addrs[0])      # registry is in the meta pool
+    try:
+        fs2 = CephFS(c.mon_addrs[0], mds2.addr, name="snapc3")
+        assert fs2.snap_list("/dur") == ["perm"]
+        assert fs2.read_file("/dur/.snap/perm/f") == b"keep-me"
+        fs2.shutdown()
+    finally:
+        mds2.shutdown()

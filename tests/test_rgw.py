@@ -112,6 +112,61 @@ def test_listing_prefix_and_pagination(s3):
     assert len(keys) == 8 and keys == sorted(keys)
 
 
+def test_delimiter_common_prefixes(s3):
+    """delimiter=/ folds "folders" into CommonPrefixes — the shape
+    `aws s3 ls` consumes (reference RGWListBucket delimiter)."""
+    import re
+    s3.request("PUT", "/delim1")
+    for key in ["top.txt", "a/one", "a/two", "a/deep/three", "b/x"]:
+        s3.request("PUT", f"/delim1/{key}", body=b"d")
+    st, _, body = s3.request(
+        "GET", "/delim1", query="list-type=2&delimiter=/")
+    assert st == 200
+    keys = re.findall(rb"<Key>([^<]+)</Key>", body)
+    cps = re.findall(rb"<Prefix>([^<]+)</Prefix>", body)
+    assert keys == [b"top.txt"]
+    assert b"a/" in cps and b"b/" in cps
+    assert b"a/deep/" not in cps          # only one level folds
+    # prefix + delimiter descends one level
+    st, _, body = s3.request(
+        "GET", "/delim1", query="list-type=2&delimiter=/&prefix=a/")
+    keys = re.findall(rb"<Key>([^<]+)</Key>", body)
+    cps = re.findall(rb"<Prefix>([^<]+)</Prefix>", body)
+    assert set(keys) == {b"a/one", b"a/two"}
+    assert b"a/deep/" in cps
+
+
+def test_delimiter_pagination_tiny_pages(s3):
+    """max-keys smaller than the folder count: the continuation token
+    must make progress past rolled-up folders (no livelock) and
+    IsTruncated must stay true until everything is emitted."""
+    import re
+    import urllib.parse
+    s3.request("PUT", "/delim2")
+    for key in ["a/1", "a/2", "b/1", "c.txt", "d/9", "e.txt"]:
+        s3.request("PUT", f"/delim2/{key}", body=b"x")
+    items = []
+    token = ""
+    pages = 0
+    while pages < 10:
+        q = "list-type=2&delimiter=/&max-keys=2" + \
+            (f"&continuation-token={token}" if token else "")
+        st, _, body = s3.request("GET", "/delim2", query=q)
+        items += re.findall(rb"<Key>([^<]+)</Key>", body)
+        items += re.findall(
+            rb"<CommonPrefixes><Prefix>([^<]+)</Prefix>", body)
+        pages += 1
+        if b"<IsTruncated>true</IsTruncated>" not in body:
+            break
+        token = urllib.parse.quote(re.search(
+            rb"<NextContinuationToken>([^<]+)"
+            rb"</NextContinuationToken>", body).group(1).decode())
+    assert sorted(set(items)) == [b"a/", b"b/", b"c.txt", b"d/",
+                                  b"e.txt"]
+    assert len(items) == 5          # no duplicates across pages
+    assert pages == 3
+
+
 def test_bucket_not_empty_and_missing(s3):
     s3.request("PUT", "/full1")
     s3.request("PUT", "/full1/obj", body=b"z")
