@@ -70,16 +70,21 @@ def dir_get(ctx, inp: bytes) -> bytes:
 
 
 def dir_list(ctx, inp: bytes) -> bytes:
-    """input: {"prefix": str, "marker": str, "max": int} ->
-    {"entries": [[key, meta]...], "truncated": bool} in key order
-    (reference rgw_bucket_dir list with pagination)."""
+    """input: {"prefix": str, "marker": str, "from": str, "max": int}
+    -> {"entries": [[key, meta]...], "truncated": bool} in key order
+    (reference rgw_bucket_dir list with pagination).  "marker" is an
+    EXCLUSIVE lower bound (keys > marker); "from" is INCLUSIVE (keys
+    >= from) — delimiter pagination resumes at a computed successor
+    that must not itself be skippable."""
     req = json.loads(inp.decode()) if inp else {}
     prefix = req.get("prefix", "")
     marker = req.get("marker", "")
+    resume = req.get("from", "")
     limit = int(req.get("max", 1000))
     d = _load(ctx)
     keys = sorted(k for k in d
-                  if k.startswith(prefix) and k > marker)
+                  if k.startswith(prefix) and k > marker
+                  and (not resume or k >= resume))
     out = [[k, d[k]] for k in keys[:limit]]
     return json.dumps({"entries": out,
                        "truncated": len(keys) > limit}).encode()

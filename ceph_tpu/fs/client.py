@@ -164,6 +164,13 @@ class CephFS:
     # -- namespace -----------------------------------------------------------
 
     def stat(self, path: str) -> dict:
+        parts = [p for p in path.split("/") if p]
+        if parts and parts[-1] == ".snap":
+            # the .snap virtual directory itself
+            from .mds import S_IFDIR
+            self.snap_list("/" + "/".join(parts[:-1]))  # ENOENT check
+            return {"ino": 0, "mode": S_IFDIR | 0o555, "size": 0,
+                    "mtime": 0}
         snap = self._split_snap(path)
         if snap is not None:
             dirpath, name, rel = snap
@@ -198,6 +205,14 @@ class CephFS:
                     raise
 
     def readdir(self, path: str) -> list[tuple[str, dict]]:
+        parts = [p for p in path.split("/") if p]
+        if parts and parts[-1] == ".snap":
+            # listing the .snap virtual dir enumerates snapshot names
+            from .mds import S_IFDIR
+            dirpath = "/" + "/".join(parts[:-1])
+            return [(n, {"ino": 0, "mode": S_IFDIR | 0o555,
+                         "size": 0, "mtime": 0})
+                    for n in self.snap_list(dirpath)]
         snap = self._split_snap(path)
         if snap is not None:
             dirpath, name, rel = snap
@@ -237,11 +252,11 @@ class CephFS:
     def snap_create(self, dirpath: str, name: str) -> None:
         """Snapshot a directory subtree (reference mkdir .snap/<name>)."""
         out = self._req("snap_create", {"path": dirpath, "name": name})
-        self._apply_snapc(out.get("snapc"))
+        self._apply_snapc(out.get("snapc"), out.get("snap_epoch", 0))
 
     def snap_rm(self, dirpath: str, name: str) -> None:
         out = self._req("snap_rm", {"path": dirpath, "name": name})
-        self._apply_snapc(out.get("snapc"))
+        self._apply_snapc(out.get("snapc"), out.get("snap_epoch", 0))
 
     def snap_list(self, dirpath: str) -> list[str]:
         return self._req("snap_list", {"path": dirpath})["snaps"]

@@ -448,6 +448,9 @@ class MDSDaemon:
                 return {}        # registry never created: no snaps
             raise                # cluster fault != "no snapshots"
         out = json.loads(raw.decode())
+        if out["truncated"]:
+            raise RadosError(errno.EIO,
+                             "snap registry exceeds one page")
         return {k.split("/", 1)[1]: m for k, m in out["entries"]}
 
     @staticmethod
@@ -474,12 +477,19 @@ class MDSDaemon:
         with self._cap_lock:
             if self._snapc_cache is not None:
                 return list(self._snapc_cache)
+            epoch_at_read = self._snap_epoch
         ids = []
         try:
             raw = self.meta.execute(
                 SNAP_REGISTRY, "rgw", "dir_list",
                 json.dumps({"max": 10000}).encode())
-            for _k, m in json.loads(raw.decode())["entries"]:
+            out = json.loads(raw.decode())
+            if out["truncated"]:
+                # a snapc missing ids silently destroys those
+                # snapshots on the next purge — refuse instead
+                raise RadosError(errno.EIO,
+                                 "snap registry exceeds one page")
+            for _k, m in out["entries"]:
                 ids.append(int(m["snapid"]))
         except RadosError as e:
             if e.errno != errno.ENOENT:
@@ -487,19 +497,24 @@ class MDSDaemon:
         ids.sort(reverse=True)
         snapc = [ids[0] if ids else 0, ids]
         with self._cap_lock:
-            self._snapc_cache = list(snapc)
+            # a snap_create/rm racing this read has bumped the epoch:
+            # its registry row may be missing from our list, and
+            # caching it would pin a stale snapc until the NEXT
+            # mutation — only cache what no mutation outran
+            if self._snap_epoch == epoch_at_read:
+                self._snapc_cache = list(snapc)
         return snapc
 
-    def _snap_mutated(self) -> list:
+    def _snap_mutated(self) -> tuple[list, int]:
         """Invalidate + recompute the snapc and bump the epoch clients
-        order their updates by; returns the fresh snapc."""
+        order their updates by; returns (snapc, epoch)."""
         with self._cap_lock:
             self._snapc_cache = None
             self._snap_epoch += 1
             epoch = self._snap_epoch
         snapc = self._fs_snapc()
         self._broadcast_snapc(snapc, epoch)
-        return snapc
+        return snapc, epoch
 
     def _broadcast_snapc(self, snapc: list, epoch: int) -> None:
         payload = json.dumps(snapc)
@@ -530,8 +545,8 @@ class MDSDaemon:
             "key": f"{dino:x}/{a['name']}",
             "meta": {"snapid": snapid,
                      "created": time.time()}}).encode())
-        snapc = self._snap_mutated()
-        return {"snapid": snapid, "snapc": snapc}
+        snapc, epoch = self._snap_mutated()
+        return {"snapid": snapid, "snapc": snapc, "snap_epoch": epoch}
 
     def _handle_snap_rm(self, a: dict) -> dict:
         _, ent = self._resolve(a["path"])
@@ -550,7 +565,8 @@ class MDSDaemon:
             self.data.selfmanaged_snap_remove(int(row["snapid"]))
         except RadosError:
             pass   # advisory; trim just won't run for this id yet
-        return {"snapc": self._snap_mutated()}
+        snapc, epoch = self._snap_mutated()
+        return {"snapc": snapc, "snap_epoch": epoch}
 
     def _handle_snap_resolve(self, a: dict) -> dict:
         """path/.snap/<name>/<rel> -> (ent at snap time, snapid).

@@ -160,12 +160,14 @@ class _Handler(BaseHTTPRequestHandler):
                     "</ListMultipartUploadsResult>").encode())
                 return
             prefix = query.get("prefix", "")
-            marker = query.get("start-after",
-                               query.get("continuation-token", ""))
+            # S3 semantics: ContinuationToken (inclusive resume point
+            # we minted) wins over StartAfter (client's exclusive key)
+            marker = query.get("start-after", "")
+            resume = query.get("continuation-token", "")
             max_keys = int(query.get("max-keys", 1000))
             delimiter = query.get("delimiter", "")
             entries, cps, truncated, next_marker = st.list_objects(
-                bucket, prefix, marker, max_keys, delimiter)
+                bucket, prefix, marker, max_keys, delimiter, resume)
             rows = "".join(
                 "<Contents>"
                 f"<Key>{escape(k)}</Key>"

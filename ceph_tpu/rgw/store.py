@@ -355,44 +355,57 @@ class RGWStore:
         except RadosError:
             pass
 
+    @staticmethod
+    def _prefix_successor(p: str) -> str | None:
+        """Smallest string ordering AFTER every string prefixed by p
+        (None when no such string exists)."""
+        while p and p[-1] == "\U0010ffff":
+            p = p[:-1]
+        if not p:
+            return None
+        return p[:-1] + chr(ord(p[-1]) + 1)
+
     def list_objects(self, bucket: str, prefix: str = "",
                      marker: str = "", max_keys: int = 1000,
-                     delimiter: str = ""
+                     delimiter: str = "", resume: str = ""
                      ) -> tuple[list, list[str], bool, str]:
-        """(contents, common_prefixes, truncated, next_marker).  With a
-        delimiter, keys sharing prefix+...+delimiter roll up into one
+        """(contents, common_prefixes, truncated, resume_point).  With
+        a delimiter, keys sharing prefix+...+delimiter roll up into one
         CommonPrefixes entry (reference RGWListBucket delimiter
         handling — what `aws s3 ls` folder listings are made of).
-        next_marker is the resume point for the continuation token —
-        past the last emitted key OR past a whole rolled-up folder."""
+        `marker` (StartAfter) is exclusive; `resume` (continuation
+        token) is an INCLUSIVE lower bound and takes precedence.  The
+        returned resume_point feeds the next request's `resume`:
+        key+"\\0" past an emitted key, or the prefix successor past a
+        rolled-up folder — so folders cost one index probe each (not a
+        walk of every key underneath) and progress is guaranteed for
+        ANY legal key bytes (no sentinel-collision livelock)."""
         self._require_bucket(bucket)
         if not delimiter:
             out = json.loads(self._cls(
                 self.meta, f"index.{bucket}", "dir_list",
-                {"prefix": prefix, "marker": marker,
+                {"prefix": prefix, "marker": marker, "from": resume,
                  "max": max_keys}).decode())
             entries = [(k, m) for k, m in out["entries"]]
-            nm = entries[-1][0] if entries else ""
+            nm = entries[-1][0] + "\x00" if entries else ""
             return entries, [], out["truncated"], nm
-        # SEEK-PAST sentinel: after rolling keys into a CommonPrefix,
-        # resume AFTER the whole folder — both so a 1M-key folder costs
-        # one index probe instead of 1M walks, and so the continuation
-        # marker can never land back on the same prefix (pagination
-        # livelock)
-        after = "\U0010ffff"
         contents: list[tuple[str, dict]] = []
         prefixes: list[str] = []
-        cur = marker
+        cur = resume
         truncated = False
-        while len(contents) + len(prefixes) < max_keys:
+        exhausted = False
+        while not exhausted and \
+                len(contents) + len(prefixes) < max_keys:
             out = json.loads(self._cls(
                 self.meta, f"index.{bucket}", "dir_list",
-                {"prefix": prefix, "marker": cur,
+                {"prefix": prefix, "marker": marker, "from": cur,
                  "max": max_keys}).decode())
             if not out["entries"]:
-                truncated = False
                 break
+            skip_cp = None     # folder already emitted from this page
             for k, m in out["entries"]:
+                if skip_cp is not None and k.startswith(skip_cp):
+                    continue   # same folder: already rolled up
                 rest = k[len(prefix):]
                 d = rest.find(delimiter)
                 if d >= 0:
@@ -400,21 +413,27 @@ class RGWStore:
                     if len(contents) + len(prefixes) >= max_keys:
                         return contents, prefixes, True, cur
                     prefixes.append(cp)
-                    cur = cp + after          # skip the whole folder
-                    break                     # re-probe past it
-                if len(contents) + len(prefixes) >= max_keys:
-                    return contents, prefixes, True, cur
-                contents.append((k, m))
-                cur = k
+                    skip_cp = cp
+                    succ = self._prefix_successor(cp)
+                    if succ is None:
+                        exhausted = True   # nothing can sort after
+                        break
+                    cur = succ
+                else:
+                    if len(contents) + len(prefixes) >= max_keys:
+                        return contents, prefixes, True, cur
+                    contents.append((k, m))
+                    cur = k + "\x00"
             else:
-                truncated = out["truncated"]
-                if not truncated:
+                if not out["truncated"]:
                     break
-        else:
-            # budget exhausted at a roll-up boundary: anything left
-            # past the marker means the listing IS truncated
+                continue
+            break    # inner break (exhausted): stop probing
+        if not exhausted and len(contents) + len(prefixes) >= max_keys:
+            # page budget reached: truncated iff anything remains
             probe = json.loads(self._cls(
                 self.meta, f"index.{bucket}", "dir_list",
-                {"prefix": prefix, "marker": cur, "max": 1}).decode())
+                {"prefix": prefix, "marker": marker, "from": cur,
+                 "max": 1}).decode())
             truncated = bool(probe["entries"])
         return contents, prefixes, truncated, cur

@@ -77,6 +77,19 @@ def test_second_client_writes_cow_after_snap(fs_env):
         fs_b.shutdown()
 
 
+def test_dot_snap_virtual_dir_lists_snapshots(fs_env):
+    _, _, fs = fs_env
+    fs.makedirs("/vd")
+    fs.write_file("/vd/f", b"1")
+    fs.snap_create("/vd", "one")
+    fs.snap_create("/vd", "two")
+    names = [k for k, _ in fs.readdir("/vd/.snap")]
+    assert sorted(names) == ["one", "two"]
+    ent = fs.stat("/vd/.snap")
+    from ceph_tpu.fs.mds import S_IFDIR
+    assert ent["mode"] & S_IFDIR
+
+
 def test_snap_rm(fs_env):
     _, _, fs = fs_env
     fs.makedirs("/rmme")

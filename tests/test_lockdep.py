@@ -1,0 +1,115 @@
+"""Lockdep (reference src/common/lockdep.cc role): lock-order cycle
+detection — unit-proves ABBA detection, then soaks the REAL cluster
+write/peering/caps paths under instrumentation and asserts the daemons
+keep a cycle-free lock order."""
+
+import threading
+
+import pytest
+
+from ceph_tpu.common import lockdep
+
+
+def test_abba_cycle_detected():
+    h = lockdep.instrument()
+    try:
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+        with b:
+            with a:        # reverse order: the classic ABBA
+                pass
+    finally:
+        h.restore()
+    with pytest.raises(lockdep.LockOrderError, match="cycle"):
+        h.check()
+
+
+def test_consistent_order_passes():
+    h = lockdep.instrument()
+    try:
+        a = threading.Lock()
+        b = threading.Lock()
+        c = threading.RLock()
+        for _ in range(3):
+            with a, b, c:
+                with c:            # RLock re-entry: no edge
+                    pass
+    finally:
+        h.restore()
+    h.check()
+    assert h.edge_count() >= 2
+
+
+def test_transitive_cycle_detected():
+    h = lockdep.instrument()
+    try:
+        a, b, c = (threading.Lock() for _ in range(3))
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        with c:
+            with a:        # a->b->c->a
+                pass
+    finally:
+        h.restore()
+    with pytest.raises(lockdep.LockOrderError):
+        h.check()
+
+
+def test_cluster_lock_order_is_acyclic():
+    """Run real daemon paths (EC + replicated writes, omap, watch/
+    notify, RBD exclusive lock + object map, recovery) with every lock
+    instrumented: any ABBA pattern anywhere in the stack fails here
+    even though the timing never deadlocks."""
+    h = lockdep.instrument()
+    try:
+        import numpy as np
+
+        from ceph_tpu.rbd import RBD, Image
+        from ceph_tpu.tools.vstart import Cluster
+        with Cluster(n_osds=4) as c:
+            client = c.client()
+            client.set_ec_profile("ldp", {
+                "plugin": "jerasure", "k": "2", "m": "1",
+                "stripe_unit": "1024"})
+            client.create_pool("ldec", "erasure",
+                               erasure_code_profile="ldp", pg_num=4)
+            client.create_pool("ldrep", "replicated", pg_num=4)
+            ec = client.open_ioctx("ldec")
+            rep = client.open_ioctx("ldrep")
+            rng = np.random.default_rng(0)
+            payload = rng.integers(0, 256, 20000,
+                                   dtype=np.uint8).tobytes()
+            ths = []
+            for t in range(4):
+                def work(t=t):
+                    for i in range(4):
+                        ec.write_full(f"e{t}_{i}", payload)
+                        rep.write_full(f"r{t}_{i}", payload)
+                    rep.omap_set(f"r{t}_0", {b"k": b"v"})
+                    assert ec.read(f"e{t}_0", len(payload)) == payload
+                ths.append(threading.Thread(target=work))
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            RBD(rep).create("ldimg", 4 << 20, order=20)
+            img = Image(rep, "ldimg", exclusive=True)
+            img.write(0, b"lockdep" * 100)
+            assert img.du() >= 1 << 20
+            img.close()
+            # a map change exercises peering/recovery lock paths
+            c.kill_osd(3)
+            c.mark_osd_down(3)
+            import time
+            time.sleep(1.0)
+    finally:
+        h.restore()
+    h.check()
+    assert h.edge_count() > 10     # the soak actually took locks

@@ -167,6 +167,35 @@ def test_delimiter_pagination_tiny_pages(s3):
     assert pages == 3
 
 
+def test_delimiter_adversarial_key_bytes(s3):
+    """Keys whose first char after a folder prefix is U+10FFFF (legal
+    S3 bytes) must not break pagination progress — the resume point is
+    a computed prefix successor, not a sentinel that can collide."""
+    import re
+    import urllib.parse
+    s3.request("PUT", "/delim3")
+    evil = urllib.parse.quote("a/\U0010ffffx", safe="")
+    s3.request("PUT", f"/delim3/{evil}", body=b"x")
+    for key in ["a/1", "b.txt"]:
+        s3.request("PUT", f"/delim3/{key}", body=b"x")
+    items = []
+    token = ""
+    for _ in range(6):
+        q = "list-type=2&delimiter=/&max-keys=1" + \
+            (f"&continuation-token={token}" if token else "")
+        st, _, body = s3.request("GET", "/delim3", query=q)
+        items += re.findall(rb"<Key>([^<]+)</Key>", body)
+        items += re.findall(
+            rb"<CommonPrefixes><Prefix>([^<]+)</Prefix>", body)
+        if b"<IsTruncated>true</IsTruncated>" not in body:
+            break
+        token = urllib.parse.quote(re.search(
+            rb"<NextContinuationToken>([^<]+)"
+            rb"</NextContinuationToken>", body).group(1).decode())
+    assert sorted(set(items)) == [b"a/", b"b.txt"]
+    assert len(items) == 2          # the folder appears exactly once
+
+
 def test_bucket_not_empty_and_missing(s3):
     s3.request("PUT", "/full1")
     s3.request("PUT", "/full1/obj", body=b"z")
