@@ -87,11 +87,17 @@ class _LockdepBase:
 
     def __init__(self, name: str | None = None):
         self._lk = self._factory()
-        self._id = id(self)
+        # serial ids, not id(self): a GC'd lock's reused address would
+        # inherit stale graph edges and report false cycles (the
+        # reference lockdep unregisters freed locks for the same
+        # reason)
+        with _SERIAL_MU:
+            _STATE["serial"] += 1
+            self._id = _STATE["serial"]
         g = _STATE["graph"]
         if g is not None:
             g.names[self._id] = name or \
-                f"{type(self).__name__}@{self._id:#x}"
+                f"{type(self).__name__}#{self._id}"
 
     def _record(self):
         g = _STATE["graph"]
@@ -161,7 +167,8 @@ class LockdepRLock(_LockdepBase):
         return self._lk._acquire_restore(state)
 
 
-_STATE: dict = {"graph": None, "held": _Held()}
+_STATE: dict = {"graph": None, "held": _Held(), "serial": 0}
+_SERIAL_MU = _real_lock()
 
 
 class Handle:

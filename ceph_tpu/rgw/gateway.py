@@ -133,7 +133,45 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _bucket_op(self, bucket: str, query: dict, body: bytes) -> None:
         st = self.gw.store
-        if self.command == "PUT":
+        if self.command == "PUT" and "versioning" in query:
+            import xml.etree.ElementTree as ET
+            try:
+                root = ET.fromstring(body.decode())
+                status = next(
+                    (c.text for c in root.iter()
+                     if c.tag.rpartition("}")[2] == "Status"), "")
+            except Exception as e:  # noqa: BLE001
+                raise RGWError(400, "MalformedXML", str(e)) from e
+            st.set_versioning(bucket, status or "")
+            self._reply(200)
+        elif self.command == "GET" and "versioning" in query:
+            status = st.get_versioning(bucket)
+            inner = f"<Status>{status}</Status>" if status else ""
+            self._reply(200, (
+                '<?xml version="1.0" encoding="UTF-8"?>'
+                f"<VersioningConfiguration>{inner}"
+                "</VersioningConfiguration>").encode())
+        elif self.command == "GET" and "versions" in query:
+            rows = st.list_versions(bucket, query.get("prefix", ""))
+            parts = []
+            for r in rows:
+                tag = "DeleteMarker" if r.get("delete_marker") \
+                    else "Version"
+                etag = (f"<ETag>&quot;{r['etag']}&quot;</ETag>"
+                        if not r.get("delete_marker") else "")
+                parts.append(
+                    f"<{tag}><Key>{escape(r['key'])}</Key>"
+                    f"<VersionId>{r['version_id']}</VersionId>"
+                    f"<IsLatest>"
+                    f"{'true' if r['is_latest'] else 'false'}"
+                    f"</IsLatest><Size>{r.get('size', 0)}</Size>"
+                    f"{etag}</{tag}>")
+            self._reply(200, (
+                '<?xml version="1.0" encoding="UTF-8"?>'
+                "<ListVersionsResult>"
+                f"<Name>{escape(bucket)}</Name>"
+                f"{''.join(parts)}</ListVersionsResult>").encode())
+        elif self.command == "PUT":
             st.create_bucket(bucket)
             self._reply(200)
         elif self.command == "DELETE":
@@ -161,9 +199,20 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             prefix = query.get("prefix", "")
             # S3 semantics: ContinuationToken (inclusive resume point
-            # we minted) wins over StartAfter (client's exclusive key)
+            # we minted, OPAQUE base64 — raw resume strings can carry
+            # bytes like NUL that are illegal in XML) wins over
+            # StartAfter (client's exclusive key)
+            import base64
             marker = query.get("start-after", "")
-            resume = query.get("continuation-token", "")
+            resume = ""
+            tok = query.get("continuation-token", "")
+            if tok:
+                try:
+                    resume = base64.urlsafe_b64decode(
+                        tok.encode()).decode()
+                except Exception as e:  # noqa: BLE001
+                    raise RGWError(400, "InvalidArgument",
+                                   "bad continuation-token") from e
             max_keys = int(query.get("max-keys", 1000))
             delimiter = query.get("delimiter", "")
             entries, cps, truncated, next_marker = st.list_objects(
@@ -177,9 +226,11 @@ class _Handler(BaseHTTPRequestHandler):
             rows += "".join(
                 f"<CommonPrefixes><Prefix>{escape(cp)}</Prefix>"
                 f"</CommonPrefixes>" for cp in cps)
-            nct = (f"<NextContinuationToken>{escape(next_marker)}"
+            tok_out = base64.urlsafe_b64encode(
+                next_marker.encode()).decode() if next_marker else ""
+            nct = (f"<NextContinuationToken>{tok_out}"
                    f"</NextContinuationToken>"
-                   if truncated and next_marker else "")
+                   if truncated and tok_out else "")
             self._reply(200, (
                 '<?xml version="1.0" encoding="UTF-8"?>'
                 "<ListBucketResult>"
@@ -264,10 +315,18 @@ class _Handler(BaseHTTPRequestHandler):
                 f"<Key>{escape(key)}</Key>"
                 f"<UploadId>{query['uploadId']}</UploadId>{rows}"
                 "</ListPartsResult>").encode())
+        elif self.command == "GET" and "versionId" in query:
+            data, meta = st.get_object_version(bucket, key,
+                                               query["versionId"])
+            self._reply(200, data, "application/octet-stream",
+                        {"ETag": f'"{meta["etag"]}"',
+                         "x-amz-version-id": meta["version_id"]})
         elif self.command == "GET":
             data, meta = st.get_object(bucket, key)
-            self._reply(200, data, "application/octet-stream",
-                        {"ETag": f'"{meta["etag"]}"'})
+            extra = {"ETag": f'"{meta["etag"]}"'}
+            if meta.get("version_id"):
+                extra["x-amz-version-id"] = meta["version_id"]
+            self._reply(200, data, "application/octet-stream", extra)
         elif self.command == "HEAD":
             meta = st.head_object(bucket, key)
             self.send_response(200)
@@ -276,6 +335,9 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
         elif self.command == "DELETE" and "uploadId" in query:
             st.abort_multipart(bucket, key, query["uploadId"])
+            self._reply(204)
+        elif self.command == "DELETE" and "versionId" in query:
+            st.delete_object_version(bucket, key, query["versionId"])
             self._reply(204)
         elif self.command == "DELETE":
             st.delete_object(bucket, key)

@@ -56,6 +56,11 @@ def _part_oid(bucket: str, upload_id: str, part_num: int) -> str:
     return f"mp_{len(bucket)}_{bucket}_{upload_id}.{part_num}"
 
 
+def _version_oid(bucket: str, version_id: str, key: str) -> str:
+    # archived version payloads (non-colliding namespace, see above)
+    return f"vr_{len(bucket)}_{bucket}_{version_id}_{key}"
+
+
 class RGWStore:
     def __init__(self, client, ec_profile: str | None = None,
                  pg_num: int = 8):
@@ -122,8 +127,13 @@ class RGWStore:
         if self.list_multipart_uploads(bucket):
             raise RGWError(409, "BucketNotEmpty",
                            f"{bucket}: multipart uploads in progress")
+        # surviving versions (incl. delete markers) hold data: block
+        for row in self.list_versions(bucket, max_keys=1):
+            raise RGWError(409, "BucketNotEmpty",
+                           f"{bucket}: object versions remain")
         self._cls(self.meta, BUCKETS_OBJ, "dir_rm", {"key": bucket})
-        for obj in (f"index.{bucket}", f"uploads.{bucket}"):
+        for obj in (f"index.{bucket}", f"uploads.{bucket}",
+                    f"versions.{bucket}"):
             try:
                 self.meta.remove(obj)
             except RadosError:
@@ -140,17 +150,216 @@ class RGWStore:
 
     # -- objects -------------------------------------------------------------
 
-    def put_object(self, bucket: str, key: str, body: bytes) -> str:
-        """Returns the ETag (md5 hex, S3 semantics)."""
+    # -- versioning (reference rgw bucket versioning + RGWListBucketV
+    #    / delete markers) --------------------------------------------------
+
+    def _bucket_meta(self, bucket: str) -> dict | None:
+        """One round-trip for existence + metadata (the object hot
+        path must not probe the bucket directory three times)."""
+        try:
+            raw = self._cls(self.meta, BUCKETS_OBJ, "dir_get",
+                            {"key": bucket})
+        except RadosError as e:
+            self._not_found(e)
+            return None
+        return json.loads(raw.decode())
+
+    def set_versioning(self, bucket: str, status: str) -> None:
+        if status not in ("Enabled", "Suspended"):
+            raise RGWError(400, "IllegalVersioningConfiguration",
+                           status)
+        meta = self._bucket_meta(bucket)
+        if meta is None:
+            raise RGWError(404, "NoSuchBucket", bucket)
+        meta["versioning"] = status       # RMW: keep created etc.
+        self._cls(self.meta, BUCKETS_OBJ, "dir_add", {
+            "key": bucket, "meta": meta})
+
+    def get_versioning(self, bucket: str) -> str:
+        meta = self._bucket_meta(bucket)
+        if meta is None:
+            raise RGWError(404, "NoSuchBucket", bucket)
+        return meta.get("versioning", "")
+
+    @staticmethod
+    def _new_version_id() -> str:
+        # time-prefixed so lexical DESC order of the version dir rows
+        # is newest-first (reference uses instance ids w/ an index
+        # sort key); inverted timestamp keeps newest first
+        import os
+        inv = (1 << 63) - time.time_ns()
+        return f"{inv:016x}.{os.urandom(6).hex()}"
+
+    def _archive_version(self, bucket: str, key: str, meta: dict,
+                         version_id: str) -> None:
+        """Record one immutable version row (newest sorts first)."""
+        self._cls(self.meta, f"versions.{bucket}", "dir_add", {
+            "key": f"{key}\x00{version_id}",
+            "meta": {**meta, "version_id": version_id}})
+
+    def list_versions(self, bucket: str, prefix: str = "",
+                      max_keys: int = 1000) -> list[dict]:
+        """Version rows up to max_keys, newest-first per key; the
+        newest row of each key is marked latest.  PAGINATES the
+        underlying index — a truncated page silently presented as
+        complete would let version deletion drop live index entries."""
         self._require_bucket(bucket)
-        old_manifest = self._manifest_of(bucket, key)
+        rows = []
+        latest_seen: set[str] = set()
+        marker = ""
+        while len(rows) < max_keys:
+            try:
+                out = json.loads(self._cls(
+                    self.meta, f"versions.{bucket}", "dir_list",
+                    {"prefix": prefix, "marker": marker,
+                     "max": min(max_keys, 1000)}).decode())
+            except RadosError as e:
+                self._not_found(e)
+                return rows
+            if not out["entries"]:
+                break
+            for k, m in out["entries"]:
+                key = k.split("\x00", 1)[0]
+                rows.append({"key": key, **m,
+                             "is_latest": key not in latest_seen})
+                latest_seen.add(key)
+                if len(rows) >= max_keys:
+                    return rows
+                marker = k
+            if not out["truncated"]:
+                break
+        return rows
+
+    def _versions_of_key(self, bucket: str, key: str) -> list[dict]:
+        # exact-key prefix: 'key' alone would also match 'keysuffix'
+        return self.list_versions(bucket, prefix=f"{key}\x00",
+                                  max_keys=100000)
+
+    def _current_meta(self, bucket: str, key: str) -> dict | None:
+        try:
+            raw = self._cls(self.meta, f"index.{bucket}", "dir_get",
+                            {"key": key})
+        except RadosError as e:
+            self._not_found(e)
+            return None
+        return json.loads(raw.decode())
+
+    def _archive_null_version(self, bucket: str, key: str) -> None:
+        """An object written BEFORE versioning was enabled has no
+        version row; S3 makes it the "null" version.  Archive its
+        existing meta (data stays at _data_oid / its multipart parts —
+        the row records where) so enabling versioning never orphans or
+        destroys pre-existing data."""
+        cur = self._current_meta(bucket, key)
+        if cur is None or cur.get("version_id"):
+            return              # absent, or already versioned
+        self._archive_version(bucket, key,
+                              {**cur, "null_data": True}, "null")
+
+    def put_object(self, bucket: str, key: str, body: bytes) -> str:
+        """Returns the ETag (md5 hex, S3 semantics).  On a versioned
+        bucket every PUT archives a new immutable version; the current
+        pointer rides the bucket index like before."""
+        bmeta = self._bucket_meta(bucket)
+        if bmeta is None:
+            raise RGWError(404, "NoSuchBucket", bucket)
         etag = hashlib.md5(body).hexdigest()
+        if bmeta.get("versioning") == "Enabled":
+            self._archive_null_version(bucket, key)
+            vid = self._new_version_id()
+            meta = {"size": len(body), "etag": etag,
+                    "mtime": time.time()}
+            self.data.write_full(_version_oid(bucket, vid, key), body)
+            self._archive_version(bucket, key, meta, vid)
+            self._cls(self.meta, f"index.{bucket}", "dir_add", {
+                "key": key, "meta": {**meta, "version_id": vid}})
+            return etag
+        old_manifest = self._manifest_of(bucket, key)
         self.data.write_full(_data_oid(bucket, key), body)
         self._cls(self.meta, f"index.{bucket}", "dir_add", {
             "key": key, "meta": {"size": len(body), "etag": etag,
                                  "mtime": time.time()}})
         self._reap_manifest(bucket, old_manifest)
         return etag
+
+    def get_object_version(self, bucket: str, key: str,
+                           version_id: str) -> tuple[bytes, dict]:
+        self._require_bucket(bucket)
+        try:
+            raw = self._cls(self.meta, f"versions.{bucket}", "dir_get",
+                            {"key": f"{key}\x00{version_id}"})
+        except RadosError as e:
+            self._not_found(e)
+            raise RGWError(404, "NoSuchVersion", version_id) from e
+        meta = json.loads(raw.decode())
+        if meta.get("delete_marker"):
+            raise RGWError(405, "MethodNotAllowed",
+                           "this version is a delete marker")
+        if meta.get("null_data"):
+            manifest = meta.get("multipart")
+            if manifest:
+                body = b"".join(
+                    bytes(self.data.read(_part_oid(
+                        bucket, manifest["upload_id"], num), size))
+                    for num, size in manifest["parts"])
+                return body, meta
+            body = self.data.read(_data_oid(bucket, key), meta["size"])
+        else:
+            body = self.data.read(
+                _version_oid(bucket, version_id, key), meta["size"])
+        return bytes(body), meta
+
+    def delete_object_version(self, bucket: str, key: str,
+                              version_id: str) -> None:
+        """Permanent removal of ONE version (S3 semantics: the only
+        way to truly destroy data on a versioned bucket).  Removing
+        the current version promotes the next-newest."""
+        self._require_bucket(bucket)
+        try:
+            self._cls(self.meta, f"versions.{bucket}", "dir_rm",
+                      {"key": f"{key}\x00{version_id}"})
+        except RadosError as e:
+            self._not_found(e)
+            raise RGWError(404, "NoSuchVersion", version_id) from e
+        if version_id == "null":
+            # the null version's payload lives at the unversioned
+            # location; reap it
+            try:
+                self.data.remove(_data_oid(bucket, key))
+            except RadosError:
+                pass
+        else:
+            try:
+                self.data.remove(_version_oid(bucket, version_id, key))
+            except RadosError:
+                pass
+        cur = self._current_meta(bucket, key)
+        cur_vid = cur.get("version_id") if cur is not None else None
+        null_is_current = (cur is not None and cur_vid is None and
+                           version_id == "null")
+        if (cur is not None and cur_vid == version_id) or \
+                null_is_current:
+            # promote the next-newest remaining REAL version; a delete
+            # marker on top means the key stays absent, never becomes
+            # a phantom zero-byte object
+            remaining = self._versions_of_key(bucket, key)
+            nxt = remaining[0] if remaining else None
+            if nxt is not None and not nxt.get("delete_marker"):
+                drop = {"key", "is_latest"}
+                if nxt.get("null_data"):
+                    # restoring the null version restores the plain
+                    # unversioned entry (data at _data_oid / manifest)
+                    drop |= {"version_id", "null_data"}
+                self._cls(self.meta, f"index.{bucket}", "dir_add", {
+                    "key": key, "meta": {
+                        k: v for k, v in nxt.items()
+                        if k not in drop}})
+            else:
+                try:
+                    self._cls(self.meta, f"index.{bucket}", "dir_rm",
+                              {"key": key})
+                except RadosError as e:
+                    self._not_found(e)
 
     def _manifest_of(self, bucket: str, key: str) -> dict | None:
         """The parts manifest of an existing multipart object, or None."""
@@ -195,11 +404,32 @@ class RGWStore:
                     _part_oid(bucket, manifest["upload_id"], num), size))
                 for num, size in manifest["parts"])
             return body, meta
-        body = self.data.read(_data_oid(bucket, key), meta["size"])
+        if meta.get("version_id"):
+            body = self.data.read(
+                _version_oid(bucket, meta["version_id"], key),
+                meta["size"])
+        else:
+            body = self.data.read(_data_oid(bucket, key), meta["size"])
         return body, meta
 
     def delete_object(self, bucket: str, key: str) -> None:
-        self._require_bucket(bucket)
+        bmeta = self._bucket_meta(bucket)
+        if bmeta is None:
+            raise RGWError(404, "NoSuchBucket", bucket)
+        if bmeta.get("versioning") == "Enabled":
+            # versioned delete = insert a delete marker as the new
+            # current; nothing is destroyed (reference delete markers)
+            self._archive_null_version(bucket, key)
+            vid = self._new_version_id()
+            meta = {"size": 0, "etag": "", "mtime": time.time(),
+                    "delete_marker": True}
+            self._archive_version(bucket, key, meta, vid)
+            try:
+                self._cls(self.meta, f"index.{bucket}", "dir_rm",
+                          {"key": key})
+            except RadosError as e:
+                self._not_found(e)
+            return
         manifest = self._manifest_of(bucket, key)
         try:
             self._cls(self.meta, f"index.{bucket}", "dir_rm",

@@ -418,6 +418,140 @@ def test_unsigned_amz_header_rejected(gw, s3):
     assert ei.value.code == 403
 
 
+VERSIONING_ON = (b'<VersioningConfiguration>'
+                 b'<Status>Enabled</Status>'
+                 b'</VersioningConfiguration>')
+
+
+def test_versioning_put_get_versions(s3):
+    """Enable versioning: overwrites archive immutable versions, GET
+    ?versionId reads them back, ListObjectVersions marks the latest
+    (reference rgw bucket versioning)."""
+    import re
+    s3.request("PUT", "/ver1")
+    s3.request("PUT", "/ver1", query="versioning", body=VERSIONING_ON)
+    _, _, body = s3.request("GET", "/ver1", query="versioning")
+    assert b"<Status>Enabled</Status>" in body
+    s3.request("PUT", "/ver1/doc", body=b"first draft")
+    s3.request("PUT", "/ver1/doc", body=b"second draft")
+    s3.request("PUT", "/ver1/doc", body=b"FINAL")
+    _, _, got = s3.request("GET", "/ver1/doc")
+    assert got == b"FINAL"
+    _, _, body = s3.request("GET", "/ver1", query="versions")
+    vids = re.findall(rb"<VersionId>([^<]+)</VersionId>", body)
+    assert len(vids) == 3
+    assert body.count(b"<IsLatest>true</IsLatest>") == 1
+    # newest-first: vids[0] is FINAL, vids[2] the first draft
+    _, _, old = s3.request("GET", "/ver1/doc",
+                           query=f"versionId={vids[2].decode()}")
+    assert old == b"first draft"
+    _, _, mid = s3.request("GET", "/ver1/doc",
+                           query=f"versionId={vids[1].decode()}")
+    assert mid == b"second draft"
+
+
+def test_versioning_delete_marker_and_restore(s3):
+    import re
+    s3.request("PUT", "/ver2")
+    s3.request("PUT", "/ver2", query="versioning", body=VERSIONING_ON)
+    s3.request("PUT", "/ver2/f", body=b"precious")
+    st, _, _ = s3.request("DELETE", "/ver2/f")
+    assert st == 204
+    # current view: gone; versions: data + a delete marker remain
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        s3.request("GET", "/ver2/f")
+    assert ei.value.code == 404
+    _, _, body = s3.request("GET", "/ver2", query="list-type=2")
+    assert b"<Key>f</Key>" not in body
+    _, _, body = s3.request("GET", "/ver2", query="versions")
+    assert body.count(b"<DeleteMarker>") == 1
+    assert body.count(b"<Version>") == 1
+    vids = re.findall(
+        rb"<Version><Key>f</Key><VersionId>([^<]+)</VersionId>", body)
+    # the data survives the delete and reads back by version id
+    _, _, got = s3.request("GET", "/ver2/f",
+                           query=f"versionId={vids[0].decode()}")
+    assert got == b"precious"
+
+
+def test_versioning_permanent_delete_promotes(s3):
+    import re
+    s3.request("PUT", "/ver3")
+    s3.request("PUT", "/ver3", query="versioning", body=VERSIONING_ON)
+    s3.request("PUT", "/ver3/x", body=b"v1")
+    s3.request("PUT", "/ver3/x", body=b"v2")
+    _, _, body = s3.request("GET", "/ver3", query="versions")
+    vids = re.findall(rb"<VersionId>([^<]+)</VersionId>", body)
+    # permanently delete the CURRENT version: v1 must be promoted
+    st, _, _ = s3.request("DELETE", "/ver3/x",
+                          query=f"versionId={vids[0].decode()}")
+    assert st == 204
+    _, _, got = s3.request("GET", "/ver3/x")
+    assert got == b"v1"
+    # delete the last one: the key disappears entirely
+    s3.request("DELETE", "/ver3/x",
+               query=f"versionId={vids[1].decode()}")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        s3.request("GET", "/ver3/x")
+    assert ei.value.code == 404
+    # bucket is genuinely empty now: deletable
+    st, _, _ = s3.request("DELETE", "/ver3")
+    assert st == 204
+
+
+def test_preversioning_object_becomes_null_version(s3):
+    """Objects written BEFORE versioning was enabled must survive as
+    the 'null' version through overwrites and deletes."""
+    import re
+    s3.request("PUT", "/ver5")
+    s3.request("PUT", "/ver5/old", body=b"pre-versioning data")
+    s3.request("PUT", "/ver5", query="versioning", body=VERSIONING_ON)
+    s3.request("PUT", "/ver5/old", body=b"new version")
+    _, _, got = s3.request("GET", "/ver5/old",
+                           query="versionId=null")
+    assert got == b"pre-versioning data"
+    _, _, body = s3.request("GET", "/ver5", query="versions")
+    assert b"<VersionId>null</VersionId>" in body
+    # delete the current version: null is promoted back
+    vids = re.findall(rb"<VersionId>([^<]+)</VersionId>", body)
+    newest = next(v for v in vids if v != b"null")
+    s3.request("DELETE", "/ver5/old",
+               query=f"versionId={newest.decode()}")
+    _, _, got = s3.request("GET", "/ver5/old")
+    assert got == b"pre-versioning data"
+
+
+def test_marker_not_promoted_as_object(s3):
+    """Deleting the current version with a delete marker next-newest
+    must leave the key ABSENT, not resurrect a phantom object."""
+    import re
+    s3.request("PUT", "/ver6")
+    s3.request("PUT", "/ver6", query="versioning", body=VERSIONING_ON)
+    s3.request("PUT", "/ver6/p", body=b"v1")
+    s3.request("DELETE", "/ver6/p")             # marker
+    s3.request("PUT", "/ver6/p", body=b"v2")    # current again
+    _, _, body = s3.request("GET", "/ver6", query="versions")
+    newest = re.search(rb"<VersionId>([^<]+)</VersionId>",
+                       body).group(1).decode()
+    s3.request("DELETE", "/ver6/p", query=f"versionId={newest}")
+    # next-newest is the marker: key must 404, not become 0 bytes
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        s3.request("GET", "/ver6/p")
+    assert ei.value.code == 404
+    _, _, body = s3.request("GET", "/ver6", query="list-type=2")
+    assert b"<Key>p</Key>" not in body
+
+
+def test_versioned_bucket_blocks_deletion(s3):
+    s3.request("PUT", "/ver4")
+    s3.request("PUT", "/ver4", query="versioning", body=VERSIONING_ON)
+    s3.request("PUT", "/ver4/k", body=b"d")
+    s3.request("DELETE", "/ver4/k")     # marker only: data survives
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        s3.request("DELETE", "/ver4")
+    assert ei.value.code == 409
+
+
 def test_copy_object(s3):
     """Server-side copy incl. multipart source (reference RGWCopyObj)."""
     s3.request("PUT", "/cpsrc")
