@@ -1,0 +1,163 @@
+"""Swift REST dialect over the same RGWStore (reference rgw_rest_swift
+— the reference gateway speaks both S3 and Swift against one RADOS
+layout; so does this one: objects PUT via S3 are readable via Swift
+and vice versa).
+
+Surface (the OpenStack object-storage subset a Swift client needs):
+
+  GET  /auth/v1.0                      X-Auth-User/X-Auth-Key ->
+                                       X-Auth-Token + X-Storage-Url
+  GET  /v1/AUTH_<acct>                 account: list containers
+  PUT  /v1/AUTH_<acct>/<c>             create container
+  DELETE /v1/AUTH_<acct>/<c>           delete container (409 if full)
+  GET  /v1/AUTH_<acct>/<c>             list objects (marker/prefix/
+                                       delimiter/limit; plain or JSON)
+  PUT  /v1/AUTH_<acct>/<c>/<obj>       upload (ETag = md5)
+  GET  /v1/AUTH_<acct>/<c>/<obj>       download
+  HEAD /v1/AUTH_<acct>/<c>/<obj>       metadata
+  DELETE /v1/AUTH_<acct>/<c>/<obj>     delete
+
+Tokens are HMACs over the account + a daily window (stateless, like
+the reference's tempauth role); Keystone integration is out of scope.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import time
+from xml.sax.saxutils import escape  # noqa: F401 (parity w/ gateway)
+
+from .store import RGWError
+
+
+def _token(secret: str, user: str, window: int) -> str:
+    return hmac.new(secret.encode(), f"{user}:{window}".encode(),
+                    hashlib.sha256).hexdigest()
+
+
+class SwiftFrontend:
+    """Routes /auth and /v1 paths; mounted by the S3 gateway's HTTP
+    handler so both dialects share one listener and one store."""
+
+    def __init__(self, store, creds: dict[str, str] | None):
+        self.store = store
+        self.creds = creds          # user -> key; None = open access
+
+    # -- auth ---------------------------------------------------------------
+
+    def _check_token(self, headers) -> None:
+        if self.creds is None:
+            return
+        tok = headers.get("x-auth-token", "")
+        window = int(time.time() // 86400)
+        for user, key in self.creds.items():
+            for w in (window, window - 1):   # tolerate day rollover
+                if hmac.compare_digest(tok, _token(key, user, w)):
+                    return
+        raise RGWError(401, "Unauthorized", "bad or missing token")
+
+    def handle_auth(self, headers) -> tuple[int, dict, bytes]:
+        user = headers.get("x-auth-user", "")
+        key = headers.get("x-auth-key", "")
+        if self.creds is None:
+            return 200, {"X-Auth-Token": "anonymous",
+                         "X-Storage-Url": "/v1/AUTH_main"}, b""
+        if self.creds.get(user) != key:
+            raise RGWError(401, "Unauthorized", "bad credentials")
+        window = int(time.time() // 86400)
+        return 200, {"X-Auth-Token": _token(key, user, window),
+                     "X-Storage-Url": "/v1/AUTH_main"}, b""
+
+    # -- dispatch -----------------------------------------------------------
+
+    def handle(self, method: str, path: str, query: dict,
+               headers, body: bytes) -> tuple[int, dict, bytes]:
+        """Returns (status, extra_headers, body)."""
+        if path.startswith("/auth"):
+            return self.handle_auth(headers)
+        self._check_token(headers)
+        parts = [p for p in path.split("/") if p]
+        # /v1/AUTH_x[/container[/object...]]
+        if len(parts) < 2:
+            raise RGWError(404, "NotFound", path)
+        rest = parts[2:]
+        if not rest:
+            return self._account(method, query)
+        container = rest[0]
+        if len(rest) == 1:
+            return self._container(method, container, query)
+        obj = "/".join(rest[1:])
+        return self._object(method, container, obj, body)
+
+    # -- account ------------------------------------------------------------
+
+    def _account(self, method: str, query: dict):
+        if method != "GET":
+            raise RGWError(405, "MethodNotAllowed", method)
+        rows = self.store.list_buckets()
+        if query.get("format") == "json":
+            out = json.dumps([{"name": n, "count": 0, "bytes": 0}
+                              for n, _m in rows]).encode()
+            return 200, {"Content-Type": "application/json"}, out
+        return 200, {"Content-Type": "text/plain"}, \
+            ("".join(f"{n}\n" for n, _m in rows)).encode()
+
+    # -- containers ---------------------------------------------------------
+
+    def _container(self, method: str, container: str, query: dict):
+        st = self.store
+        if method == "PUT":
+            try:
+                st.create_bucket(container)
+            except RGWError as e:
+                if e.status != 409:
+                    raise
+            return 201, {}, b""
+        if method == "DELETE":
+            st.delete_bucket(container)
+            return 204, {}, b""
+        if method == "HEAD":
+            if not st.bucket_exists(container):
+                raise RGWError(404, "NotFound", container)
+            return 204, {}, b""
+        if method == "GET":
+            limit = int(query.get("limit", 10000))
+            entries, cps, _trunc, _nm = st.list_objects(
+                container, prefix=query.get("prefix", ""),
+                marker=query.get("marker", ""), max_keys=limit,
+                delimiter=query.get("delimiter", ""))
+            if query.get("format") == "json":
+                rows = [{"name": k, "bytes": m["size"],
+                         "hash": m["etag"]} for k, m in entries]
+                rows += [{"subdir": cp} for cp in cps]
+                return 200, {"Content-Type": "application/json"}, \
+                    json.dumps(rows).encode()
+            names = [k for k, _ in entries] + list(cps)
+            return 200, {"Content-Type": "text/plain"}, \
+                ("".join(f"{n}\n" for n in sorted(names))).encode()
+        raise RGWError(405, "MethodNotAllowed", method)
+
+    # -- objects ------------------------------------------------------------
+
+    def _object(self, method: str, container: str, obj: str,
+                body: bytes):
+        st = self.store
+        if method == "PUT":
+            etag = st.put_object(container, obj, body)
+            return 201, {"ETag": etag}, b""
+        if method == "GET":
+            data, meta = st.get_object(container, obj)
+            return 200, {"ETag": meta["etag"],
+                         "Content-Type": "application/octet-stream"}, \
+                bytes(data)
+        if method == "HEAD":
+            meta = st.head_object(container, obj)
+            return 200, {"ETag": meta["etag"],
+                         "Content-Length-Override": str(meta["size"])}, \
+                b""
+        if method == "DELETE":
+            st.delete_object(container, obj)
+            return 204, {}, b""
+        raise RGWError(405, "MethodNotAllowed", method)
