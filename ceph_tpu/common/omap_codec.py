@@ -24,19 +24,31 @@ def encode_kv(kv: dict[bytes, bytes]) -> bytes:
     return b"".join(out)
 
 
+def _take(buf: bytes, off: int, ln: int) -> tuple[bytes, int]:
+    """Bounds-checked slice: a hostile/corrupt length must raise a
+    clean ValueError (mapped to -EINVAL at the op switch), never a
+    struct.error past the end or a silent truncation."""
+    if ln < 0 or off + ln > len(buf):
+        raise ValueError(
+            f"omap frame truncated: need {ln} bytes at {off}, "
+            f"have {len(buf)}")
+    return bytes(buf[off:off + ln]), off + ln
+
+
+def _u32(buf: bytes, off: int) -> tuple[int, int]:
+    if off + 4 > len(buf):
+        raise ValueError(f"omap frame truncated at {off}")
+    return _U32.unpack_from(buf, off)[0], off + 4
+
+
 def decode_kv(buf: bytes, off: int = 0) -> tuple[dict[bytes, bytes], int]:
-    (n,) = _U32.unpack_from(buf, off)
-    off += 4
+    n, off = _u32(buf, off)
     kv: dict[bytes, bytes] = {}
     for _ in range(n):
-        (kl,) = _U32.unpack_from(buf, off)
-        off += 4
-        k = bytes(buf[off:off + kl])
-        off += kl
-        (vl,) = _U32.unpack_from(buf, off)
-        off += 4
-        kv[k] = bytes(buf[off:off + vl])
-        off += vl
+        kl, off = _u32(buf, off)
+        k, off = _take(buf, off, kl)
+        vl, off = _u32(buf, off)
+        kv[k], off = _take(buf, off, vl)
     return kv, off
 
 
@@ -50,12 +62,10 @@ def encode_keys(keys) -> bytes:
 
 
 def decode_keys(buf: bytes, off: int = 0) -> tuple[list[bytes], int]:
-    (n,) = _U32.unpack_from(buf, off)
-    off += 4
+    n, off = _u32(buf, off)
     keys: list[bytes] = []
     for _ in range(n):
-        (kl,) = _U32.unpack_from(buf, off)
-        off += 4
-        keys.append(bytes(buf[off:off + kl]))
-        off += kl
+        kl, off = _u32(buf, off)
+        k, off = _take(buf, off, kl)
+        keys.append(k)
     return keys, off

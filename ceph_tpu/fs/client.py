@@ -261,12 +261,21 @@ class CephFS:
     def snap_list(self, dirpath: str) -> list[str]:
         return self._req("snap_list", {"path": dirpath})["snaps"]
 
-    def _uncache(self, *paths: str) -> None:
+    def _uncache(self, *paths: str, subtree: bool = False) -> None:
         """Our own namespace mutations invalidate the lease cache: no
-        revoke arrives for them (we ARE the holder)."""
+        revoke arrives for them (we ARE the holder).  subtree=True
+        also evicts every cached descendant — renaming/removing a
+        directory must not leave stat hits live under the old name
+        for up to LEASE_TTL."""
         with self._lock:
             for p in paths:
-                self._stat_cache.pop(_norm(p), None)
+                np = _norm(p)
+                self._stat_cache.pop(np, None)
+                if subtree:
+                    pre = np.rstrip("/") + "/"
+                    for c in [c for c in self._stat_cache
+                              if c.startswith(pre)]:
+                        self._stat_cache.pop(c, None)
 
     def unlink(self, path: str) -> None:
         self._req("unlink", {"path": path})
@@ -274,11 +283,11 @@ class CephFS:
 
     def rmdir(self, path: str) -> None:
         self._req("rmdir", {"path": path})
-        self._uncache(path)
+        self._uncache(path, subtree=True)
 
     def rename(self, src: str, dst: str) -> None:
         self._req("rename", {"src": src, "dst": dst})
-        self._uncache(src, dst)
+        self._uncache(src, dst, subtree=True)
 
     # -- file I/O ------------------------------------------------------------
 

@@ -507,7 +507,7 @@ class OSDDaemon:
                 # no pipelining, and the batch window could never see
                 # two ops.  Per-object ordering still comes from the
                 # stripe locks in _handle_client_op.
-                self._op_pool.submit(self._handle_client_op, conn, msg)
+                self._op_pool.submit(self._handle_client_op_safe, conn, msg)
             elif isinstance(msg, M.MOSDECSubOpWrite):
                 self.perf.inc("subop_w")
                 self.apply_sub_write(msg.pgid, msg.txn, msg.log_entries,
@@ -560,12 +560,13 @@ class OSDDaemon:
             elif isinstance(msg, M.MOSDPing):
                 self._handle_ping(conn, msg)
         except Exception as e:  # noqa: BLE001 - daemon must not die
-            eno = getattr(e, "errno", errno.EIO)
-            if eno != errno.EAGAIN:   # EAGAIN is routine (not-primary /
-                import traceback      # peering-incomplete backoff)
-                traceback.print_exc()
             if isinstance(msg, M.MOSDOp):
-                conn.send_message(M.MOSDOpReply(msg.tid, -eno))
+                self._reply_op_error(conn, msg, e)
+            elif getattr(e, "errno", None) != errno.EAGAIN:
+                # cluster-internal paths send no error reply; a
+                # swallowed traceback here would hide real bugs
+                import traceback
+                traceback.print_exc()
 
     def _handle_map(self, msg: M.MMonMap) -> None:
         self._last_map_time = time.time()
@@ -1340,6 +1341,35 @@ class OSDDaemon:
         return "allow *" in caps or \
             re.search(r"allow\s+[rx]*w", caps) is not None
 
+    def _reply_op_error(self, conn, msg: M.MOSDOp, e: BaseException
+                        ) -> None:
+        """Map an op-path exception to an errno reply: ValueError
+        (malformed/hostile client payload) becomes EINVAL.  Log only
+        the unexpected — EAGAIN is routine peering backoff, and a
+        ValueError is already answered, so neither deserves a
+        traceback a hostile client could spam."""
+        eno = getattr(e, "errno", None) or \
+            (errno.EINVAL if isinstance(e, ValueError) else errno.EIO)
+        if eno != errno.EAGAIN and not isinstance(e, ValueError):
+            import traceback
+            traceback.print_exc()
+        try:
+            conn.send_message(M.MOSDOpReply(msg.tid, -eno))
+        except Exception:   # connection already gone
+            pass
+
+    def _handle_client_op_safe(self, conn, msg: M.MOSDOp) -> None:
+        """Exception fence for ops that run off the dispatch thread
+        (op pool / notify thread).  Without it a raised error — incl.
+        the routine EAGAIN from _get_pg during peering — dies inside
+        the Future and the client stalls a full attempt timeout
+        instead of fast-retrying (reference: do_op replies -errno on
+        every failure path)."""
+        try:
+            self._handle_client_op(conn, msg)
+        except Exception as e:  # noqa: BLE001 - must reply, not die
+            self._reply_op_error(conn, msg, e)
+
     def _handle_client_op(self, conn, msg: M.MOSDOp) -> None:
         """reference PrimaryLogPG::do_op/do_osd_ops: decode the op
         vector, build a PGTransaction for mutations, execute reads."""
@@ -1372,7 +1402,7 @@ class OSDDaemon:
                 # (reference: notifies complete via a Context, not
                 # inline in the dispatch thread)
                 threading.Thread(
-                    target=self._do_client_op, args=(conn, msg, _t0),
+                    target=self._do_client_op_safe, args=(conn, msg, _t0),
                     daemon=True,
                     name=f"osd.{self.osd_id}.notify").start()
             else:
@@ -1381,6 +1411,14 @@ class OSDDaemon:
         key = (msg.pgid.pgid.pool, msg.oid.name)
         with self._obj_locks[hash(key) % len(self._obj_locks)]:
             self._do_client_op(conn, msg, _t0)
+
+    def _do_client_op_safe(self, conn, msg: M.MOSDOp, _t0: float) -> None:
+        """Same exception fence as _handle_client_op_safe for the
+        detached notify thread."""
+        try:
+            self._do_client_op(conn, msg, _t0)
+        except Exception as e:  # noqa: BLE001
+            self._reply_op_error(conn, msg, e)
 
     def _do_client_op(self, conn, msg: M.MOSDOp, _t0: float) -> None:
         state = self._get_pg(msg.pgid.pgid)

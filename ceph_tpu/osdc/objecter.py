@@ -176,17 +176,30 @@ class Objecter:
                 pass
         oid = hobject_t(pool=pool_id, name=name, snap=snap)
         last_err = None
-        for attempt in range(attempts):
+        # EAGAIN (not-primary / peering-incomplete) replies arrive in
+        # milliseconds now that the OSD fences every op path; they ride
+        # a short backoff BUDGET instead of the attempt counter, or a
+        # 2s peering blip would burn all attempts instantly (reference:
+        # client op backoff, RECOVERY_WAIT).  Anchored at the FIRST
+        # EAGAIN (not op entry — a slow first attempt must not eat the
+        # budget) and bounded well below the op timeout: a PG that
+        # CANNOT peer (too many shards down) must fail fast, not pin
+        # the caller for the whole op budget
+        deadline = None
+        attempt = 0
+        while attempt < attempts:
             tgt = self._calc_target(pool_id, name)
             if tgt is None:
                 self.refresh_map()
                 last_err = -errno.EHOSTUNREACH
+                attempt += 1
                 continue
             spg, primary = tgt
             info = self.osdmap.osds.get(primary)
             if info is None or info.addr is None:
                 self.refresh_map()
                 last_err = -errno.EHOSTUNREACH
+                attempt += 1
                 continue
             with self._lock:
                 self._tid += 1
@@ -199,15 +212,22 @@ class Objecter:
             if w["event"].wait(timeout):
                 reply = w["reply"]
                 if reply.result == -errno.EAGAIN:
-                    # primary moved (reference retarget on map change)
+                    # primary moved or PG still peering: retarget
                     self.refresh_map()
                     last_err = reply.result
+                    if deadline is None:
+                        deadline = time.time() + min(timeout, 5.0)
+                    if time.time() < deadline:
+                        time.sleep(0.25)
+                    else:
+                        attempt += 1    # budget exhausted
                     continue
                 return reply
             with self._lock:
                 self._waiters.pop(tid, None)
             self.refresh_map()
             last_err = -errno.ETIMEDOUT
+            attempt += 1
         raise TimedOut(f"op {name} failed after {attempts} attempts "
                        f"(last {last_err})")
 

@@ -274,12 +274,19 @@ class RGWStore:
             self._cls(self.meta, f"index.{bucket}", "dir_add", {
                 "key": key, "meta": {**meta, "version_id": vid}})
             return etag
-        old_manifest = self._manifest_of(bucket, key)
+        suspended = bool(bmeta.get("versioning"))   # "" = never versioned
+        reap = self._displaced_manifests(bucket, key, suspended)
+        meta = {"size": len(body), "etag": etag, "mtime": time.time()}
         self.data.write_full(_data_oid(bucket, key), body)
         self._cls(self.meta, f"index.{bucket}", "dir_add", {
-            "key": key, "meta": {"size": len(body), "etag": etag,
-                                 "mtime": time.time()}})
-        self._reap_manifest(bucket, old_manifest)
+            "key": key, "meta": meta})
+        if suspended:
+            # Suspended bucket: S3 says the PUT replaces the null
+            # version — (re)write the null row to match the new bytes
+            self._archive_version(bucket, key,
+                                  {**meta, "null_data": True}, "null")
+        for m in reap:
+            self._reap_manifest(bucket, m)
         return etag
 
     def get_object_version(self, bucket: str, key: str,
@@ -295,14 +302,17 @@ class RGWStore:
         if meta.get("delete_marker"):
             raise RGWError(405, "MethodNotAllowed",
                            "this version is a delete marker")
+        manifest = meta.get("multipart")
+        if manifest:
+            # multipart versions (null or minted) read their parts in
+            # place — each complete has a unique upload_id, so part
+            # objects never collide across versions
+            body = b"".join(
+                bytes(self.data.read(_part_oid(
+                    bucket, manifest["upload_id"], num), size))
+                for num, size in manifest["parts"])
+            return body, meta
         if meta.get("null_data"):
-            manifest = meta.get("multipart")
-            if manifest:
-                body = b"".join(
-                    bytes(self.data.read(_part_oid(
-                        bucket, manifest["upload_id"], num), size))
-                    for num, size in manifest["parts"])
-                return body, meta
             body = self.data.read(_data_oid(bucket, key), meta["size"])
         else:
             body = self.data.read(
@@ -315,13 +325,19 @@ class RGWStore:
         way to truly destroy data on a versioned bucket).  Removing
         the current version promotes the next-newest."""
         self._require_bucket(bucket)
+        vmeta = self._version_row(bucket, key, version_id)
+        if vmeta is None:
+            raise RGWError(404, "NoSuchVersion", version_id)
         try:
             self._cls(self.meta, f"versions.{bucket}", "dir_rm",
                       {"key": f"{key}\x00{version_id}"})
         except RadosError as e:
             self._not_found(e)
             raise RGWError(404, "NoSuchVersion", version_id) from e
-        if version_id == "null":
+        if vmeta.get("multipart"):
+            # a multipart version owns its parts (unique upload_id)
+            self._reap_manifest(bucket, vmeta["multipart"])
+        elif version_id == "null":
             # the null version's payload lives at the unversioned
             # location; reap it
             try:
@@ -361,15 +377,34 @@ class RGWStore:
                 except RadosError as e:
                     self._not_found(e)
 
-    def _manifest_of(self, bucket: str, key: str) -> dict | None:
-        """The parts manifest of an existing multipart object, or None."""
+    def _version_row(self, bucket: str, key: str,
+                     version_id: str) -> dict | None:
         try:
-            raw = self._cls(self.meta, f"index.{bucket}", "dir_get",
-                            {"key": key})
+            raw = self._cls(self.meta, f"versions.{bucket}", "dir_get",
+                            {"key": f"{key}\x00{version_id}"})
         except RadosError as e:
             self._not_found(e)
             return None
-        return json.loads(raw.decode()).get("multipart")
+        return json.loads(raw.decode())
+
+    def _displaced_manifests(self, bucket: str, key: str,
+                             suspended: bool) -> list[dict]:
+        """Manifests whose LAST reference disappears when a
+        non-versioned write/delete displaces the current object: the
+        current index row's manifest (unless its own version row
+        still references it), plus — on a Suspended bucket, where S3
+        says the write REPLACES the null version — the existing null
+        row's manifest.  Reaping anything else would destroy an
+        archived version's data; reaping less leaks parts forever."""
+        out: dict[str, dict] = {}
+        cur = self._current_meta(bucket, key)
+        if cur and cur.get("multipart") and not cur.get("version_id"):
+            out[cur["multipart"]["upload_id"]] = cur["multipart"]
+        if suspended:
+            row = self._version_row(bucket, key, "null")
+            if row and row.get("multipart"):
+                out[row["multipart"]["upload_id"]] = row["multipart"]
+        return list(out.values())
 
     def _reap_manifest(self, bucket: str, manifest: dict | None) -> None:
         """Remove the part objects an overwritten/deleted manifest
@@ -430,16 +465,23 @@ class RGWStore:
             except RadosError as e:
                 self._not_found(e)
             return
-        manifest = self._manifest_of(bucket, key)
+        suspended = bool(bmeta.get("versioning"))
+        reap = self._displaced_manifests(bucket, key, suspended)
         try:
             self._cls(self.meta, f"index.{bucket}", "dir_rm",
                       {"key": key})
         except RadosError as e:
             self._not_found(e)
             raise RGWError(404, "NoSuchKey", key) from e
-        if manifest:
-            self._reap_manifest(bucket, manifest)
-            return
+        if suspended:
+            # S3: DELETE on a Suspended bucket replaces the null
+            # version with a null DELETE MARKER (the displaced null
+            # data is destroyed; version_id'd rows survive untouched)
+            self._archive_version(bucket, key, {
+                "size": 0, "etag": "", "mtime": time.time(),
+                "delete_marker": True}, "null")
+        for m in reap:
+            self._reap_manifest(bucket, m)
         try:
             self.data.remove(_data_oid(bucket, key))
         except RadosError:
@@ -544,14 +586,33 @@ class RGWStore:
             md5cat += bytes.fromhex(meta["etag"])
             manifest.append([num, meta["size"]])
             total += meta["size"]
-        old_manifest = self._manifest_of(bucket, key)
         etag = f"{hashlib.md5(md5cat).hexdigest()}-{len(parts)}"
-        self._cls(self.meta, f"index.{bucket}", "dir_add", {
-            "key": key, "meta": {
-                "size": total, "etag": etag, "mtime": time.time(),
-                "multipart": {"upload_id": upload_id,
-                              "parts": manifest}}})
-        self._reap_manifest(bucket, old_manifest)
+        obj_meta = {"size": total, "etag": etag, "mtime": time.time(),
+                    "multipart": {"upload_id": upload_id,
+                                  "parts": manifest}}
+        bmeta = self._bucket_meta(bucket) or {}
+        if bmeta.get("versioning") == "Enabled":
+            # S3: CompleteMultipartUpload on a versioned bucket mints
+            # a new object version like any PUT; the overwritten
+            # current survives as a version row (its manifest stays
+            # referenced by that row — never reaped here)
+            self._archive_null_version(bucket, key)
+            vid = self._new_version_id()
+            self._archive_version(bucket, key, obj_meta, vid)
+            self._cls(self.meta, f"index.{bucket}", "dir_add", {
+                "key": key, "meta": {**obj_meta, "version_id": vid}})
+        else:
+            suspended = bool(bmeta.get("versioning"))
+            reap = self._displaced_manifests(bucket, key, suspended)
+            self._cls(self.meta, f"index.{bucket}", "dir_add", {
+                "key": key, "meta": obj_meta})
+            if suspended:
+                # like put_object: the complete replaces the null
+                # version on a Suspended bucket
+                self._archive_version(
+                    bucket, key, {**obj_meta, "null_data": True}, "null")
+            for m in reap:
+                self._reap_manifest(bucket, m)
         # unreferenced parts (uploaded but not listed in the complete)
         listed = {num for num, _ in parts}
         for num in have:

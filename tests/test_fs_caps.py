@@ -111,6 +111,36 @@ def test_dead_holder_does_not_block_open(cluster):
     fs_b.shutdown()
 
 
+def test_rename_dir_evicts_descendant_stat_cache(cluster):
+    """Renaming/removing a directory must evict cached stats of its
+    DESCENDANTS too — a stale hit under the old name for up to
+    LEASE_TTL makes removed paths look alive (round-3 advisor)."""
+    fs = _mount(cluster, "subtree")
+    fs.mkdir("/sub")
+    f = fs.open("/sub/deep.txt", "w")   # stays open: caps held
+    f.write(b"x")
+    f.flush()
+    assert fs.stat("/sub/deep.txt")["size"] == 1   # primes the cache
+    fs.rename("/sub", "/sub2")
+    with pytest.raises(FSError):
+        fs.stat("/sub/deep.txt")        # must MISS, not serve stale
+    assert fs.stat("/sub2/deep.txt")["size"] == 1
+    f.close()
+    # rmdir of a tree: descendants evicted as well
+    fs2 = _mount(cluster, "subtree2")
+    fs2.mkdir("/gone")
+    g = fs2.open("/gone/a.txt", "w")
+    g.write(b"y")
+    g.close()
+    assert fs2.stat("/gone/a.txt")["size"] == 1
+    fs2.unlink("/gone/a.txt")
+    fs2.rmdir("/gone")
+    with pytest.raises(FSError):
+        fs2.stat("/gone/a.txt")
+    fs2.shutdown()
+    fs.shutdown()
+
+
 def test_mdlog_replays_half_applied_rename(cluster):
     """Write a rename intent to the MDLog, apply only the dst half
     (simulating an MDS crash between the two dentry updates), restart
@@ -169,3 +199,4 @@ def test_mdlog_replays_half_applied_unlink(cluster):
         fs3.shutdown()
     finally:
         mds3.shutdown()
+

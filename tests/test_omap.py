@@ -209,3 +209,32 @@ def test_rados_cli_omap(cluster):
     assert rados_cli.main(base + ["rmomapkey", "cliobj", "k1"]) == 0
     io = client.open_ioctx("omappool")
     assert io.omap_get_keys("cliobj") == [b"k2"]
+
+
+def test_malformed_omap_payload_einval(cluster):
+    """A hostile/corrupt omap frame (embedded length past the buffer
+    end) must come back as a clean, FAST -EINVAL reply — not a
+    swallowed exception that stalls the client into its per-attempt
+    timeout (round-3 advisor findings on daemon.py op-pool exception
+    handling + omap_codec length trust)."""
+    import struct
+    import time as _t
+    _, client = cluster
+    io = client.open_ioctx("omappool")
+    # count=1, klen=0xffffffff, no bytes behind it
+    evil = struct.pack("<II", 1, 0xFFFFFFFF)
+    t0 = _t.time()
+    with pytest.raises(RadosError) as ei:
+        io._submit("evil", [["omapsetkeys", len(evil)]], evil)
+    import errno
+    assert ei.value.errno == errno.EINVAL
+    # fast failure, not a 30s attempt timeout
+    assert _t.time() - t0 < 10
+    # count exceeding the payload is rejected too
+    evil2 = struct.pack("<I", 0x7FFFFFFF)
+    with pytest.raises(RadosError) as ei:
+        io._submit("evil", [["omaprmkeys", len(evil2)]], evil2)
+    assert ei.value.errno == errno.EINVAL
+    # the daemon survived: a normal op still works
+    io.omap_set("evil", {b"ok": b"1"})
+    assert io.omap_get_keys("evil") == [b"ok"]

@@ -542,6 +542,203 @@ def test_marker_not_promoted_as_object(s3):
     assert b"<Key>p</Key>" not in body
 
 
+def test_multipart_complete_on_versioned_bucket(gw, s3):
+    """CompleteMultipartUpload on a versioning-Enabled bucket mints a
+    NEW version: the overwritten current is archived (its data and
+    manifest survive, readable by versionId), the completed object
+    gets its own version id, and no still-referenced parts are reaped
+    (reference: multipart completes go through the same versioned-PUT
+    path as RGWPutObj)."""
+    import re
+    s3.request("PUT", "/vermp")
+    s3.request("PUT", "/vermp", query="versioning", body=VERSIONING_ON)
+    # current is a plain versioned object first
+    s3.request("PUT", "/vermp/obj", body=b"plain v1")
+    # then a multipart complete overwrites it
+    rng = np.random.default_rng(7)
+    chunks = [rng.integers(0, 256, 30000, dtype=np.uint8).tobytes()
+              for _ in range(2)]
+    _, _, body = s3.request("POST", "/vermp/obj", query="uploads")
+    up1 = re.search(rb"<UploadId>([^<]+)</UploadId>",
+                    body).group(1).decode()
+    etags = []
+    for i, c in enumerate(chunks):
+        _, hdrs, _ = s3.request(
+            "PUT", "/vermp/obj",
+            query=f"partNumber={i + 1}&uploadId={up1}", body=c)
+        etags.append(hdrs["ETag"].strip('"'))
+    st, _, _ = s3.request("POST", "/vermp/obj", query=f"uploadId={up1}",
+                          body=_complete_xml(list(enumerate(etags, 1))))
+    assert st == 200
+    _, _, got = s3.request("GET", "/vermp/obj")
+    assert got == b"".join(chunks)
+    # both versions listed, old one readable by id
+    _, _, body = s3.request("GET", "/vermp", query="versions")
+    vids = re.findall(rb"<VersionId>([^<]+)</VersionId>", body)
+    assert len(vids) == 2
+    _, _, old = s3.request("GET", "/vermp/obj",
+                           query=f"versionId={vids[1].decode()}")
+    assert old == b"plain v1"
+    # a SECOND multipart complete must not reap the first one's parts
+    _, _, body = s3.request("POST", "/vermp/obj", query="uploads")
+    up2 = re.search(rb"<UploadId>([^<]+)</UploadId>",
+                    body).group(1).decode()
+    _, hdrs, _ = s3.request("PUT", "/vermp/obj",
+                            query=f"partNumber=1&uploadId={up2}",
+                            body=b"z" * 5000)
+    s3.request("POST", "/vermp/obj", query=f"uploadId={up2}",
+               body=_complete_xml([(1, hdrs["ETag"].strip('"'))]))
+    _, _, body = s3.request("GET", "/vermp", query="versions")
+    vids = re.findall(rb"<VersionId>([^<]+)</VersionId>", body)
+    assert len(vids) == 3
+    # the archived multipart version still reads back bit-identical
+    _, _, got = s3.request("GET", "/vermp/obj",
+                           query=f"versionId={vids[1].decode()}")
+    assert got == b"".join(chunks)
+    # permanently deleting the archived multipart version reaps its
+    # parts and promotes nothing (it wasn't current)
+    s3.request("DELETE", "/vermp/obj",
+               query=f"versionId={vids[1].decode()}")
+    from ceph_tpu.rgw.store import _part_oid
+    from ceph_tpu.rados.client import RadosError
+    with pytest.raises(RadosError):
+        gw.store.data.read(_part_oid("vermp", up1, 1), 1)
+    _, _, got = s3.request("GET", "/vermp/obj")
+    assert got == b"z" * 5000
+
+
+def test_suspended_bucket_keeps_archived_version_data(gw, s3):
+    """On a versioning-SUSPENDED bucket, an overwrite must not reap a
+    manifest (or null data) still referenced by an archived version
+    row — Enable, multipart-complete v1, Suspend, complete again:
+    GET ?versionId=v1 must still read back bit-identical."""
+    import re
+    VERSIONING_OFF = (b'<VersioningConfiguration>'
+                      b'<Status>Suspended</Status>'
+                      b'</VersioningConfiguration>')
+    s3.request("PUT", "/susp")
+    s3.request("PUT", "/susp", query="versioning", body=VERSIONING_ON)
+    _, _, body = s3.request("POST", "/susp/m", query="uploads")
+    up1 = re.search(rb"<UploadId>([^<]+)</UploadId>",
+                    body).group(1).decode()
+    _, h, _ = s3.request("PUT", "/susp/m",
+                         query=f"partNumber=1&uploadId={up1}",
+                         body=b"V1" * 9000)
+    s3.request("POST", "/susp/m", query=f"uploadId={up1}",
+               body=_complete_xml([(1, h["ETag"].strip('"'))]))
+    s3.request("PUT", "/susp", query="versioning", body=VERSIONING_OFF)
+    _, _, body = s3.request("GET", "/susp", query="versioning")
+    assert b"<Status>Suspended</Status>" in body
+    # second complete while suspended: displaces the current WITHOUT
+    # destroying v1's parts (v1's version row references them)
+    _, _, body = s3.request("POST", "/susp/m", query="uploads")
+    up2 = re.search(rb"<UploadId>([^<]+)</UploadId>",
+                    body).group(1).decode()
+    _, h, _ = s3.request("PUT", "/susp/m",
+                         query=f"partNumber=1&uploadId={up2}",
+                         body=b"V2" * 9000)
+    s3.request("POST", "/susp/m", query=f"uploadId={up2}",
+               body=_complete_xml([(1, h["ETag"].strip('"'))]))
+    _, _, got = s3.request("GET", "/susp/m")
+    assert got == b"V2" * 9000
+    _, _, body = s3.request("GET", "/susp", query="versions")
+    vids = [v for v in re.findall(rb"<VersionId>([^<]+)</VersionId>",
+                                  body) if v != b"null"]
+    _, _, v1 = s3.request("GET", "/susp/m",
+                          query=f"versionId={vids[0].decode()}")
+    assert v1 == b"V1" * 9000
+    # plain-object flavor: the null row tracks the suspended PUT
+    # (S3: PUT on Suspended replaces the null version) while the
+    # version_id'd row survives
+    s3.request("PUT", "/susp/p", body=b"will-be-replaced")
+    s3.request("PUT", "/susp", query="versioning", body=VERSIONING_ON)
+    s3.request("PUT", "/susp/p", body=b"versioned")
+    s3.request("PUT", "/susp", query="versioning", body=VERSIONING_OFF)
+    s3.request("PUT", "/susp/p", body=b"suspended-put")
+    _, _, got = s3.request("GET", "/susp/p", query="versionId=null")
+    assert got == b"suspended-put"
+
+
+def test_suspended_null_multipart_replaced_not_leaked(gw, s3):
+    """A multipart-backed NULL version displaced by a suspended write
+    is REPLACED per S3 — its parts reaped (no leak), the null row
+    re-pointed; and a suspended DELETE leaves a null delete marker."""
+    import re
+    VERSIONING_OFF = (b'<VersioningConfiguration>'
+                      b'<Status>Suspended</Status>'
+                      b'</VersioningConfiguration>')
+    s3.request("PUT", "/susp2")
+    # multipart object pre-versioning (will become the null version)
+    _, _, body = s3.request("POST", "/susp2/k", query="uploads")
+    up1 = re.search(rb"<UploadId>([^<]+)</UploadId>",
+                    body).group(1).decode()
+    _, h, _ = s3.request("PUT", "/susp2/k",
+                         query=f"partNumber=1&uploadId={up1}",
+                         body=b"N1" * 8000)
+    s3.request("POST", "/susp2/k", query=f"uploadId={up1}",
+               body=_complete_xml([(1, h["ETag"].strip('"'))]))
+    s3.request("PUT", "/susp2", query="versioning", body=VERSIONING_ON)
+    s3.request("PUT", "/susp2/k", body=b"enabled-era")  # archives null
+    s3.request("PUT", "/susp2", query="versioning", body=VERSIONING_OFF)
+    # suspended PUT replaces the null version: old null multipart's
+    # parts must be reaped, null row re-pointed at the new bytes
+    s3.request("PUT", "/susp2/k", body=b"replacement")
+    from ceph_tpu.rgw.store import _part_oid
+    from ceph_tpu.rados.client import RadosError
+    with pytest.raises(RadosError):
+        gw.store.data.read(_part_oid("susp2", up1, 1), 1)
+    _, _, got = s3.request("GET", "/susp2/k", query="versionId=null")
+    assert got == b"replacement"
+    # the Enabled-era version_id'd row still reads back
+    _, _, body = s3.request("GET", "/susp2", query="versions")
+    vids = [v for v in re.findall(rb"<VersionId>([^<]+)</VersionId>",
+                                  body) if v != b"null"]
+    _, _, got = s3.request("GET", "/susp2/k",
+                           query=f"versionId={vids[0].decode()}")
+    assert got == b"enabled-era"
+    # suspended DELETE: null row becomes a delete marker; the
+    # versioned row survives; current 404s
+    st, _, _ = s3.request("DELETE", "/susp2/k")
+    assert st == 204
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        s3.request("GET", "/susp2/k")
+    assert ei.value.code == 404
+    _, _, body = s3.request("GET", "/susp2", query="versions")
+    assert b"<DeleteMarker>" in body
+    assert b"<VersionId>null</VersionId>" in body
+    _, _, got = s3.request("GET", "/susp2/k",
+                           query=f"versionId={vids[0].decode()}")
+    assert got == b"enabled-era"
+
+
+def test_preversioning_multipart_survives_versioned_complete(s3):
+    """A pre-versioning multipart object must survive as the null
+    version when a versioned multipart complete overwrites it."""
+    import re
+    s3.request("PUT", "/vermp2")
+    _, _, body = s3.request("POST", "/vermp2/m", query="uploads")
+    up = re.search(rb"<UploadId>([^<]+)</UploadId>",
+                   body).group(1).decode()
+    _, hdrs, _ = s3.request("PUT", "/vermp2/m",
+                            query=f"partNumber=1&uploadId={up}",
+                            body=b"oldpart" * 2000)
+    s3.request("POST", "/vermp2/m", query=f"uploadId={up}",
+               body=_complete_xml([(1, hdrs["ETag"].strip('"'))]))
+    s3.request("PUT", "/vermp2", query="versioning", body=VERSIONING_ON)
+    _, _, body = s3.request("POST", "/vermp2/m", query="uploads")
+    up2 = re.search(rb"<UploadId>([^<]+)</UploadId>",
+                    body).group(1).decode()
+    _, hdrs, _ = s3.request("PUT", "/vermp2/m",
+                            query=f"partNumber=1&uploadId={up2}",
+                            body=b"newpart" * 2000)
+    s3.request("POST", "/vermp2/m", query=f"uploadId={up2}",
+               body=_complete_xml([(1, hdrs["ETag"].strip('"'))]))
+    _, _, got = s3.request("GET", "/vermp2/m", query="versionId=null")
+    assert got == b"oldpart" * 2000
+    _, _, got = s3.request("GET", "/vermp2/m")
+    assert got == b"newpart" * 2000
+
+
 def test_versioned_bucket_blocks_deletion(s3):
     s3.request("PUT", "/ver4")
     s3.request("PUT", "/ver4", query="versioning", body=VERSIONING_ON)
