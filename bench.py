@@ -2,24 +2,36 @@
 """Headline benchmark: EC encode GB/s, k=8 m=3, 1 MiB stripes (vs CPU).
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N,
+   "value_min": ..., "value_max": ..., "n_passes": ..., "cpu_abs_GBps": ...}
 
-value       = jax-plugin (TPU when available) encode throughput, input
+value       = MEDIAN of n_passes independent slope measurements of the
+              jax-plugin (TPU when available) encode throughput, input
               GB/s over 1 MiB objects split k=8 + m=3 parity, batched
               and device-resident (the OSD worker keeps stripes on
               device; reference analog is the in-memory buffer of
-              ceph_erasure_code_benchmark).
-vs_baseline = value / best-CPU-plugin throughput measured on this host —
-              the stand-in for the reference's ISA-L single-socket number
-              (the reference publishes no absolute numbers; BASELINE.md).
+              ceph_erasure_code_benchmark).  Passes are SPACED over
+              minutes: the shared axon tunnel swings single samples
+              2-3x by hour-of-day, so one sample is weather, the
+              median of spaced samples is climate.  value_min/max
+              publish the observed spread so two runs can be compared
+              honestly.
+vs_baseline = value / cpu_abs_GBps, the PINNED CPU denominator: best
+              CPU plugin, fixed iteration count, median of repeats —
+              recorded absolutely so the ratio's movement can always
+              be attributed to the numerator or denominator.
 
-Measurement method: the encode is chained through a `lax.fori_loop`
-(each iteration's input depends on the previous parity) and timed as
-the difference between a 150-iteration and a 50-iteration dispatch.
-This defeats both async-dispatch undercounting and any runtime-level
-elision/caching of repeated identical computations (observed over the
-axon tunnel: timing the same buffer repeatedly reports impossible,
-above-roofline numbers), and cancels the dispatch/tunnel latency.
+Measurement method (each pass): the encode is chained through a
+`lax.fori_loop` (each iteration's input depends on the previous
+parity) and timed as the difference between a 150-iteration and a
+50-iteration dispatch.  This defeats both async-dispatch
+undercounting and any runtime-level elision/caching of repeated
+identical computations (observed over the axon tunnel: timing the
+same buffer repeatedly reports impossible, above-roofline numbers),
+and cancels the dispatch/tunnel latency.
+
+Knobs (env): BENCH_PASSES (default 5 on TPU, 1 on CPU),
+BENCH_SPACING_S (default 25 on TPU, 0 on CPU).
 
 Mirrors the canonical invocation of the reference benchmark
 (src/erasure-code/isa/README: `-p isa -P k=8 -P m=3 -S 1048576 -i 1000`).
@@ -35,16 +47,24 @@ import numpy as np
 K, M, SIZE = 8, 3, 1 << 20
 BATCH = 32                      # 1 MiB objects per device batch
 ITERS_LO, ITERS_HI = 50, 150
+CPU_ITERS = 2000                # fixed work per CPU timing repeat
+CPU_REPEATS = 5
 
 
-def time_encode_cpu(codec, chunks, min_iters=5, min_time=2.0):
-    codec.encode_chunks(chunks)
-    t0 = time.perf_counter()
-    iters = 0
-    while iters < min_iters or time.perf_counter() - t0 < min_time:
-        codec.encode_chunks(chunks)
-        iters += 1
-    return iters * SIZE / (time.perf_counter() - t0)
+def time_encode_cpu(codec, chunks, iters=CPU_ITERS, repeats=CPU_REPEATS):
+    """Pinned denominator: FIXED iteration count, median of repeats.
+    The old adaptive-duration loop let the measured rate pick its own
+    sample size, which moved the published ratio between rounds on
+    denominator noise alone (r02 6.26 vs r03 4.10 GB/s, same code)."""
+    codec.encode_chunks(chunks)          # warm
+    rates = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            codec.encode_chunks(chunks)
+        rates.append(iters * SIZE / (time.perf_counter() - t0))
+    rates.sort()
+    return rates[len(rates) // 2]
 
 
 def _slope_time(step, x0, rows, iters_lo=ITERS_LO, iters_hi=ITERS_HI,
@@ -193,12 +213,31 @@ def main():
         except Exception as e:  # noqa: BLE001
             print(f"# cpu plugin {plugin} failed: {e}", file=sys.stderr)
 
+    import jax
+    on_tpu = jax.default_backend() != "cpu"
+    passes = int(os.environ.get("BENCH_PASSES", 5 if on_tpu else 1))
+    spacing = float(os.environ.get("BENCH_SPACING_S",
+                                   25.0 if on_tpu else 0.0))
     error = None
-    try:
-        value = time_encode_jax(jax_codec)
-    except Exception as e:  # noqa: BLE001
-        print(f"# jax encode failed: {e}", file=sys.stderr)
-        value, error = 0.0, f"encode: {e}"
+    samples = []
+    for i in range(passes):
+        if i and spacing:
+            time.sleep(spacing)
+        try:
+            samples.append(time_encode_jax(jax_codec))
+            print(f"# encode pass {i + 1}/{passes}: "
+                  f"{samples[-1] / 1e9:.1f} GB/s", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"# jax encode pass {i + 1} failed: {e}",
+                  file=sys.stderr)
+            if error is None:
+                error = f"encode: {e}"
+    if samples:
+        samples.sort()
+        value = samples[len(samples) // 2]
+        error = None            # any landed pass clears pass failures
+    else:
+        value = 0.0
 
     # decode-1/2/3 tracked alongside the headline (BASELINE.json
     # north_star; reference `-w decode -e 1/2/3`)
@@ -218,10 +257,18 @@ def main():
         "value": round(value / 1e9, 3),
         "unit": "GB/s",
         "vs_baseline": round(value / cpu_best, 3) if cpu_best else None,
+        # spread of the spaced passes: two driver runs whose medians
+        # fall inside each other's [min, max] agree
+        "value_min": round(samples[0] / 1e9, 3) if samples else None,
+        "value_max": round(samples[-1] / 1e9, 3) if samples else None,
+        "n_passes": len(samples),
+        "pass_spacing_s": spacing,
+        # PINNED absolute denominator (fixed iters, median of repeats)
+        "cpu_abs_GBps": round(cpu_best / 1e9, 3) if cpu_best else None,
         # numerator is device-resident batched slope timing; denominator
         # is per-call synchronous CPU encode (includes Python dispatch) —
         # see BASELINE.md for the methodology note
-        "baseline_method": "cpu_per_call_sync",
+        "baseline_method": "cpu_per_call_sync_fixed_iters",
         **extras,
     }
     if error is not None:

@@ -83,11 +83,37 @@ class Message:
     def decode_wire(self, meta: dict, data: bytes) -> None:
         pass
 
+    def data_parts(self) -> list[bytes]:
+        """The data segment as a list of buffers.  Payload-heavy
+        messages override this so the wire path never concatenates
+        their bytes (writev-style framing); data_segment() stays the
+        joined view for decode symmetry."""
+        d = self.data_segment()
+        return [d] if d else []
+
     # -- envelope -----------------------------------------------------------
 
     def encode(self, seq: int = 0) -> bytes:
         return encode_frame(self.type_id, seq, self.to_meta(),
                             self.data_segment())
+
+    def encode_parts(self, seq: int = 0) -> tuple[bytes, ...]:
+        """Zero-concat frame: (head+meta, *data_parts, pcrc).  Joining
+        the parts yields exactly encode(seq) — retention stores the
+        tuple and only joins on (rare) replay; the writer writes each
+        part, so a 1 MiB payload is never copied into a frame buffer."""
+        meta_raw = json.dumps(self.to_meta(),
+                              separators=(",", ":")).encode()
+        parts = self.data_parts()
+        dlen = sum(len(p) for p in parts)
+        head = _HEADER.pack(MAGIC, self.type_id, seq, len(meta_raw),
+                            dlen, 0)
+        hcrc = _crc.crc32c(head[:-4], 0xFFFFFFFF)
+        head = head[:-4] + struct.pack("<I", hcrc)
+        c = _crc.crc32c(meta_raw, 0xFFFFFFFF)
+        for p in parts:
+            c = _crc.crc32c(p, c)
+        return (head + meta_raw, *parts, struct.pack("<I", c))
 
     HEADER_SIZE = _HEADER.size
 

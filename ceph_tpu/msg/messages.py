@@ -317,6 +317,11 @@ class MOSDECSubOpReadReply(Message):
     def data_segment(self):
         return self.data + self._attr_blob
 
+    def data_parts(self):
+        # zero-concat wire path: the (up to 128 KiB+) shard payload is
+        # never copied into a joined frame buffer
+        return [p for p in (self.data, self._attr_blob) if p]
+
     def decode_wire(self, meta, data):
         self.pgid = spg_from_json(meta["pgid"])
         self.tid, self.shard = meta["tid"], meta["shard"]

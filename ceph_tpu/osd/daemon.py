@@ -104,7 +104,12 @@ class MessengerShardBackend(ShardBackend):
             ent = self._pending_writes.pop(msg.tid, None)
         if ent:
             on_commit, shard = ent
-            on_commit(shard)
+            # Replies are fast-dispatched on the reactor, but
+            # on_commit transitively runs the write pipeline
+            # (try_finish_rmw -> check_ops -> possibly a BLOCKING
+            # probe() whose stat replies must be delivered by this
+            # very loop) — always punt to the dispatch executor.
+            Messenger.submit_dispatch(on_commit, shard)
 
     # -- reads --------------------------------------------------------------
 
@@ -124,6 +129,32 @@ class MessengerShardBackend(ShardBackend):
         conn = self.daemon.conn_to_osd(osd)
         conn.send_message(M.MOSDECSubOpRead(spg, tid, oid, off, length))
 
+    def sub_read_batch(self, reqs, on_done) -> None:
+        """Fan out [(shard, oid, off, length), ...] with ONE reactor
+        task for all remote sends; the local shard (if any) is read
+        after the remote requests are in flight."""
+        pairs = []
+        local = []
+        for shard, oid, off, length in reqs:
+            osd = self._osd_for(shard)
+            spg = spg_t(self.pgid, shard)
+            if osd is None:
+                on_done(shard, None)
+                continue
+            if osd == self.daemon.osd_id:
+                local.append((spg, shard, oid, off, length))
+                continue
+            tid = self._next_tid()
+            with self.lock:
+                self._pending_reads[tid] = (on_done, shard)
+            conn = self.daemon.conn_to_osd(osd)
+            pairs.append((conn, M.MOSDECSubOpRead(spg, tid, oid, off,
+                                                  length)))
+        if pairs:
+            self.daemon.messenger.send_batch(pairs)
+        for spg, shard, oid, off, length in local:
+            on_done(shard, self.daemon.read_shard(spg, oid, off, length))
+
     def handle_read_reply(self, msg: M.MOSDECSubOpReadReply) -> None:
         with self.lock:
             ent = self._pending_reads.pop(msg.tid, None)
@@ -131,7 +162,14 @@ class MessengerShardBackend(ShardBackend):
             on_done, shard = ent
             data = (np.frombuffer(msg.data, dtype=np.uint8)
                     if msg.result == 0 else None)
-            on_done(shard, data)
+            if getattr(on_done, "loop_safe", False):
+                # gather callbacks (store + Event.set) may run inline
+                # on the reactor — the hot client-read fan-out path
+                on_done(shard, data)
+            else:
+                # RMW pre-reads continue the write pipeline (decode +
+                # encode + possibly blocking probe()): off the loop
+                Messenger.submit_dispatch(on_done, shard, data)
 
     # -- sync metadata RPCs -------------------------------------------------
 
@@ -413,6 +451,16 @@ class OSDDaemon:
         self.messenger = Messenger(f"osd.{osd_id}", auth=auth,
                                    secure=secure)
         self.messenger.add_dispatcher(self._dispatch)
+        # fast dispatch (reference ms_fast_dispatch): the EC data-path
+        # RPCs run inline on the reactor — their handlers never block
+        # on nested RPCs (shard read = store read + async send; the
+        # reply routers hand off to callbacks/events; ping replies
+        # inline; MOSDOp's dispatch is just an op-pool submit).
+        # Sub-WRITES stay on the executor (store commit may do real
+        # I/O on BlueStore/FileStore).
+        self.messenger.fast_dispatch = lambda msg: isinstance(
+            msg, (M.MOSDOp, M.MOSDECSubOpRead, M.MOSDECSubOpReadReply,
+                  M.MOSDECSubOpWriteReply, M.MOSDPing))
         # fault-injection knobs ride the config system so the thrasher
         # (and injectargs at runtime) can set them per daemon
         # (reference ms_inject_* dev options, options.cc:1071-1092)

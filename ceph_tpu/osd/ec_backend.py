@@ -63,6 +63,13 @@ class ShardBackend:
         on_done(shard, data|None-on-error)."""
         raise NotImplementedError
 
+    def sub_read_batch(self, reqs, on_done) -> None:
+        """Fan out [(shard, oid, off, length), ...]; transports
+        override to amortize per-message scheduling (one reactor task
+        for the whole fan-out)."""
+        for shard, oid, off, length in reqs:
+            self.sub_read(shard, oid, off, length, on_done)
+
     def get_hinfo(self, shard: int, oid: hobject_t) -> HashInfo | None:
         raise NotImplementedError
 
@@ -643,17 +650,21 @@ class ECBackend:
                 got[shard] = data
             if len(got) >= self.k or len(got) + len(failed) >= issued[0]:
                 ready.set()
+        on_done.loop_safe = True      # store + Event.set only: may run
+        #                               inline on the reactor
 
         issued[0] = self.k
-        for s in range(self.k):
-            self.shards.sub_read(s, oid, chunk_off, chunk_len, on_done)
+        self.shards.sub_read_batch(
+            [(s, oid, chunk_off, chunk_len) for s in range(self.k)],
+            on_done)
         if not ready.wait(timeout=30) or (failed and len(got) < self.k):
             # degraded: fan out to parity shards until k gathered
             # (reference get_remaining_shards :1633 / fast_read)
             ready.clear()
             issued[0] = self.n
-            for s in range(self.k, self.n):
-                self.shards.sub_read(s, oid, chunk_off, chunk_len, on_done)
+            self.shards.sub_read_batch(
+                [(s, oid, chunk_off, chunk_len)
+                 for s in range(self.k, self.n)], on_done)
             ready.wait(timeout=30)
         if len(got) < self.k:
             raise ErasureCodeError(5, f"unrecoverable read {oid}")
@@ -690,9 +701,10 @@ class ECBackend:
             done["n"] += 1
             if len(got) >= self.k or done["n"] >= len(targets):
                 ready.set()
+        on_done.loop_safe = True      # store + Event.set only
 
-        for s in targets:
-            self.shards.sub_read(s, oid, 0, chunk_len, on_done)
+        self.shards.sub_read_batch(
+            [(s, oid, 0, chunk_len) for s in targets], on_done)
         ready.wait(timeout=30)
         if len(got) < self.k:
             raise ErasureCodeError(5, f"cannot recover {oid}: "

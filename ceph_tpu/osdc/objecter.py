@@ -32,6 +32,11 @@ class Objecter:
         self.messenger = Messenger(name, auth=auth, secure=secure)
         self.messenger.compress_algo = compress
         self.messenger.add_dispatcher(self._dispatch)
+        # op/command replies only wake waiter events — inline on the
+        # reactor (reference ms_fast_dispatch).  Watch/notify events
+        # run arbitrary user callbacks and stay on the executor.
+        self.messenger.fast_dispatch = lambda msg: isinstance(
+            msg, (M.MOSDOpReply, M.MMonCommandAck))
         # one (host, port) or a monmap-style list of them (reference
         # MonClient hunts across the monmap)
         from ..msg.addrs import normalize_mon_addrs

@@ -63,14 +63,19 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _reply(self, status: int, body: bytes = b"",
                content_type: str = "application/xml",
-               extra: dict | None = None) -> None:
+               extra: dict | None = None,
+               content_length: str | None = None) -> None:
+        """content_length overrides the header for HEAD replies that
+        advertise the RESOURCE's size rather than the (empty) body's."""
         self.send_response(status)
         self.send_header("Content-Type", content_type)
-        self.send_header("Content-Length", str(len(body)))
+        self.send_header("Content-Length",
+                         content_length if content_length is not None
+                         else str(len(body)))
         for k, v in (extra or {}).items():
             self.send_header(k, v)
         self.end_headers()
-        if self.command != "HEAD":
+        if self.command != "HEAD" and body:
             self.wfile.write(body)
 
     def _fail(self, e: RGWError) -> None:
@@ -79,6 +84,17 @@ class _Handler(BaseHTTPRequestHandler):
     def _route(self) -> None:
         parsed = urllib.parse.urlsplit(self.path)
         path = urllib.parse.unquote(parsed.path)
+        if path == "/auth" or path.startswith("/auth/") or \
+                path == "/swift" or path.startswith("/swift/"):
+            # Swift dialect shares the listener and the store
+            # (reference rgw_rest_swift.cc: one frontend stack, two
+            # REST dialects, one RADOS layout).  Mounted under the
+            # reference's default /swift prefix (+ the classic
+            # /auth/v1.0 tempauth endpoint) so Swift never shadows an
+            # S3 bucket named 'v1'.  Swift authenticates by token,
+            # not SigV4.
+            self._swift_route(parsed, path)
+            return
         body = self._read_body()
         if self.gw.creds is not None:
             try:
@@ -111,6 +127,26 @@ class _Handler(BaseHTTPRequestHandler):
             self._fail(e)
         except Exception as e:  # noqa: BLE001 - surface as 500
             self._reply(500, _xml_error("InternalError", repr(e)))
+
+    def _swift_route(self, parsed, path: str) -> None:
+        body = self._read_body()
+        query = dict(urllib.parse.parse_qsl(
+            parsed.query, keep_blank_values=True))
+        try:
+            status, extra, out = self.gw.swift.handle(
+                self.command, path, query, self.headers, body)
+        except RGWError as e:
+            self._reply(e.status, f"{e.code}: {e}".encode(),
+                        "text/plain")
+            return
+        except Exception as e:  # noqa: BLE001 - surface as 500
+            self._reply(500, repr(e).encode(), "text/plain")
+            return
+        extra = dict(extra)
+        ctype = extra.pop("Content-Type", "text/plain")
+        # HEAD carries the RESOURCE's length, pre-set by the frontend
+        clen = extra.pop("Content-Length", None)
+        self._reply(status, out, ctype, extra, content_length=clen)
 
     do_GET = do_PUT = do_DELETE = do_HEAD = do_POST = _route
 
@@ -383,6 +419,8 @@ class S3Gateway:
                  ec_profile: str | None = None):
         self.store = RGWStore(client, ec_profile=ec_profile)
         self.creds = creds          # access_key -> secret; None = open
+        from .swift import SwiftFrontend
+        self.swift = SwiftFrontend(self.store, creds)
         self.httpd = ThreadingHTTPServer(addr, _Handler)
         self.httpd.gateway = self
         self.addr = self.httpd.server_address[:2]

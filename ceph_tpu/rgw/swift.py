@@ -3,19 +3,21 @@
 layout; so does this one: objects PUT via S3 are readable via Swift
 and vice versa).
 
-Surface (the OpenStack object-storage subset a Swift client needs):
+Surface (the OpenStack object-storage subset a Swift client needs),
+mounted under the reference's default /swift prefix so Swift never
+shadows an S3 bucket named 'v1' (rgw_swift_url_prefix):
 
-  GET  /auth/v1.0                      X-Auth-User/X-Auth-Key ->
-                                       X-Auth-Token + X-Storage-Url
-  GET  /v1/AUTH_<acct>                 account: list containers
-  PUT  /v1/AUTH_<acct>/<c>             create container
-  DELETE /v1/AUTH_<acct>/<c>           delete container (409 if full)
-  GET  /v1/AUTH_<acct>/<c>             list objects (marker/prefix/
-                                       delimiter/limit; plain or JSON)
-  PUT  /v1/AUTH_<acct>/<c>/<obj>       upload (ETag = md5)
-  GET  /v1/AUTH_<acct>/<c>/<obj>       download
-  HEAD /v1/AUTH_<acct>/<c>/<obj>       metadata
-  DELETE /v1/AUTH_<acct>/<c>/<obj>     delete
+  GET  /auth/v1.0                        X-Auth-User/X-Auth-Key ->
+                                         X-Auth-Token + X-Storage-Url
+  GET  /swift/v1/AUTH_<acct>             account: list containers
+  PUT  /swift/v1/AUTH_<acct>/<c>         create container
+  DELETE /swift/v1/AUTH_<acct>/<c>       delete container (409 if full)
+  GET  /swift/v1/AUTH_<acct>/<c>         list objects (marker/prefix/
+                                         delimiter/limit; plain or JSON)
+  PUT  /swift/v1/AUTH_<acct>/<c>/<obj>   upload (ETag = md5)
+  GET  /swift/v1/AUTH_<acct>/<c>/<obj>   download
+  HEAD /swift/v1/AUTH_<acct>/<c>/<obj>   metadata
+  DELETE /swift/v1/AUTH_<acct>/<c>/<obj> delete
 
 Tokens are HMACs over the account + a daily window (stateless, like
 the reference's tempauth role); Keystone integration is out of scope.
@@ -27,7 +29,6 @@ import hashlib
 import hmac
 import json
 import time
-from xml.sax.saxutils import escape  # noqa: F401 (parity w/ gateway)
 
 from .store import RGWError
 
@@ -63,12 +64,12 @@ class SwiftFrontend:
         key = headers.get("x-auth-key", "")
         if self.creds is None:
             return 200, {"X-Auth-Token": "anonymous",
-                         "X-Storage-Url": "/v1/AUTH_main"}, b""
-        if self.creds.get(user) != key:
+                         "X-Storage-Url": "/swift/v1/AUTH_main"}, b""
+        if not hmac.compare_digest(str(self.creds.get(user, "")), key):
             raise RGWError(401, "Unauthorized", "bad credentials")
         window = int(time.time() // 86400)
         return 200, {"X-Auth-Token": _token(key, user, window),
-                     "X-Storage-Url": "/v1/AUTH_main"}, b""
+                     "X-Storage-Url": "/swift/v1/AUTH_main"}, b""
 
     # -- dispatch -----------------------------------------------------------
 
@@ -79,10 +80,12 @@ class SwiftFrontend:
             return self.handle_auth(headers)
         self._check_token(headers)
         parts = [p for p in path.split("/") if p]
-        # /v1/AUTH_x[/container[/object...]]
-        if len(parts) < 2:
+        # /swift/v1/AUTH_x[/container[/object...]] — version and
+        # account segments are validated, not just counted
+        if len(parts) < 3 or parts[1] != "v1" or \
+                not parts[2].startswith("AUTH_"):
             raise RGWError(404, "NotFound", path)
-        rest = parts[2:]
+        rest = parts[3:]
         if not rest:
             return self._account(method, query)
         container = rest[0]
@@ -154,9 +157,11 @@ class SwiftFrontend:
                 bytes(data)
         if method == "HEAD":
             meta = st.head_object(container, obj)
+            # real Content-Length (the resource's size, not the empty
+            # response body) — the gateway's HTTP layer honors a
+            # pre-set Content-Length instead of len(body)
             return 200, {"ETag": meta["etag"],
-                         "Content-Length-Override": str(meta["size"])}, \
-                b""
+                         "Content-Length": str(meta["size"])}, b""
         if method == "DELETE":
             st.delete_object(container, obj)
             return 204, {}, b""

@@ -55,6 +55,12 @@ def bench_pool(cluster, client, pool: str, seconds: float,
         t.join()
     elapsed = time.time() - t0
     wrote = sum(counts)
+    # Settle before the read phase: trailing write-pipeline work
+    # (acks, roll-forward, retention trims) otherwise competes with
+    # the reads and understates the read path ~2x.  The reference's
+    # `rados bench seq` is likewise a separate phase run against a
+    # settled pool, not the tail of the write storm.
+    time.sleep(2.0)
     # read-back verification pass (sequential, first writer's objects)
     r0 = time.time()
     rn = 0
