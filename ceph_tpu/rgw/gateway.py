@@ -96,11 +96,19 @@ class _Handler(BaseHTTPRequestHandler):
             self._swift_route(parsed, path)
             return
         body = self._read_body()
-        if self.gw.creds is not None:
+        # identity: the verified access key, or None for anonymous
+        # requests (no Authorization header).  Anonymous requests pass
+        # routing and face the ACL checks — a BAD signature still
+        # fails hard (reference rgw_auth_s3 -> verify_permission
+        # split: authentication vs authorization).
+        self._identity = None
+        if self.gw.creds is not None and \
+                self.headers.get("Authorization"):
             try:
                 auth = sigv4.verify_request(
                     self.command, parsed.path, parsed.query,
                     dict(self.headers), body, self.gw.creds)
+                self._identity = auth["access_key"]
                 if auth["streaming"]:
                     # aws-chunked body: strip the framing after
                     # verifying each chunk's rolling signature
@@ -150,15 +158,99 @@ class _Handler(BaseHTTPRequestHandler):
 
     do_GET = do_PUT = do_DELETE = do_HEAD = do_POST = _route
 
+    # -- ACLs (reference rgw_acl.h canned ACLs, enforced like
+    #    rgw_op.cc verify_permission) ---------------------------------------
+
+    CANNED_ACLS = ("private", "public-read", "public-read-write",
+                   "authenticated-read")
+
+    def _acl_allows(self, owner, canned: str, perm: str) -> bool:
+        """perm is 'READ' or 'WRITE'.  Owner (or legacy ownerless
+        resources, for any authenticated caller) always passes; the
+        canned ACL grants the rest."""
+        if self.gw.creds is None:
+            return True                       # open gateway: no ACLs
+        ident = self._identity
+        if ident is not None and (owner is None or ident == owner):
+            return True
+        if canned == "public-read-write":
+            return perm in ("READ", "WRITE")
+        if canned == "public-read":
+            return perm == "READ"
+        if canned == "authenticated-read":
+            return perm == "READ" and ident is not None
+        return False                          # private
+
+    def _bucket_acl(self, bucket: str) -> tuple:
+        meta = self.gw.store._bucket_meta(bucket)
+        if meta is None:
+            raise RGWError(404, "NoSuchBucket", bucket)
+        return meta.get("owner"), meta.get("acl", "private")
+
+    def _require_bucket_perm(self, bucket: str, perm: str) -> None:
+        owner, canned = self._bucket_acl(bucket)
+        if not self._acl_allows(owner, canned, perm):
+            raise RGWError(403, "AccessDenied", bucket)
+
+    def _require_bucket_owner(self, bucket: str) -> None:
+        owner, _ = self._bucket_acl(bucket)
+        if self.gw.creds is not None and not (
+                self._identity is not None and
+                (owner is None or self._identity == owner)):
+            raise RGWError(403, "AccessDenied", bucket)
+
+    def _require_object_perm(self, bucket: str, key: str,
+                             meta: dict, perm: str) -> None:
+        """Object ACL governs the object (S3: a public-read BUCKET
+        does not expose its objects; each object carries its own
+        canned ACL, default private to its owner)."""
+        owner = meta.get("owner")
+        if owner is None:                     # legacy/ownerless object
+            owner = self._bucket_acl(bucket)[0]
+        if not self._acl_allows(owner, meta.get("acl", "private"),
+                                perm):
+            raise RGWError(403, "AccessDenied", f"{bucket}/{key}")
+
+    def _requested_acl(self) -> str:
+        acl = self.headers.get("x-amz-acl", "") or "private"
+        if acl not in self.CANNED_ACLS:
+            raise RGWError(400, "InvalidArgument",
+                           f"unsupported canned ACL {acl!r}")
+        return acl
+
+    def _acl_xml(self, owner, canned: str) -> bytes:
+        grants = {"private": ["owner:FULL_CONTROL"],
+                  "public-read": ["owner:FULL_CONTROL", "AllUsers:READ"],
+                  "public-read-write": ["owner:FULL_CONTROL",
+                                        "AllUsers:READ", "AllUsers:WRITE"],
+                  "authenticated-read": ["owner:FULL_CONTROL",
+                                         "AuthenticatedUsers:READ"]}
+        rows = "".join(
+            f"<Grant><Grantee>{escape(g.split(':')[0])}</Grantee>"
+            f"<Permission>{g.split(':')[1]}</Permission></Grant>"
+            for g in grants[canned])
+        return (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            "<AccessControlPolicy>"
+            f"<Owner><ID>{escape(owner or '')}</ID></Owner>"
+            f"<AccessControlList>{rows}</AccessControlList>"
+            "</AccessControlPolicy>").encode()
+
     # -- service -------------------------------------------------------------
 
     def _service_get(self) -> None:
         if self.command != "GET":
             self._reply(405, _xml_error("MethodNotAllowed", self.command))
             return
+        if self.gw.creds is not None and self._identity is None:
+            # S3 has no anonymous ListBuckets
+            self._reply(403, _xml_error("AccessDenied", "anonymous"))
+            return
         rows = "".join(
             f"<Bucket><Name>{escape(b)}</Name></Bucket>"
-            for b, _m in self.gw.store.list_buckets())
+            for b, m in self.gw.store.list_buckets()
+            if self.gw.creds is None or m.get("owner") is None or
+            m.get("owner") == self._identity)
         self._reply(200, (
             '<?xml version="1.0" encoding="UTF-8"?>'
             "<ListAllMyBucketsResult>"
@@ -169,7 +261,31 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _bucket_op(self, bucket: str, query: dict, body: bytes) -> None:
         st = self.gw.store
-        if self.command == "PUT" and "versioning" in query:
+        if self.command == "PUT" and "lifecycle" in query:
+            self._require_bucket_owner(bucket)
+            st.set_lifecycle(bucket, _parse_lifecycle_body(body))
+            self._reply(200)
+        elif self.command == "GET" and "lifecycle" in query:
+            self._require_bucket_owner(bucket)
+            rules = st.get_lifecycle(bucket)
+            if not rules:
+                raise RGWError(404, "NoSuchLifecycleConfiguration",
+                               bucket)
+            self._reply(200, _lifecycle_xml(rules))
+        elif self.command == "DELETE" and "lifecycle" in query:
+            self._require_bucket_owner(bucket)
+            st.delete_lifecycle(bucket)
+            self._reply(204)
+        elif self.command == "PUT" and "acl" in query:
+            self._require_bucket_owner(bucket)
+            st.set_bucket_acl(bucket, self._requested_acl())
+            self._reply(200)
+        elif self.command == "GET" and "acl" in query:
+            self._require_bucket_owner(bucket)
+            owner, canned = self._bucket_acl(bucket)
+            self._reply(200, self._acl_xml(owner, canned))
+        elif self.command == "PUT" and "versioning" in query:
+            self._require_bucket_owner(bucket)
             import xml.etree.ElementTree as ET
             try:
                 root = ET.fromstring(body.decode())
@@ -181,6 +297,7 @@ class _Handler(BaseHTTPRequestHandler):
             st.set_versioning(bucket, status or "")
             self._reply(200)
         elif self.command == "GET" and "versioning" in query:
+            self._require_bucket_owner(bucket)
             status = st.get_versioning(bucket)
             inner = f"<Status>{status}</Status>" if status else ""
             self._reply(200, (
@@ -188,6 +305,7 @@ class _Handler(BaseHTTPRequestHandler):
                 f"<VersioningConfiguration>{inner}"
                 "</VersioningConfiguration>").encode())
         elif self.command == "GET" and "versions" in query:
+            self._require_bucket_owner(bucket)
             rows = st.list_versions(bucket, query.get("prefix", ""))
             parts = []
             for r in rows:
@@ -208,18 +326,33 @@ class _Handler(BaseHTTPRequestHandler):
                 f"<Name>{escape(bucket)}</Name>"
                 f"{''.join(parts)}</ListVersionsResult>").encode())
         elif self.command == "PUT":
-            st.create_bucket(bucket)
+            if self.gw.creds is not None and self._identity is None:
+                raise RGWError(403, "AccessDenied",
+                               "anonymous bucket creation")
+            existing = st._bucket_meta(bucket)
+            if existing is not None:
+                eo = existing.get("owner")
+                if self.gw.creds is not None and eo is not None and \
+                        eo != self._identity:
+                    raise RGWError(409, "BucketAlreadyExists", bucket)
+                self._reply(200)    # idempotent re-create by owner:
+                return              # keep versioning/acl meta intact
+            st.create_bucket(bucket, owner=self._identity,
+                             acl=self._requested_acl())
             self._reply(200)
         elif self.command == "DELETE":
+            self._require_bucket_owner(bucket)
             st.delete_bucket(bucket)
             self._reply(204)
         elif self.command in ("GET", "HEAD"):
             if self.command == "HEAD":
-                if st.bucket_exists(bucket):
-                    self._reply(200)
-                else:
+                if not st.bucket_exists(bucket):
                     self._reply(404, _xml_error("NoSuchBucket", bucket))
+                    return
+                self._require_bucket_perm(bucket, "READ")
+                self._reply(200)
                 return
+            self._require_bucket_perm(bucket, "READ")
             if "uploads" in query:
                 rows = "".join(
                     "<Upload>"
@@ -284,7 +417,28 @@ class _Handler(BaseHTTPRequestHandler):
     def _object_op(self, bucket: str, key: str, query: dict,
                    body: bytes) -> None:
         st = self.gw.store
-        if self.command == "PUT" and "partNumber" in query:
+        # the owner/acl stamp every write path records on the object
+        def _stamp():
+            ex = {}
+            if self._identity is not None:
+                ex["owner"] = self._identity
+            acl = self._requested_acl()
+            if acl != "private":
+                ex["acl"] = acl
+            return ex
+        if self.command == "PUT" and "acl" in query:
+            meta = st.head_object(bucket, key)
+            self._require_object_perm(bucket, key, meta, "WRITE_ACP")
+            st.set_object_acl(bucket, key, self._requested_acl())
+            self._reply(200)
+        elif self.command == "GET" and "acl" in query:
+            meta = st.head_object(bucket, key)
+            self._require_object_perm(bucket, key, meta, "READ_ACP")
+            self._reply(200, self._acl_xml(
+                meta.get("owner") or self._bucket_acl(bucket)[0],
+                meta.get("acl", "private")))
+        elif self.command == "PUT" and "partNumber" in query:
+            self._require_bucket_perm(bucket, "WRITE")
             try:
                 part_num = int(query["partNumber"])
             except ValueError:
@@ -295,13 +449,18 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200, extra={"ETag": f'"{etag}"'})
         elif self.command == "PUT" and \
                 self.headers.get("x-amz-copy-source"):
+            self._require_bucket_perm(bucket, "WRITE")
             src = urllib.parse.unquote(
                 self.headers["x-amz-copy-source"]).lstrip("/")
             src_bucket, _, src_key = src.partition("/")
             if not src_key:
                 raise RGWError(400, "InvalidArgument",
                                "x-amz-copy-source must be /bucket/key")
-            out = st.copy_object(src_bucket, src_key, bucket, key)
+            src_meta = st.head_object(src_bucket, src_key)
+            self._require_object_perm(src_bucket, src_key, src_meta,
+                                      "READ")
+            out = st.copy_object(src_bucket, src_key, bucket, key,
+                                 extra=_stamp())
             import datetime
             lm = datetime.datetime.fromtimestamp(
                 out["mtime"], datetime.timezone.utc).strftime(
@@ -313,9 +472,11 @@ class _Handler(BaseHTTPRequestHandler):
                 f"<LastModified>{lm}</LastModified>"
                 "</CopyObjectResult>").encode())
         elif self.command == "PUT":
-            etag = st.put_object(bucket, key, body)
+            self._require_bucket_perm(bucket, "WRITE")
+            etag = st.put_object(bucket, key, body, extra=_stamp())
             self._reply(200, extra={"ETag": f'"{etag}"'})
         elif self.command == "POST" and "uploads" in query:
+            self._require_bucket_perm(bucket, "WRITE")
             upload_id = st.init_multipart(bucket, key)
             self._reply(200, (
                 '<?xml version="1.0" encoding="UTF-8"?>'
@@ -325,9 +486,10 @@ class _Handler(BaseHTTPRequestHandler):
                 f"<UploadId>{upload_id}</UploadId>"
                 "</InitiateMultipartUploadResult>").encode())
         elif self.command == "POST" and "uploadId" in query:
+            self._require_bucket_perm(bucket, "WRITE")
             parts = _parse_complete_body(body)
             etag = st.complete_multipart(bucket, key, query["uploadId"],
-                                         parts)
+                                         parts, extra=_stamp())
             self._reply(200, (
                 '<?xml version="1.0" encoding="UTF-8"?>'
                 "<CompleteMultipartUploadResult>"
@@ -336,6 +498,7 @@ class _Handler(BaseHTTPRequestHandler):
                 f"<ETag>&quot;{etag}&quot;</ETag>"
                 "</CompleteMultipartUploadResult>").encode())
         elif self.command == "GET" and "uploadId" in query:
+            self._require_bucket_perm(bucket, "WRITE")
             rows = "".join(
                 "<Part>"
                 f"<PartNumber>{num}</PartNumber>"
@@ -352,12 +515,19 @@ class _Handler(BaseHTTPRequestHandler):
                 f"<UploadId>{query['uploadId']}</UploadId>{rows}"
                 "</ListPartsResult>").encode())
         elif self.command == "GET" and "versionId" in query:
+            # ACL check on the META before paying the data read —
+            # denied requests must not drive full object reads
+            vmeta = st._version_row(bucket, key, query["versionId"])
+            if vmeta is not None:
+                self._require_object_perm(bucket, key, vmeta, "READ")
             data, meta = st.get_object_version(bucket, key,
                                                query["versionId"])
             self._reply(200, data, "application/octet-stream",
                         {"ETag": f'"{meta["etag"]}"',
                          "x-amz-version-id": meta["version_id"]})
         elif self.command == "GET":
+            meta = st.head_object(bucket, key)
+            self._require_object_perm(bucket, key, meta, "READ")
             data, meta = st.get_object(bucket, key)
             extra = {"ETag": f'"{meta["etag"]}"'}
             if meta.get("version_id"):
@@ -365,21 +535,120 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200, data, "application/octet-stream", extra)
         elif self.command == "HEAD":
             meta = st.head_object(bucket, key)
-            self.send_response(200)
-            self.send_header("Content-Length", str(meta["size"]))
-            self.send_header("ETag", f'"{meta["etag"]}"')
-            self.end_headers()
+            self._require_object_perm(bucket, key, meta, "READ")
+            self._reply(200, content_length=str(meta["size"]),
+                        extra={"ETag": f'"{meta["etag"]}"'})
         elif self.command == "DELETE" and "uploadId" in query:
+            self._require_bucket_perm(bucket, "WRITE")
             st.abort_multipart(bucket, key, query["uploadId"])
             self._reply(204)
         elif self.command == "DELETE" and "versionId" in query:
+            self._require_bucket_owner(bucket)   # permanent destroy
             st.delete_object_version(bucket, key, query["versionId"])
             self._reply(204)
         elif self.command == "DELETE":
+            self._require_bucket_perm(bucket, "WRITE")
             st.delete_object(bucket, key)
             self._reply(204)
         else:
             self._reply(405, _xml_error("MethodNotAllowed", self.command))
+
+
+def _parse_lifecycle_body(body: bytes) -> list[dict]:
+    """LifecycleConfiguration XML -> rule dicts (reference rgw_lc
+    grammar subset: Expiration/Days, ExpiredObjectDeleteMarker,
+    AbortIncompleteMultipartUpload/DaysAfterInitiation)."""
+    import xml.etree.ElementTree as ET
+    try:
+        root = ET.fromstring(body.decode())
+    except Exception as e:  # noqa: BLE001
+        raise RGWError(400, "MalformedXML", str(e)) from e
+
+    def tag(el):
+        return el.tag.rpartition("}")[2]
+
+    def pos_int(txt, what):
+        try:
+            v = int(txt)
+        except ValueError as e:
+            raise RGWError(400, "MalformedXML",
+                           f"{what} {txt!r}") from e
+        if v < 1:       # S3: must be a positive integer — a zero or
+            # negative value would make the sweep delete everything
+            raise RGWError(400, "InvalidArgument",
+                           f"{what} must be a positive integer")
+        return v
+
+    rules = []
+    for el in root.iter():
+        if tag(el) != "Rule":
+            continue
+        rule: dict = {"prefix": ""}
+        status = "Enabled"
+        # STRUCTURE-aware walk (direct children only): a Transition
+        # rule also carries <Days>, and flat tag-matching would misread
+        # it as Expiration days — turning a move-to-GLACIER request
+        # into deletion
+        for child in el:
+            t = tag(child)
+            txt = (child.text or "").strip()
+            if t == "ID":
+                rule["id"] = txt
+            elif t == "Prefix":
+                rule["prefix"] = txt
+            elif t == "Filter":
+                for f in child:
+                    if tag(f) == "Prefix":
+                        rule["prefix"] = (f.text or "").strip()
+            elif t == "Status":
+                status = txt
+            elif t == "Expiration":
+                for e in child:
+                    if tag(e) == "Days":
+                        rule["days"] = pos_int(
+                            (e.text or "").strip(), "Days")
+                    elif tag(e) == "ExpiredObjectDeleteMarker":
+                        rule["expired_obj_delete_marker"] = \
+                            (e.text or "").strip() == "true"
+            elif t == "AbortIncompleteMultipartUpload":
+                for e in child:
+                    if tag(e) == "DaysAfterInitiation":
+                        rule["abort_mpu_days"] = pos_int(
+                            (e.text or "").strip(),
+                            "DaysAfterInitiation")
+            elif t in ("Transition", "NoncurrentVersionTransition"):
+                raise RGWError(501, "NotImplemented",
+                               f"{t} (no storage classes)")
+        if status == "Enabled":
+            rules.append(rule)
+    if not rules:
+        raise RGWError(400, "MalformedXML", "no enabled Rule")
+    return rules
+
+
+def _lifecycle_xml(rules: list[dict]) -> bytes:
+    parts = []
+    for r in rules:
+        body = f"<ID>{escape(r.get('id', ''))}</ID>" \
+               f"<Prefix>{escape(r.get('prefix', ''))}</Prefix>" \
+               "<Status>Enabled</Status>"
+        exp = ""
+        if r.get("days"):
+            exp += f"<Days>{r['days']}</Days>"
+        if r.get("expired_obj_delete_marker"):
+            exp += ("<ExpiredObjectDeleteMarker>true"
+                    "</ExpiredObjectDeleteMarker>")
+        if exp:     # ONE Expiration element (S3 schema)
+            body += f"<Expiration>{exp}</Expiration>"
+        if r.get("abort_mpu_days"):
+            body += ("<AbortIncompleteMultipartUpload>"
+                     f"<DaysAfterInitiation>{r['abort_mpu_days']}"
+                     "</DaysAfterInitiation>"
+                     "</AbortIncompleteMultipartUpload>")
+        parts.append(f"<Rule>{body}</Rule>")
+    return ('<?xml version="1.0" encoding="UTF-8"?>'
+            "<LifecycleConfiguration>"
+            f"{''.join(parts)}</LifecycleConfiguration>").encode()
 
 
 def _parse_complete_body(body: bytes) -> list[tuple[int, str]]:
@@ -416,7 +685,8 @@ class S3Gateway:
 
     def __init__(self, client, addr: tuple[str, int] = ("127.0.0.1", 0),
                  creds: dict[str, str] | None = None,
-                 ec_profile: str | None = None):
+                 ec_profile: str | None = None,
+                 lc_interval: float = 60.0):
         self.store = RGWStore(client, ec_profile=ec_profile)
         self.creds = creds          # access_key -> secret; None = open
         from .swift import SwiftFrontend
@@ -428,8 +698,25 @@ class S3Gateway:
             target=self.httpd.serve_forever, daemon=True,
             name="rgw-frontend")
         self._thread.start()
+        # lifecycle worker (reference RGWLC thread): periodic sweep of
+        # every bucket's rules; tests call store.lifecycle_sweep(now=)
+        # directly with a mocked clock
+        self._lc_stop = threading.Event()
+
+        def _lc_loop():
+            while not self._lc_stop.wait(lc_interval):
+                try:
+                    self.store.lifecycle_sweep()
+                except Exception:  # noqa: BLE001 - worker must survive
+                    import traceback
+                    traceback.print_exc()
+
+        self._lc_thread = threading.Thread(
+            target=_lc_loop, daemon=True, name="rgw-lc")
+        self._lc_thread.start()
 
     def shutdown(self) -> None:
+        self._lc_stop.set()
         self.httpd.shutdown()
         self.httpd.server_close()
 

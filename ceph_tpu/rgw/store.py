@@ -69,6 +69,12 @@ class RGWStore:
         self.meta = client.open_ioctx(META_POOL)
         self.data = client.open_ioctx(DATA_POOL)
         self._cls(self.meta, BUCKETS_OBJ, "dir_init")
+        # bucket-meta rows are read-modify-written whole (versioning/
+        # acl/lifecycle share one row); concurrent HTTP handler threads
+        # must not interleave their RMWs or the second write silently
+        # drops the first's field
+        import threading as _threading
+        self._bmeta_lock = _threading.Lock()
 
     def _ensure_pools(self, ec_profile, pg_num) -> None:
         for name, kind in ((META_POOL, "replicated"),
@@ -92,13 +98,135 @@ class RGWStore:
 
     # -- buckets -------------------------------------------------------------
 
-    def create_bucket(self, bucket: str) -> None:
+    def create_bucket(self, bucket: str, owner: str | None = None,
+                      acl: str = "private") -> None:
         if not bucket or "/" in bucket:
             raise RGWError(400, "InvalidBucketName", bucket)
+        meta: dict = {"created": time.time()}
+        if owner is not None:
+            meta["owner"] = owner
+        if acl != "private":
+            meta["acl"] = acl
         self._cls(self.meta, BUCKETS_OBJ, "dir_add", {
-            "key": bucket,
-            "meta": {"created": time.time()}})
+            "key": bucket, "meta": meta})
         self._cls(self.meta, f"index.{bucket}", "dir_init")
+
+    def set_bucket_acl(self, bucket: str, acl: str) -> None:
+        with self._bmeta_lock:
+            meta = self._bucket_meta(bucket)
+            if meta is None:
+                raise RGWError(404, "NoSuchBucket", bucket)
+            meta["acl"] = acl               # RMW: keep created/owner etc.
+            self._cls(self.meta, BUCKETS_OBJ, "dir_add", {
+                "key": bucket, "meta": meta})
+
+    def set_object_acl(self, bucket: str, key: str, acl: str) -> None:
+        cur = self._current_meta(bucket, key)
+        if cur is None:
+            raise RGWError(404, "NoSuchKey", key)
+        cur["acl"] = acl
+        self._cls(self.meta, f"index.{bucket}", "dir_add", {
+            "key": key, "meta": cur})
+
+    # -- lifecycle (reference rgw_lc.h: per-bucket rules evaluated by
+    #    a background worker) ----------------------------------------------
+
+    def set_lifecycle(self, bucket: str, rules: list[dict]) -> None:
+        """rules: [{id, prefix, days?, expired_obj_delete_marker?,
+        abort_mpu_days?}, ...] — the Expiration(Days) /
+        ExpiredObjectDeleteMarker / AbortIncompleteMultipartUpload
+        subset of the reference's LC rule grammar."""
+        with self._bmeta_lock:
+            meta = self._bucket_meta(bucket)
+            if meta is None:
+                raise RGWError(404, "NoSuchBucket", bucket)
+            for r in rules:
+                if not (r.get("days") or r.get("abort_mpu_days") or
+                        r.get("expired_obj_delete_marker")):
+                    raise RGWError(400, "MalformedXML",
+                                   f"rule {r.get('id', '?')} has no action")
+            meta["lifecycle"] = rules
+            self._cls(self.meta, BUCKETS_OBJ, "dir_add", {
+                "key": bucket, "meta": meta})
+
+    def get_lifecycle(self, bucket: str) -> list[dict]:
+        meta = self._bucket_meta(bucket)
+        if meta is None:
+            raise RGWError(404, "NoSuchBucket", bucket)
+        return meta.get("lifecycle", [])
+
+    def delete_lifecycle(self, bucket: str) -> None:
+        with self._bmeta_lock:
+            meta = self._bucket_meta(bucket)
+            if meta is None:
+                raise RGWError(404, "NoSuchBucket", bucket)
+            meta.pop("lifecycle", None)
+            self._cls(self.meta, BUCKETS_OBJ, "dir_add", {
+                "key": bucket, "meta": meta})
+
+    def lifecycle_sweep(self, now: float | None = None) -> dict:
+        """One pass over every bucket with lifecycle rules (the
+        reference's RGWLC::process).  Returns counters for
+        observability/tests.  `now` is injectable for time-mocked
+        tests."""
+        now = time.time() if now is None else now
+        stats = {"expired": 0, "markers_removed": 0, "mpu_aborted": 0}
+        for bucket, bmeta in self.list_buckets():
+            rules = bmeta.get("lifecycle")
+            if not rules:
+                continue
+            for rule in rules:
+                prefix = rule.get("prefix", "")
+                days = rule.get("days")
+                if days:
+                    cutoff = now - days * 86400
+                    marker = ""
+                    while True:
+                        entries, _cps, trunc, nm = self.list_objects(
+                            bucket, prefix=prefix, marker=marker,
+                            max_keys=1000)
+                        for k, m in entries:
+                            if m.get("mtime", now) <= cutoff:
+                                try:
+                                    self.delete_object(bucket, k)
+                                    stats["expired"] += 1
+                                except RGWError:
+                                    pass
+                        if not trunc or not entries:
+                            break
+                        marker = entries[-1][0]
+                if rule.get("expired_obj_delete_marker"):
+                    # a delete marker whose key has NO other versions
+                    # is dead weight: remove it (S3
+                    # ExpiredObjectDeleteMarker)
+                    by_key: dict[str, list] = {}
+                    for row in self.list_versions(
+                            bucket, prefix=prefix, max_keys=100000):
+                        by_key.setdefault(row["key"], []).append(row)
+                    for k, rows in by_key.items():
+                        if len(rows) == 1 and \
+                                rows[0].get("delete_marker"):
+                            try:
+                                self.delete_object_version(
+                                    bucket, k, rows[0]["version_id"])
+                                stats["markers_removed"] += 1
+                            except RGWError:
+                                pass
+                mpu_days = rule.get("abort_mpu_days")
+                if mpu_days:
+                    cutoff = now - mpu_days * 86400
+                    for k, upload_id, m in \
+                            self.list_multipart_uploads(bucket):
+                        if not k.startswith(prefix):
+                            continue
+                        if m.get("initiated", now) <= cutoff:
+                            try:
+                                self.abort_multipart(bucket, k,
+                                                     upload_id)
+                                stats["mpu_aborted"] += 1
+                            except RGWError:
+                                pass
+        return stats
 
     @staticmethod
     def _not_found(e: RadosError) -> bool:
@@ -168,12 +296,13 @@ class RGWStore:
         if status not in ("Enabled", "Suspended"):
             raise RGWError(400, "IllegalVersioningConfiguration",
                            status)
-        meta = self._bucket_meta(bucket)
-        if meta is None:
-            raise RGWError(404, "NoSuchBucket", bucket)
-        meta["versioning"] = status       # RMW: keep created etc.
-        self._cls(self.meta, BUCKETS_OBJ, "dir_add", {
-            "key": bucket, "meta": meta})
+        with self._bmeta_lock:
+            meta = self._bucket_meta(bucket)
+            if meta is None:
+                raise RGWError(404, "NoSuchBucket", bucket)
+            meta["versioning"] = status       # RMW: keep created etc.
+            self._cls(self.meta, BUCKETS_OBJ, "dir_add", {
+                "key": bucket, "meta": meta})
 
     def get_versioning(self, bucket: str) -> str:
         meta = self._bucket_meta(bucket)
@@ -256,10 +385,13 @@ class RGWStore:
         self._archive_version(bucket, key,
                               {**cur, "null_data": True}, "null")
 
-    def put_object(self, bucket: str, key: str, body: bytes) -> str:
+    def put_object(self, bucket: str, key: str, body: bytes,
+                   extra: dict | None = None) -> str:
         """Returns the ETag (md5 hex, S3 semantics).  On a versioned
         bucket every PUT archives a new immutable version; the current
-        pointer rides the bucket index like before."""
+        pointer rides the bucket index like before.  `extra` merges
+        additional rows into the object meta (owner/acl stamps from
+        the gateway's auth layer)."""
         bmeta = self._bucket_meta(bucket)
         if bmeta is None:
             raise RGWError(404, "NoSuchBucket", bucket)
@@ -268,7 +400,7 @@ class RGWStore:
             self._archive_null_version(bucket, key)
             vid = self._new_version_id()
             meta = {"size": len(body), "etag": etag,
-                    "mtime": time.time()}
+                    "mtime": time.time(), **(extra or {})}
             self.data.write_full(_version_oid(bucket, vid, key), body)
             self._archive_version(bucket, key, meta, vid)
             self._cls(self.meta, f"index.{bucket}", "dir_add", {
@@ -276,7 +408,8 @@ class RGWStore:
             return etag
         suspended = bool(bmeta.get("versioning"))   # "" = never versioned
         reap = self._displaced_manifests(bucket, key, suspended)
-        meta = {"size": len(body), "etag": etag, "mtime": time.time()}
+        meta = {"size": len(body), "etag": etag, "mtime": time.time(),
+                **(extra or {})}
         self.data.write_full(_data_oid(bucket, key), body)
         self._cls(self.meta, f"index.{bucket}", "dir_add", {
             "key": key, "meta": meta})
@@ -488,14 +621,16 @@ class RGWStore:
             pass
 
     def copy_object(self, src_bucket: str, src_key: str,
-                    dst_bucket: str, dst_key: str) -> dict:
+                    dst_bucket: str, dst_key: str,
+                    extra: dict | None = None) -> dict:
         """Server-side copy (reference RGWCopyObj, rgw_op.h:1500s):
         the client never sees the bytes.  A multipart source is
         materialized into a plain destination object (the reference
         copies manifests tail-first; one data object is the honest
         equivalent at this scale)."""
         body, _meta = self.get_object(src_bucket, src_key)
-        etag = self.put_object(dst_bucket, dst_key, bytes(body))
+        etag = self.put_object(dst_bucket, dst_key, bytes(body),
+                               extra=extra)
         return {"etag": etag, "mtime": time.time()}
 
     # -- multipart uploads (reference rgw_op.h:1716-1754) -------------------
@@ -558,7 +693,8 @@ class RGWStore:
         return rows
 
     def complete_multipart(self, bucket: str, key: str, upload_id: str,
-                           parts: list[tuple[int, str]]) -> str:
+                           parts: list[tuple[int, str]],
+                           extra: dict | None = None) -> str:
         """parts = [(part_num, etag), ...] from the client's
         CompleteMultipartUpload body.  Validates against what was
         uploaded (reference RGWCompleteMultipart::execute), writes the
@@ -589,7 +725,8 @@ class RGWStore:
         etag = f"{hashlib.md5(md5cat).hexdigest()}-{len(parts)}"
         obj_meta = {"size": total, "etag": etag, "mtime": time.time(),
                     "multipart": {"upload_id": upload_id,
-                                  "parts": manifest}}
+                                  "parts": manifest},
+                    **(extra or {})}
         bmeta = self._bucket_meta(bucket) or {}
         if bmeta.get("versioning") == "Enabled":
             # S3: CompleteMultipartUpload on a versioned bucket mints

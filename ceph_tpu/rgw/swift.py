@@ -48,15 +48,17 @@ class SwiftFrontend:
 
     # -- auth ---------------------------------------------------------------
 
-    def _check_token(self, headers) -> None:
+    def _check_token(self, headers) -> str | None:
+        """Returns the authenticated user (the bucket/object owner
+        for writes), or None on an open-access frontend."""
         if self.creds is None:
-            return
+            return None
         tok = headers.get("x-auth-token", "")
         window = int(time.time() // 86400)
         for user, key in self.creds.items():
             for w in (window, window - 1):   # tolerate day rollover
                 if hmac.compare_digest(tok, _token(key, user, w)):
-                    return
+                    return user
         raise RGWError(401, "Unauthorized", "bad or missing token")
 
     def handle_auth(self, headers) -> tuple[int, dict, bytes]:
@@ -78,7 +80,7 @@ class SwiftFrontend:
         """Returns (status, extra_headers, body)."""
         if path.startswith("/auth"):
             return self.handle_auth(headers)
-        self._check_token(headers)
+        user = self._check_token(headers)
         parts = [p for p in path.split("/") if p]
         # /swift/v1/AUTH_x[/container[/object...]] — version and
         # account segments are validated, not just counted
@@ -87,19 +89,22 @@ class SwiftFrontend:
             raise RGWError(404, "NotFound", path)
         rest = parts[3:]
         if not rest:
-            return self._account(method, query)
+            return self._account(method, query, user)
         container = rest[0]
         if len(rest) == 1:
-            return self._container(method, container, query)
+            return self._container(method, container, query, user)
         obj = "/".join(rest[1:])
-        return self._object(method, container, obj, body)
+        return self._object(method, container, obj, body, user)
 
     # -- account ------------------------------------------------------------
 
-    def _account(self, method: str, query: dict):
+    def _account(self, method: str, query: dict,
+                 user: str | None = None):
         if method != "GET":
             raise RGWError(405, "MethodNotAllowed", method)
-        rows = self.store.list_buckets()
+        rows = [(n, m) for n, m in self.store.list_buckets()
+                if self.creds is None or m.get("owner") is None or
+                m.get("owner") == user]
         if query.get("format") == "json":
             out = json.dumps([{"name": n, "count": 0, "bytes": 0}
                               for n, _m in rows]).encode()
@@ -109,23 +114,54 @@ class SwiftFrontend:
 
     # -- containers ---------------------------------------------------------
 
-    def _container(self, method: str, container: str, query: dict):
+    def _require_access(self, container: str, user: str | None,
+                        perm: str) -> None:
+        """Same owner/canned-ACL gate the S3 dialect enforces — a
+        Swift token must not become a side door into another
+        account's private bucket (Swift users are always
+        authenticated, so authenticated-read passes)."""
+        meta = self.store._bucket_meta(container)
+        if meta is None:
+            raise RGWError(404, "NotFound", container)
+        if self.creds is None:
+            return
+        owner = meta.get("owner")
+        if owner is None or owner == user:
+            return
+        canned = meta.get("acl", "private")
+        if canned == "public-read-write" and perm in ("READ", "WRITE"):
+            return
+        if canned in ("public-read", "authenticated-read") and \
+                perm == "READ":
+            return
+        raise RGWError(403, "Forbidden", container)
+
+    def _container(self, method: str, container: str, query: dict,
+                   user: str | None = None):
         st = self.store
         if method == "PUT":
-            try:
-                st.create_bucket(container)
-            except RGWError as e:
-                if e.status != 409:
-                    raise
+            existing = st._bucket_meta(container)
+            if existing is None:
+                try:
+                    st.create_bucket(container, owner=user)
+                except RGWError as e:
+                    if e.status != 409:
+                        raise
+            elif existing.get("owner") not in (None, user):
+                # a different account owns this name: no hijack
+                raise RGWError(409, "Conflict", container)
             return 201, {}, b""
         if method == "DELETE":
+            self._require_access(container, user, "OWNER")
             st.delete_bucket(container)
             return 204, {}, b""
         if method == "HEAD":
             if not st.bucket_exists(container):
                 raise RGWError(404, "NotFound", container)
+            self._require_access(container, user, "READ")
             return 204, {}, b""
         if method == "GET":
+            self._require_access(container, user, "READ")
             limit = int(query.get("limit", 10000))
             entries, cps, _trunc, _nm = st.list_objects(
                 container, prefix=query.get("prefix", ""),
@@ -144,25 +180,51 @@ class SwiftFrontend:
 
     # -- objects ------------------------------------------------------------
 
+    def _object_readable(self, container: str, obj: str,
+                         user: str | None, meta: dict) -> None:
+        """Object-level gate mirroring the S3 dialect: object owner,
+        else the object's canned ACL (default private), with the
+        bucket owner as fallback owner for ownerless objects."""
+        if self.creds is None:
+            return
+        owner = meta.get("owner")
+        if owner is None:
+            bmeta = self.store._bucket_meta(container) or {}
+            owner = bmeta.get("owner")
+        if owner is None or owner == user:
+            return
+        if meta.get("acl", "private") in ("public-read",
+                                          "public-read-write",
+                                          "authenticated-read"):
+            return      # swift callers are always authenticated
+        raise RGWError(403, "Forbidden", f"{container}/{obj}")
+
     def _object(self, method: str, container: str, obj: str,
-                body: bytes):
+                body: bytes, user: str | None = None):
         st = self.store
         if method == "PUT":
-            etag = st.put_object(container, obj, body)
+            self._require_access(container, user, "WRITE")
+            etag = st.put_object(
+                container, obj, body,
+                extra={"owner": user} if user else None)
             return 201, {"ETag": etag}, b""
         if method == "GET":
+            meta = st.head_object(container, obj)
+            self._object_readable(container, obj, user, meta)
             data, meta = st.get_object(container, obj)
             return 200, {"ETag": meta["etag"],
                          "Content-Type": "application/octet-stream"}, \
                 bytes(data)
         if method == "HEAD":
             meta = st.head_object(container, obj)
+            self._object_readable(container, obj, user, meta)
             # real Content-Length (the resource's size, not the empty
             # response body) — the gateway's HTTP layer honors a
             # pre-set Content-Length instead of len(body)
             return 200, {"ETag": meta["etag"],
                          "Content-Length": str(meta["size"])}, b""
         if method == "DELETE":
+            self._require_access(container, user, "WRITE")
             st.delete_object(container, obj)
             return 204, {}, b""
         raise RGWError(405, "MethodNotAllowed", method)

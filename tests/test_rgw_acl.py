@@ -1,0 +1,265 @@
+"""S3 canned ACLs (reference rgw_acl.h, enforcement per rgw_op.cc
+verify_permission): private / public-read / public-read-write /
+authenticated-read on buckets and objects, exercised through a served
+socket with an owner account, a second account, and anonymous."""
+
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ceph_tpu.rgw import S3Gateway
+from ceph_tpu.rgw import sigv4
+from ceph_tpu.tools.vstart import Cluster
+
+OWNER, OWNER_SECRET = "owner", "ownersecret"
+OTHER, OTHER_SECRET = "other", "othersecret"
+
+
+class S3Client:
+    def __init__(self, addr, access, secret):
+        self.base = f"http://{addr[0]}:{addr[1]}"
+        self.host = f"{addr[0]}:{addr[1]}"
+        self.access, self.secret = access, secret
+
+    def request(self, method, path, query="", body=b"", headers=None):
+        headers = {"host": self.host, **(headers or {})}
+        headers.update(sigv4.sign_request(
+            method, path, query, headers, body, self.access,
+            self.secret))
+        url = self.base + path + (f"?{query}" if query else "")
+        req = urllib.request.Request(url, data=body if body else None,
+                                     method=method, headers=headers)
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+
+
+def anon(base, method, path, body=b"", query=""):
+    url = base + path + (f"?{query}" if query else "")
+    req = urllib.request.Request(url, data=body if body else None,
+                                 method=method)
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, dict(resp.headers), resp.read()
+
+
+@pytest.fixture(scope="module")
+def env():
+    with Cluster(n_osds=3) as c:
+        gw = S3Gateway(c.client(), creds={OWNER: OWNER_SECRET,
+                                          OTHER: OTHER_SECRET})
+        yield {
+            "gw": gw,
+            "owner": S3Client(gw.addr, OWNER, OWNER_SECRET),
+            "other": S3Client(gw.addr, OTHER, OTHER_SECRET),
+            "base": f"http://{gw.addr[0]}:{gw.addr[1]}",
+        }
+        gw.shutdown()
+
+
+def _code(exc_info):
+    return exc_info.value.code
+
+
+def test_private_default_denies_everyone_but_owner(env):
+    owner, other, base = env["owner"], env["other"], env["base"]
+    owner.request("PUT", "/priv")
+    owner.request("PUT", "/priv/secret.txt", body=b"classified")
+    st, _, got = owner.request("GET", "/priv/secret.txt")
+    assert st == 200 and got == b"classified"
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        other.request("GET", "/priv/secret.txt")
+    assert _code(ei) == 403
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        anon(base, "GET", "/priv/secret.txt")
+    assert _code(ei) == 403
+    # anonymous/second-account writes denied too
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        anon(base, "PUT", "/priv/evil.txt", body=b"x")
+    assert _code(ei) == 403
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        other.request("PUT", "/priv/evil.txt", body=b"x")
+    assert _code(ei) == 403
+    # bucket listing denied to non-owners
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        other.request("GET", "/priv", query="list-type=2")
+    assert _code(ei) == 403
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        anon(base, "GET", "/priv", query="list-type=2")
+    assert _code(ei) == 403
+
+
+def test_public_read_object(env):
+    """VERDICT done-criterion: public-read object GETs without auth
+    succeed, everything else 403s."""
+    owner, other, base = env["owner"], env["other"], env["base"]
+    owner.request("PUT", "/pub")
+    owner.request("PUT", "/pub/open.txt", body=b"readable by all",
+                  headers={"x-amz-acl": "public-read"})
+    owner.request("PUT", "/pub/closed.txt", body=b"owner only")
+    st, _, got = anon(base, "GET", "/pub/open.txt")
+    assert st == 200 and got == b"readable by all"
+    st, hdrs, _ = anon(base, "HEAD", "/pub/open.txt")
+    assert st == 200 and int(hdrs["Content-Length"]) == 15
+    st, _, got = other.request("GET", "/pub/open.txt")
+    assert st == 200
+    # the sibling object in the same bucket stays private
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        anon(base, "GET", "/pub/closed.txt")
+    assert _code(ei) == 403
+    # public-read grants READ, not WRITE
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        anon(base, "PUT", "/pub/open.txt", body=b"defaced")
+    assert _code(ei) == 403
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        anon(base, "DELETE", "/pub/open.txt")
+    assert _code(ei) == 403
+
+
+def test_authenticated_read(env):
+    owner, other, base = env["owner"], env["other"], env["base"]
+    owner.request("PUT", "/authd")
+    owner.request("PUT", "/authd/members.txt", body=b"for members",
+                  headers={"x-amz-acl": "authenticated-read"})
+    st, _, got = other.request("GET", "/authd/members.txt")
+    assert st == 200 and got == b"for members"
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        anon(base, "GET", "/authd/members.txt")
+    assert _code(ei) == 403
+
+
+def test_public_read_write_bucket(env):
+    owner, other, base = env["owner"], env["other"], env["base"]
+    owner.request("PUT", "/dropbox",
+                  headers={"x-amz-acl": "public-read-write"})
+    # second account and anonymous can both write
+    st, _, _ = other.request("PUT", "/dropbox/from-other",
+                             body=b"other's data")
+    assert st == 200
+    st, _, _ = anon(base, "PUT", "/dropbox/from-anon", body=b"anon data")
+    assert st == 200
+    # uploader owns its object: other can read its own back
+    st, _, got = other.request("GET", "/dropbox/from-other")
+    assert st == 200 and got == b"other's data"
+    # the bucket ACL also opens the LISTING
+    st, _, body = anon(base, "GET", "/dropbox", query="list-type=2")
+    assert st == 200 and b"from-anon" in body
+
+
+def test_bucket_public_read_opens_listing_not_objects(env):
+    """S3 semantics: a public-read BUCKET exposes the listing, not
+    the objects — each object still carries its own ACL."""
+    owner, base = env["owner"], env["base"]
+    owner.request("PUT", "/listable",
+                  headers={"x-amz-acl": "public-read"})
+    owner.request("PUT", "/listable/hidden.txt", body=b"still private")
+    st, _, body = anon(base, "GET", "/listable", query="list-type=2")
+    assert st == 200 and b"hidden.txt" in body
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        anon(base, "GET", "/listable/hidden.txt")
+    assert _code(ei) == 403
+
+
+def test_acl_subresource_and_flip(env):
+    owner, other, base = env["owner"], env["other"], env["base"]
+    owner.request("PUT", "/flip")
+    owner.request("PUT", "/flip/doc", body=b"contents")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        anon(base, "GET", "/flip/doc")
+    assert _code(ei) == 403
+    # owner flips the object public via PUT ?acl
+    st, _, _ = owner.request("PUT", "/flip/doc", query="acl",
+                             headers={"x-amz-acl": "public-read"})
+    assert st == 200
+    st, _, got = anon(base, "GET", "/flip/doc")
+    assert st == 200 and got == b"contents"
+    # GET ?acl reflects it (owner-only)
+    st, _, body = owner.request("GET", "/flip/doc", query="acl")
+    assert b"AllUsers" in body and b"READ" in body
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        other.request("GET", "/flip/doc", query="acl")
+    assert _code(ei) == 403
+    # non-owner cannot flip ACLs
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        other.request("PUT", "/flip/doc", query="acl",
+                      headers={"x-amz-acl": "public-read-write"})
+    assert _code(ei) == 403
+    # bucket ?acl set + get
+    owner.request("PUT", "/flip", query="acl",
+                  headers={"x-amz-acl": "public-read"})
+    st, _, body = owner.request("GET", "/flip", query="acl")
+    assert b"AllUsers" in body
+
+
+def test_bucket_admin_owner_only(env):
+    owner, other = env["owner"], env["other"]
+    owner.request("PUT", "/admin1")
+    VERSIONING_ON = (b'<VersioningConfiguration><Status>Enabled'
+                     b'</Status></VersioningConfiguration>')
+    for fn in (
+        lambda: other.request("PUT", "/admin1", query="versioning",
+                              body=VERSIONING_ON),
+        lambda: other.request("GET", "/admin1", query="versioning"),
+        lambda: other.request("GET", "/admin1", query="versions"),
+        lambda: other.request("DELETE", "/admin1"),
+    ):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            fn()
+        assert _code(ei) == 403
+    # name squatting: second account cannot re-create the bucket
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        other.request("PUT", "/admin1")
+    assert _code(ei) == 409
+    # idempotent re-create by the owner is fine
+    st, _, _ = owner.request("PUT", "/admin1")
+    assert st == 200
+
+
+def test_list_buckets_scoped_to_identity(env):
+    owner, other = env["owner"], env["other"]
+    owner.request("PUT", "/mine-only")
+    other.request("PUT", "/theirs-only")
+    _, _, body = owner.request("GET", "/")
+    assert b"<Name>mine-only</Name>" in body
+    assert b"theirs-only" not in body
+    _, _, body = other.request("GET", "/")
+    assert b"<Name>theirs-only</Name>" in body
+    assert b"mine-only" not in body
+
+
+def test_invalid_canned_acl_400(env):
+    owner = env["owner"]
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        owner.request("PUT", "/badacl",
+                      headers={"x-amz-acl": "world-domination"})
+    assert _code(ei) == 400
+
+
+def test_anonymous_service_and_bucket_create_denied(env):
+    base = env["base"]
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        anon(base, "GET", "/")
+    assert _code(ei) == 403
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        anon(base, "PUT", "/anonbucket")
+    assert _code(ei) == 403
+
+
+def test_copy_respects_source_read_and_dest_write(env):
+    owner, other = env["owner"], env["other"]
+    owner.request("PUT", "/cpsrc2")
+    owner.request("PUT", "/cpsrc2/private-src", body=b"s")
+    owner.request("PUT", "/cpsrc2/public-src", body=b"p",
+                  headers={"x-amz-acl": "public-read"})
+    other.request("PUT", "/cpdst2")
+    # copying a private source the caller cannot read: 403
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        other.request("PUT", "/cpdst2/stolen",
+                      headers={"x-amz-copy-source": "/cpsrc2/private-src"})
+    assert _code(ei) == 403
+    # a public-read source copies fine into the caller's own bucket
+    st, _, _ = other.request("PUT", "/cpdst2/ok",
+                             headers={"x-amz-copy-source":
+                                      "/cpsrc2/public-src"})
+    assert st == 200
+    _, _, got = other.request("GET", "/cpdst2/ok")
+    assert got == b"p"

@@ -191,3 +191,42 @@ def test_missing_account_path_404(base, tok):
     with pytest.raises(urllib.error.HTTPError) as ei:
         _req(base, "GET", "/swift/v1", headers=tok)
     assert ei.value.code == 404
+
+
+def test_swift_cross_account_isolation(gw, base):
+    """A second Swift account's token must not open another account's
+    private containers (round-4 review: Swift must enforce the same
+    owner/ACL gate as the S3 dialect)."""
+    # second account
+    gw.creds["intruder"] = "intrudersecret"
+    st, hdrs, _ = _req(base, "GET", "/auth/v1.0",
+                       headers={"X-Auth-User": "intruder",
+                                "X-Auth-Key": "intrudersecret"})
+    tok2 = {"X-Auth-Token": hdrs["X-Auth-Token"]}
+    st, hdrs, _ = _req(base, "GET", "/auth/v1.0",
+                       headers={"X-Auth-User": USER, "X-Auth-Key": KEY})
+    tok1 = {"X-Auth-Token": hdrs["X-Auth-Token"]}
+    _req(base, "PUT", "/swift/v1/AUTH_main/private1", headers=tok1)
+    _req(base, "PUT", "/swift/v1/AUTH_main/private1/secret",
+         body=b"mine", headers=tok1)
+    for m, p, body in (("GET", "/swift/v1/AUTH_main/private1", b""),
+                       ("GET", "/swift/v1/AUTH_main/private1/secret", b""),
+                       ("PUT", "/swift/v1/AUTH_main/private1/x", b"z"),
+                       ("DELETE", "/swift/v1/AUTH_main/private1/secret",
+                        b""),
+                       ("DELETE", "/swift/v1/AUTH_main/private1", b"")):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _req(base, m, p, body=body, headers=tok2)
+        assert ei.value.code == 403, (m, p)
+    # container name hijack blocked
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(base, "PUT", "/swift/v1/AUTH_main/private1", headers=tok2)
+    assert ei.value.code == 409
+    # account listing scoped to the token's identity
+    _, _, body = _req(base, "GET", "/swift/v1/AUTH_main", headers=tok2)
+    assert b"private1" not in body
+    # owner still has full access
+    _, _, got = _req(base, "GET", "/swift/v1/AUTH_main/private1/secret",
+                     headers=tok1)
+    assert got == b"mine"
+    del gw.creds["intruder"]
