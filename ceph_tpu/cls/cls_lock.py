@@ -34,7 +34,11 @@ def lock(ctx: ClsContext, inp: bytes) -> bytes:
         if st["type"] == "exclusive" or ltype == "exclusive":
             if owner not in lockers:
                 raise ClsError(errno.EBUSY, "locked")
-    lockers[owner] = {"name": name, "type": ltype}
+    # the locker's messenger entity rides the record (reference
+    # cls_lock stores the locker's addr/cookie) so a steal can
+    # blacklist the old owner at the OSDs before breaking the lock
+    lockers[owner] = {"name": name, "type": ltype,
+                      "entity": req.get("entity")}
     st["type"] = ltype
     _store(ctx, st)
     return b"{}"

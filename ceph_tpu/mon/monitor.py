@@ -483,6 +483,32 @@ class Monitor:
                     self.osdmap.bump_epoch()
                     self._propose_current()
                 return 0, {"in": osd_id}
+            if prefix == "osd blacklist add":
+                entity = str(cmd["entity"])
+                ttl = float(cmd.get("expire", 3600.0))
+                import time as _time
+                with self.lock:
+                    # prune expired entries while we hold the map
+                    now = _time.time()
+                    self.osdmap.blacklist = {
+                        e: t for e, t in self.osdmap.blacklist.items()
+                        if t > now}
+                    self.osdmap.blacklist[entity] = now + ttl
+                    self.osdmap.bump_epoch()
+                    self._propose_current()
+                return 0, {"blacklisted": entity,
+                           "epoch": self.osdmap.epoch}
+            if prefix == "osd blacklist rm":
+                entity = str(cmd["entity"])
+                with self.lock:
+                    if entity not in self.osdmap.blacklist:
+                        return -errno.ENOENT, {"error": entity}
+                    del self.osdmap.blacklist[entity]
+                    self.osdmap.bump_epoch()
+                    self._propose_current()
+                return 0, {"removed": entity}
+            if prefix == "osd blacklist ls":
+                return 0, {"blacklist": dict(self.osdmap.blacklist)}
             if prefix == "osd down":
                 osd_id = int(cmd["id"])
                 with self.lock:

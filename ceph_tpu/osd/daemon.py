@@ -1421,6 +1421,18 @@ class OSDDaemon:
     def _handle_client_op(self, conn, msg: M.MOSDOp) -> None:
         """reference PrimaryLogPG::do_op/do_osd_ops: decode the op
         vector, build a PGTransaction for mutations, execute reads."""
+        # blacklist fence (reference OSDMap blacklist / EBLACKLISTED):
+        # a fenced client's ops — including ones already in flight
+        # when an exclusive-lock steal blacklisted it — are rejected,
+        # never applied
+        ent = getattr(conn, "peer_entity", None)
+        if ent is not None and \
+                self.osdmap.blacklist.get(ent, 0) > time.time():
+            # expired entries no longer fence (the mon prunes them
+            # from the map lazily; the TTL is authoritative here)
+            conn.send_message(M.MOSDOpReply(
+                msg.tid, -errno.ESHUTDOWN, b"", self.osdmap.epoch))
+            return
         # OSDCap check: a read-only client credential cannot mutate
         # (reference OSDCap grammar reduced to the keyring's subset)
         if self.messenger.auth is not None:

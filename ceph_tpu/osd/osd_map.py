@@ -62,6 +62,12 @@ class OSDMap:
         self.crush = CrushWrapper()
         self.pg_temp: dict[pg_t, list[int]] = {}
         self.ec_profiles: dict[str, dict[str, str]] = {}
+        # client fencing (reference OSDMap blacklist, consumed by
+        # ManagedLock): messenger entity -> expiry unix time.  OSDs
+        # reject ops from blacklisted entities with -ESHUTDOWN (the
+        # EBLACKLISTED role), closing the in-flight-op window an
+        # exclusive-lock steal leaves open.
+        self.blacklist: dict[str, float] = {}
 
     # -- queries ------------------------------------------------------------
 
@@ -186,6 +192,7 @@ class OSDMap:
             "pg_temp": [[pg.pool, pg.seed, osds]
                         for pg, osds in self.pg_temp.items()],
             "ec_profiles": self.ec_profiles,
+            "blacklist": self.blacklist,
             "crush": {
                 "devices": [[d.id, d.weight, d.device_class]
                             for d in crush.devices.values()],
@@ -220,6 +227,7 @@ class OSDMap:
         for pool, seed, osds in j.get("pg_temp", []):
             m.pg_temp[pg_t(pool, seed)] = osds
         m.ec_profiles = dict(j.get("ec_profiles", {}))
+        m.blacklist = dict(j.get("blacklist", {}))
         cj = j["crush"]
         cm = m.crush.map
         for did, w, dc in cj["devices"]:

@@ -82,7 +82,8 @@ class ExclusiveLock:
             self._watch_cookie = self.io.watch(self.header_oid,
                                               self._on_notify)
         req = {"name": LOCK_NAME, "owner": self.owner_id,
-               "type": "exclusive"}
+               "type": "exclusive",
+               "entity": self.io.client.objecter.messenger.entity}
         try:
             try:
                 self._cls("lock", req)
@@ -98,6 +99,7 @@ class ExclusiveLock:
                         errno.EBUSY,
                         f"image {self.image_name} is locked by a live "
                         f"client (steal to take over)") from e
+                self._blacklist_owners()
                 self._cls("break_lock", {})
                 self._cls("lock", req)
         except Exception:
@@ -109,6 +111,34 @@ class ExclusiveLock:
         # fence any previous owner's handle
         self.io.notify(self.header_oid, json.dumps(
             {"event": "acquired", "owner": self.owner_id}).encode())
+
+    def _blacklist_owners(self) -> None:
+        """Fence the old owner(s) at the OSDs BEFORE breaking the
+        lock (reference ManagedLock: blacklist-on-break-lock closes
+        the window where the fenced owner's already-sent ops land
+        after the steal).  Waits until this client observes the
+        blacklisting osdmap epoch so the break doesn't race the map."""
+        client = self.io.client
+        my_entity = client.objecter.messenger.entity
+        try:
+            info = json.loads(self._cls("get_info", {}).decode())
+        except RadosError:
+            return
+        epoch = 0
+        for owner, rec in (info.get("lockers") or {}).items():
+            ent = (rec or {}).get("entity")
+            if not ent or ent == my_entity:
+                continue
+            r, out = client.mon_command({
+                "prefix": "osd blacklist add", "entity": ent})
+            if r == 0:
+                epoch = max(epoch, out.get("epoch", 0))
+        # map barrier (librados wait_for_latest_osdmap role)
+        import time
+        deadline = time.time() + 10
+        while epoch and client.objecter.osdmap.epoch < epoch and \
+                time.time() < deadline:
+            client.objecter.refresh_map()
 
     def check(self) -> None:
         """Raise LockLost if this handle was fenced."""

@@ -364,6 +364,28 @@ class Image:
             self.io.write_full(_data(self.name, block), content)
         self._present_blocks.add(block)
 
+    def _read_block_at(self, block: int, snapid: int) -> bytes:
+        """One whole block read at an explicit snap context (the
+        export-diff walk reads both sides of a snap pair)."""
+        save = self._read_snap_id
+        self._read_snap_id = snapid or 0
+        try:
+            return self._read_block(block, 0, self.block_size)
+        finally:
+            self._read_snap_id = save
+
+    def export_diff(self, fh, from_snap: str | None = None,
+                    to_snap: str | None = None) -> int:
+        """Between-snap delta stream (reference rbd export-diff)."""
+        from .diff import export_diff
+        return export_diff(self, fh, from_snap, to_snap)
+
+    def import_diff(self, fh) -> dict:
+        """Apply a delta stream (reference rbd import-diff)."""
+        self._writable()
+        from .diff import import_diff
+        return import_diff(self, fh)
+
     def read(self, offset: int, length: int) -> bytes:
         length = max(0, min(length, self.size() - offset))
         bs = self.block_size
@@ -404,6 +426,9 @@ class Image:
         snapid = self.io.selfmanaged_snap_create()
         self._header["snaps"].append(snap)
         self._header["snap_ids"][snap] = snapid
+        # size at snap time: export-diff must bound its walk by the
+        # snapshot's extent, not the (possibly resized) head's
+        self._header.setdefault("snap_sizes", {})[snap] = self.size()
         self._save_header()
         self._apply_snapc()   # later writes COW against this snap
 

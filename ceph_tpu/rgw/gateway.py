@@ -159,27 +159,16 @@ class _Handler(BaseHTTPRequestHandler):
     do_GET = do_PUT = do_DELETE = do_HEAD = do_POST = _route
 
     # -- ACLs (reference rgw_acl.h canned ACLs, enforced like
-    #    rgw_op.cc verify_permission) ---------------------------------------
+    #    rgw_op.cc verify_permission; decision shared with the Swift
+    #    dialect via rgw/acl.py) -------------------------------------------
 
-    CANNED_ACLS = ("private", "public-read", "public-read-write",
-                   "authenticated-read")
+    from .acl import CANNED_ACLS  # noqa: F401 (class-level re-export)
 
     def _acl_allows(self, owner, canned: str, perm: str) -> bool:
-        """perm is 'READ' or 'WRITE'.  Owner (or legacy ownerless
-        resources, for any authenticated caller) always passes; the
-        canned ACL grants the rest."""
         if self.gw.creds is None:
             return True                       # open gateway: no ACLs
-        ident = self._identity
-        if ident is not None and (owner is None or ident == owner):
-            return True
-        if canned == "public-read-write":
-            return perm in ("READ", "WRITE")
-        if canned == "public-read":
-            return perm == "READ"
-        if canned == "authenticated-read":
-            return perm == "READ" and ident is not None
-        return False                          # private
+        from .acl import canned_allows
+        return canned_allows(self._identity, owner, canned, perm)
 
     def _bucket_acl(self, bucket: str) -> tuple:
         meta = self.gw.store._bucket_meta(bucket)
@@ -528,7 +517,7 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.command == "GET":
             meta = st.head_object(bucket, key)
             self._require_object_perm(bucket, key, meta, "READ")
-            data, meta = st.get_object(bucket, key)
+            data, meta = st.get_object(bucket, key, meta=meta)
             extra = {"ETag": f'"{meta["etag"]}"'}
             if meta.get("version_id"):
                 extra["x-amz-version-id"] = meta["version_id"]

@@ -561,8 +561,13 @@ class RGWStore:
             raise RGWError(404, "NoSuchKey", key) from e
         return json.loads(raw.decode())
 
-    def get_object(self, bucket: str, key: str) -> tuple[bytes, dict]:
-        meta = self.head_object(bucket, key)
+    def get_object(self, bucket: str, key: str,
+                   meta: dict | None = None) -> tuple[bytes, dict]:
+        """`meta` short-circuits the index lookup when the caller
+        already fetched the row (the gateway's ACL check) — the
+        hottest read path must not pay two identical dir_gets."""
+        if meta is None:
+            meta = self.head_object(bucket, key)
         manifest = meta.get("multipart")
         if manifest:
             # stitch parts in part-number order (reference RGWGetObj

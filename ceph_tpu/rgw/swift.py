@@ -116,25 +116,19 @@ class SwiftFrontend:
 
     def _require_access(self, container: str, user: str | None,
                         perm: str) -> None:
-        """Same owner/canned-ACL gate the S3 dialect enforces — a
-        Swift token must not become a side door into another
-        account's private bucket (Swift users are always
-        authenticated, so authenticated-read passes)."""
+        """Same owner/canned-ACL gate the S3 dialect enforces (ONE
+        shared predicate, rgw/acl.py) — a Swift token must not become
+        a side door into another account's private bucket.  Swift
+        callers are always authenticated."""
         meta = self.store._bucket_meta(container)
         if meta is None:
             raise RGWError(404, "NotFound", container)
         if self.creds is None:
             return
-        owner = meta.get("owner")
-        if owner is None or owner == user:
-            return
-        canned = meta.get("acl", "private")
-        if canned == "public-read-write" and perm in ("READ", "WRITE"):
-            return
-        if canned in ("public-read", "authenticated-read") and \
-                perm == "READ":
-            return
-        raise RGWError(403, "Forbidden", container)
+        from .acl import canned_allows
+        if not canned_allows(user, meta.get("owner"),
+                             meta.get("acl", "private"), perm):
+            raise RGWError(403, "Forbidden", container)
 
     def _container(self, method: str, container: str, query: dict,
                    user: str | None = None):
@@ -182,22 +176,20 @@ class SwiftFrontend:
 
     def _object_readable(self, container: str, obj: str,
                          user: str | None, meta: dict) -> None:
-        """Object-level gate mirroring the S3 dialect: object owner,
-        else the object's canned ACL (default private), with the
-        bucket owner as fallback owner for ownerless objects."""
+        """Object-level gate mirroring the S3 dialect (same shared
+        predicate): object owner, else the object's canned ACL
+        (default private), with the bucket owner as fallback owner
+        for ownerless objects."""
         if self.creds is None:
             return
         owner = meta.get("owner")
         if owner is None:
             bmeta = self.store._bucket_meta(container) or {}
             owner = bmeta.get("owner")
-        if owner is None or owner == user:
-            return
-        if meta.get("acl", "private") in ("public-read",
-                                          "public-read-write",
-                                          "authenticated-read"):
-            return      # swift callers are always authenticated
-        raise RGWError(403, "Forbidden", f"{container}/{obj}")
+        from .acl import canned_allows
+        if not canned_allows(user, owner, meta.get("acl", "private"),
+                             "READ"):
+            raise RGWError(403, "Forbidden", f"{container}/{obj}")
 
     def _object(self, method: str, container: str, obj: str,
                 body: bytes, user: str | None = None):
@@ -211,7 +203,7 @@ class SwiftFrontend:
         if method == "GET":
             meta = st.head_object(container, obj)
             self._object_readable(container, obj, user, meta)
-            data, meta = st.get_object(container, obj)
+            data, meta = st.get_object(container, obj, meta=meta)
             return 200, {"ETag": meta["etag"],
                          "Content-Type": "application/octet-stream"}, \
                 bytes(data)

@@ -16,6 +16,8 @@ dispatch) at the subset the library supports:
   rbd -m MON -p POOL flatten NAME
   rbd -m MON -p POOL export NAME FILE      ('-' = stdout)
   rbd -m MON -p POOL import FILE NAME      ('-' = stdin)
+  rbd -m MON -p POOL export-diff [--from-snap A] NAME[@B] FILE
+  rbd -m MON -p POOL import-diff FILE NAME
   rbd -m MON -p POOL du NAME
   rbd -m MON -p POOL lock ls NAME
   rbd -m MON -p POOL bench NAME --io-size N --io-total N
@@ -49,6 +51,8 @@ def main(argv=None) -> int:
     ap.add_argument("--io-total", type=int, default=64 << 20)
     ap.add_argument("--exclusive", action="store_true",
                     help="hold the exclusive lock during I/O commands")
+    ap.add_argument("--from-snap", default=None,
+                    help="export-diff: the base snapshot")
     add_auth_args(ap)
     args = ap.parse_args(argv)
 
@@ -132,6 +136,27 @@ def main(argv=None) -> int:
             img.write(0, data)
             img.close()
             print(f"imported {len(data)} bytes to {name}")
+        elif cmd == "export-diff":
+            # rbd export-diff [--from-snap S] IMG[@TO] FILE
+            name, to_snap = _split_at(rest[0]) if "@" in rest[0] \
+                else (rest[0], None)
+            img = Image(io, name)
+            out = sys.stdout.buffer if rest[1] == "-" else \
+                open(rest[1], "wb")
+            n = img.export_diff(out, from_snap=args.from_snap,
+                                to_snap=to_snap)
+            if rest[1] != "-":
+                out.close()
+                print(f"exported {n} changed extents")
+        elif cmd == "import-diff":
+            # rbd import-diff FILE IMG
+            inp = sys.stdin.buffer if rest[0] == "-" else \
+                open(rest[0], "rb")
+            img = Image(io, rest[1], exclusive=args.exclusive)
+            stats = img.import_diff(inp)
+            img.close()
+            print(f"applied {stats['w']} writes / {stats['z']} zero "
+                  f"runs ({stats['bytes']} bytes)")
         elif cmd == "du":
             img = Image(io, rest[0], exclusive=args.exclusive)
             used = img.du()

@@ -212,3 +212,68 @@ def test_fenced_handle_cannot_corrupt_journal(cluster):
     ops = [e[1]["op"] for e in entries]
     assert ops.count("write") == 1     # only the pre-steal write
     thief.close()
+
+
+def test_blacklist_fences_in_flight_op(cluster):
+    """VERDICT r3 #10: a steal BLACKLISTS the old owner at the OSDs
+    (reference OSDMap blacklist + ManagedLock), so an op already in
+    flight when the lock was stolen — delayed on the wire via
+    ms_inject — is REJECTED at the OSD, never applied."""
+    import json
+    import threading
+    import time
+    c, _ = cluster
+    client_a = c.client()
+    client_b = c.client()
+    io_a = client_a.open_ioctx("rbdlk")
+    io_b = client_b.open_ioctx("rbdlk")
+    RBD(io_a).create("imgbl", 8 * MB, order=20)
+    old = Image(io_a, "imgbl", exclusive=True)
+    old.write(0, b"X" * 4096)
+
+    # delay every subsequent frame from A by exactly 3s (in flight on
+    # the wire when the steal happens)
+    msgr_a = client_a.objecter.messenger
+
+    class _Rng:
+        def random(self):
+            # inject check is strict `random() < prob`: 0.99 both
+            # passes the gate and scales the delay to ~3s
+            return 0.99
+
+        def randrange(self, n):
+            return 1
+
+    msgr_a.inject_delay_prob = 1.0
+    msgr_a.inject_delay_max = 3.0
+    msgr_a._inject_rng = _Rng()
+    results = {}
+
+    def delayed_write():
+        try:
+            old.write(4096, b"D" * 4096)
+            results["out"] = "applied"
+        except Exception as e:  # noqa: BLE001
+            results["out"] = e
+
+    wt = threading.Thread(target=delayed_write, daemon=True)
+    wt.start()
+    time.sleep(0.5)          # write is dispatched, sleeping on the wire
+    thief = Image(io_b, "imgbl", exclusive=True, steal=True)
+    # the old owner's entity is on the cluster blacklist
+    r, out = client_b.mon_command({"prefix": "osd blacklist ls"})
+    assert r == 0 and msgr_a.entity in out["blacklist"]
+    wt.join(30)
+    # the delayed op was REJECTED at the OSD (ESHUTDOWN), not applied
+    assert results["out"] != "applied"
+    assert getattr(results["out"], "errno", None) == errno.ESHUTDOWN, \
+        results["out"]
+    got = thief.read(4096, 4096)
+    assert bytes(got) == b"\x00" * 4096, "fenced in-flight op applied!"
+    # thief owns the image and writes fine
+    thief.write(4096, b"T" * 4096)
+    assert bytes(thief.read(4096, 4096)) == b"T" * 4096
+    thief.close()
+    msgr_a.inject_delay_prob = 0.0
+    client_a.shutdown()
+    client_b.shutdown()
