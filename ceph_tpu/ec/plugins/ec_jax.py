@@ -135,6 +135,31 @@ class ErasureCodeJax(ErasureCode):
                 "uses Mosaic bitcasts); use encode_chunks_device on CPU")
         return bs.gf_bitmatmul_w32(self._enc_bitmat32, words, self.m)
 
+    def encode_words_with_crc(self, words, tile: int | None = None,
+                              wb: int | None = None):
+        """Device-resident fused parity + per-tile crc L-bits over
+        word-packed input at the headline operating point (the hier-crc
+        kernel; see ops/crc32c_linear.subblock_crc_bits_w32).  words
+        (k, W) int32; W bytes per shard must be a tile multiple.
+        Returns (parity (m, W) int32, crc L-bits ((W*4//tile)*rows, 32)
+        int32) — the write path's checksum-and-parity-in-one-launch
+        (reference analog: plugin encode + ECUtil.cc:172 HashInfo
+        append, two separate passes there)."""
+        import jax.numpy as jnp
+        bs = _ops()
+        from ...ops import crc32c_linear as cl
+        if not self._use_w32:
+            raise RuntimeError(
+                "encode_words_with_crc requires a TPU backend")
+        tile = tile or bs.FUSED_TILE_HIER
+        wb = wb or bs.FUSED_WB
+        cmat_sub = jnp.asarray(cl.crc_tile_matrix_w32(wb))
+        combine = jnp.asarray(
+            cl.crc_combine_matrix(tile // 4 // wb, 4 * wb))
+        return bs.gf_encode_with_crc_pallas_w32_hier(
+            self._enc_bitmat32, cmat_sub, combine, words, self.m,
+            tile=tile, wb=wb)
+
     def encode_stripes(self, stripes):
         """Batched encode: (B, k, C) -> (B, m, C), one kernel launch.
 

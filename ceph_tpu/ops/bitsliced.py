@@ -489,6 +489,77 @@ def gf_encode_with_crc_pallas_w32(bitmat32, cmat32, words, m: int,
     )(bitmat32.astype(jnp.int8), cmat32, words)
 
 
+FUSED_WB = 512       # hier-crc sub-block, words (2 KiB); lane multiple
+FUSED_TILE_HIER = W32_TILE   # hier matrices are tile-size-independent
+
+
+def _make_gf_crc_kernel_w32_hier(interpret: bool, wb: int):
+    def _kern(bitmat_ref, cmat_sub_ref, in_ref, par_ref, lsub_ref):
+        """Fused parity + level-1 hierarchical crc at the headline
+        kernel's tile: the same VMEM-resident words feed the MXU parity
+        matmul and the sub-block crc matmuls (see
+        crc32c_linear.subblock_crc_bits_w32 for why the flat crc matmul
+        capped the fused tile at 2 KiB)."""
+        from . import crc32c_linear as cl
+        w = in_ref[:]                                  # (k, Wt) i32
+        par_words = _w32_parity_words(bitmat_ref[:], w, interpret)
+        par_ref[:] = par_words
+        allw = jnp.concatenate([w, par_words], axis=0)  # (k+m, Wt)
+        lsub_ref[:] = cl.subblock_crc_bits_w32(
+            allw, cmat_sub_ref[:], wb)                  # ((k+m)*S, 32)
+    return _kern
+
+
+@functools.partial(jax.jit, static_argnames=("m", "tile", "wb",
+                                             "interpret"))
+def gf_encode_with_crc_pallas_w32_hier(bitmat32, cmat_sub, combine,
+                                       words, m: int,
+                                       tile: int = FUSED_TILE_HIER,
+                                       wb: int = FUSED_WB,
+                                       interpret: bool = False):
+    """Hier-crc twin of gf_encode_with_crc_pallas_w32.  words (k, W)
+    i32, tile in BYTES; cmat_sub from crc_tile_matrix_w32(wb), combine
+    from crc_combine_matrix(tile//(4*wb), 4*wb).  Returns (parity (m, W)
+    i32, crc L-bits (ntiles*rows, 32) i32) — same contract as the flat
+    kernel, one L-row block per tile.  The kernel emits per-sub-block
+    L-vectors (~0.1% of input bytes); the level-2 advance-combine runs
+    as plain XLA here, inside the same jit."""
+    from . import crc32c_linear as cl
+    k, wtot = words.shape
+    wt = tile // 4
+    assert wtot % wt == 0, (wtot, wt)
+    assert wt % wb == 0, (wt, wb)
+    s = wt // wb
+    r = k + m
+    assert (r * s) % 8 == 0, (r, s)     # lsub out-block sublane align
+    grid = (wtot // wt,)
+    rows = _crc_rows(r)
+    parity, lsub = pl.pallas_call(
+        _make_gf_crc_kernel_w32_hier(interpret, wb),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((32 * m, 32 * k), lambda t: (0, 0)),
+            pl.BlockSpec((32 * wb, 32), lambda t: (0, 0)),
+            pl.BlockSpec((k, wt), lambda t: (0, t)),
+        ],
+        out_specs=[
+            pl.BlockSpec((m, wt), lambda t: (0, t)),
+            pl.BlockSpec((r * s, 32), lambda t: (t, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, wtot), jnp.int32),
+            jax.ShapeDtypeStruct(((wtot // wt) * r * s, 32), jnp.int32),
+        ],
+        interpret=interpret,
+        **_parallel_grid(1, interpret),
+    )(bitmat32.astype(jnp.int8), cmat_sub, words)
+    crc = cl.combine_subblock_crcs(lsub, combine, r, s)  # (nt, r, 32)
+    pad = rows - r
+    if pad:
+        crc = jnp.pad(crc, ((0, 0), (0, pad), (0, 0)))
+    return parity, crc.reshape(-1, 32)
+
+
 @functools.partial(jax.jit, static_argnames=("m", "tile"))
 def gf_encode_with_crc_xla(bitmat, cmat, chunks, m: int,
                            tile: int = FUSED_TILE):
@@ -532,13 +603,19 @@ def gf_encode_extents_with_crc(bitmat, bitmat32, runs, m: int,
     crc32c_linear.fold_tile_crcs seeded per shard.
     """
     from . import crc32c_linear as cl
-    tile = FUSED_TILE
     if force_xla is None:
         force_xla = jax.default_backend() == "cpu"
     if use_w32 is None:
         use_w32 = not force_xla
     runs = [np.ascontiguousarray(r, dtype=np.uint8) for r in runs]
     k = runs[0].shape[0]
+    # operating point: big sequential drains ride the hier-crc kernel at
+    # the headline tile (FUSED_TILE_HIER); small/mixed drains keep the
+    # flat 2 KiB tile where padding waste would dominate
+    tile = FUSED_TILE
+    if use_w32 and not force_xla and \
+            min(r.shape[1] for r in runs) >= FUSED_TILE_HIER:
+        tile = FUSED_TILE_HIER
     meta = []           # (width, body) per run
     padded = []
     for r in runs:
@@ -555,6 +632,18 @@ def gf_encode_extents_with_crc(bitmat, bitmat32, runs, m: int,
         parity_big, crc_bits = gf_encode_with_crc_xla(
             bitmat, cmat, jnp.asarray(big), m)
         crc_bits = np.asarray(crc_bits)                # (ntiles, k+m, 32)
+    elif use_w32 and tile == FUSED_TILE_HIER:
+        wt, wb = tile // 4, FUSED_WB
+        cmat_sub = jnp.asarray(cl.crc_tile_matrix_w32(wb))
+        combine = jnp.asarray(cl.crc_combine_matrix(wt // wb, 4 * wb))
+        words = big.view("<u4").view(np.int32)
+        par_words, crc_flat = gf_encode_with_crc_pallas_w32_hier(
+            bitmat32, cmat_sub, combine, jnp.asarray(words), m,
+            tile=tile, wb=wb, interpret=interpret)
+        parity_big = np.asarray(par_words).view("<u4").view(np.uint8) \
+            .reshape(m, big.shape[1])
+        crc_bits = np.asarray(crc_flat).reshape(
+            ntiles_total, rows, 32)[:, :k + m]
     elif use_w32:
         wt = tile // 4
         cmat32 = jnp.asarray(cl.crc_tile_matrix_w32(wt))

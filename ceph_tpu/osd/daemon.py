@@ -12,9 +12,12 @@ OSD.cc:5210 + failure_queue :5502).
 Idiomatic shifts: the ShardedOpWQ thread-shards collapse into the
 messenger's dispatcher pool (Python threads are not the scaling axis
 here — the TPU codec launch is, and it batches inside ECBackend); the
-PG/PeeringState machinery is reduced to "the acting set the current map
-gives each PG", with peering-on-map-change limited to refreshing those
-acting sets (full log-based peering is roadmap).
+PG/PeeringState machinery runs full log-based peering on map change
+(_peer_pg below: GetLog-style shard interrogation, authoritative-log
+selection by min last_update with last_epoch_started fencing, divergent
+rollback, stale-shard adoption — the role of the reference's
+boost::statechart in src/osd/PeeringState.h, expressed as one
+deterministic pass instead of an event machine).
 """
 
 from __future__ import annotations

@@ -1,0 +1,112 @@
+"""Sweep the fused parity+crc w32 kernel's tile size on real hardware.
+
+The fused kernel (ops/bitsliced.py gf_encode_with_crc_pallas_w32) had
+never been tuned at the headline kernel's operating point: FUSED_TILE
+was 2048 bytes while the bare-encode W32_TILE is 131072.  The fused
+kernel's crc L-matrix (cmat32, one 32-bit row per input BIT of the
+tile) costs 1 KiB of VMEM per byte of tile, so the tile cannot simply
+be raised to W32_TILE — this sweep finds the knee.
+
+Usage: python -m ceph_tpu.tools.fused_tile_sweep [tiles...]
+"""
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ec.registry import ErasureCodePluginRegistry
+from ..ops import bitsliced as bs
+from ..ops import crc32c_linear as cl
+
+K, M, SIZE, BATCH = 8, 3, 1 << 20, 32
+
+
+def slope_rate(step, x0, iters_lo=20, iters_hi=60):
+    """bench.py-style chained fori_loop slope timing (crc feeds the
+    chain so neither output can be dead-code-eliminated)."""
+    def make(iters):
+        @jax.jit
+        def f(x):
+            def body(i, x):
+                r = step(x)
+                return x.at[:M, :].set(x[:M, :] ^ r)
+            return lax.fori_loop(0, iters, body, x)
+        return f
+
+    f_lo, f_hi = make(iters_lo), make(iters_hi)
+    jax.block_until_ready(f_lo(x0))
+    jax.block_until_ready(f_hi(x0))
+    best = []
+    for rep in range(3):
+        v = jax.block_until_ready(x0 ^ (rep + 1))
+        t0 = time.perf_counter()
+        jax.block_until_ready(f_lo(v))
+        lo = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        jax.block_until_ready(f_hi(v))
+        hi = time.perf_counter() - t0
+        dt = (hi - lo) / (iters_hi - iters_lo)
+        # same roofline elision gate as bench.py: an above-1TB/s slope
+        # is a silently-elided pass, not a fast kernel
+        if dt > 0 and BATCH * SIZE / dt < 1e12:
+            best.append(BATCH * SIZE / dt)
+    best.sort()
+    return best[len(best) // 2] if best else 0.0
+
+
+def main():
+    tiles = [int(t) for t in sys.argv[1:]] or [2048, 4096, 8192, 16384]
+    reg = ErasureCodePluginRegistry.instance()
+    codec = reg.factory("jax", {"k": str(K), "m": str(M),
+                                "technique": "cauchy"})
+    rng = np.random.default_rng(0)
+    flat = rng.integers(0, 256, (K, BATCH * SIZE // K), dtype=np.uint8)
+    words = jnp.asarray(flat.view(np.int32))
+    codec.encode_words(words)            # build bitmats
+    bitmat32 = codec._enc_bitmat32
+
+    flat_mode = "--flat" in sys.argv
+    for tile in tiles:
+        wt = tile // 4
+        if flat_mode:
+            try:
+                cmat32 = jnp.asarray(cl.crc_tile_matrix_w32(wt))
+
+                def step(x, cmat32=cmat32, tile=tile):
+                    par, crc = bs.gf_encode_with_crc_pallas_w32(
+                        bitmat32, cmat32, x, M, tile=tile)
+                    return par ^ jnp.sum(crc)   # crc feeds chain: no DCE
+
+                rate = slope_rate(step, words)
+                print(f"flat tile={tile:6d}  {rate / 1e9:7.2f} GB/s  "
+                      f"(cmat {wt * 32 * 32 * 4 / 2**20:.1f} MiB)")
+            except Exception as e:  # noqa: BLE001
+                print(f"flat tile={tile:6d}  FAILED: {type(e).__name__}: "
+                      f"{str(e)[:200]}")
+            continue
+        for wb in (256, 512, 1024):
+            if wt % wb:
+                continue
+            try:
+                cmat_sub = jnp.asarray(cl.crc_tile_matrix_w32(wb))
+                combine = jnp.asarray(
+                    cl.crc_combine_matrix(wt // wb, 4 * wb))
+
+                def step(x, cs=cmat_sub, cb=combine, tile=tile, wb=wb):
+                    par, crc = bs.gf_encode_with_crc_pallas_w32_hier(
+                        bitmat32, cs, cb, x, M, tile=tile, wb=wb)
+                    return par ^ jnp.sum(crc)   # crc feeds chain: no DCE
+
+                rate = slope_rate(step, words)
+                print(f"hier tile={tile:6d} wb={wb:5d}  "
+                      f"{rate / 1e9:7.2f} GB/s")
+            except Exception as e:  # noqa: BLE001
+                print(f"hier tile={tile:6d} wb={wb:5d}  FAILED: "
+                      f"{type(e).__name__}: {str(e)[:200]}")
+
+
+if __name__ == "__main__":
+    main()

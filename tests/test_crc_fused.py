@@ -159,6 +159,91 @@ def test_w32_fused_kernel_interpret():
         assert got == C.crc32c(allsh[s].tobytes(), 0xFFFFFFFF), f"shard {s}"
 
 
+def test_hier_fused_kernel_interpret():
+    """The hier-crc w32 fused kernel (interpret mode): per-sub-block
+    level-1 L-vectors + XLA level-2 advance-combine must reproduce the
+    byte-path host crc exactly (the round-5 kernel that unlocks the
+    headline tile for the fused path; flat cmat capped it at 2 KiB)."""
+    import jax.numpy as jnp
+    from ceph_tpu.ops import bitsliced as bs
+    from ceph_tpu.ec import gf
+
+    k, m = 4, 2
+    tile, wb = 4096, 128          # s = 8, (k+m)*s = 48: sublane-aligned
+    n = tile * 2
+    mat = gf.cauchy_rs_matrix(k, m)[k:]
+    bitmat32 = jnp.asarray(bs._w32_bitmat(mat), dtype=jnp.int8)
+    cmat_sub = jnp.asarray(cl.crc_tile_matrix_w32(wb))
+    combine = jnp.asarray(cl.crc_combine_matrix(tile // 4 // wb, 4 * wb))
+    rng = np.random.default_rng(8)
+    chunks = rng.integers(0, 256, (k, n), dtype=np.uint8)
+    words = jnp.asarray(chunks.view("<u4").view(np.int32))
+    par_w, crc_flat = bs.gf_encode_with_crc_pallas_w32_hier(
+        bitmat32, cmat_sub, combine, words, m, tile=tile, wb=wb,
+        interpret=True)
+    parity = np.asarray(par_w).view("<u4").view(np.uint8).reshape(m, n)
+    np.testing.assert_array_equal(parity, gf.gf_matvec(mat, chunks))
+    rows = bs._crc_rows(k + m)
+    crc_bits = np.asarray(crc_flat).reshape(-1, rows, 32)[:, :k + m]
+    tile_ls = cl.bits_to_u32(crc_bits).T           # (k+m, ntiles)
+    allsh = np.concatenate([chunks, parity], axis=0)
+    for s in range(k + m):
+        got = cl.fold_tile_crcs(tile_ls[s], tile, 0xFFFFFFFF)
+        assert got == C.crc32c(allsh[s].tobytes(), 0xFFFFFFFF), f"shard {s}"
+
+
+def test_crc_combine_matrix_matches_fold():
+    """Level-2 combine matrix == the host fold over equal sub-blocks."""
+    import jax.numpy as jnp
+    s, bb = 4, 64                 # 4 sub-blocks of 64 bytes
+    rng = np.random.default_rng(9)
+    data = rng.integers(0, 256, s * bb, dtype=np.uint8)
+    cmat = cl.crc_tile_matrix(bb)
+    ls = []
+    for si in range(s):
+        block = data[si * bb:(si + 1) * bb]
+        bits = np.unpackbits(block[None, :], axis=0, bitorder="little")
+        lb = np.asarray(cl.tile_crc_bits(
+            jnp.asarray(bits.astype(np.int8)), jnp.asarray(cmat)))
+        ls.append(lb[0])          # (32,) 0/1
+    lsub = jnp.asarray(np.stack(ls).astype(np.int32))      # (s, 32)
+    combine = jnp.asarray(cl.crc_combine_matrix(s, bb))
+    out = cl.combine_subblock_crcs(lsub, combine, r=1, s=s)
+    got = int(cl.bits_to_u32(np.asarray(out))[0, 0])
+    assert got == C.crc32c(data.tobytes(), 0)
+
+
+def test_multi_extent_hier_dispatch_interpret():
+    """gf_encode_extents_with_crc's hier branch (runs >= FUSED_TILE_HIER
+    select the headline-tile hier kernel) driven end-to-end in interpret
+    mode — the production TPU drain path for big sequential writes."""
+    import jax.numpy as jnp
+    from ceph_tpu.ops import bitsliced as bs
+    from ceph_tpu.ec import gf
+
+    k, m = 4, 2
+    mat = gf.cauchy_rs_matrix(k, m)[k:]
+    bitmat = jnp.asarray(bs.interleave_bitmatrix(mat), dtype=jnp.int8)
+    bitmat32 = jnp.asarray(bs._w32_bitmat(mat), dtype=jnp.int8)
+    rng = np.random.default_rng(10)
+    widths = [bs.FUSED_TILE_HIER, bs.FUSED_TILE_HIER + 513]  # tail fold
+    runs = [rng.integers(0, 256, (k, w), dtype=np.uint8) for w in widths]
+    results = bs.gf_encode_extents_with_crc(
+        bitmat, bitmat32, runs, m, use_w32=True, force_xla=False,
+        interpret=True)
+    seeds = [0xFFFFFFFF] * (k + m)
+    for run, (par, tls, tail, tile) in zip(runs, results):
+        assert tile == bs.FUSED_TILE_HIER
+        np.testing.assert_array_equal(
+            np.asarray(par), gf.gf_matvec(mat, run))
+        allsh = np.concatenate([run, np.asarray(par)], axis=0)
+        for s in range(k + m):
+            got = cl.fold_tile_crcs(tls[s], tile, seeds[s],
+                                    tail[s].tobytes())
+            assert got == C.crc32c(allsh[s].tobytes(), seeds[s]), \
+                f"shard {s}"
+
+
 def test_multi_extent_fused_launch():
     """gf_encode_extents_with_crc: several runs of different (unaligned)
     lengths in one launch; per-run parity and seed-chained crcs must
