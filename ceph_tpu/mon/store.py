@@ -3,7 +3,7 @@
 Fills the MonitorDBStore role (reference src/mon/MonitorDBStore.h:37 —
 every Paxos transaction is applied through one KV store so a restarted
 monitor comes back with full state: maps, auth entities, config, pool
-and EC-profile definitions).  Backed by the same LogDB (WAL + snapshot)
+and EC-profile definitions).  Backed by the same LsmDB (LSM engine)
 the FileStore uses; with no data dir it degrades to a MemDB so purely
 in-memory test clusters keep their current shape.
 
@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import json
 
-from ..store.kv import LogDB, MemDB, WriteBatch
+from ..store.kv import MemDB, WriteBatch, open_kv
 
 K_COMMITTED = b"paxos:committed"
 K_PROMISED = b"paxos:promised"
@@ -30,7 +30,7 @@ K_UNCOMMITTED = b"paxos:uncommitted"
 
 class MonitorStore:
     def __init__(self, path: str | None = None):
-        self.db = LogDB(path) if path else MemDB()
+        self.db = open_kv(path)
 
     # -- committed value ----------------------------------------------------
 

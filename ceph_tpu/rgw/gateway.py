@@ -119,6 +119,21 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(403, _xml_error("SignatureDoesNotMatch",
                                             str(e)))
                 return
+        elif self.gw.creds is not None and \
+                sigv4.is_presigned(parsed.query):
+            # query-string SigV4 (presigned URL): authentication via
+            # X-Amz-* query params, UNSIGNED-PAYLOAD, expiry enforced
+            # (reference rgw_auth_s3.cc query-string path).  A BAD
+            # presigned request fails hard — it never downgrades to
+            # anonymous.
+            try:
+                auth = sigv4.verify_presigned(
+                    self.command, parsed.path, parsed.query,
+                    dict(self.headers), self.gw.creds)
+                self._identity = auth["access_key"]
+            except sigv4.SigError as e:
+                self._reply(403, _xml_error("AccessDenied", str(e)))
+                return
         parts = path.lstrip("/").split("/", 1)
         bucket = parts[0]
         key = parts[1] if len(parts) > 1 else None
@@ -176,9 +191,50 @@ class _Handler(BaseHTTPRequestHandler):
             raise RGWError(404, "NoSuchBucket", bucket)
         return meta.get("owner"), meta.get("acl", "private")
 
-    def _require_bucket_perm(self, bucket: str, perm: str) -> None:
-        owner, canned = self._bucket_acl(bucket)
-        if not self._acl_allows(owner, canned, perm):
+    def _bucket_meta_or_404(self, bucket: str) -> dict:
+        """ONE bucket-index round-trip per authz decision (store.py
+        _bucket_meta's own contract) — policy and ACL both read from
+        the returned meta."""
+        meta = self.gw.store._bucket_meta(bucket)
+        if meta is None:
+            raise RGWError(404, "NoSuchBucket", bucket)
+        return meta
+
+    def _policy_eval(self, bmeta: dict, bucket: str, action: str,
+                     key: str | None = None) -> str | None:
+        """Bucket-policy decision for this request's identity, or None
+        when the bucket has no policy (reference rgw_iam_policy.cc
+        eval_principal/eval_statements)."""
+        pol = bmeta.get("policy")
+        if not pol:
+            return None
+        from .policy import bucket_arn, evaluate, object_arn
+        arn = object_arn(bucket, key) if key is not None \
+            else bucket_arn(bucket)
+        return evaluate(pol, self._identity, action, arn)
+
+    # default policy action per canned-ACL permission bit
+    _PERM_ACTION = {"READ": "s3:GetObject", "WRITE": "s3:PutObject",
+                    "READ_ACP": "s3:GetObjectAcl",
+                    "WRITE_ACP": "s3:PutObjectAcl"}
+
+    def _require_bucket_perm(self, bucket: str, perm: str,
+                             action: str | None = None,
+                             key: str | None = None) -> None:
+        """AWS combination: explicit policy Deny always wins, policy
+        Allow grants without consulting the ACL, otherwise the canned
+        ACL decides."""
+        bmeta = self._bucket_meta_or_404(bucket)
+        decision = self._policy_eval(
+            bmeta, bucket, action or
+            ("s3:ListBucket" if perm == "READ" else "s3:PutObject"),
+            key)
+        if decision == "Deny":
+            raise RGWError(403, "AccessDenied", bucket)
+        if decision == "Allow":
+            return
+        if not self._acl_allows(bmeta.get("owner"),
+                                bmeta.get("acl", "private"), perm):
             raise RGWError(403, "AccessDenied", bucket)
 
     def _require_bucket_owner(self, bucket: str) -> None:
@@ -189,13 +245,22 @@ class _Handler(BaseHTTPRequestHandler):
             raise RGWError(403, "AccessDenied", bucket)
 
     def _require_object_perm(self, bucket: str, key: str,
-                             meta: dict, perm: str) -> None:
+                             meta: dict, perm: str,
+                             action: str | None = None) -> None:
         """Object ACL governs the object (S3: a public-read BUCKET
         does not expose its objects; each object carries its own
-        canned ACL, default private to its owner)."""
+        canned ACL, default private to its owner).  Bucket policy is
+        consulted first, the AWS way (Deny final, Allow grants)."""
+        bmeta = self._bucket_meta_or_404(bucket)
+        decision = self._policy_eval(
+            bmeta, bucket, action or self._PERM_ACTION[perm], key)
+        if decision == "Deny":
+            raise RGWError(403, "AccessDenied", f"{bucket}/{key}")
+        if decision == "Allow":
+            return
         owner = meta.get("owner")
         if owner is None:                     # legacy/ownerless object
-            owner = self._bucket_acl(bucket)[0]
+            owner = bmeta.get("owner")
         if not self._acl_allows(owner, meta.get("acl", "private"),
                                 perm):
             raise RGWError(403, "AccessDenied", f"{bucket}/{key}")
@@ -250,7 +315,28 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _bucket_op(self, bucket: str, query: dict, body: bytes) -> None:
         st = self.gw.store
-        if self.command == "PUT" and "lifecycle" in query:
+        if self.command == "PUT" and "policy" in query:
+            self._require_bucket_owner(bucket)
+            from .policy import PolicyError, validate_policy
+            try:
+                doc = validate_policy(body)
+            except PolicyError as e:
+                raise RGWError(400, "MalformedPolicy", str(e)) from e
+            st.set_bucket_policy(bucket, doc)
+            self._reply(204)
+        elif self.command == "GET" and "policy" in query:
+            self._require_bucket_owner(bucket)
+            pol = st.get_bucket_policy(bucket)
+            if pol is None:
+                raise RGWError(404, "NoSuchBucketPolicy", bucket)
+            import json as _json
+            self._reply(200, _json.dumps(pol).encode(),
+                        "application/json")
+        elif self.command == "DELETE" and "policy" in query:
+            self._require_bucket_owner(bucket)
+            st.set_bucket_policy(bucket, None)
+            self._reply(204)
+        elif self.command == "PUT" and "lifecycle" in query:
             self._require_bucket_owner(bucket)
             st.set_lifecycle(bucket, _parse_lifecycle_body(body))
             self._reply(200)
@@ -427,7 +513,8 @@ class _Handler(BaseHTTPRequestHandler):
                 meta.get("owner") or self._bucket_acl(bucket)[0],
                 meta.get("acl", "private")))
         elif self.command == "PUT" and "partNumber" in query:
-            self._require_bucket_perm(bucket, "WRITE")
+            self._require_bucket_perm(bucket, "WRITE",
+                                      action="s3:PutObject", key=key)
             try:
                 part_num = int(query["partNumber"])
             except ValueError:
@@ -438,7 +525,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200, extra={"ETag": f'"{etag}"'})
         elif self.command == "PUT" and \
                 self.headers.get("x-amz-copy-source"):
-            self._require_bucket_perm(bucket, "WRITE")
+            self._require_bucket_perm(bucket, "WRITE",
+                                      action="s3:PutObject", key=key)
             src = urllib.parse.unquote(
                 self.headers["x-amz-copy-source"]).lstrip("/")
             src_bucket, _, src_key = src.partition("/")
@@ -461,11 +549,13 @@ class _Handler(BaseHTTPRequestHandler):
                 f"<LastModified>{lm}</LastModified>"
                 "</CopyObjectResult>").encode())
         elif self.command == "PUT":
-            self._require_bucket_perm(bucket, "WRITE")
+            self._require_bucket_perm(bucket, "WRITE",
+                                      action="s3:PutObject", key=key)
             etag = st.put_object(bucket, key, body, extra=_stamp())
             self._reply(200, extra={"ETag": f'"{etag}"'})
         elif self.command == "POST" and "uploads" in query:
-            self._require_bucket_perm(bucket, "WRITE")
+            self._require_bucket_perm(bucket, "WRITE",
+                                      action="s3:PutObject", key=key)
             upload_id = st.init_multipart(bucket, key)
             self._reply(200, (
                 '<?xml version="1.0" encoding="UTF-8"?>'
@@ -475,7 +565,8 @@ class _Handler(BaseHTTPRequestHandler):
                 f"<UploadId>{upload_id}</UploadId>"
                 "</InitiateMultipartUploadResult>").encode())
         elif self.command == "POST" and "uploadId" in query:
-            self._require_bucket_perm(bucket, "WRITE")
+            self._require_bucket_perm(bucket, "WRITE",
+                                      action="s3:PutObject", key=key)
             parts = _parse_complete_body(body)
             etag = st.complete_multipart(bucket, key, query["uploadId"],
                                          parts, extra=_stamp())
@@ -487,7 +578,9 @@ class _Handler(BaseHTTPRequestHandler):
                 f"<ETag>&quot;{etag}&quot;</ETag>"
                 "</CompleteMultipartUploadResult>").encode())
         elif self.command == "GET" and "uploadId" in query:
-            self._require_bucket_perm(bucket, "WRITE")
+            self._require_bucket_perm(
+                bucket, "WRITE",
+                action="s3:ListMultipartUploadParts", key=key)
             rows = "".join(
                 "<Part>"
                 f"<PartNumber>{num}</PartNumber>"
@@ -528,7 +621,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200, content_length=str(meta["size"]),
                         extra={"ETag": f'"{meta["etag"]}"'})
         elif self.command == "DELETE" and "uploadId" in query:
-            self._require_bucket_perm(bucket, "WRITE")
+            self._require_bucket_perm(
+                bucket, "WRITE", action="s3:AbortMultipartUpload",
+                key=key)
             st.abort_multipart(bucket, key, query["uploadId"])
             self._reply(204)
         elif self.command == "DELETE" and "versionId" in query:
@@ -536,7 +631,8 @@ class _Handler(BaseHTTPRequestHandler):
             st.delete_object_version(bucket, key, query["versionId"])
             self._reply(204)
         elif self.command == "DELETE":
-            self._require_bucket_perm(bucket, "WRITE")
+            self._require_bucket_perm(bucket, "WRITE",
+                                      action="s3:DeleteObject", key=key)
             st.delete_object(bucket, key)
             self._reply(204)
         else:

@@ -100,6 +100,99 @@ class SigError(Exception):
     pass
 
 
+# -- query-string (presigned URL) auth ---------------------------------------
+#
+# Reference: rgw_auth_s3.cc query-string SigV4 (X-Amz-Signature & co in
+# the query instead of an Authorization header; payload is always
+# UNSIGNED-PAYLOAD; expiry carried in X-Amz-Expires relative to
+# X-Amz-Date, capped at 7 days like AWS).
+
+MAX_PRESIGN_EXPIRES = 7 * 24 * 3600
+
+
+def presign_url(method: str, path: str, access_key: str, secret: str,
+                expires: int, host: str = "", query: str = "",
+                now: datetime.datetime | None = None) -> str:
+    """Client side: returns the full query string (existing `query`
+    params + the X-Amz-* auth params) for a presigned request."""
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    amzdate = now.strftime("%Y%m%dT%H%M%SZ")
+    datestamp = now.strftime("%Y%m%d")
+    scope = f"{datestamp}/{REGION}/{SERVICE}/aws4_request"
+    params = urllib.parse.parse_qsl(query, keep_blank_values=True)
+    params += [
+        ("X-Amz-Algorithm", ALGO),
+        ("X-Amz-Credential", f"{access_key}/{scope}"),
+        ("X-Amz-Date", amzdate),
+        ("X-Amz-Expires", str(expires)),
+        ("X-Amz-SignedHeaders", "host"),
+    ]
+    qs = urllib.parse.urlencode(params)
+    creq = canonical_request(method, path, qs, {"host": host},
+                             ["host"], "UNSIGNED-PAYLOAD")
+    sts = string_to_sign(amzdate, datestamp, creq)
+    sig = hmac.new(signing_key(secret, datestamp), sts.encode(),
+                   hashlib.sha256).hexdigest()
+    return qs + "&X-Amz-Signature=" + sig
+
+
+def is_presigned(query: str) -> bool:
+    q = dict(urllib.parse.parse_qsl(query, keep_blank_values=True))
+    return "X-Amz-Signature" in q and \
+        q.get("X-Amz-Algorithm", ALGO) == ALGO
+
+
+def verify_presigned(method: str, path: str, query: str, headers: dict,
+                     creds: dict[str, str],
+                     now: datetime.datetime | None = None) -> dict:
+    """Server side: validates query-string SigV4; returns
+    {"access_key": ...}.  Raises SigError on bad signature, unknown
+    key, malformed params, or an expired/overlong window."""
+    params = urllib.parse.parse_qsl(query, keep_blank_values=True)
+    q = dict(params)
+    if q.get("X-Amz-Algorithm") != ALGO:
+        raise SigError("X-Amz-Algorithm must be " + ALGO)
+    try:
+        access_key, datestamp, region, service, _ = \
+            q["X-Amz-Credential"].split("/")
+        amzdate = q["X-Amz-Date"]
+        expires = int(q["X-Amz-Expires"])
+        signed = q["X-Amz-SignedHeaders"].split(";")
+        got_sig = q["X-Amz-Signature"]
+    except (KeyError, ValueError) as e:
+        raise SigError(f"malformed presigned query: {e}") from e
+    secret = creds.get(access_key)
+    if secret is None:
+        raise SigError(f"unknown access key {access_key!r}")
+    if not 0 < expires <= MAX_PRESIGN_EXPIRES:
+        raise SigError("X-Amz-Expires out of range")
+    try:
+        ts = datetime.datetime.strptime(
+            amzdate, "%Y%m%dT%H%M%SZ").replace(
+            tzinfo=datetime.timezone.utc)
+    except ValueError as e:
+        raise SigError(f"bad X-Amz-Date: {e}") from e
+    if not amzdate.startswith(datestamp):
+        raise SigError("X-Amz-Date does not match credential scope")
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    if now < ts - datetime.timedelta(seconds=900):
+        raise SigError("presigned URL not yet valid")
+    if now > ts + datetime.timedelta(seconds=expires):
+        raise SigError("presigned URL expired")
+    # canonical query = every param except the signature itself
+    qs = urllib.parse.urlencode(
+        [(k, v) for k, v in params if k != "X-Amz-Signature"])
+    hdrs = {k.lower(): v for k, v in headers.items()}
+    creq = canonical_request(method, path, qs, hdrs, signed,
+                             "UNSIGNED-PAYLOAD")
+    sts = string_to_sign(amzdate, datestamp, creq)
+    want = hmac.new(signing_key(secret, datestamp), sts.encode(),
+                    hashlib.sha256).hexdigest()
+    if not hmac.compare_digest(got_sig, want):
+        raise SigError("presigned signature mismatch")
+    return {"access_key": access_key, "streaming": False}
+
+
 # -- aws-chunked streaming payloads ------------------------------------------
 
 def _chunk_sts(amzdate: str, datestamp: str, prev_sig: str,

@@ -120,6 +120,27 @@ class RGWStore:
             self._cls(self.meta, BUCKETS_OBJ, "dir_add", {
                 "key": bucket, "meta": meta})
 
+    def set_bucket_policy(self, bucket: str, policy: dict | None) -> None:
+        """Attach (or with None, detach) a validated policy document to
+        the bucket meta (reference: RGW_ATTR_IAM_POLICY xattr on the
+        bucket instance, src/rgw/rgw_iam_policy.cc consumers)."""
+        with self._bmeta_lock:
+            meta = self._bucket_meta(bucket)
+            if meta is None:
+                raise RGWError(404, "NoSuchBucket", bucket)
+            if policy is None:
+                meta.pop("policy", None)
+            else:
+                meta["policy"] = policy
+            self._cls(self.meta, BUCKETS_OBJ, "dir_add", {
+                "key": bucket, "meta": meta})
+
+    def get_bucket_policy(self, bucket: str) -> dict | None:
+        meta = self._bucket_meta(bucket)
+        if meta is None:
+            raise RGWError(404, "NoSuchBucket", bucket)
+        return meta.get("policy")
+
     def set_object_acl(self, bucket: str, key: str, acl: str) -> None:
         cur = self._current_meta(bucket, key)
         if cur is None:

@@ -43,7 +43,7 @@ from ..osd.types import ghobject_t, hobject_t, spg_t
 from . import object_store as os_
 from .allocator import Allocator
 from .file_store import _esc
-from .kv import LogDB, WriteBatch
+from .kv import KeyValueDB, WriteBatch, open_kv
 from .object_store import ObjectStore, Transaction
 
 MIN_ALLOC = 4096
@@ -60,7 +60,7 @@ def _csums(data: bytes) -> list[int]:
 class BlueStore(ObjectStore):
     def __init__(self, path: str, compression: str | None = None):
         self.root = Path(path)
-        self.kv: LogDB | None = None
+        self.kv: KeyValueDB | None = None
         self._lock = threading.RLock()
         self._block_f = None
         self._mounted = False
@@ -98,7 +98,7 @@ class BlueStore(ObjectStore):
 
     def mount(self) -> None:
         self.root.mkdir(parents=True, exist_ok=True)
-        self.kv = LogDB(str(self.root / "kv"))
+        self.kv = open_kv(str(self.root / "kv"))
         block = self.root / "block"
         if not block.exists():
             block.write_bytes(b"")

@@ -10,7 +10,7 @@ BlueStore deferred/WAL semantics, BlueStore.h:1504 STATE_DEFERRED_*).
 
 Layout under the store root:
   journal.log              WAL of pending transaction batches
-  kv/                      LogDB: xattrs, omap, object index
+  kv/                      LsmDB: xattrs, omap, object index
   objects/<coll>/<name>    object data files
 
 Object data rides files; everything else rides the KV — the same split
@@ -30,7 +30,7 @@ import numpy as np
 from ..common import crc32c as _crc
 from ..osd.types import ghobject_t, hobject_t, spg_t
 from . import object_store as os_
-from .kv import LogDB, WriteBatch
+from .kv import KeyValueDB, WriteBatch, open_kv
 from .object_store import ObjectStore, Transaction
 
 
@@ -43,7 +43,7 @@ class FileStore(ObjectStore):
     def __init__(self, path: str):
         self.root = Path(path)
         self.journal_path = self.root / "journal.log"
-        self.kv: LogDB | None = None
+        self.kv: KeyValueDB | None = None
         self._lock = threading.RLock()
         self._journal_f = None
         self._mounted = False
@@ -73,7 +73,7 @@ class FileStore(ObjectStore):
 
     def mount(self) -> None:
         self.root.mkdir(parents=True, exist_ok=True)
-        self.kv = LogDB(str(self.root / "kv"))
+        self.kv = open_kv(str(self.root / "kv"))
         self._replay_journal()
         self._journal_f = open(self.journal_path, "ab")
         self._mounted = True

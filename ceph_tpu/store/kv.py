@@ -5,10 +5,12 @@ prefixed key-value store with atomic write batches, backing store
 metadata (and, in the reference, the entire mon store).  Implementations:
 
   MemDB — dict-backed (reference MemDB role; tests)
-  LogDB — durable log-structured store: an append-only WAL of batches
-          (crc-protected, fsync'd) over a periodically-rewritten
-          snapshot — the same recovery shape as RocksDB's WAL+SST
-          without the LSM machinery this build doesn't need.
+  LogDB — durable WAL + whole-file snapshot (kept for small stores and
+          as the round-4 comparison point; O(total-keys) compaction)
+  LsmDB — the real engine (kv_lsm.py): memtable + WAL + block-based
+          SSTables + leveled compaction, the RocksDBStore role.  All
+          durable subsystems (BlueStore-role metadata, FileStore omap,
+          the mon store) ride this one.
 """
 
 from __future__ import annotations
@@ -106,6 +108,7 @@ class LogDB(KeyValueDB):
             self._d = {bytes.fromhex(k): bytes.fromhex(v)
                        for k, v in raw.items()}
         if self.wal.exists():
+            good = 0
             with open(self.wal, "rb") as f:
                 while True:
                     head = f.read(8)
@@ -116,12 +119,20 @@ class LogDB(KeyValueDB):
                     if len(body) < ln or \
                             _crc.crc32c(body, 0xFFFFFFFF) != crc:
                         break  # torn tail: stop replay (reference WAL)
+                    good = f.tell()
                     for op in json.loads(body.decode()):
                         if op[0] == "set":
                             self._d[bytes.fromhex(op[1])] = \
                                 bytes.fromhex(op[2])
                         else:
                             self._d.pop(bytes.fromhex(op[1]), None)
+            if good < self.wal.stat().st_size:
+                # truncate the torn bytes so post-restart appends are
+                # not stranded behind a permanently unreadable record
+                with open(self.wal, "r+b") as f:
+                    f.truncate(good)
+                    f.flush()
+                    os.fsync(f.fileno())
 
     # -- API ----------------------------------------------------------------
 
@@ -175,3 +186,30 @@ class LogDB(KeyValueDB):
     def close(self) -> None:
         with self._lock:
             self._wal_f.close()
+
+
+def open_kv(path: str | None, **kw) -> KeyValueDB:
+    """Factory: the durable default is the LSM engine; no path = MemDB.
+    (Reference analog: KeyValueDB::create picking RocksDBStore,
+    src/kv/KeyValueDB.cc.)  A data dir written by the old LogDB format
+    (snapshot.json / wal.log) is migrated in place on first open."""
+    if not path:
+        return MemDB()
+    from .kv_lsm import LsmDB
+    p = Path(path)
+    old_snap, old_wal = p / "snapshot.json", p / "wal.log"
+    if old_snap.exists() or old_wal.exists():
+        old = LogDB(path)
+        items = list(old.iterate())
+        old.close()
+        db = LsmDB(path, **kw)
+        batch = WriteBatch()
+        for k, v in items:
+            batch.set(k, v)
+        if batch.ops:
+            db.submit(batch)
+        db.compact()                 # settle into SSTs before the old
+        old_snap.unlink(missing_ok=True)   # artifacts disappear
+        old_wal.unlink(missing_ok=True)
+        return db
+    return LsmDB(path, **kw)

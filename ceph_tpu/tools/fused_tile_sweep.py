@@ -58,7 +58,8 @@ def slope_rate(step, x0, iters_lo=20, iters_hi=60):
 
 
 def main():
-    tiles = [int(t) for t in sys.argv[1:]] or [2048, 4096, 8192, 16384]
+    tiles = [int(t) for t in sys.argv[1:]
+             if not t.startswith("-")] or [2048, 4096, 8192, 16384]
     reg = ErasureCodePluginRegistry.instance()
     codec = reg.factory("jax", {"k": str(K), "m": str(M),
                                 "technique": "cauchy"})

@@ -1,0 +1,303 @@
+"""Bucket policies (reference rgw_iam_policy.cc subset) + presigned
+URLs (query-string SigV4, rgw_auth_s3.cc): allow/deny matrix across
+accounts and anonymous, policy/ACL combination, presigned round-trip
+and expiry rejection."""
+
+import datetime
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ceph_tpu.rgw import S3Gateway
+from ceph_tpu.rgw import sigv4
+from ceph_tpu.rgw.policy import (PolicyError, evaluate, object_arn,
+                                 validate_policy)
+from ceph_tpu.tools.vstart import Cluster
+
+OWNER, OWNER_SECRET = "owner", "ownersecret"
+OTHER, OTHER_SECRET = "other", "othersecret"
+
+
+class S3Client:
+    def __init__(self, addr, access, secret):
+        self.base = f"http://{addr[0]}:{addr[1]}"
+        self.host = f"{addr[0]}:{addr[1]}"
+        self.access, self.secret = access, secret
+
+    def request(self, method, path, query="", body=b"", headers=None):
+        headers = {"host": self.host, **(headers or {})}
+        headers.update(sigv4.sign_request(
+            method, path, query, headers, body, self.access,
+            self.secret))
+        url = self.base + path + (f"?{query}" if query else "")
+        req = urllib.request.Request(url, data=body if body else None,
+                                     method=method, headers=headers)
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+
+
+def anon(base, method, path, body=b"", query=""):
+    url = base + path + (f"?{query}" if query else "")
+    req = urllib.request.Request(url, data=body if body else None,
+                                 method=method)
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, dict(resp.headers), resp.read()
+
+
+@pytest.fixture(scope="module")
+def env():
+    with Cluster(n_osds=3) as c:
+        gw = S3Gateway(c.client(), creds={OWNER: OWNER_SECRET,
+                                          OTHER: OTHER_SECRET})
+        yield {
+            "gw": gw,
+            "owner": S3Client(gw.addr, OWNER, OWNER_SECRET),
+            "other": S3Client(gw.addr, OTHER, OTHER_SECRET),
+            "base": f"http://{gw.addr[0]}:{gw.addr[1]}",
+            "host": f"{gw.addr[0]}:{gw.addr[1]}",
+        }
+        gw.shutdown()
+
+
+def _code(ei):
+    return ei.value.code
+
+
+def _policy(*statements):
+    return json.dumps({"Version": "2012-10-17",
+                       "Statement": list(statements)}).encode()
+
+
+# -- document validation ------------------------------------------------------
+
+def test_validate_rejects_malformed():
+    for bad in (b"not json", b"[]", b"{}",
+                _policy()[:-2] + b"}",          # empty Statement
+                json.dumps({"Version": "2008-10-17", "Statement": [
+                    {"Effect": "Allow", "Principal": "*",
+                     "Action": "s3:GetObject",
+                     "Resource": "arn:aws:s3:::b/*"}]}).encode(),
+                _policy({"Effect": "Maybe", "Principal": "*",
+                         "Action": "s3:GetObject",
+                         "Resource": "arn:aws:s3:::b/*"}),
+                _policy({"Effect": "Allow", "Principal": "*",
+                         "Action": "iam:Nope",
+                         "Resource": "arn:aws:s3:::b/*"}),
+                _policy({"Effect": "Allow", "Principal": "*",
+                         "Action": "s3:GetObject",
+                         "Resource": "not-an-arn"})):
+        with pytest.raises(PolicyError):
+            validate_policy(bad)
+
+
+def test_evaluate_matrix():
+    pol = validate_policy(_policy(
+        {"Effect": "Allow", "Principal": "*", "Action": "s3:GetObject",
+         "Resource": "arn:aws:s3:::b/pub/*"},
+        {"Effect": "Allow", "Principal": {"AWS": ["other"]},
+         "Action": ["s3:PutObject", "s3:DeleteObject"],
+         "Resource": "arn:aws:s3:::b/drop/*"},
+        {"Effect": "Deny", "Principal": "*", "Action": "s3:*",
+         "Resource": "arn:aws:s3:::b/secret/*"}))
+    # anonymous read of pub/*
+    assert evaluate(pol, None, "s3:GetObject",
+                    object_arn("b", "pub/x")) == "Allow"
+    assert evaluate(pol, None, "s3:PutObject",
+                    object_arn("b", "pub/x")) is None
+    # principal-scoped write
+    assert evaluate(pol, "other", "s3:PutObject",
+                    object_arn("b", "drop/y")) == "Allow"
+    assert evaluate(pol, "someone", "s3:PutObject",
+                    object_arn("b", "drop/y")) is None
+    # explicit deny beats any allow
+    assert evaluate(pol, "other", "s3:GetObject",
+                    object_arn("b", "secret/z")) == "Deny"
+    # wildcard action
+    pol2 = validate_policy(_policy(
+        {"Effect": "Allow", "Principal": "*", "Action": "s3:Get*",
+         "Resource": "arn:aws:s3:::b/*"}))
+    assert evaluate(pol2, None, "s3:GetObject",
+                    object_arn("b", "k")) == "Allow"
+    assert evaluate(pol2, None, "s3:PutObject",
+                    object_arn("b", "k")) is None
+
+
+# -- end-to-end through the gateway -------------------------------------------
+
+def test_policy_crud_and_owner_only(env):
+    owner, other = env["owner"], env["other"]
+    owner.request("PUT", "/polbkt")
+    doc = _policy({"Effect": "Allow", "Principal": "*",
+                   "Action": "s3:GetObject",
+                   "Resource": "arn:aws:s3:::polbkt/*"})
+    st, _, _ = owner.request("PUT", "/polbkt", query="policy", body=doc)
+    assert st == 204
+    st, _, got = owner.request("GET", "/polbkt", query="policy")
+    assert st == 200 and json.loads(got)["Version"] == "2012-10-17"
+    # non-owner cannot read or write the policy
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        other.request("GET", "/polbkt", query="policy")
+    assert _code(ei) == 403
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        other.request("PUT", "/polbkt", query="policy", body=doc)
+    assert _code(ei) == 403
+    # malformed policy rejected
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        owner.request("PUT", "/polbkt", query="policy", body=b"nope")
+    assert _code(ei) == 400
+    # delete, then GET is 404
+    st, _, _ = owner.request("DELETE", "/polbkt", query="policy")
+    assert st == 204
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        owner.request("GET", "/polbkt", query="policy")
+    assert _code(ei) == 404
+
+
+def test_policy_allows_over_private_acl(env):
+    """Policy Allow grants access an ACL alone would deny."""
+    owner, other, base = env["owner"], env["other"], env["base"]
+    owner.request("PUT", "/shared")
+    owner.request("PUT", "/shared/pub/hello.txt", body=b"open")
+    owner.request("PUT", "/shared/priv.txt", body=b"closed")
+    owner.request("PUT", "/shared", query="policy", body=_policy(
+        {"Effect": "Allow", "Principal": "*",
+         "Action": "s3:GetObject",
+         "Resource": "arn:aws:s3:::shared/pub/*"},
+        {"Effect": "Allow", "Principal": {"AWS": OTHER},
+         "Action": "s3:PutObject",
+         "Resource": "arn:aws:s3:::shared/drop/*"}))
+    # anonymous + other can read pub/* despite private object ACL
+    st, _, got = anon(base, "GET", "/shared/pub/hello.txt")
+    assert st == 200 and got == b"open"
+    st, _, _ = other.request("GET", "/shared/pub/hello.txt")
+    assert st == 200
+    # but not outside the granted prefix
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        anon(base, "GET", "/shared/priv.txt")
+    assert _code(ei) == 403
+    # other can write into drop/* only
+    st, _, _ = other.request("PUT", "/shared/drop/in.txt", body=b"x")
+    assert st == 200
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        other.request("PUT", "/shared/elsewhere.txt", body=b"x")
+    assert _code(ei) == 403
+    # anonymous writes stay denied (policy is principal-scoped)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        anon(base, "PUT", "/shared/drop/anon.txt", body=b"x")
+    assert _code(ei) == 403
+
+
+def test_policy_deny_overrides_acl_and_owner_objects(env):
+    """Explicit Deny beats a public-read object ACL — and even the
+    second account's own granted allows."""
+    owner, other, base = env["owner"], env["other"], env["base"]
+    owner.request("PUT", "/fortress")
+    owner.request("PUT", "/fortress/open.txt", body=b"fine",
+                  headers={"x-amz-acl": "public-read"})
+    owner.request("PUT", "/fortress/vault/gold.txt", body=b"bars",
+                  headers={"x-amz-acl": "public-read"})
+    owner.request("PUT", "/fortress", query="policy", body=_policy(
+        {"Effect": "Deny", "Principal": {"AWS": [OTHER]},
+         "Action": "s3:GetObject",
+         "Resource": "arn:aws:s3:::fortress/vault/*"}))
+    # public-read ACL still works outside the denied prefix
+    st, _, _ = other.request("GET", "/fortress/open.txt")
+    assert st == 200
+    st, _, _ = anon(base, "GET", "/fortress/open.txt")
+    assert st == 200
+    # deny overrides the public-read ACL for the named principal
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        other.request("GET", "/fortress/vault/gold.txt")
+    assert _code(ei) == 403
+    # anonymous is not the denied principal: ACL still grants
+    st, _, _ = anon(base, "GET", "/fortress/vault/gold.txt")
+    assert st == 200
+
+
+def test_policy_delete_object_action(env):
+    owner, other = env["owner"], env["other"]
+    owner.request("PUT", "/deltest")
+    owner.request("PUT", "/deltest/a.txt", body=b"1")
+    owner.request("PUT", "/deltest/b.txt", body=b"2")
+    owner.request("PUT", "/deltest", query="policy", body=_policy(
+        {"Effect": "Allow", "Principal": {"AWS": OTHER},
+         "Action": "s3:DeleteObject",
+         "Resource": "arn:aws:s3:::deltest/a.txt"}))
+    st, _, _ = other.request("DELETE", "/deltest/a.txt")
+    assert st == 204
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        other.request("DELETE", "/deltest/b.txt")
+    assert _code(ei) == 403
+
+
+# -- presigned URLs -----------------------------------------------------------
+
+def test_presigned_roundtrip(env):
+    owner, base, host = env["owner"], env["base"], env["host"]
+    owner.request("PUT", "/presign")
+    owner.request("PUT", "/presign/doc.txt", body=b"sealed")
+    # GET via presigned URL, no Authorization header
+    qs = sigv4.presign_url("GET", "/presign/doc.txt", OWNER,
+                           OWNER_SECRET, expires=300, host=host)
+    st, _, got = anon(base, "GET", "/presign/doc.txt", query=qs)
+    assert st == 200 and got == b"sealed"
+    # PUT via presigned URL
+    qs = sigv4.presign_url("PUT", "/presign/up.txt", OWNER,
+                           OWNER_SECRET, expires=300, host=host)
+    st, _, _ = anon(base, "PUT", "/presign/up.txt", body=b"new",
+                    query=qs)
+    assert st == 200
+    st, _, got = owner.request("GET", "/presign/up.txt")
+    assert got == b"new"
+
+
+def test_presigned_expiry_and_tamper(env):
+    owner, base, host = env["owner"], env["base"], env["host"]
+    owner.request("PUT", "/presign2")
+    owner.request("PUT", "/presign2/x.txt", body=b"v")
+    old = datetime.datetime.now(
+        datetime.timezone.utc) - datetime.timedelta(seconds=600)
+    qs = sigv4.presign_url("GET", "/presign2/x.txt", OWNER,
+                           OWNER_SECRET, expires=60, host=host, now=old)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        anon(base, "GET", "/presign2/x.txt", query=qs)
+    assert _code(ei) == 403             # expired
+    # tampered path: signature over a different key must not transfer
+    qs = sigv4.presign_url("GET", "/presign2/x.txt", OWNER,
+                           OWNER_SECRET, expires=300, host=host)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        anon(base, "GET", "/presign2/other.txt", query=qs)
+    assert _code(ei) == 403
+    # tampered expiry: stretching the window breaks the signature
+    qs2 = qs.replace("X-Amz-Expires=300", "X-Amz-Expires=86400")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        anon(base, "GET", "/presign2/x.txt", query=qs2)
+    assert _code(ei) == 403
+    # overlong window rejected outright
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        anon(base, "GET", "/presign2/x.txt",
+             query=sigv4.presign_url(
+                 "GET", "/presign2/x.txt", OWNER, OWNER_SECRET,
+                 expires=8 * 24 * 3600, host=host))
+    assert _code(ei) == 403
+
+
+def test_presigned_respects_policy_deny(env):
+    """A presigned URL authenticates as its signer — policy denies
+    still apply to that principal."""
+    owner, other, base, host = (env["owner"], env["other"],
+                                env["base"], env["host"])
+    owner.request("PUT", "/presign3")
+    owner.request("PUT", "/presign3/k.txt", body=b"v",
+                  headers={"x-amz-acl": "public-read"})
+    owner.request("PUT", "/presign3", query="policy", body=_policy(
+        {"Effect": "Deny", "Principal": {"AWS": [OTHER]},
+         "Action": "s3:GetObject",
+         "Resource": "arn:aws:s3:::presign3/*"}))
+    qs = sigv4.presign_url("GET", "/presign3/k.txt", OTHER,
+                           OTHER_SECRET, expires=300, host=host)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        anon(base, "GET", "/presign3/k.txt", query=qs)
+    assert _code(ei) == 403
