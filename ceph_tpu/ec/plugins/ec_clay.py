@@ -8,13 +8,17 @@ in minimum_to_decode (reference ErasureCodeInterface.h:297,
 ErasureCodeClay.h:57 get_sub_chunk_count).
 
 Construction (Clay codes, FAST'18 — the same family the reference
-implements): nodes are points (x, y) on a q x t grid (q = d-k+1,
-t = (k+m)/q, chunk i -> x=i%q, y=i//q); every chunk splits into q^t
-sub-chunks indexed by planes z = (z_0..z_{t-1}), z_y in [0,q).  An
-uncoupled symbol U(x,y;z) per node per plane forms, within each plane,
-a codeword of a scalar (n,k) MDS code; the stored (coupled) symbols C
-relate to U by a pairwise invertible transform: vertex (x,y) in plane z
-with x != z_y pairs with vertex (z_y, y) in plane z(y->x), and
+implements): nodes are points (x, y) on a q x t grid (q = d-k+1).  For
+general d the grid is padded with nu = (-(k+m)) mod q VIRTUAL nodes —
+zero-filled data chunks that exist only inside the codec (reference
+ErasureCodeClay.cc:273 "shortened" codes); t = (k+m+nu)/q.  Real chunk
+i maps to node i for i < k and i + nu otherwise.  Every chunk splits
+into q^t sub-chunks indexed by planes z = (z_0..z_{t-1}), z_y in [0,q).
+An uncoupled symbol U(x,y;z) per node per plane forms, within each
+plane, a codeword of a scalar MDS code with m parities; the stored
+(coupled) symbols C relate to U by a pairwise invertible transform:
+vertex (x,y) in plane z with x != z_y pairs with vertex (z_y, y) in
+plane z(y->x), and
 
     [ C_A@z ; C_B@z' ] = [[1, g], [g, 1]] [ U_A@z ; U_B@z' ]   (g^2 != 1)
 
@@ -27,13 +31,14 @@ each plane's <= m unknown U's solve via the MDS parity-check system, and
 the erased C's re-couple.  Encode IS decode with the parity chunks as
 the erasures (exactly the reference's approach).
 
-Repair: losing one chunk (x0,y0) with d = n-1 helpers reads only the
-q^{t-1} "repair planes" {z : z_{y0} = x0} from each helper; per plane
-the q unknowns (failed U + the y0-column helpers' U) solve in one m x m
-system, and the coupling relation reproduces the failed chunk's
-sub-chunks on the remaining planes.  Scope: d = k+m-1 (the reference's
-recommended/default d, e.g. k=8 m=4 d=11); smaller d falls back to
-full-chunk reads.
+Repair: losing one chunk (x0,y0) with d helpers reads only the q^{t-1}
+"repair planes" {z : z_{y0} = x0} from each helper — the bandwidth-
+optimal d/(d-k+1) chunk-equivalents total.  The d < k+m-1 case adds
+"aloof" survivors excluded from the helper set (reference
+repair_one_lost_chunk's aloof_nodes): the per-plane erasure set is the
+lost node's whole column plus the aloof nodes — exactly m unknowns —
+and a helper paired with an erased/aloof vertex decouples through that
+partner's already-solved U (score induction) instead of its unread C.
 """
 
 from __future__ import annotations
@@ -59,8 +64,9 @@ class ErasureCodeClay(ErasureCode):
         self.d = 0
         self.q = 0
         self.t = 0
+        self.nu = 0                       # virtual (shortening) nodes
         self.sub_chunks = 0
-        self.H: np.ndarray | None = None  # (m, n) parity check of base MDS
+        self.H: np.ndarray | None = None  # (m, N) parity check of base MDS
 
     # -- setup --------------------------------------------------------------
 
@@ -69,18 +75,17 @@ class ErasureCodeClay(ErasureCode):
         self.m = profile.to_int("m", 2)
         self.d = profile.to_int("d", self.k + self.m - 1)
         n = self.k + self.m
-        if self.d != n - 1:
+        if not self.k < self.d <= n - 1:
             raise ErasureCodeError(
                 errno.EINVAL,
-                f"clay: only d=k+m-1 supported (got d={self.d}, k+m-1={n - 1})")
+                f"clay: need k < d <= k+m-1 (got d={self.d}, k={self.k}, "
+                f"m={self.m})")
         self.q = self.d - self.k + 1
-        if n % self.q:
-            raise ErasureCodeError(
-                errno.EINVAL, f"clay: q={self.q} must divide k+m={n}")
-        self.t = n // self.q
+        self.nu = (-n) % self.q
+        self.t = (n + self.nu) // self.q
         self.sub_chunks = self.q ** self.t
-        base = gf.cauchy_rs_matrix(self.k, self.m)
-        p = base[self.k:]                      # (m, k)
+        base = gf.cauchy_rs_matrix(self.k + self.nu, self.m)
+        p = base[self.k + self.nu:]            # (m, k+nu)
         self.H = np.concatenate([p, np.eye(self.m, dtype=np.uint8)], axis=1)
         det = 1 ^ gf.gf_mul(GAMMA, GAMMA)
         self._cinv = gf.gf_inv(det)
@@ -99,10 +104,26 @@ class ErasureCodeClay(ErasureCode):
         align = self.sub_chunks
         return -(-per // align) * align
 
-    # -- geometry -----------------------------------------------------------
+    # -- geometry (all in PADDED node ids: 0..N-1, N = q*t) -----------------
 
-    def _node(self, chunk: int) -> tuple[int, int]:
-        return chunk % self.q, chunk // self.q
+    @property
+    def N(self) -> int:
+        return self.q * self.t
+
+    def _pad_id(self, chunk: int) -> int:
+        """Real chunk id -> padded node id (virtual nodes sit between
+        data and parity, reference ErasureCodeClay.cc:312)."""
+        return chunk if chunk < self.k else chunk + self.nu
+
+    def _real_id(self, node: int) -> int | None:
+        if node < self.k:
+            return node
+        if node < self.k + self.nu:
+            return None                   # virtual
+        return node - self.nu
+
+    def _node(self, node_id: int) -> tuple[int, int]:
+        return node_id % self.q, node_id // self.q
 
     def _chunk(self, x: int, y: int) -> int:
         return y * self.q + x
@@ -131,13 +152,12 @@ class ErasureCodeClay(ErasureCode):
     def _solve_plane(self, u_known: dict, unknown_nodes: list,
                      shape) -> dict:
         """Solve H u = 0 for the unknown nodes of one plane."""
-        n = self.k + self.m
         cols = [self._chunk(x, y) for (x, y) in unknown_nodes]
         a = self.H[:, cols]                          # (m, u)
         rhs = np.zeros((self.m, *shape), dtype=np.uint8)
         lut = gf.mul_table()
         for r in range(self.m):
-            for j in range(n):
+            for j in range(self.N):
                 if j in cols:
                     continue
                 h = int(self.H[r, j])
@@ -152,9 +172,8 @@ class ErasureCodeClay(ErasureCode):
         return {cols[i]: sol[i] for i in range(len(cols))}
 
     def decode_layered(self, C: np.ndarray, erased: list[int]) -> np.ndarray:
-        """C: (n, sub_chunks, S); rows in `erased` are garbage on input,
-        reconstructed on output."""
-        n = self.k + self.m
+        """C: (N, sub_chunks, S) in padded node order; rows in `erased`
+        (padded ids) are garbage on input, reconstructed on output."""
         S = C.shape[2]
         erased_nodes = {self._node(e) for e in erased}
         if len(erased) > self.m:
@@ -173,7 +192,7 @@ class ErasureCodeClay(ErasureCode):
         for z in planes:
             zi = self._z_index(z)
             u_known: dict[int, np.ndarray] = {}
-            for ch in range(n):
+            for ch in range(self.N):
                 x, y = self._node(ch)
                 if ch in erased_set:
                     continue
@@ -218,40 +237,83 @@ class ErasureCodeClay(ErasureCode):
         assert cs % self.sub_chunks == 0, (cs, self.sub_chunks)
         return chunks.reshape(n_rows, self.sub_chunks, cs // self.sub_chunks)
 
+    def _pad_rows(self, rows: np.ndarray) -> np.ndarray:
+        """(k+m, sub, S) real rows -> (N, sub, S) with zero virtual
+        rows spliced between data and parity."""
+        if not self.nu:
+            return rows
+        z = np.zeros((self.nu, *rows.shape[1:]), dtype=rows.dtype)
+        return np.concatenate([rows[:self.k], z, rows[self.k:]], axis=0)
+
+    def _strip_rows(self, rows: np.ndarray) -> np.ndarray:
+        if not self.nu:
+            return rows
+        return np.concatenate(
+            [rows[:self.k], rows[self.k + self.nu:]], axis=0)
+
     def encode_chunks(self, chunks: np.ndarray) -> np.ndarray:
-        n = self.k + self.m
         cs = chunks.shape[1]
-        C = np.zeros((n, self.sub_chunks, cs // self.sub_chunks),
+        C = np.zeros((self.N, self.sub_chunks, cs // self.sub_chunks),
                      dtype=np.uint8)
         C[: self.k] = self._to_planes(chunks)
-        C = self.decode_layered(C, list(range(self.k, n)))
-        return C[self.k:].reshape(self.m, cs)
+        C = self.decode_layered(
+            C, list(range(self.k + self.nu, self.N)))
+        return C[self.k + self.nu:].reshape(self.m, cs)
 
     def decode_chunks(self, dense: np.ndarray, erasures) -> np.ndarray:
         cs = dense.shape[1]
-        C = self._to_planes(dense).copy()
-        C = self.decode_layered(C, sorted(set(erasures)))
-        return C.reshape(dense.shape[0], cs)
+        C = self._pad_rows(self._to_planes(dense).copy())
+        C = self.decode_layered(
+            C, sorted({self._pad_id(e) for e in erasures}))
+        return self._strip_rows(C).reshape(dense.shape[0], cs)
 
     # -- repair-optimal reads ----------------------------------------------
 
     def repair_planes(self, lost_chunk: int) -> list[int]:
-        x0, y0 = self._node(lost_chunk)
+        x0, y0 = self._node(self._pad_id(lost_chunk))
         return sorted(self._z_index(z) for z in self._planes()
                       if z[y0] == x0)
 
+    def _column_chunks(self, lost_chunk: int) -> set[int]:
+        """REAL ids of the lost chunk's grid column (the q-1 partners
+        that must be in every helper set; virtual ids excluded)."""
+        _x0, y0 = self._node(self._pad_id(lost_chunk))
+        out = set()
+        for x in range(self.q):
+            r = self._real_id(self._chunk(x, y0))
+            if r is not None and r != lost_chunk:
+                out.add(r)
+        return out
+
+    def choose_helpers(self, lost_chunk: int,
+                       available: set[int]) -> list[int] | None:
+        """The reference's helper choice (minimum_to_repair): the lost
+        node's column partners first, then fill to d from the rest.
+        None if single-failure repair is not applicable."""
+        col = self._column_chunks(lost_chunk)
+        if not col <= available or len(available) < self.d:
+            return None
+        helpers = sorted(col)
+        for ch in sorted(available):
+            if len(helpers) >= self.d:
+                break
+            if ch not in col and ch != lost_chunk:
+                helpers.append(ch)
+        return helpers if len(helpers) == self.d else None
+
     def minimum_to_decode(self, want_to_read, available):
-        """Single lost chunk with every other chunk available -> repair
-        planes only (the sub-chunk (offset,count) contract,
-        reference ErasureCodeClay minimum_to_repair)."""
+        """Single lost chunk with its column intact and >= d survivors
+        -> repair planes only from d chosen helpers (the sub-chunk
+        (offset,count) contract, reference minimum_to_repair)."""
         want = set(want_to_read)
         avail = set(available)
         missing = want - avail
-        n = self.k + self.m
-        if len(missing) == 1 and len(avail) >= n - 1:
-            planes = self.repair_planes(next(iter(missing)))
-            runs = self._runs(planes)
-            return {h: list(runs) for h in sorted(avail)[: self.d]}
+        if len(missing) == 1:
+            lost = next(iter(missing))
+            helpers = self.choose_helpers(lost, avail - want)
+            if helpers is not None:
+                runs = self._runs(self.repair_planes(lost))
+                return {h: list(runs) for h in helpers}
         return super().minimum_to_decode(want, avail)
 
     @staticmethod
@@ -267,54 +329,71 @@ class ErasureCodeClay(ErasureCode):
     def repair(self, lost_chunk: int,
                helper_planes: dict[int, np.ndarray],
                sub_size: int) -> np.ndarray:
-        """Rebuild `lost_chunk` from d helpers' repair-plane sub-chunks.
+        """Rebuild `lost_chunk` from exactly d helpers' repair-plane
+        sub-chunks.
 
-        helper_planes: chunk_id -> (len(repair_planes), sub_size) array,
-        rows ordered like repair_planes(lost_chunk).
-        Returns the full (sub_chunks * sub_size,) chunk.
+        helper_planes: real chunk_id -> (len(repair_planes), sub_size)
+        array, rows ordered like repair_planes(lost_chunk).  Survivors
+        NOT in helper_planes are "aloof": their symbols are never read
+        and their per-plane U's are solved as unknowns (reference
+        repair_one_lost_chunk).  Returns the full chunk.
         """
-        n = self.k + self.m
-        x0, y0 = self._node(lost_chunk)
+        lost = self._pad_id(lost_chunk)
+        x0, y0 = self._node(lost)
         rp = self.repair_planes(lost_chunk)
         rp_pos = {zi: i for i, zi in enumerate(rp)}
-        if len(helper_planes) < self.d:
-            raise ErasureCodeError(errno.EIO, "clay: need d helpers")
+        if len(helper_planes) != self.d:
+            raise ErasureCodeError(
+                errno.EIO, f"clay: need exactly d={self.d} helpers "
+                f"(got {len(helper_planes)})")
+        if not self._column_chunks(lost_chunk) <= set(helper_planes):
+            raise ErasureCodeError(
+                errno.EIO, "clay: helper set must include the lost "
+                "chunk's column partners")
         lut = gf.mul_table()
+        # padded helper table; virtual nodes are zero-filled helpers
+        helpers = {self._pad_id(ch): arr
+                   for ch, arr in helper_planes.items()}
+        for v in range(self.k, self.k + self.nu):
+            helpers[v] = np.zeros((len(rp), sub_size), dtype=np.uint8)
+        # erasure set per plane: the lost column + aloof survivors —
+        # exactly m unknowns (q + (k+m-d-1) = m)
+        column = {self._chunk(x, y0) for x in range(self.q)}
+        aloof = set(range(self.N)) - set(helpers) - {lost}
+        erasures = column | aloof
+        erased_nodes = {self._node(e) for e in erasures}
         out = np.zeros((self.sub_chunks, sub_size), dtype=np.uint8)
-        # U values on repair planes, per node
-        planes = [z for z in self._planes() if z[y0] == x0]
-        ua_col_y0: dict[tuple[int, int], np.ndarray] = {}  # (x, zi) -> U_A
+        U: dict[tuple[int, int], np.ndarray] = {}  # (node, zi) -> U
+        planes = sorted((z for z in self._planes() if z[y0] == x0),
+                        key=lambda z: (self._score(z, erased_nodes), z))
         for z in planes:
             zi = self._z_index(z)
             u_known: dict[int, np.ndarray] = {}
-            unknown_nodes = [(x0, y0)]
-            for ch in range(n):
+            for ch in range(self.N):
+                if ch in erasures:
+                    continue
                 x, y = self._node(ch)
-                if ch == lost_chunk:
-                    continue
-                cv = helper_planes[ch][rp_pos[zi]]
-                if y == y0:
-                    # pairs with the lost node at a non-repair plane:
-                    # U unknown, solved below
-                    unknown_nodes.append((x, y))
-                    continue
+                cv = helpers[ch][rp_pos[zi]]
                 if z[y] == x:
                     u_known[ch] = cv
                 else:
-                    bx = z[y]
-                    bch = self._chunk(bx, y)
+                    bch = self._chunk(z[y], y)
                     z2 = list(z)
                     z2[y] = x
                     z2i = self._z_index(tuple(z2))
-                    c_b = helper_planes[bch][rp_pos[z2i]]
-                    u_known[ch] = self._decouple(cv, c_b)
-            sol = self._solve_plane(u_known, unknown_nodes, (sub_size,))
-            out[zi] = sol[lost_chunk]               # hole-aligned: C = U
-            for x in range(self.q):
-                if x == x0:
-                    continue
-                ch = self._chunk(x, y0)
-                ua_col_y0[(x, zi)] = sol[ch]
+                    if bch in erasures:
+                        # partner unread: decouple via its U, solved in
+                        # a lower-score plane (score induction — bch is
+                        # hole-aligned at z, not at z2)
+                        u_known[ch] = cv ^ lut[GAMMA][U[(bch, z2i)]]
+                    else:
+                        u_known[ch] = self._decouple(
+                            cv, helpers[bch][rp_pos[z2i]])
+            sol = self._solve_plane(
+                u_known, [self._node(e) for e in erasures], (sub_size,))
+            for ch, val in sol.items():
+                U[(ch, zi)] = val
+            out[zi] = sol[lost]                 # hole-aligned: C = U
         # non-repair planes of the lost chunk via the coupling relation:
         # lost node B at z' pairs with A=(x,y0) at z = z'(y0->x0), z in rp
         ginv = gf.gf_inv(GAMMA)
@@ -327,10 +406,14 @@ class ErasureCodeClay(ErasureCode):
                 zprime = list(z)
                 zprime[y0] = x
                 zpi = self._z_index(tuple(zprime))
-                u_a = ua_col_y0[(x, zi)]
-                c_a = helper_planes[ch][rp_pos[zi]]
-                # C_A@z = U_A + g U_B  ->  U_B = (C_A + U_A)/g
-                u_b = lut[ginv][c_a ^ u_a]
+                u_a = U[(ch, zi)]               # column U: plane-solved
+                if ch in helpers:
+                    c_a = helpers[ch][rp_pos[zi]]
+                    # C_A@z = U_A + g U_B  ->  U_B = (C_A + U_A)/g
+                    u_b = lut[ginv][c_a ^ u_a]
+                else:
+                    raise ErasureCodeError(
+                        errno.EIO, "clay: column partner missing")
                 # C_B@z' = g U_A + U_B
                 out[zpi] = lut[GAMMA][u_a] ^ u_b
         return out.reshape(-1)

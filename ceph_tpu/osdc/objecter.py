@@ -55,6 +55,19 @@ class Objecter:
         # (reference linger_ops / watch support, Objecter.h)
         self._watch_cbs: dict[int, object] = {}
         self._next_cookie = 0
+        # linger registrations: cookie -> {"pool", "name"} — the linger
+        # thread re-asserts each on the current primary so a watch
+        # survives its OSD's death/remap (reference Objecter.cc:1293
+        # _scan_requests resending linger ops on every new map; here a
+        # periodic check-and-rewatch replaces map-push-driven resend)
+        self._lingers: dict[int, dict] = {}
+        self.linger_interval = 5.0
+        self._linger_stop = threading.Event()
+        self._linger_thread: threading.Thread | None = None
+        # serializes unwatch against the linger tick's check-and-rewatch
+        # (without it, unwatch between the tick's liveness check and its
+        # re-send resurrects a canceled cookie forever)
+        self._linger_op_lock = threading.Lock()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -104,6 +117,7 @@ class Objecter:
         self.mon_conn = self.messenger.connect(self.mon_addr)
 
     def shutdown(self) -> None:
+        self._linger_stop.set()
         self.messenger.shutdown()
 
     # -- dispatch -----------------------------------------------------------
@@ -252,11 +266,68 @@ class Objecter:
                 cookie = int.from_bytes(_os.urandom(8), "little") | 1
             self._watch_cbs[cookie] = callback
         self.op_submit(pool_id, name, [["watch", cookie]])
+        with self._lock:
+            self._lingers[cookie] = {"pool": pool_id, "name": name}
+        self._ensure_linger_thread()
         return cookie
 
     def unwatch(self, pool_id: int, name: str, cookie: int) -> None:
-        self.op_submit(pool_id, name, [["unwatch", cookie]])
-        self._watch_cbs.pop(cookie, None)
+        with self._linger_op_lock:
+            with self._lock:
+                self._lingers.pop(cookie, None)
+            self.op_submit(pool_id, name, [["unwatch", cookie]])
+            self._watch_cbs.pop(cookie, None)
+
+    def _ensure_linger_thread(self) -> None:
+        with self._lock:
+            if self._linger_thread is not None and \
+                    self._linger_thread.is_alive():
+                return
+            self._linger_thread = threading.Thread(
+                target=self._linger_loop, daemon=True,
+                name="objecter-linger")
+            self._linger_thread.start()
+
+    def _linger_loop(self) -> None:
+        """Keep every registered watch alive across OSD death, revive,
+        and PG remap.  Each tick: refresh the map, then verify (via
+        listwatchers, a cheap read on the primary) that our cookie is
+        still registered — a fresh primary or a restarted OSD has an
+        empty watcher table — and re-send the watch op if not.  The
+        reference drives this from map pushes + per-watch ping timers
+        (Objecter::_linger_ops_resend, WatchNotify ping); a periodic
+        check-and-rewatch gives the same guarantee without a mon-push
+        subscription."""
+        import json as _json
+        while not self._linger_stop.wait(self.linger_interval):
+            with self._lock:
+                regs = dict(self._lingers)
+            if not regs:
+                continue
+            try:
+                self.refresh_map(timeout=2.0)
+            except Exception:  # noqa: BLE001 - mon electing: next tick
+                pass
+            for cookie, reg in regs.items():
+                # the whole check-and-rewatch is atomic vs unwatch()
+                with self._linger_op_lock:
+                    with self._lock:
+                        if cookie not in self._lingers:
+                            continue     # unwatched meanwhile
+                    try:
+                        reply = self.op_submit(
+                            reg["pool"], reg["name"],
+                            [["listwatchers"]], timeout=5.0,
+                            attempts=1)
+                        live = _json.loads(bytes(reply.data).decode()) \
+                            if reply.result == 0 else []
+                        if cookie not in live:
+                            self.op_submit(
+                                reg["pool"], reg["name"],
+                                [["watch", cookie]], timeout=5.0,
+                                attempts=1)
+                    except Exception:  # noqa: BLE001 - OSD still down:
+                        continue       # re-check next tick
 
     def notify(self, pool_id: int, name: str, payload: bytes) -> None:
         self.op_submit(pool_id, name, [["notify", len(payload)]],

@@ -246,7 +246,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _require_object_perm(self, bucket: str, key: str,
                              meta: dict, perm: str,
-                             action: str | None = None) -> None:
+                             action: str | None = None) -> dict:
         """Object ACL governs the object (S3: a public-read BUCKET
         does not expose its objects; each object carries its own
         canned ACL, default private to its owner).  Bucket policy is
@@ -257,13 +257,14 @@ class _Handler(BaseHTTPRequestHandler):
         if decision == "Deny":
             raise RGWError(403, "AccessDenied", f"{bucket}/{key}")
         if decision == "Allow":
-            return
+            return bmeta
         owner = meta.get("owner")
         if owner is None:                     # legacy/ownerless object
             owner = bmeta.get("owner")
         if not self._acl_allows(owner, meta.get("acl", "private"),
                                 perm):
             raise RGWError(403, "AccessDenied", f"{bucket}/{key}")
+        return bmeta
 
     def _requested_acl(self) -> str:
         acl = self.headers.get("x-amz-acl", "") or "private"
@@ -508,9 +509,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200)
         elif self.command == "GET" and "acl" in query:
             meta = st.head_object(bucket, key)
-            self._require_object_perm(bucket, key, meta, "READ_ACP")
+            bmeta = self._require_object_perm(bucket, key, meta,
+                                              "READ_ACP")
             self._reply(200, self._acl_xml(
-                meta.get("owner") or self._bucket_acl(bucket)[0],
+                meta.get("owner") or bmeta.get("owner"),
                 meta.get("acl", "private")))
         elif self.command == "PUT" and "partNumber" in query:
             self._require_bucket_perm(bucket, "WRITE",

@@ -245,6 +245,12 @@ class LsmDB(KeyValueDB):
                 p = self.dir / fe["name"]
                 if not p.exists():      # crashed mid-compaction: the
                     continue            # manifest write is the commit
+                # decoded bounds cached once (underscore keys stay out
+                # of the manifest) — get() binary-searches these on
+                # every read, and hex-decoding per lookup would sit on
+                # the hottest metadata path
+                fe["_min"] = bytes.fromhex(fe["min"])
+                fe["_max"] = bytes.fromhex(fe["max"])
                 lvl.append(fe)
                 self._readers[fe["name"]] = SSTReader(p)
             self._levels.append(lvl)
@@ -254,7 +260,9 @@ class LsmDB(KeyValueDB):
 
     def _write_manifest(self) -> None:
         m = {"next_seq": self._next_seq,
-             "levels": [[fe for fe in lvl] for lvl in self._levels]}
+             "levels": [[{k: v for k, v in fe.items()
+                          if not k.startswith("_")} for fe in lvl]
+                        for lvl in self._levels]}
         tmp = self._manifest_path().with_suffix(".tmp")
         with open(tmp, "w") as f:
             json.dump(m, f)
@@ -335,14 +343,20 @@ class LsmDB(KeyValueDB):
     @staticmethod
     def _find_file(lvl: list[dict], key: bytes) -> int | None:
         """Binary search a non-overlapping level for the file covering
-        key."""
-        keys = [bytes.fromhex(fe["min"]) for fe in lvl]
-        i = bisect.bisect_right(keys, key) - 1
-        if i >= 0 and key <= bytes.fromhex(lvl[i]["max"]):
+        key (cached decoded bounds — no per-read hex work)."""
+        i = bisect.bisect_right(lvl, key, key=lambda fe: fe["_min"]) - 1
+        if i >= 0 and key <= lvl[i]["_max"]:
             return i
         return None
 
+    MAX_KEY = 0xFFFF     # keys pack as <H in the WAL/SST record format
+
     def submit(self, batch: WriteBatch, sync: bool = True) -> None:
+        for op in batch.ops:
+            if len(op[1]) > self.MAX_KEY:
+                raise ValueError(
+                    f"LsmDB key too long ({len(op[1])} > "
+                    f"{self.MAX_KEY} bytes)")
         body = bytearray()
         for op in batch.ops:
             if op[0] == "set":
@@ -407,8 +421,7 @@ class LsmDB(KeyValueDB):
                 rank += 1
             for lvl in self._levels[1:]:
                 its = [self._readers[fe["name"]].scan(start)
-                       for fe in lvl
-                       if bytes.fromhex(fe["max"]) >= start]
+                       for fe in lvl if fe["_max"] >= start]
                 for it in its:
                     sources.append((rank, it))
                 rank += 1
@@ -463,7 +476,8 @@ class LsmDB(KeyValueDB):
         size = w.path.stat().st_size
         self.stats["compact_bytes_out"] += size
         return {"name": w.path.name, "min": w.min_key.hex(),
-                "max": w.max_key.hex(), "count": w.count, "bytes": size}
+                "max": w.max_key.hex(), "count": w.count, "bytes": size,
+                "_min": w.min_key, "_max": w.max_key}
 
     def _flush_locked(self) -> None:
         items = sorted(self._mem.items())
@@ -505,14 +519,13 @@ class LsmDB(KeyValueDB):
             up_files = list(self._levels[0])
         else:
             up_files = [self._levels[level][victim]]
-        lo = min(bytes.fromhex(fe["min"]) for fe in up_files)
-        hi = max(bytes.fromhex(fe["max"]) for fe in up_files)
+        lo = min(fe["_min"] for fe in up_files)
+        hi = max(fe["_max"] for fe in up_files)
         if len(self._levels) <= level + 1:
             self._levels.append([])
         down = self._levels[level + 1]
         overlap = [fe for fe in down
-                   if not (bytes.fromhex(fe["max"]) < lo or
-                           bytes.fromhex(fe["min"]) > hi)]
+                   if not (fe["_max"] < lo or fe["_min"] > hi)]
         bottommost = (level + 2 >= len(self._levels) or
                       not any(self._levels[level + 2:]))
         # merge newest-first ranks: L0 newest-last in list

@@ -1,0 +1,114 @@
+"""Objecter linger ops: a watch must survive its OSD's death/remap and
+still receive the next notify (reference Objecter.cc:1293 linger-op
+resend on new maps; VERDICT r4 missing #7)."""
+
+import time
+
+import pytest
+
+from ceph_tpu.tools.vstart import Cluster
+
+POOL = "lingerpool"
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with Cluster(n_osds=5) as c:
+        cl = c.client()
+        cl.create_pool(POOL, pg_num=8, size=3)
+        yield c
+
+
+def _wait(pred, timeout=30.0, step=0.2):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return False
+
+
+def test_watch_survives_primary_death(cluster):
+    watcher_client = cluster.client()
+    notifier_client = cluster.client()
+    io_w = watcher_client.open_ioctx(POOL)
+    io_n = notifier_client.open_ioctx(POOL)
+    io_n.write_full("lobj", b"x")
+
+    got = []
+    watcher_client.objecter.linger_interval = 0.3   # fast re-assert
+    cookie = io_w.watch("lobj", lambda name, payload: got.append(
+        (name, bytes(payload))))
+    # sanity: notify reaches the watcher pre-failure
+    io_n.notify("lobj", b"before")
+    assert _wait(lambda: ("lobj", b"before") in got)
+
+    # kill the primary OSD of the watched object and mark it down so
+    # the PG remaps to a new primary (whose watcher table is empty)
+    pool_id = io_w.pool_id
+    _spg, primary = watcher_client.objecter._calc_target(pool_id,
+                                                        "lobj")
+    cluster.kill_osd(primary)
+    cluster.mark_osd_down(primary)
+
+    # the linger thread must notice and re-register on the new primary
+    def rewatched():
+        try:
+            tgt = notifier_client.objecter._calc_target(pool_id, "lobj")
+            if tgt is None or tgt[1] == primary:
+                notifier_client.objecter.refresh_map(timeout=1.0)
+                return False
+            return cookie in io_n.list_watchers("lobj")
+        except Exception:  # noqa: BLE001 - peering blip
+            return False
+    assert _wait(rewatched, timeout=30.0), "watch never re-registered"
+
+    # and the next notify is delivered
+    io_n.notify("lobj", b"after-failover")
+    assert _wait(lambda: ("lobj", b"after-failover") in got), \
+        "notify lost after failover"
+
+
+def test_watch_survives_osd_restart_same_primary(cluster):
+    """kill -9 + revive with the SAME primary: the restarted OSD's
+    watcher table is empty, so only re-assertion restores delivery."""
+    watcher_client = cluster.client()
+    notifier_client = cluster.client()
+    io_w = watcher_client.open_ioctx(POOL)
+    io_n = notifier_client.open_ioctx(POOL)
+    io_n.write_full("robj", b"y")
+
+    got = []
+    watcher_client.objecter.linger_interval = 0.3
+    cookie = io_w.watch("robj", lambda name, payload: got.append(
+        bytes(payload)))
+    io_n.notify("robj", b"pre")
+    assert _wait(lambda: b"pre" in got)
+
+    pool_id = io_w.pool_id
+    _spg, primary = watcher_client.objecter._calc_target(pool_id,
+                                                        "robj")
+    cluster.kill_osd(primary)
+    cluster.revive_osd(primary)
+
+    def rewatched():
+        try:
+            return cookie in io_n.list_watchers("robj")
+        except Exception:  # noqa: BLE001
+            return False
+    assert _wait(rewatched, timeout=30.0), \
+        "watch never re-registered after restart"
+    io_n.notify("robj", b"post")
+    assert _wait(lambda: b"post" in got), "notify lost after restart"
+
+
+def test_unwatch_stops_reassertion(cluster):
+    watcher_client = cluster.client()
+    io_w = watcher_client.open_ioctx(POOL)
+    io_w.write_full("uobj", b"z")
+    watcher_client.objecter.linger_interval = 0.2
+    cookie = io_w.watch("uobj", lambda n, p: None)
+    assert cookie in io_w.list_watchers("uobj")
+    io_w.unwatch("uobj", cookie)
+    time.sleep(1.0)                      # a few linger ticks
+    assert cookie not in io_w.list_watchers("uobj")
