@@ -308,7 +308,11 @@ class ErasureCodeClay(ErasureCode):
         want = set(want_to_read)
         avail = set(available)
         missing = want - avail
-        if len(missing) == 1:
+        # repair path ONLY when the lost chunk is the sole want — the
+        # reference's is_repair rejects want_to_read.size() > 1 the
+        # same way (a mixed want would otherwise get a map that never
+        # reads the other wanted, available chunks)
+        if len(missing) == 1 and want <= missing:
             lost = next(iter(missing))
             helpers = self.choose_helpers(lost, avail - want)
             if helpers is not None:

@@ -64,10 +64,6 @@ class Objecter:
         self.linger_interval = 5.0
         self._linger_stop = threading.Event()
         self._linger_thread: threading.Thread | None = None
-        # serializes unwatch against the linger tick's check-and-rewatch
-        # (without it, unwatch between the tick's liveness check and its
-        # re-send resurrects a canceled cookie forever)
-        self._linger_op_lock = threading.Lock()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -272,11 +268,14 @@ class Objecter:
         return cookie
 
     def unwatch(self, pool_id: int, name: str, cookie: int) -> None:
-        with self._linger_op_lock:
-            with self._lock:
-                self._lingers.pop(cookie, None)
-            self.op_submit(pool_id, name, [["unwatch", cookie]])
-            self._watch_cbs.pop(cookie, None)
+        # pop BEFORE the op: a linger tick that starts after this point
+        # sees the cookie gone and skips; a tick already mid-flight is
+        # compensated by its own post-rewatch membership re-check (see
+        # _linger_loop) — so no lock is held across a blocking op
+        with self._lock:
+            self._lingers.pop(cookie, None)
+        self.op_submit(pool_id, name, [["unwatch", cookie]])
+        self._watch_cbs.pop(cookie, None)
 
     def _ensure_linger_thread(self) -> None:
         with self._lock:
@@ -309,25 +308,33 @@ class Objecter:
             except Exception:  # noqa: BLE001 - mon electing: next tick
                 pass
             for cookie, reg in regs.items():
-                # the whole check-and-rewatch is atomic vs unwatch()
-                with self._linger_op_lock:
-                    with self._lock:
-                        if cookie not in self._lingers:
-                            continue     # unwatched meanwhile
-                    try:
-                        reply = self.op_submit(
+                with self._lock:
+                    if cookie not in self._lingers:
+                        continue         # unwatched meanwhile
+                try:
+                    reply = self.op_submit(
+                        reg["pool"], reg["name"], [["listwatchers"]],
+                        timeout=5.0, attempts=1)
+                    live = _json.loads(bytes(reply.data).decode()) \
+                        if reply.result == 0 else []
+                    if cookie not in live:
+                        self.op_submit(
                             reg["pool"], reg["name"],
-                            [["listwatchers"]], timeout=5.0,
+                            [["watch", cookie]], timeout=5.0,
                             attempts=1)
-                        live = _json.loads(bytes(reply.data).decode()) \
-                            if reply.result == 0 else []
-                        if cookie not in live:
+                        # compensate the unwatch race: if the app
+                        # unwatched while we were re-asserting, undo —
+                        # otherwise the orphan cookie would eat every
+                        # future notify's ack wait
+                        with self._lock:
+                            still = cookie in self._lingers
+                        if not still:
                             self.op_submit(
                                 reg["pool"], reg["name"],
-                                [["watch", cookie]], timeout=5.0,
+                                [["unwatch", cookie]], timeout=5.0,
                                 attempts=1)
-                    except Exception:  # noqa: BLE001 - OSD still down:
-                        continue       # re-check next tick
+                except Exception:  # noqa: BLE001 - OSD still down:
+                    continue           # re-check next tick
 
     def notify(self, pool_id: int, name: str, payload: bytes) -> None:
         self.op_submit(pool_id, name, [["notify", len(payload)]],

@@ -79,6 +79,47 @@ def bench_pool(cluster, client, pool: str, seconds: float,
     }
 
 
+def _setup_profiles(client) -> None:
+    client.set_ec_profile("cb21", {
+        "plugin": "jerasure", "k": "2", "m": "1",
+        "stripe_unit": "4096"})
+    client.set_ec_profile("cb83", {
+        "plugin": "jerasure", "k": "8", "m": "3",
+        "stripe_unit": "4096"})
+
+
+def _make_pool(client, name: str, profile: str | None) -> str:
+    pool = f"pool_{name}"
+    if profile:
+        client.create_pool(pool, "erasure",
+                           erasure_code_profile=profile, pg_num=16)
+    else:
+        client.create_pool(pool, "replicated", size=3, pg_num=16)
+    return pool
+
+
+def _matrix(args) -> list[tuple[str, str | None, float]]:
+    """ONE matrix for both topologies (the A/B claim depends on it)."""
+    rows = [("replicated", None, 0.0)]
+    if not args.quick:
+        rows.append(("ec_k2m1", "cb21", 0.0))
+    rows += [("ec_k8m3", "cb83", 0.0),
+             ("ec_k8m3_batched", "cb83", args.window_ms)]
+    return rows
+
+
+def _bench_row(c, client, args, name, profile, window,
+               extra: dict) -> dict:
+    pool = _make_pool(client, name, profile)
+    res = bench_pool(c, client, pool, args.seconds, args.threads,
+                     args.size)
+    row = {"config": name, "objectstore": args.objectstore,
+           "threads": args.threads, "obj_size": args.size,
+           "batch_window_ms": window, **res, **extra}
+    print(json.dumps(row), flush=True)
+    return row
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="cluster_bench")
     ap.add_argument("--seconds", type=float, default=5.0)
@@ -90,57 +131,71 @@ def main(argv=None) -> int:
                     help="batch window for the windowed EC rows")
     ap.add_argument("--quick", action="store_true",
                     help="small matrix (replicated + one EC profile)")
+    ap.add_argument("--processes", action="store_true",
+                    help="multi-process topology (ProcCluster): each "
+                         "daemon its own interpreter — cluster numbers "
+                         "measure the system, not one GIL")
     args = ap.parse_args(argv)
+
+    if args.processes:
+        return _main_processes(args)
 
     from ..tools.vstart import Cluster
 
-    rows = []
     import tempfile
     data_dir = tempfile.mkdtemp(prefix="cbench_") \
         if args.objectstore != "memstore" else None
     with Cluster(n_osds=args.osds, objectstore=args.objectstore,
                  data_dir=data_dir) as c:
         client = c.client()
-        client.set_ec_profile("cb21", {
-            "plugin": "jerasure", "k": "2", "m": "1",
-            "stripe_unit": "4096"})
-        client.set_ec_profile("cb83", {
-            "plugin": "jerasure", "k": "8", "m": "3",
-            "stripe_unit": "4096"})
-        matrix = [("replicated", None, 0.0)]
-        if not args.quick:
-            matrix.append(("ec_k2m1", "cb21", 0.0))
-        matrix += [("ec_k8m3", "cb83", 0.0),
-                   ("ec_k8m3_batched", "cb83", args.window_ms)]
-        for name, profile, window in matrix:
-            pool = f"pool_{name}"
-            if profile:
-                client.create_pool(pool, "erasure",
-                                   erasure_code_profile=profile,
-                                   pg_num=16)
-            else:
-                client.create_pool(pool, "replicated", size=3,
-                                   pg_num=16)
+        _setup_profiles(client)
+        for name, profile, window in _matrix(args):
             for osd in c.osds:
                 osd.cct.conf.set("tpu_batch_window_ms", window)
-            res = bench_pool(c, client, pool, args.seconds,
-                             args.threads, args.size)
-            launches = sum(
+            counters = {
+                "codec_launches": -sum(
+                    getattr(st.backend, "batched_launches", 0)
+                    for osd in c.osds
+                    for st in getattr(osd, "pgs", {}).values()),
+                "codec_extents": -sum(
+                    getattr(st.backend, "batched_extents", 0)
+                    for osd in c.osds
+                    for st in getattr(osd, "pgs", {}).values())}
+            _bench_row(c, client, args, name, profile, window, {})
+            # report per-row deltas of the cumulative in-process
+            # counters (unavailable cross-process)
+            counters["codec_launches"] += sum(
                 getattr(st.backend, "batched_launches", 0)
                 for osd in c.osds
                 for st in getattr(osd, "pgs", {}).values())
-            extents = sum(
+            counters["codec_extents"] += sum(
                 getattr(st.backend, "batched_extents", 0)
                 for osd in c.osds
                 for st in getattr(osd, "pgs", {}).values())
-            row = {"config": name, "objectstore": args.objectstore,
-                   "threads": args.threads,
-                   "obj_size": args.size,
-                   "batch_window_ms": window, **res,
-                   "codec_launches": launches,
-                   "codec_extents": extents}
-            rows.append(row)
-            print(json.dumps(row), flush=True)
+            print(json.dumps({"config": name, **counters}), flush=True)
+    return 0
+
+
+def _main_processes(args) -> int:
+    """Process-topology twin of the SAME matrix.  Per-OSD conf must
+    ride the spawn command line, so rows whose batch window differs
+    get their own cluster; codec launch counters live in other
+    processes and are not reported."""
+    from ..tools.proc_cluster import ProcCluster
+
+    by_window: dict[float, list] = {}
+    for name, profile, window in _matrix(args):
+        by_window.setdefault(window, []).append((name, profile, window))
+    for window, rows in by_window.items():
+        conf = {"tpu_batch_window_ms": window} if window else {}
+        with ProcCluster(n_osds=args.osds,
+                         objectstore=args.objectstore,
+                         conf=conf) as c:
+            client = c.client()
+            _setup_profiles(client)
+            for name, profile, w in rows:
+                _bench_row(c, client, args, name, profile, w,
+                           {"topology": "processes"})
     return 0
 
 

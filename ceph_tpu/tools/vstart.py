@@ -1,7 +1,9 @@
 """Dev cluster launcher (reference src/vstart.sh + qa/standalone/
 ceph-helpers.sh run_mon/run_osd): start a mon and N OSDs on localhost
 loopback — in-process threads by default (standalone-test style: many
-daemons, one host, real messenger over loopback).
+daemons, one host, real messenger over loopback).  For the
+multi-PROCESS topology (real SIGKILL, no shared GIL/memory) use
+tools/proc_cluster.ProcCluster, same surface.
 
 Library use:
     with Cluster(n_osds=6) as c:
