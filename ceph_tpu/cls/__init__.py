@@ -109,4 +109,5 @@ class ClsContext:
 
 # -- built-in classes --------------------------------------------------------
 
-from . import cls_lock, cls_numops, cls_refcount, cls_rgw  # noqa: E402,F401
+from . import (cls_journal, cls_lock, cls_numops,  # noqa: E402,F401
+               cls_refcount, cls_rgw)

@@ -7,13 +7,12 @@ it is applied — write-ahead, src/librbd/journal/) + the rbd-mirror
 daemon's replayer (src/tools/rbd_mirror/ImageReplayer: tail the
 journal, apply events to a peer image, advance the commit position).
 
-Layout: an index object ("rbd_journal.<image>") maintained by the
-directory object class keyed by zero-padded sequence numbers (atomic
-server-side appends, ordered listing = replay order); bulky write
-payloads live in per-entry data objects so the index stays light.
-The replayer's position is stored per peer in the index meta entry
-"@pos.<peer>" (reference journal client registration + commit
-positions).
+Layout: a journal header object ("rbd_journal.<image>") maintained by
+the journal object class (cls/cls_journal.py — the same cls seam the
+reference routes this through, src/cls/journal): atomic server-side
+seq allocation, ordered listing, per-peer client commit positions, and
+client-fenced trim.  Bulky write payloads live in per-entry data
+objects so the header stays light.
 """
 
 from __future__ import annotations
@@ -42,28 +41,27 @@ def _resolve_data_oid(image: str, event: dict, seq: int) -> str:
 class Journal:
     """Ordered event log for one image (reference journal::Journaler)."""
 
-    POS_PREFIX = "@pos."
-    NEXT_KEY = "@next"
-
     def __init__(self, ioctx: IoCtx, image: str):
         self.io = ioctx
         self.image = image
         self.oid = _journal_oid(image)
-        self.io.execute(self.oid, "rgw", "dir_init", b"")
+        self.io.execute(self.oid, "journal", "create", b"")
+        self._registered: set[str] = set()   # client_register cache
 
-    def _list(self, after: str) -> list:
-        """Full ordered listing, following pagination."""
+    def _list(self, after_seq: int) -> list:
+        """Full ordered [(seq, event)...] listing, following
+        pagination."""
         out = []
-        marker = after
+        pos = after_seq
         while True:
-            raw = self.io.execute(self.oid, "rgw", "dir_list",
-                                  json.dumps({"marker": marker,
+            raw = self.io.execute(self.oid, "journal", "list",
+                                  json.dumps({"after_seq": pos,
                                               "max": 4096}).encode())
             page = json.loads(raw.decode())
             out.extend(page["entries"])
             if not page["truncated"] or not page["entries"]:
                 return out
-            marker = page["entries"][-1][0]
+            pos = page["entries"][-1][0]
 
     # -- recording (image side) ---------------------------------------------
 
@@ -80,15 +78,15 @@ class Journal:
         image mutation never happened either)."""
         if not data:
             return int(self.io.execute(
-                self.oid, "rgw", "log_append",
-                json.dumps({"meta": event}).encode()))
+                self.oid, "journal", "append",
+                json.dumps({"entry": event}).encode()))
         doid = f"rbd_journal.{self.image}.data.{uuid.uuid4().hex}"
         self.io.write_full(doid, data)
         event = dict(event, data_len=len(data), data_oid=doid)
         try:
             return int(self.io.execute(
-                self.oid, "rgw", "log_append",
-                json.dumps({"meta": event}).encode()))
+                self.oid, "journal", "append",
+                json.dumps({"entry": event}).encode()))
         except Exception:
             # index write failed but we're still alive: reclaim the
             # would-be orphan (its random name is unreachable by trim)
@@ -102,24 +100,24 @@ class Journal:
 
     def get_position(self, peer: str) -> int:
         try:
-            raw = self.io.execute(self.oid, "rgw", "dir_get", json.dumps(
-                {"key": self.POS_PREFIX + peer}).encode())
+            raw = self.io.execute(self.oid, "journal", "client_get",
+                                  json.dumps({"id": peer}).encode())
         except RadosError:
             return -1
-        return int(json.loads(raw.decode())["seq"])
+        return int(json.loads(raw.decode())["pos"])
 
     def set_position(self, peer: str, seq: int) -> None:
-        self.io.execute(self.oid, "rgw", "dir_add", json.dumps(
-            {"key": self.POS_PREFIX + peer,
-             "meta": {"seq": seq}}).encode())
+        if peer not in self._registered:     # idempotent; once per
+            self.io.execute(                 # handle, not per event
+                self.oid, "journal", "client_register",
+                json.dumps({"id": peer, "pos": -1}).encode())
+            self._registered.add(peer)
+        self.io.execute(self.oid, "journal", "client_update",
+                        json.dumps({"id": peer, "pos": seq}).encode())
 
     def entries_after(self, seq: int):
         """Yield (seq, event, data) in order for every entry > seq."""
-        marker = f"{seq:016x}" if seq >= 0 else ""
-        for key, event in self._list(after=marker):
-            if key.startswith("@"):
-                continue
-            eseq = int(key, 16)
+        for eseq, event in self._list(after_seq=seq):
             data = b""
             if event.get("data_len"):
                 doid = _resolve_data_oid(self.image, event, eseq)
@@ -140,21 +138,22 @@ class Journal:
 
     def trim_to(self, seq: int) -> None:
         """Drop entries every peer has replayed (reference journal
-        trimming at the minimum commit position)."""
-        for key, event in self._list(after=""):
-            if key.startswith("@"):
-                continue
-            eseq = int(key, 16)
-            if eseq > seq:
-                break
-            if event.get("data_len"):
-                try:
-                    self.io.remove(
-                        _resolve_data_oid(self.image, event, eseq))
-                except RadosError:
-                    pass
-            self.io.execute(self.oid, "rgw", "dir_rm", json.dumps(
-                {"key": key}).encode())
+        trimming at the minimum commit position — and the class
+        REFUSES a trim past the slowest registered client, so a lagging
+        mirror can never lose unreplayed events).  The fenced cls trim
+        runs FIRST; payload objects are deleted only after it succeeds
+        (deleting them first would destroy data the fence just
+        protected)."""
+        doids = [(_resolve_data_oid(self.image, event, eseq))
+                 for eseq, event in self._list(after_seq=-1)
+                 if eseq <= seq and event.get("data_len")]
+        self.io.execute(self.oid, "journal", "trim",
+                        json.dumps({"to_seq": seq}).encode())
+        for doid in doids:
+            try:
+                self.io.remove(doid)
+            except RadosError:
+                pass
 
 
 class ImageReplayer:

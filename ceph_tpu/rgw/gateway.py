@@ -773,8 +773,10 @@ class S3Gateway:
     def __init__(self, client, addr: tuple[str, int] = ("127.0.0.1", 0),
                  creds: dict[str, str] | None = None,
                  ec_profile: str | None = None,
-                 lc_interval: float = 60.0):
-        self.store = RGWStore(client, ec_profile=ec_profile)
+                 lc_interval: float = 60.0, modlog: bool = False):
+        # modlog=True for a multisite source zone (rgw/sync.py)
+        self.store = RGWStore(client, ec_profile=ec_profile,
+                              modlog=modlog)
         self.creds = creds          # access_key -> secret; None = open
         from .swift import SwiftFrontend
         self.swift = SwiftFrontend(self.store, creds)

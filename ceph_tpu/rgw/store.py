@@ -36,6 +36,7 @@ from ..rados.client import RadosError
 META_POOL = ".rgw.meta"
 DATA_POOL = ".rgw.data"
 BUCKETS_OBJ = "buckets"
+MODLOG_OBJ = "rgw_modlog"
 
 
 class RGWError(Exception):
@@ -63,12 +64,22 @@ def _version_oid(bucket: str, version_id: str, key: str) -> str:
 
 class RGWStore:
     def __init__(self, client, ec_profile: str | None = None,
-                 pg_num: int = 8):
+                 pg_num: int = 8, modlog: bool = False):
         self.client = client
         self._ensure_pools(ec_profile, pg_num)
         self.meta = client.open_ioctx(META_POOL)
         self.data = client.open_ioctx(DATA_POOL)
         self._cls(self.meta, BUCKETS_OBJ, "dir_init")
+        # zone mod-log: one journal object recording WHAT changed
+        # (reference rgw_datalog/bilog, the feed of rgw_data_sync.cc);
+        # the sync agent (rgw/sync.py) reconciles current state per
+        # entry, so replay is idempotent.  OPT-IN (multisite zones
+        # only): a standalone zone must not pay a journal append per
+        # mutation; enabling sync on an existing zone starts with
+        # ZoneReplayer.full_sync() to cover the pre-log history.
+        self.modlog_enabled = modlog
+        if modlog:
+            self.meta.execute(MODLOG_OBJ, "journal", "create", b"")
         # bucket-meta rows are read-modify-written whole (versioning/
         # acl/lifecycle share one row); concurrent HTTP handler threads
         # must not interleave their RMWs or the second write silently
@@ -96,6 +107,20 @@ class RGWStore:
         inp = json.dumps(payload).encode() if payload is not None else b""
         return io.execute(oid, "rgw", method, inp)
 
+    def _modlog(self, op: str, bucket: str,
+                key: str | None = None) -> None:
+        """WRITE-AHEAD: call sites log BEFORE mutating, so a crash
+        between log and mutation reconciles to a no-op, while a
+        mutation-then-crash-before-log would silently diverge the
+        zones forever."""
+        if not self.modlog_enabled:
+            return
+        entry = {"op": op, "bucket": bucket, "ts": time.time()}
+        if key is not None:
+            entry["key"] = key
+        self.meta.execute(MODLOG_OBJ, "journal", "append",
+                          json.dumps({"entry": entry}).encode())
+
     # -- buckets -------------------------------------------------------------
 
     def create_bucket(self, bucket: str, owner: str | None = None,
@@ -107,6 +132,7 @@ class RGWStore:
             meta["owner"] = owner
         if acl != "private":
             meta["acl"] = acl
+        self._modlog("sync_bucket", bucket)
         self._cls(self.meta, BUCKETS_OBJ, "dir_add", {
             "key": bucket, "meta": meta})
         self._cls(self.meta, f"index.{bucket}", "dir_init")
@@ -117,6 +143,7 @@ class RGWStore:
             if meta is None:
                 raise RGWError(404, "NoSuchBucket", bucket)
             meta["acl"] = acl               # RMW: keep created/owner etc.
+            self._modlog("sync_bucket", bucket)
             self._cls(self.meta, BUCKETS_OBJ, "dir_add", {
                 "key": bucket, "meta": meta})
 
@@ -132,6 +159,7 @@ class RGWStore:
                 meta.pop("policy", None)
             else:
                 meta["policy"] = policy
+            self._modlog("sync_bucket", bucket)
             self._cls(self.meta, BUCKETS_OBJ, "dir_add", {
                 "key": bucket, "meta": meta})
 
@@ -146,6 +174,7 @@ class RGWStore:
         if cur is None:
             raise RGWError(404, "NoSuchKey", key)
         cur["acl"] = acl
+        self._modlog("sync", bucket, key)
         self._cls(self.meta, f"index.{bucket}", "dir_add", {
             "key": key, "meta": cur})
 
@@ -167,6 +196,7 @@ class RGWStore:
                     raise RGWError(400, "MalformedXML",
                                    f"rule {r.get('id', '?')} has no action")
             meta["lifecycle"] = rules
+            self._modlog("sync_bucket", bucket)
             self._cls(self.meta, BUCKETS_OBJ, "dir_add", {
                 "key": bucket, "meta": meta})
 
@@ -182,6 +212,7 @@ class RGWStore:
             if meta is None:
                 raise RGWError(404, "NoSuchBucket", bucket)
             meta.pop("lifecycle", None)
+            self._modlog("sync_bucket", bucket)
             self._cls(self.meta, BUCKETS_OBJ, "dir_add", {
                 "key": bucket, "meta": meta})
 
@@ -280,6 +311,7 @@ class RGWStore:
         for row in self.list_versions(bucket, max_keys=1):
             raise RGWError(409, "BucketNotEmpty",
                            f"{bucket}: object versions remain")
+        self._modlog("sync_bucket", bucket)
         self._cls(self.meta, BUCKETS_OBJ, "dir_rm", {"key": bucket})
         for obj in (f"index.{bucket}", f"uploads.{bucket}",
                     f"versions.{bucket}"):
@@ -322,6 +354,7 @@ class RGWStore:
             if meta is None:
                 raise RGWError(404, "NoSuchBucket", bucket)
             meta["versioning"] = status       # RMW: keep created etc.
+            self._modlog("sync_bucket", bucket)
             self._cls(self.meta, BUCKETS_OBJ, "dir_add", {
                 "key": bucket, "meta": meta})
 
@@ -417,6 +450,7 @@ class RGWStore:
         if bmeta is None:
             raise RGWError(404, "NoSuchBucket", bucket)
         etag = hashlib.md5(body).hexdigest()
+        self._modlog("sync", bucket, key)
         if bmeta.get("versioning") == "Enabled":
             self._archive_null_version(bucket, key)
             vid = self._new_version_id()
@@ -482,6 +516,7 @@ class RGWStore:
         vmeta = self._version_row(bucket, key, version_id)
         if vmeta is None:
             raise RGWError(404, "NoSuchVersion", version_id)
+        self._modlog("sync", bucket, key)
         try:
             self._cls(self.meta, f"versions.{bucket}", "dir_rm",
                       {"key": f"{key}\x00{version_id}"})
@@ -610,6 +645,7 @@ class RGWStore:
         bmeta = self._bucket_meta(bucket)
         if bmeta is None:
             raise RGWError(404, "NoSuchBucket", bucket)
+        self._modlog("sync", bucket, key)
         if bmeta.get("versioning") == "Enabled":
             # versioned delete = insert a delete marker as the new
             # current; nothing is destroyed (reference delete markers)
@@ -727,6 +763,7 @@ class RGWStore:
         manifest index entry, reaps the upload bookkeeping.  The
         combined ETag is md5-of-binary-part-md5s + "-N" (S3
         convention)."""
+        self._modlog("sync", bucket, key)
         self._require_upload(bucket, key, upload_id)
         if not parts:
             raise RGWError(400, "MalformedXML", "no parts listed")

@@ -1,0 +1,67 @@
+"""cls_journal object class (reference src/cls/journal): atomic seq
+allocation, ordered listing, client commit positions, fenced trim."""
+
+import json
+
+import pytest
+
+from ceph_tpu.rados.client import RadosError
+from ceph_tpu.tools.vstart import Cluster
+
+
+@pytest.fixture(scope="module")
+def io():
+    with Cluster(n_osds=2) as c:
+        client = c.client()
+        client.create_pool("jp", pg_num=4, size=2)
+        yield client.open_ioctx("jp")
+
+
+def _j(io, method, payload=None):
+    inp = json.dumps(payload).encode() if payload is not None else b""
+    return io.execute("jrn", "journal", method, inp)
+
+
+def test_append_seq_and_list(io):
+    _j(io, "create")
+    seqs = [int(_j(io, "append", {"entry": {"n": i}})) for i in range(5)]
+    assert seqs == [0, 1, 2, 3, 4]
+    out = json.loads(_j(io, "list", {"after_seq": 1, "max": 2}).decode())
+    assert [s for s, _ in out["entries"]] == [2, 3]
+    assert out["truncated"] is True
+    out = json.loads(_j(io, "list", {"after_seq": 3}).decode())
+    assert [s for s, _ in out["entries"]] == [4]
+    assert out["truncated"] is False
+
+
+def test_client_positions_monotonic(io):
+    _j(io, "create")
+    _j(io, "client_register", {"id": "m1", "pos": -1})
+    _j(io, "client_update", {"id": "m1", "pos": 3})
+    # registration is idempotent and keeps the position
+    _j(io, "client_register", {"id": "m1", "pos": -1})
+    got = json.loads(_j(io, "client_get", {"id": "m1"}).decode())
+    assert got["pos"] == 3
+    # positions never rewind
+    _j(io, "client_update", {"id": "m1", "pos": 1})
+    got = json.loads(_j(io, "client_get", {"id": "m1"}).decode())
+    assert got["pos"] == 3
+    with pytest.raises(RadosError):
+        _j(io, "client_get", {"id": "ghost"})
+
+
+def test_trim_fenced_by_slowest_client(io):
+    io.execute("jrn2", "journal", "create", b"")
+
+    def j2(method, payload):
+        return io.execute("jrn2", "journal", method,
+                          json.dumps(payload).encode())
+    for i in range(6):
+        j2("append", {"entry": {"i": i}})
+    j2("client_register", {"id": "slow", "pos": 2})
+    j2("client_register", {"id": "fast", "pos": 5})
+    with pytest.raises(RadosError):
+        j2("trim", {"to_seq": 4})       # past the slow client
+    j2("trim", {"to_seq": 2})
+    out = json.loads(j2("list", {"after_seq": -1}).decode())
+    assert [s for s, _ in out["entries"]] == [3, 4, 5]

@@ -54,7 +54,7 @@ def test_append_crash_window_never_wedges_replay(env):
     orig_execute = src.execute
 
     def failing_execute(oid, cls, method, data):
-        if method == "log_append":
+        if cls == "journal" and method == "append":
             raise RuntimeError("simulated crash before index write")
         return orig_execute(oid, cls, method, data)
 
