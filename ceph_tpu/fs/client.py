@@ -48,6 +48,7 @@ class CephFS:
         self.messenger = Messenger(name, auth=auth, secure=secure)
         self.messenger.add_dispatcher(self._dispatch)
         self.mds_conn = self.messenger.connect(tuple(mds_addr))
+        self._mds_conns: dict[tuple, object] = {}   # other ranks
         self._lock = threading.Lock()
         self._tid = 0
         self._waiters: dict[int, dict] = {}
@@ -146,20 +147,77 @@ class CephFS:
         for f in dirty:
             f._dirty = False
 
-    def _req(self, op: str, args: dict, timeout: float = 30.0) -> dict:
+    def _req_raw(self, conn, op: str, args: dict,
+                 timeout: float = 30.0):
         with self._lock:
             self._tid += 1
             tid = self._tid
             w = {"event": threading.Event(), "reply": None}
             self._waiters[tid] = w
-        self.mds_conn.send_message(M.MClientRequest(op, args, tid))
+        conn.send_message(M.MClientRequest(op, args, tid))
         if not w["event"].wait(timeout):
+            with self._lock:             # no reply will ever pop it:
+                self._waiters.pop(tid, None)   # reclaim the waiter
             raise FSError(110, f"mds request {op} timed out")
-        reply = w["reply"]
+        return w["reply"]
+
+    def _conn_for(self, addr: tuple):
+        """Connection to another MDS rank (multi-MDS redirects); mounts
+        a session on first use so caps/revokes work against that rank."""
+        with self._lock:
+            conn = self._mds_conns.get(addr)
+        if conn is not None:
+            return conn
+        conn = self.messenger.connect(addr)
+        reply = self._req_raw(conn, "mount",
+                              {"client": self.client_id})
         if reply.result != 0:
+            raise FSError(-reply.result, "mount on redirect target")
+        with self._lock:
+            self._mds_conns[addr] = conn
+        return conn
+
+    def _req(self, op: str, args: dict, timeout: float = 30.0) -> dict:
+        """MDS RPC with multi-MDS handling: ESTALE+redirect_addr sends
+        the op to the owning rank (reference client MDS-session
+        retargeting on auth hints); EAGAIN (subtree frozen by a
+        migration, or a transient server retry limit) backs off and
+        retries until the authority settles."""
+        import errno as _e
+        conn = self.mds_conn
+        cur_addr = None                  # non-None = redirected conn
+        redirects = 0
+        deadline = time.time() + timeout
+        while True:
+            try:
+                attempt = min(timeout, 10.0) if cur_addr else timeout
+                reply = self._req_raw(conn, op, args, attempt)
+            except FSError as e:
+                if e.errno == 110 and cur_addr is not None and \
+                        time.time() < deadline:
+                    # the redirect target died: drop the cached conn
+                    # and re-resolve authority from the primary (the
+                    # surviving rank auto-takes-over dead subtrees)
+                    with self._lock:
+                        self._mds_conns.pop(cur_addr, None)
+                    conn, cur_addr = self.mds_conn, None
+                    continue
+                raise
+            if reply.result == 0:
+                return reply.out
+            if reply.result == -_e.ESTALE and \
+                    reply.out.get("redirect_addr"):
+                redirects += 1
+                if redirects > 8:
+                    raise FSError(_e.ELOOP, f"redirect loop on {op}")
+                cur_addr = tuple(reply.out["redirect_addr"])
+                conn = self._conn_for(cur_addr)
+                continue
+            if reply.result == -_e.EAGAIN and time.time() < deadline:
+                time.sleep(0.2)
+                continue
             raise FSError(-reply.result,
                           reply.out.get("error", op))
-        return reply.out
 
     # -- namespace -----------------------------------------------------------
 
@@ -191,6 +249,11 @@ class CephFS:
                 self._stat_cache[norm] = (dict(ent),
                                           time.time() + LEASE_TTL)
         return ent
+
+    def export_dir(self, path: str, to_rank: str) -> dict:
+        """Migrate a subtree's authority to another MDS rank
+        (redirect-routed to the current owner like any path op)."""
+        return self._req("export_dir", {"path": path, "to": to_rank})
 
     def mkdir(self, path: str) -> None:
         self._req("mkdir", {"path": path})

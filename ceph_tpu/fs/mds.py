@@ -41,9 +41,22 @@ src/mds/Server.cc):
   then is the new open granted — so contending clients always observe
   each other's flushed state.
 
-Locking: one MDS owns the namespace (reference single-active rank 0);
+Locking: each active MDS owns the subtrees the SUBTREE MAP assigns it
+(reference MDSRank auth + subtree partitioning); within a rank,
 per-directory striped locks serialize multi-step ops (rename takes
 both directory locks in ino order).
+
+Multi-MDS (reference Migrator.cc / MDBalancer, idiomatically reduced):
+because dirfrags live IN RADOS (not in MDS memory), migrating a subtree
+moves AUTHORITY, not metadata — export freezes the subtree (EAGAIN to
+clients, who retry), flushes/revokes client caps under it, then commits
+ONE atomic subtree-map update; the importer has nothing to import.  A
+donor crash mid-export recovers from its mdlog intent: the map update
+is the commit point, so the export either happened or it didn't.
+Clients reaching the wrong rank get a redirect with the owner's addr
+(reference forward/auth hints).  Rank failover: a surviving MDS
+`mds_takeover`s a dead peer — probes its address, replays the peer's
+pending mdlog intents, and adopts its subtrees in the map.
 """
 
 from __future__ import annotations
@@ -62,6 +75,7 @@ DATA_POOL = "cephfs_data"
 ROOT_INO = 1
 INOTABLE_OBJ = "mds_inotable"
 SNAP_REGISTRY = "mds_snaptable"
+SUBTREE_OBJ = "mds_subtreemap"
 
 S_IFDIR = 0o040000
 S_IFREG = 0o100000
@@ -103,11 +117,26 @@ class MDSDaemon:
         from .mdlog import MDLog
         # log keyed by MDS name: a restart under the same name replays
         # its own intents; a concurrently-booted second MDS must NOT
-        # replay (and delete) a live peer's in-flight intents.  Rank
-        # takeover of a dead peer's log (reference standby-replay) is
-        # out of scope — single active MDS.
+        # replay (and delete) a live peer's in-flight intents.  A DEAD
+        # peer's log is replayed by whoever runs mds_takeover.
         self.mdlog = MDLog(self.meta, rank=name)
         self._replay_mdlog()
+        # multi-MDS state: subtree authority + migration freezes
+        self.rank = name
+        # frozen prefixes: an immutable snapshot REPLACED on change, so
+        # gate reads never race an in-place mutation from the export
+        # thread
+        self._frozen: frozenset[str] = frozenset()
+        self._subtree_cache: tuple[float, dict] | None = None
+        self._fsmap_cache: tuple[float, dict] | None = None
+        self._probe_cache: dict[str, tuple[float, bool]] = {}
+        self._takeover_lock = threading.Lock()
+        self._inflight = 0                   # gated path-ops in flight
+        self._inflight_lock = threading.Lock()
+        self._peer_tid = 0                   # MDS->MDS slave requests
+        self._peer_waiters: dict[int, dict] = {}
+        self.ops_served = 0                  # observability (tests)
+        self._bootstrap_subtree_map()
         self.messenger = Messenger("mds", auth=auth, secure=secure)
         self.messenger.add_dispatcher(self._dispatch)
         self.addr = self.messenger.bind(addr)
@@ -208,6 +237,281 @@ class MDSDaemon:
         return int(self.meta.execute(f"dir.{dino:x}", "rgw",
                                      "dir_count", b""))
 
+    # -- subtree authority (reference MDCache subtree map + Migrator) -------
+
+    @staticmethod
+    def _norm(path: str) -> str:
+        parts = [p for p in path.split("/") if p]
+        return "/" + "/".join(parts)
+
+    def _bootstrap_subtree_map(self) -> None:
+        """First active MDS claims the root subtree.  (A simultaneous
+        first-boot of two MDSes could race the claim; deployments boot
+        rank 0 first, like the reference's rank-0 creation.)"""
+        self.meta.execute(SUBTREE_OBJ, "rgw", "dir_init", b"")
+        try:
+            self.meta.execute(SUBTREE_OBJ, "rgw", "dir_get",
+                              json.dumps({"key": "/"}).encode())
+        except RadosError:
+            self.meta.execute(SUBTREE_OBJ, "rgw", "dir_add", json.dumps(
+                {"key": "/", "meta": {"rank": self.rank}}).encode())
+
+    def _load_subtrees(self, force: bool = False) -> dict[str, str]:
+        now = time.time()
+        if not force and self._subtree_cache is not None and \
+                now - self._subtree_cache[0] < 1.0:
+            return self._subtree_cache[1]
+        raw = self.meta.execute(SUBTREE_OBJ, "rgw", "dir_list",
+                                json.dumps({"max": 10000}).encode())
+        m = {k: v["rank"]
+             for k, v in json.loads(raw.decode())["entries"]}
+        self._subtree_cache = (now, m)
+        return m
+
+    def _authority(self, path: str) -> str:
+        """Longest-prefix owner of `path`.  "mine" from a fresh-enough
+        cache is trustworthy (this rank updates its own cache
+        synchronously when it exports); "not mine" forces a refresh
+        before redirecting, so an importer serves as soon as the map
+        commits."""
+        path = self._norm(path)
+
+        def owner_in(m):
+            best, best_len = None, -1
+            for prefix, rank in m.items():
+                p = prefix.rstrip("/") or "/"
+                if (path == p or path.startswith(p + "/") or
+                        p == "/") and len(p) > best_len:
+                    best, best_len = rank, len(p)
+            return best
+
+        owner = owner_in(self._load_subtrees())
+        if owner != self.rank:
+            owner = owner_in(self._load_subtrees(force=True))
+        return owner
+
+    def _fs_mds_map(self, force: bool = False) -> dict:
+        now = time.time()
+        if not force and self._fsmap_cache is not None and \
+                now - self._fsmap_cache[0] < 2.0:
+            return self._fsmap_cache[1]
+        try:
+            _r, out = self.client.mon_command({"prefix": "fs dump"})
+            m = out["filesystems"].get(self.fs_name, {}).get("mds", {})
+        except Exception:  # noqa: BLE001 - mon electing
+            m = (self._fsmap_cache or (0, {}))[1]
+        self._fsmap_cache = (now, m)
+        return m
+
+    def _mds_addr(self, rank: str,
+                  force: bool = False) -> tuple | None:
+        ent = self._fs_mds_map(force).get(rank)
+        if ent and ent.get("addr"):
+            return tuple(ent["addr"])
+        return None
+
+    def _peer_alive(self, rank: str, addr: tuple) -> bool:
+        import socket
+        now = time.time()
+        hit = self._probe_cache.get(rank)
+        if hit is not None and now - hit[0] < 2.0:
+            return hit[1]
+        try:
+            with socket.create_connection(tuple(addr), timeout=0.5):
+                alive = True
+        except OSError:
+            alive = False
+        self._probe_cache[rank] = (now, alive)
+        return alive
+
+    def _authority_gate(self, path: str,
+                        allow_foreign: bool = False) -> str | None:
+        owner = self._authority(path)
+        if owner == self.rank or owner is None:
+            return None
+        if allow_foreign:
+            return owner
+        addr = self._mds_addr(owner) or self._mds_addr(owner,
+                                                       force=True)
+        if addr is not None and self._peer_alive(owner, addr):
+            raise _Redirect(owner, addr)
+        # recorded owner is dead or unknown: adopt its subtrees and
+        # serve (auto-failover; the reference drives this from mon
+        # beacons + standby promotion — the probe+takeover form is the
+        # reduced single-host equivalent, split-brain caveat documented
+        # in _handle_takeover)
+        self._handle_takeover({"rank": owner, "force": True})
+
+    def _frozen_gate(self, path: str) -> None:
+        path = self._norm(path)
+        for fz in self._frozen:
+            if path == fz or path.startswith(fz + "/") or fz == "/":
+                raise _Err(errno.EAGAIN, f"subtree {fz} migrating")
+
+    def _subtree_inos(self, dino: int) -> list[int]:
+        out = [dino]
+        for name, ent in self._dlist(dino):
+            if name.startswith("@"):
+                continue
+            if ent.get("mode", 0) & S_IFDIR:
+                out.extend(self._subtree_inos(ent["ino"]))
+            else:
+                out.append(ent["ino"])
+        return out
+
+    def _handle_export_dir(self, a: dict) -> dict:
+        """Migrate authority over a subtree to another rank (reference
+        Migrator::export_dir, collapsed to an authority hand-off —
+        see the module docstring).  `hold_s` is a test hook that holds
+        the freeze window open."""
+        path = self._norm(a["path"])
+        to = a["to"]
+        if self._authority(path) != self.rank:
+            raise _Err(errno.EINVAL, f"{path} not owned by this rank")
+        if to != self.rank and self._mds_addr(to, force=True) is None:
+            raise _Err(errno.ENOENT, f"no such mds {to!r}")
+        _, ent = self._resolve(path)
+        if not ent["mode"] & S_IFDIR:
+            raise _Err(errno.ENOTDIR, path)
+        ev = {"op": "export", "path": path, "to": to}
+        seq = self.mdlog.append(ev)
+        self._frozen = self._frozen | {path}
+        # drain: ops admitted BEFORE the freeze may still be mutating
+        # the subtree; the map must not commit under their feet
+        # (reference Migrator waits for in-flight requests)
+        deadline = time.time() + 10.0
+        while self._inflight > 0 and time.time() < deadline:
+            time.sleep(0.02)
+        try:
+            # cap migration, reduced: flush + revoke every cap under
+            # the subtree so dirty state reaches the shared pool before
+            # authority moves; clients re-open against the new owner
+            # on their next op (via redirect)
+            for ino in self._subtree_inos(ent["ino"]):
+                with self._cap_lock:
+                    holders = list(self._caps.get(ino, {}))
+                for sess in holders:
+                    with self._cap_lock:
+                        self._cap_seq += 1
+                        seq_r = self._cap_seq
+                    self._revoke(sess, ino, "", seq_r)
+            if a.get("hold_s"):
+                time.sleep(float(a["hold_s"]))
+            # THE commit point: one atomic map update
+            self.meta.execute(SUBTREE_OBJ, "rgw", "dir_add", json.dumps(
+                {"key": path, "meta": {"rank": to}}).encode())
+            self._subtree_cache = None
+        finally:
+            self._frozen = self._frozen - {path}
+        self.mdlog.mark_done(seq)
+        return {"exported": path, "to": to}
+
+    def _handle_takeover(self, a: dict) -> dict:
+        """Adopt a dead peer's subtrees + replay its pending mdlog
+        intents (rank failover; reference standby takeover +
+        MDCache::resolve).  force=True skips the liveness probe — the
+        probe guards the common case, but a partitioned-yet-alive peer
+        could still be usurped (the reference closes this with mon
+        fencing/blacklist; documented reduction)."""
+        peer = a["rank"]
+        if peer == self.rank:
+            raise _Err(errno.EINVAL, "cannot take over self")
+        addr = self._mds_addr(peer, force=True)
+        if addr is not None and not a.get("force"):
+            import socket
+            try:
+                with socket.create_connection(addr, timeout=1.0):
+                    raise _Err(errno.EBUSY, f"mds {peer} is alive")
+            except OSError:
+                pass              # unreachable: proceed
+        from .mdlog import MDLog
+        with self._takeover_lock:
+            peer_log = MDLog(self.meta, rank=peer)
+            replayed = 0
+            for seq, ev in peer_log.pending():
+                self._apply_event(ev)
+                peer_log.mark_done(seq)
+                replayed += 1
+            adopted = []
+            for prefix, owner in self._load_subtrees(
+                    force=True).items():
+                if owner == peer:
+                    self.meta.execute(
+                        SUBTREE_OBJ, "rgw", "dir_add", json.dumps(
+                            {"key": prefix,
+                             "meta": {"rank": self.rank}}).encode())
+                    adopted.append(prefix)
+            self._subtree_cache = None
+            return {"adopted": adopted, "replayed": replayed}
+
+    # -- MDS-to-MDS slave requests (reference Server slave ops /
+    #    Migrator peer messages, reduced) ------------------------------------
+
+    def _peer_request(self, rank: str, op: str, args: dict,
+                      timeout: float = 10.0) -> dict:
+        addr = self._mds_addr(rank) or self._mds_addr(rank, force=True)
+        if addr is None:
+            raise _Err(errno.EIO, f"peer mds {rank} unknown")
+        conn = self.messenger.connect(tuple(addr))
+        with self._inflight_lock:
+            self._peer_tid += 1
+            tid = self._peer_tid
+            w = {"event": threading.Event(), "reply": None}
+            self._peer_waiters[tid] = w
+        conn.send_message(M.MClientRequest(op, args, tid))
+        if not w["event"].wait(timeout):
+            with self._inflight_lock:
+                self._peer_waiters.pop(tid, None)
+            raise _Err(errno.EIO, f"peer mds {rank} timed out")
+        r = w["reply"]
+        if r.result != 0:
+            raise _Err(-r.result, f"peer {op}: {r.out.get('error')}")
+        return r.out
+
+    def _handle_peer_drm(self, a: dict) -> dict:
+        """Slave half of a cross-rank rename: remove a dentry from a
+        dirfrag THIS rank owns, on behalf of the dst owner.  Guarded by
+        the expected ino so a racing local mutation is never clobbered
+        (reference rmdir/rename witness ops)."""
+        dino, name = a["dino"], a["name"]
+        with self._dir_lock(dino):
+            cur = self._dget(dino, name)
+            if cur is not None and cur["ino"] == a["ino"]:
+                self._drm(dino, name)
+        return {}
+
+    def _rename_cross(self, a: dict, src_owner: str) -> dict:
+        """Cross-rank rename: this rank owns dst; the src dentry is
+        removed THROUGH its owner.  The intent is journaled here, so a
+        crash between the local link and the peer removal replays to
+        completion — never a doubled entry that stays."""
+        sdino, sname = self._split(a["src"])
+        ddino, dname = self._split(a["dst"])
+        with self._dir_lock(ddino):
+            ent = self._dget(sdino, sname)   # read-only peek is safe
+            if ent is None:
+                raise _Err(errno.ENOENT, a["src"])
+            existing = self._dget(ddino, dname)
+            replaced = None
+            if existing is not None:
+                if existing["mode"] & S_IFDIR:
+                    raise _Err(errno.EISDIR, a["dst"])
+                if existing["ino"] != ent["ino"]:
+                    replaced = existing
+            ev = {"op": "rename_cross", "sdino": sdino, "sname": sname,
+                  "ddino": ddino, "dname": dname, "ent": ent,
+                  "replaced": replaced, "src_owner": src_owner}
+            seq = self.mdlog.append(ev)
+            self._dset(ddino, dname, ent)
+            # if the peer call fails the intent stays pending and the
+            # removal completes on replay/takeover
+            self._peer_request(src_owner, "peer_drm", {
+                "dino": sdino, "name": sname, "ino": ent["ino"]})
+        if replaced is not None:
+            self._purge_data(replaced)
+        self.mdlog.mark_done(seq)
+        return {}
+
     # -- path walking (reference Server::rdlock_path_pin_ref) ---------------
 
     def _resolve(self, path: str) -> tuple[int, dict]:
@@ -240,11 +544,24 @@ class MDSDaemon:
     # -- dispatch ------------------------------------------------------------
 
     def _dispatch(self, conn, msg) -> None:
+        if isinstance(msg, M.MClientReply):
+            # reply to one of OUR slave requests to a peer MDS
+            with self._inflight_lock:
+                w = self._peer_waiters.pop(msg.tid, None)
+            if w is not None:
+                w["reply"] = msg
+                w["event"].set()
+            return
         if not isinstance(msg, M.MClientRequest):
             return
         try:
             out = self._handle(msg.op, msg.args, conn)
             conn.send_message(M.MClientReply(msg.tid, 0, out))
+        except _Redirect as r:
+            conn.send_message(M.MClientReply(
+                msg.tid, -errno.ESTALE,
+                {"redirect_rank": r.rank,
+                 "redirect_addr": list(r.addr)}))
         except _Err as e:
             conn.send_message(M.MClientReply(msg.tid, -e.errno,
                                              {"error": str(e)}))
@@ -255,7 +572,47 @@ class MDSDaemon:
             conn.send_message(M.MClientReply(
                 msg.tid, -errno.EIO, {"error": repr(e)}))
 
+    PATH_OPS = frozenset({
+        "open", "stat", "mkdir", "create", "readdir", "setattr",
+        "unlink", "rmdir", "snap_create", "snap_rm", "snap_list",
+        "snap_resolve", "export_dir"})
+
     def _handle(self, op: str, a: dict, conn=None) -> dict:
+        if op in self.PATH_OPS or op == "rename":
+            # subtree authority first (redirect to the owner), then the
+            # migration freeze (EAGAIN: retry until authority settles).
+            # Rename gates BOTH paths; a foreign SRC does not redirect —
+            # the dst owner executes and removes the foreign dentry
+            # through the src owner (peer_drm), so no rank ever mutates
+            # a dirfrag it does not own.
+            paths = ([a["dst"], a["src"]] if op == "rename"
+                     else [a["path"]])
+            for p in paths:
+                self._authority_gate(p, allow_foreign=(
+                    op == "rename" and p == a.get("src")))
+                self._frozen_gate(p)
+            self.ops_served += 1
+            if op == "export_dir":      # the drainer itself is not
+                return self._handle_gated(op, a, conn)   # counted
+            with self._inflight_lock:
+                self._inflight += 1
+            try:
+                return self._handle_gated(op, a, conn)
+            finally:
+                with self._inflight_lock:
+                    self._inflight -= 1
+        return self._handle_gated(op, a, conn)
+
+    def _handle_gated(self, op: str, a: dict, conn=None) -> dict:
+        if op == "export_dir":
+            # gated above: only the subtree's owner reaches here
+            return self._handle_export_dir(a)
+        if op == "peer_drm":
+            return self._handle_peer_drm(a)
+        if op == "mds_takeover":
+            return self._handle_takeover(a)
+        if op == "subtree_map":
+            return {"map": self._load_subtrees(force=True)}
         if op == "mount":
             sess = a.get("client")
             if sess:
@@ -398,6 +755,9 @@ class MDSDaemon:
                 return {}
             raise _Err(errno.EAGAIN, a["path"])
         if op == "rename":
+            src_owner = self._authority(a["src"])
+            if src_owner not in (None, self.rank):
+                return self._rename_cross(a, src_owner)
             sdino, sname = self._split(a["src"])
             ddino, dname = self._split(a["dst"])
             if (sdino, sname) == (ddino, dname):
@@ -758,6 +1118,28 @@ class MDSDaemon:
                 self._drm(ev["sdino"], ev["sname"])
             if ev.get("replaced"):
                 self._purge_data(ev["replaced"])
+        elif op == "export":
+            # the subtree-map write is the commit point: if it landed,
+            # the export completed; if not, authority never moved and
+            # there is nothing to roll back (the freeze dies with the
+            # crashed process).  Either way the intent just retires.
+            pass
+        elif op == "rename_cross":
+            dst = self._dget(ev["ddino"], ev["dname"])
+            if dst is None or dst["ino"] != ev["ent"]["ino"]:
+                self._dset(ev["ddino"], ev["dname"], ev["ent"])
+            # finish the foreign-side removal: directly if we own the
+            # src dirfrag by now (takeover), else through its owner
+            cur = self._dget(ev["sdino"], ev["sname"])
+            if cur is not None and cur["ino"] == ev["ent"]["ino"]:
+                try:
+                    self._peer_request(ev["src_owner"], "peer_drm", {
+                        "dino": ev["sdino"], "name": ev["sname"],
+                        "ino": ev["ent"]["ino"]})
+                except _Err:
+                    self._drm(ev["sdino"], ev["sname"])
+            if ev.get("replaced"):
+                self._purge_data(ev["replaced"])
 
     def _replay_mdlog(self) -> None:
         for seq, ev in self.mdlog.pending():
@@ -808,6 +1190,16 @@ class MDSDaemon:
                 self.data.remove(data_oid(ent["ino"], b))
             except RadosError:
                 pass
+
+
+class _Redirect(Exception):
+    """This rank is not the path's authority: bounce the client to
+    the owner (reference MDS forward / auth hints)."""
+
+    def __init__(self, rank: str, addr: tuple):
+        super().__init__(f"redirect to mds {rank} at {addr}")
+        self.rank = rank
+        self.addr = addr
 
 
 class _Err(Exception):

@@ -685,6 +685,16 @@ class Monitor:
                        sorted(self.fsmap["filesystems"])}
         if prefix == "fs dump":
             return 0, copy.deepcopy(self.fsmap)
+        if prefix == "fs set max_mds":
+            name = cmd["name"]
+            with self.lock:
+                if name not in self.fsmap["filesystems"]:
+                    return -errno.ENOENT, {"error": f"no fs {name}"}
+                self.fsmap["filesystems"][name]["max_mds"] = \
+                    int(cmd["max_mds"])
+                self.fsmap["epoch"] += 1
+                self._propose_current()
+            return 0, {"max_mds": int(cmd["max_mds"])}
         if prefix == "mds boot":
             mds_name = cmd["name"]
             fs_name = cmd.get("fs")
@@ -694,16 +704,18 @@ class Monitor:
                     fs_name = next(iter(fss))
                 if fs_name not in fss:
                     return -errno.ENOENT, {"error": f"no fs {fs_name}"}
-                # active iff no OTHER active exists: a restarting sole
-                # MDS keeps (re-takes) active; a new MDS joining a
-                # filesystem with a live active becomes standby
-                others_active = any(
-                    e["state"] == "active"
-                    for n, e in fss[fs_name]["mds"].items()
-                    if n != mds_name)
+                # active while the fs has active slots (max_mds,
+                # reference FSMap promotion); a restarting MDS re-takes
+                # its slot, extra MDSes become standby
+                max_mds = int(fss[fs_name].get("max_mds", 1))
+                others_active = sum(
+                    1 for n, e in fss[fs_name]["mds"].items()
+                    if n != mds_name and e["state"] == "active")
+                state = "active" if others_active < max_mds \
+                    else "standby"
                 fss[fs_name]["mds"][mds_name] = {
                     "addr": list(cmd.get("addr") or ()),
-                    "state": "standby" if others_active else "active"}
+                    "state": state}
                 self.fsmap["epoch"] += 1
                 self._propose_current()
             return 0, {"fs": fs_name,

@@ -109,10 +109,13 @@ class RGWStore:
 
     def _modlog(self, op: str, bucket: str,
                 key: str | None = None) -> None:
-        """WRITE-AHEAD: call sites log BEFORE mutating, so a crash
-        between log and mutation reconciles to a no-op, while a
-        mutation-then-crash-before-log would silently diverge the
-        zones forever."""
+        """Mutations log TWICE: once after validation/before mutating
+        (write-ahead: a crash between log and mutation reconciles to a
+        no-op, while mutate-then-crash-before-log would diverge the
+        zones forever) and once after success (a replayer that consumed
+        the write-ahead entry BEFORE the mutation landed would
+        otherwise commit past it and never see the final state).
+        Failed ops log nothing.  The replayer coalesces duplicates."""
         if not self.modlog_enabled:
             return
         entry = {"op": op, "bucket": bucket, "ts": time.time()}
@@ -136,6 +139,7 @@ class RGWStore:
         self._cls(self.meta, BUCKETS_OBJ, "dir_add", {
             "key": bucket, "meta": meta})
         self._cls(self.meta, f"index.{bucket}", "dir_init")
+        self._modlog("sync_bucket", bucket)     # post-success
 
     def set_bucket_acl(self, bucket: str, acl: str) -> None:
         with self._bmeta_lock:
@@ -146,6 +150,7 @@ class RGWStore:
             self._modlog("sync_bucket", bucket)
             self._cls(self.meta, BUCKETS_OBJ, "dir_add", {
                 "key": bucket, "meta": meta})
+            self._modlog("sync_bucket", bucket)  # post-success
 
     def set_bucket_policy(self, bucket: str, policy: dict | None) -> None:
         """Attach (or with None, detach) a validated policy document to
@@ -162,6 +167,7 @@ class RGWStore:
             self._modlog("sync_bucket", bucket)
             self._cls(self.meta, BUCKETS_OBJ, "dir_add", {
                 "key": bucket, "meta": meta})
+            self._modlog("sync_bucket", bucket)  # post-success
 
     def get_bucket_policy(self, bucket: str) -> dict | None:
         meta = self._bucket_meta(bucket)
@@ -177,6 +183,7 @@ class RGWStore:
         self._modlog("sync", bucket, key)
         self._cls(self.meta, f"index.{bucket}", "dir_add", {
             "key": key, "meta": cur})
+        self._modlog("sync", bucket, key)       # post-success
 
     # -- lifecycle (reference rgw_lc.h: per-bucket rules evaluated by
     #    a background worker) ----------------------------------------------
@@ -199,6 +206,7 @@ class RGWStore:
             self._modlog("sync_bucket", bucket)
             self._cls(self.meta, BUCKETS_OBJ, "dir_add", {
                 "key": bucket, "meta": meta})
+            self._modlog("sync_bucket", bucket)  # post-success
 
     def get_lifecycle(self, bucket: str) -> list[dict]:
         meta = self._bucket_meta(bucket)
@@ -215,6 +223,7 @@ class RGWStore:
             self._modlog("sync_bucket", bucket)
             self._cls(self.meta, BUCKETS_OBJ, "dir_add", {
                 "key": bucket, "meta": meta})
+            self._modlog("sync_bucket", bucket)  # post-success
 
     def lifecycle_sweep(self, now: float | None = None) -> dict:
         """One pass over every bucket with lifecycle rules (the
@@ -319,6 +328,7 @@ class RGWStore:
                 self.meta.remove(obj)
             except RadosError:
                 pass
+        self._modlog("sync_bucket", bucket)     # post-success
 
     def list_buckets(self) -> list[tuple[str, dict]]:
         out = json.loads(self._cls(self.meta, BUCKETS_OBJ, "dir_list",
@@ -357,6 +367,7 @@ class RGWStore:
             self._modlog("sync_bucket", bucket)
             self._cls(self.meta, BUCKETS_OBJ, "dir_add", {
                 "key": bucket, "meta": meta})
+            self._modlog("sync_bucket", bucket)  # post-success
 
     def get_versioning(self, bucket: str) -> str:
         meta = self._bucket_meta(bucket)
@@ -460,6 +471,7 @@ class RGWStore:
             self._archive_version(bucket, key, meta, vid)
             self._cls(self.meta, f"index.{bucket}", "dir_add", {
                 "key": key, "meta": {**meta, "version_id": vid}})
+            self._modlog("sync", bucket, key)   # post-success
             return etag
         suspended = bool(bmeta.get("versioning"))   # "" = never versioned
         reap = self._displaced_manifests(bucket, key, suspended)
@@ -475,6 +487,7 @@ class RGWStore:
                                   {**meta, "null_data": True}, "null")
         for m in reap:
             self._reap_manifest(bucket, m)
+        self._modlog("sync", bucket, key)       # post-success
         return etag
 
     def get_object_version(self, bucket: str, key: str,
@@ -565,6 +578,7 @@ class RGWStore:
                               {"key": key})
                 except RadosError as e:
                     self._not_found(e)
+        self._modlog("sync", bucket, key)       # post-success
 
     def _version_row(self, bucket: str, key: str,
                      version_id: str) -> dict | None:
@@ -645,6 +659,13 @@ class RGWStore:
         bmeta = self._bucket_meta(bucket)
         if bmeta is None:
             raise RGWError(404, "NoSuchBucket", bucket)
+        suspended_or_versioned = bool(bmeta.get("versioning"))
+        if not suspended_or_versioned and \
+                self._current_meta(bucket, key) is None:
+            # validate BEFORE logging: a failed op must not feed the
+            # mod-log (active-active agents would ping-pong spurious
+            # entries forever)
+            raise RGWError(404, "NoSuchKey", key)
         self._modlog("sync", bucket, key)
         if bmeta.get("versioning") == "Enabled":
             # versioned delete = insert a delete marker as the new
@@ -659,6 +680,7 @@ class RGWStore:
                           {"key": key})
             except RadosError as e:
                 self._not_found(e)
+            self._modlog("sync", bucket, key)   # post-success
             return
         suspended = bool(bmeta.get("versioning"))
         reap = self._displaced_manifests(bucket, key, suspended)
@@ -681,6 +703,7 @@ class RGWStore:
             self.data.remove(_data_oid(bucket, key))
         except RadosError:
             pass
+        self._modlog("sync", bucket, key)       # post-success
 
     def copy_object(self, src_bucket: str, src_key: str,
                     dst_bucket: str, dst_key: str,
@@ -763,7 +786,6 @@ class RGWStore:
         manifest index entry, reaps the upload bookkeeping.  The
         combined ETag is md5-of-binary-part-md5s + "-N" (S3
         convention)."""
-        self._modlog("sync", bucket, key)
         self._require_upload(bucket, key, upload_id)
         if not parts:
             raise RGWError(400, "MalformedXML", "no parts listed")
@@ -785,6 +807,7 @@ class RGWStore:
             md5cat += bytes.fromhex(meta["etag"])
             manifest.append([num, meta["size"]])
             total += meta["size"]
+        self._modlog("sync", bucket, key)   # validated: will mutate
         etag = f"{hashlib.md5(md5cat).hexdigest()}-{len(parts)}"
         obj_meta = {"size": total, "etag": etag, "mtime": time.time(),
                     "multipart": {"upload_id": upload_id,
@@ -822,6 +845,7 @@ class RGWStore:
                 except RadosError:
                     pass
         self._rm_upload_bookkeeping(bucket, key, upload_id)
+        self._modlog("sync", bucket, key)   # post-success (see _modlog)
         return etag
 
     def abort_multipart(self, bucket: str, key: str,

@@ -102,15 +102,18 @@ class ZoneReplayer:
         n = 0
         for bucket, _meta in self.src.list_buckets():
             self._sync_bucket(bucket)
-            marker = ""
+            resume = ""
             while True:
-                entries, _cps, truncated, marker = \
-                    self.src.list_objects(bucket, "", marker, 1000,
-                                          "", "")
+                # the returned resume point is an INCLUSIVE token for
+                # the `resume` parameter (not the exclusive marker) —
+                # feeding it to marker would skip a key equal to it
+                entries, _cps, truncated, resume = \
+                    self.src.list_objects(bucket, "", "", 1000,
+                                          "", resume)
                 for key, _m in entries:
                     self._sync_object(bucket, key)
                     n += 1
-                if not truncated or not marker:
+                if not truncated or not resume:
                     break
         return n
 
