@@ -49,6 +49,7 @@ class CephFS:
         self.messenger.add_dispatcher(self._dispatch)
         self.mds_conn = self.messenger.connect(tuple(mds_addr))
         self._mds_conns: dict[tuple, object] = {}   # other ranks
+        self._route_cache: dict[str, tuple] = {}    # path -> owner addr
         self._lock = threading.Lock()
         self._tid = 0
         self._waiters: dict[int, dict] = {}
@@ -186,6 +187,18 @@ class CephFS:
         import errno as _e
         conn = self.mds_conn
         cur_addr = None                  # non-None = redirected conn
+        # last-known-owner cache: ops under an exported subtree go
+        # straight to the owning rank instead of paying a permanent
+        # ESTALE redirect hop through the primary every time
+        route_key = args.get("path") or args.get("dst")
+        cached = self._route_cache.get(route_key) \
+            if route_key else None
+        if cached is not None:
+            try:
+                conn = self._conn_for(cached)
+                cur_addr = cached
+            except FSError:
+                self._route_cache.pop(route_key, None)
         redirects = 0
         deadline = time.time() + timeout
         while True:
@@ -200,16 +213,24 @@ class CephFS:
                     # surviving rank auto-takes-over dead subtrees)
                     with self._lock:
                         self._mds_conns.pop(cur_addr, None)
+                    if route_key:
+                        self._route_cache.pop(route_key, None)
                     conn, cur_addr = self.mds_conn, None
                     continue
                 raise
             if reply.result == 0:
+                if route_key and cur_addr is not None:
+                    if len(self._route_cache) > 4096:
+                        self._route_cache.clear()
+                    self._route_cache[route_key] = cur_addr
                 return reply.out
             if reply.result == -_e.ESTALE and \
                     reply.out.get("redirect_addr"):
                 redirects += 1
                 if redirects > 8:
                     raise FSError(_e.ELOOP, f"redirect loop on {op}")
+                if route_key:
+                    self._route_cache.pop(route_key, None)
                 cur_addr = tuple(reply.out["redirect_addr"])
                 conn = self._conn_for(cur_addr)
                 continue

@@ -114,14 +114,9 @@ class MDSDaemon:
         self._snapc_cache: list | None = None
         self._snap_epoch = 0
         self._flush_waiters: dict[tuple, threading.Event] = {}
-        from .mdlog import MDLog
-        # log keyed by MDS name: a restart under the same name replays
-        # its own intents; a concurrently-booted second MDS must NOT
-        # replay (and delete) a live peer's in-flight intents.  A DEAD
-        # peer's log is replayed by whoever runs mds_takeover.
-        self.mdlog = MDLog(self.meta, rank=name)
-        self._replay_mdlog()
-        # multi-MDS state: subtree authority + migration freezes
+        # multi-MDS state: subtree authority + migration freezes —
+        # initialized BEFORE the mdlog replay, whose rename_cross
+        # handler consults the fsmap/peer machinery
         self.rank = name
         # frozen prefixes: an immutable snapshot REPLACED on change, so
         # gate reads never race an in-place mutation from the export
@@ -136,6 +131,13 @@ class MDSDaemon:
         self._peer_tid = 0                   # MDS->MDS slave requests
         self._peer_waiters: dict[int, dict] = {}
         self.ops_served = 0                  # observability (tests)
+        from .mdlog import MDLog
+        # log keyed by MDS name: a restart under the same name replays
+        # its own intents; a concurrently-booted second MDS must NOT
+        # replay (and delete) a live peer's in-flight intents.  A DEAD
+        # peer's log is replayed by whoever runs mds_takeover.
+        self.mdlog = MDLog(self.meta, rank=name)
+        self._replay_mdlog()
         self._bootstrap_subtree_map()
         self.messenger = Messenger("mds", auth=auth, secure=secure)
         self.messenger.add_dispatcher(self._dispatch)
@@ -375,7 +377,8 @@ class MDSDaemon:
             raise _Err(errno.ENOTDIR, path)
         ev = {"op": "export", "path": path, "to": to}
         seq = self.mdlog.append(ev)
-        self._frozen = self._frozen | {path}
+        with self._inflight_lock:        # RMW of the snapshot is
+            self._frozen = self._frozen | {path}   # serialized
         # drain: ops admitted BEFORE the freeze may still be mutating
         # the subtree; the map must not commit under their feet
         # (reference Migrator waits for in-flight requests)
@@ -402,7 +405,8 @@ class MDSDaemon:
                 {"key": path, "meta": {"rank": to}}).encode())
             self._subtree_cache = None
         finally:
-            self._frozen = self._frozen - {path}
+            with self._inflight_lock:
+                self._frozen = self._frozen - {path}
         self.mdlog.mark_done(seq)
         return {"exported": path, "to": to}
 
@@ -587,16 +591,22 @@ class MDSDaemon:
             # a dirfrag it does not own.
             paths = ([a["dst"], a["src"]] if op == "rename"
                      else [a["path"]])
-            for p in paths:
-                self._authority_gate(p, allow_foreign=(
-                    op == "rename" and p == a.get("src")))
-                self._frozen_gate(p)
-            self.ops_served += 1
             if op == "export_dir":      # the drainer itself is not
-                return self._handle_gated(op, a, conn)   # counted
+                self._authority_gate(a["path"])          # counted
+                self._frozen_gate(a["path"])
+                self.ops_served += 1
+                return self._handle_gated(op, a, conn)
+            # register in-flight BEFORE the freeze check: an op that
+            # passed the gate must already be visible to the export
+            # drain loop, or the map could commit under its feet
             with self._inflight_lock:
                 self._inflight += 1
             try:
+                for p in paths:
+                    self._authority_gate(p, allow_foreign=(
+                        op == "rename" and p == a.get("src")))
+                    self._frozen_gate(p)
+                self.ops_served += 1
                 return self._handle_gated(op, a, conn)
             finally:
                 with self._inflight_lock:
@@ -1136,7 +1146,10 @@ class MDSDaemon:
                     self._peer_request(ev["src_owner"], "peer_drm", {
                         "dino": ev["sdino"], "name": ev["sname"],
                         "ino": ev["ent"]["ino"]})
-                except _Err:
+                except (_Err, AttributeError):
+                    # peer dead/unknown, or boot-time replay before the
+                    # messenger exists: complete the ino-guarded
+                    # removal directly (idempotent)
                     self._drm(ev["sdino"], ev["sname"])
             if ev.get("replaced"):
                 self._purge_data(ev["replaced"])

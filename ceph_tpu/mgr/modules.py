@@ -52,9 +52,14 @@ class HealthModule(MgrModule):
 
 
 class BalancerModule(MgrModule):
-    """Even the PG->OSD distribution with pg_temp overrides (the upmap
-    balancer role).  Greedy: move one PG at a time from the most- to
-    the least-loaded OSD until the spread is within threshold."""
+    """Even the PG->OSD distribution with pg_upmap_items (the upmap
+    balancer; reference pybind/mgr/balancer upmap mode over
+    OSDMap::calc_pg_upmaps).  Greedy: substitute one device at a time
+    on the most-loaded OSD's PGs toward the least-loaded OSD until the
+    spread is within threshold.  Upmap items override the RAW crush
+    result per PG, so they compose with CRUSH and survive remaps of
+    unrelated devices — unlike the pg_temp acting-set override, which
+    stays the peering/backfill lever."""
 
     name = "balancer"
     run_interval = 2.0
@@ -66,52 +71,65 @@ class BalancerModule(MgrModule):
         self.active = True
         self.moves = 0
 
-    def compute_moves(self) -> list[tuple[pg_t, list[int]]]:
+    def compute_moves(self) -> list[tuple[pg_t, list[tuple[int, int]]]]:
+        """-> [(pgid, upmap pairs for that pg)] — the calc_pg_upmaps
+        role."""
         m = self.get_osdmap()
         up_osds = [o.id for o in m.osds.values() if o.up and o.in_]
         if len(up_osds) < 2:
             return []
         load: dict[int, int] = {o: 0 for o in up_osds}
+        # positional raw+upmap lists (NOT the compacted up set: zip
+        # alignment with the raw crush result must hold even when a
+        # raw-set OSD is down)
         placement: dict[pg_t, list[int]] = {}
         for pool in m.pools.values():
             for seed in range(pool.pg_num):
                 pgid = pg_t(pool.id, seed)
                 try:
-                    _, acting, _, _ = m.pg_to_up_acting_osds(pgid)
+                    cur = m.pg_to_raw_upmap_osds(pgid)
                 except Exception:  # noqa: BLE001
                     continue
-                placement[pgid] = list(acting)
-                for o in acting:
+                placement[pgid] = list(cur)
+                for o in cur:
                     if o in load:
                         load[o] += 1
-        moves: list[tuple[pg_t, list[int]]] = []
+        touched: set[pg_t] = set()
         for _ in range(self.max_moves_per_tick):
             hot = max(load, key=load.get)
             cold = min(load, key=load.get)
             if load[hot] - load[cold] <= self.threshold:
                 break
-            # one PG on `hot` whose acting set lacks `cold`
-            for pgid, acting in placement.items():
-                if hot in acting and cold not in acting:
-                    new_acting = [cold if o == hot else o
-                                  for o in acting]
-                    moves.append((pgid, new_acting))
-                    placement[pgid] = new_acting
+            # one PG mapped onto `hot` whose up set lacks `cold`
+            for pgid, up in placement.items():
+                if hot in up and cold not in up:
+                    placement[pgid] = [cold if o == hot else o
+                                       for o in up]
+                    touched.add(pgid)
                     load[hot] -= 1
                     load[cold] += 1
                     break
             else:
                 break
-        return moves
+        # emit each touched PG's items as the POSITIONAL diff of the
+        # raw crush result vs the desired placement — a simultaneous
+        # substitution map with no chains (how calc_pg_upmaps emits)
+        out = []
+        for pgid in touched:
+            raw = m.pg_to_raw_osds(pgid)
+            pairs = sorted((o, d) for o, d in
+                           zip(raw, placement[pgid]) if o != d)
+            out.append((pgid, pairs))
+        return out
 
     def tick(self) -> None:
         if not self.active:
             return
-        for pgid, acting in self.compute_moves():
+        for pgid, pairs in self.compute_moves():
             r, _ = self.mon_command({
-                "prefix": "osd pg-temp",
+                "prefix": "osd pg-upmap-items",
                 "pgid": [pgid.pool, pgid.seed],
-                "osds": acting})
+                "pairs": [list(p) for p in pairs]})
             if r == 0:
                 self.moves += 1
 

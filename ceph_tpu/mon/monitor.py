@@ -532,6 +532,35 @@ class Monitor:
                     self.osdmap.bump_epoch()
                     self._propose_current()
                 return 0, {"pg_temp": [str(pgid), osds]}
+            if prefix == "osd pg-upmap-items":
+                # fine-grained mapping override (reference OSDMonitor
+                # osd pg-upmap-items; consumed by the balancer)
+                pgid = pg_t(*cmd["pgid"])
+                pairs = [tuple(int(x) for x in p)
+                         for p in cmd["pairs"]]
+                with self.lock:
+                    if pgid.pool not in self.osdmap.pools:
+                        return -errno.ENOENT, {
+                            "error": f"no pool {pgid.pool}"}
+                    bad = [p for p in pairs
+                           if p[1] not in self.osdmap.osds]
+                    if bad:
+                        return -errno.ENOENT, {
+                            "error": f"unknown target osds {bad}"}
+                    if pairs:
+                        self.osdmap.pg_upmap_items[pgid] = pairs
+                    else:
+                        self.osdmap.pg_upmap_items.pop(pgid, None)
+                    self.osdmap.bump_epoch()
+                    self._propose_current()
+                return 0, {"pg_upmap_items": [str(pgid), pairs]}
+            if prefix == "osd rm-pg-upmap-items":
+                pgid = pg_t(*cmd["pgid"])
+                with self.lock:
+                    self.osdmap.pg_upmap_items.pop(pgid, None)
+                    self.osdmap.bump_epoch()
+                    self._propose_current()
+                return 0, {"removed": str(pgid)}
             if prefix == "osd pool selfmanaged-snap-create":
                 # allocate one snap id (reference OSDMonitor
                 # prepare_pool_op SELFMANAGED_SNAP_CREATE)

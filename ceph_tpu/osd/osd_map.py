@@ -61,6 +61,12 @@ class OSDMap:
         self.pool_ids_by_name: dict[str, int] = {}
         self.crush = CrushWrapper()
         self.pg_temp: dict[pg_t, list[int]] = {}
+        # fine-grained balancer overrides (reference pg_upmap_items,
+        # OSDMap.h): per-PG [from, to] device substitutions applied to
+        # the RAW crush result — unlike pg_temp (a whole acting-set
+        # override for peering/backfill), upmap items survive remaps of
+        # unrelated devices and compose with CRUSH
+        self.pg_upmap_items: dict[pg_t, list[tuple[int, int]]] = {}
         self.ec_profiles: dict[str, dict[str, str]] = {}
         # client fencing (reference OSDMap blacklist, consumed by
         # ManagedLock): messenger entity -> expiry unix time.  OSDs
@@ -107,13 +113,27 @@ class OSDMap:
         return self.crush.do_rule(pool.crush_rule, x, pool.size,
                                   weight_of=self._weight_of())
 
+    def pg_to_raw_upmap_osds(self, pgid: pg_t) -> list[int]:
+        """Raw crush result with pg_upmap_items applied, BEFORE any
+        up/down filtering — the positional list the balancer diffs
+        against (reference _pg_to_raw_osds + _apply_upmap)."""
+        raw = self.pg_to_raw_osds(pgid)
+        pairs = self.pg_upmap_items.get(pgid)
+        if pairs:
+            mapping = dict(pairs)
+            cand = [mapping.get(d, d) for d in raw]
+            live = [d for d in cand if d != CRUSH_ITEM_NONE]
+            if len(set(live)) == len(live):
+                raw = cand
+        return raw
+
     def pg_to_up_acting_osds(self, pgid: pg_t
                              ) -> tuple[list[int], list[int], int, int]:
         """(up, acting, up_primary, acting_primary) — reference
         OSDMap.cc:2627.  EC pools keep positional NONE holes; replicated
         pools compact them out."""
         pool = self.pools[pgid.pool]
-        raw = self.pg_to_raw_osds(pgid)
+        raw = self.pg_to_raw_upmap_osds(pgid)
         if pool.is_erasure():
             up = [d if d != CRUSH_ITEM_NONE and self.is_up(d)
                   else CRUSH_ITEM_NONE for d in raw]
@@ -191,6 +211,9 @@ class OSDMap:
                       for p in self.pools.values()],
             "pg_temp": [[pg.pool, pg.seed, osds]
                         for pg, osds in self.pg_temp.items()],
+            "pg_upmap_items": [
+                [pg.pool, pg.seed, [list(p) for p in pairs]]
+                for pg, pairs in self.pg_upmap_items.items()],
             "ec_profiles": self.ec_profiles,
             "blacklist": self.blacklist,
             "crush": {
@@ -226,6 +249,9 @@ class OSDMap:
             m.pool_ids_by_name[name] = pid
         for pool, seed, osds in j.get("pg_temp", []):
             m.pg_temp[pg_t(pool, seed)] = osds
+        for pool, seed, pairs in j.get("pg_upmap_items", []):
+            m.pg_upmap_items[pg_t(pool, seed)] = \
+                [tuple(p) for p in pairs]
         m.ec_profiles = dict(j.get("ec_profiles", {}))
         m.blacklist = dict(j.get("blacklist", {}))
         cj = j["crush"]

@@ -1,0 +1,167 @@
+"""CRUSH text compiler/decompiler + tester (reference
+CrushCompiler.cc / CrushTester.cc roles) and pg-upmap-items placement
+overrides (reference OSDMap::_apply_upmap / calc_pg_upmaps)."""
+
+import pytest
+
+from ceph_tpu.crush.compiler import (CrushCompileError, compile_text,
+                                     decompile)
+from ceph_tpu.crush.compiler import test_rule as crush_test_rule
+
+MAP_TEXT = """
+# devices
+device 0 osd.0
+device 1 osd.1
+device 2 osd.2
+device 3 osd.3
+device 4 osd.4
+device 5 osd.5
+
+# types
+type 0 osd
+type 1 host
+type 11 root
+
+# buckets
+host node0 {
+    id -2
+    alg straw2
+    hash 0
+    item osd.0 weight 1.000
+    item osd.1 weight 1.000
+}
+host node1 {
+    id -3
+    alg straw2
+    item osd.2 weight 1.000
+    item osd.3 weight 1.000
+}
+host node2 {
+    id -4
+    alg straw2
+    item osd.4 weight 1.000
+    item osd.5 weight 2.000
+}
+root default {
+    id -1
+    alg straw2
+    item node0 weight 2.000
+    item node1 weight 2.000
+    item node2 weight 3.000
+}
+
+# rules
+rule replicated_rule {
+    id 0
+    type replicated
+    step take default
+    step chooseleaf firstn 0 type host
+    step emit
+}
+"""
+
+
+def test_compile_basic():
+    compiled = compile_text(MAP_TEXT)
+    cm = compiled.map
+    assert len(cm.devices) == 6
+    assert len(cm.buckets) == 4
+    assert cm.buckets_by_name["default"].weight == 7.0
+    assert 0 in cm.rules
+    out = cm.do_rule(0, 1234, 3)
+    assert len(out) == 3 and len(set(out)) == 3
+
+
+def test_roundtrip_identical_placements():
+    c1 = compile_text(MAP_TEXT)
+    c2 = compile_text(decompile(c1))
+    for x in range(256):
+        assert c1.map.do_rule(0, x, 3) == c2.map.do_rule(0, x, 3)
+
+
+def test_compile_errors_have_line_numbers():
+    for bad, what in [
+        (MAP_TEXT.replace("alg straw2", "alg straw", 1), "alg"),
+        (MAP_TEXT.replace("id -2", "", 1), "missing id"),
+        (MAP_TEXT.replace("item osd.5 weight 2.000",
+                          "item osd.9 weight 2.000"), "unknown item"),
+        (MAP_TEXT.replace("step emit", "step jump", 1), "unknown step"),
+    ]:
+        with pytest.raises(CrushCompileError) as ei:
+            compile_text(bad)
+        assert "line " in str(ei.value), what
+
+
+def test_tester_validates_good_map():
+    compiled = compile_text(MAP_TEXT)
+    res = crush_test_rule(compiled.map, 0, 3, n_inputs=512)
+    assert res["ok"], res["problems"][:3]
+    # weight proportionality: osd.5 (weight 2) gets ~2x osd.4
+    util = res["utilization"]
+    assert util[5] > util[4] * 1.4
+
+
+def test_tester_flags_failure_domain_violation():
+    """A rule choosing OSDs directly can land two replicas on one
+    host — the tester's chooseleaf check must catch a map whose rule
+    claims host-level separation it cannot deliver."""
+    collapsed = """
+device 0 osd.0
+device 1 osd.1
+device 2 osd.2
+type 0 osd
+type 1 host
+type 11 root
+host only {
+    id -2
+    alg straw2
+    item osd.0 weight 1.000
+    item osd.1 weight 1.000
+    item osd.2 weight 1.000
+}
+root default {
+    id -1
+    alg straw2
+    item only weight 3.000
+}
+rule r {
+    id 0
+    type replicated
+    step take default
+    step chooseleaf firstn 0 type host
+    step emit
+}
+"""
+    compiled = compile_text(collapsed)
+    res = crush_test_rule(compiled.map, 0, 3, n_inputs=64)
+    assert not res["ok"]            # 3 replicas cannot span 1 host
+
+
+def test_upmap_items_positional_override():
+    from ceph_tpu.osd.osd_map import OSDMap, PoolType
+    from ceph_tpu.osd.types import pg_t
+    m = OSDMap()
+    for i in range(6):
+        m.add_osd(i, host=f"h{i}")
+        m.set_osd_up(i, ("127.0.0.1", 7800 + i))
+    rule = m.crush.add_simple_rule("r", "default", "host", 3)
+    pool = m.create_pool("up", PoolType.REPLICATED, 3, 8, rule)
+    pgid = pg_t(pool.id, 0)
+    raw = m.pg_to_raw_osds(pgid)
+    outsider = next(o for o in range(6) if o not in raw)
+    m.pg_upmap_items[pgid] = [(raw[0], outsider)]
+    up, acting, _, _ = m.pg_to_up_acting_osds(pgid)
+    assert outsider in up and raw[0] not in up
+    assert up == acting                  # no pg_temp: acting follows
+    # swap chains apply simultaneously (a->b, b->c)
+    m.pg_upmap_items[pgid] = [(raw[0], raw[1]), (raw[1], outsider)]
+    up2, _, _, _ = m.pg_to_up_acting_osds(pgid)
+    assert raw[1] in up2 and outsider in up2 and raw[0] not in up2
+    # a duplicating pair set is ignored wholesale
+    m.pg_upmap_items[pgid] = [(raw[0], raw[1])]
+    up3, _, _, _ = m.pg_to_up_acting_osds(pgid)
+    assert up3 == raw
+    # survives the json round trip
+    m.pg_upmap_items[pgid] = [(raw[0], outsider)]
+    m2 = OSDMap.from_json(m.to_json())
+    assert m2.pg_upmap_items[pgid] == [(raw[0], outsider)]

@@ -56,8 +56,10 @@ def test_health_ok_then_warn_on_osd_down(env):
 
 
 def test_balancer_reduces_spread(env):
-    """The balancer's pg_temp moves must shrink the max-min PG-count
-    gap across OSDs (and the data stays readable afterwards)."""
+    """The balancer's pg-upmap-items must shrink the max-min PG-count
+    gap across OSDs' UP sets (the upmap lever operates on the raw
+    mapping; pg_temp stays the peering override) — and the data stays
+    readable afterwards."""
     c, client, mgr = env
     io = client.open_ioctx("mgp")
     rng = np.random.default_rng(0)
@@ -74,21 +76,27 @@ def test_balancer_reduces_spread(env):
         load = {o.id: 0 for o in m.osds.values() if o.up and o.in_}
         for pool in m.pools.values():
             for seed in range(pool.pg_num):
-                _, acting, _, _ = m.pg_to_up_acting_osds(
+                up, _, _, _ = m.pg_to_up_acting_osds(
                     pg_t(pool.id, seed))
-                for o in acting:
+                for o in up:
                     if o in load:
                         load[o] += 1
         return max(load.values()) - min(load.values())
 
-    # force a skew: pile several PGs onto the same three OSDs
+    # force a skew: upmap several PGs onto the same three OSDs
     from ceph_tpu.osd.types import pg_t
     pool = next(p for p in mgr.osdmap.pools.values()
                 if p.name == "mgp")
     for seed in range(4):
+        pgid = pg_t(pool.id, seed)
+        up, _, _, _ = mgr.osdmap.pg_to_up_acting_osds(pgid)
+        pairs = [[frm, to] for frm, to in zip(up, [0, 1, 2])
+                 if frm != to and to not in up]
+        if not pairs:
+            continue
         r, _ = client.mon_command({
-            "prefix": "osd pg-temp", "pgid": [pool.id, seed],
-            "osds": [0, 1, 2]})
+            "prefix": "osd pg-upmap-items", "pgid": [pool.id, seed],
+            "pairs": pairs})
         assert r == 0
     assert wait_until(lambda: spread() > bal.threshold)
     before = spread()
