@@ -81,7 +81,6 @@ def compile_text(text: str) -> CompiledMap:
     line numbers on malformed input (reference CrushCompiler::compile)."""
     cm = CrushMap()
     types: dict[int, str] = {}
-    type_names: set[str] = set()
     rule_types: dict[int, str] = {}
     dev_by_name: dict[str, int] = {}
     lines = list(_tokens(text))
@@ -116,7 +115,6 @@ def compile_text(text: str) -> CompiledMap:
             if len(t) != 3:
                 err(lineno, "type <id> <name>")
             types[int(t[1])] = t[2]
-            type_names.add(t[2])
             i += 1
         elif t[0] == "tunable":
             i += 1                       # accepted and ignored
@@ -124,7 +122,7 @@ def compile_text(text: str) -> CompiledMap:
             i = _parse_rule(cm, rule_types, lines, i, err,
                             resolve_item)
         elif len(t) >= 2 and t[-1] == "{":
-            i = _parse_bucket(cm, types, type_names, lines, i, err,
+            i = _parse_bucket(cm, types, lines, i, err,
                               resolve_item, dev_by_name)
         else:
             err(lineno, f"unexpected {' '.join(t)!r}")
@@ -138,12 +136,11 @@ def compile_text(text: str) -> CompiledMap:
     return CompiledMap(cm, types or dict(DEFAULT_TYPES), rule_types)
 
 
-def _parse_bucket(cm, types, type_names, lines, i, err, resolve_item,
+def _parse_bucket(cm, types, lines, i, err, resolve_item,
                   dev_by_name):
     lineno, t = lines[i]
     type_name, name = t[0], t[1]
-    if types and type_name not in types.values() and \
-            type_name not in type_names:
+    if types and type_name not in types.values():
         err(lineno, f"unknown bucket type {type_name!r}")
     bid = None
     items: list[tuple[int, float]] = []
@@ -204,7 +201,8 @@ def _parse_rule(cm, rule_types, lines, i, err, resolve_item):
             pass                          # legacy fields: accepted
         elif t[0] == "step":
             if t[1] == "take":
-                steps.append(Step(op="take", item=t[2]))
+                resolve_item(lineno, t[2])   # unknown target: err here,
+                steps.append(Step(op="take", item=t[2]))   # not at map time
             elif t[1] == "emit":
                 steps.append(Step(op="emit"))
             elif t[1] in ("choose", "chooseleaf"):
@@ -344,12 +342,29 @@ def test_rule(cm: CrushMap, rule_id: int, num_rep: int,
         if len(problems) > 16:
             break
     # weight proportionality (loose bound: straw2 converges ~1/sqrt(n))
-    total_w = sum(cm.item_weight(d) or 0.0 for d in cm.devices) if \
-        weight_of is None else sum(weight_of(d) for d in cm.devices)
+    # — over the devices REACHABLE from the rule's take roots only:
+    # declared-but-unbucketed spares must not skew the baseline
+    reachable: set[int] = set()
+
+    def walk(item: int):
+        if item >= 0:
+            reachable.add(item)
+            return
+        for child in cm.buckets[item].items:
+            walk(child)
+
+    for st in rule.steps:
+        if st.op == "take":
+            item = st.item
+            if isinstance(item, str):
+                item = cm.buckets_by_name[item].id
+            walk(item)
+    total_w = sum((cm.item_weight(d) if weight_of is None
+                   else weight_of(d)) or 0.0 for d in reachable)
     expected = {}
     placed = sum(util.values())
     if total_w > 0 and placed:
-        for d in cm.devices:
+        for d in sorted(reachable):
             w = (cm.item_weight(d) if weight_of is None
                  else weight_of(d)) or 0.0
             expected[d] = placed * w / total_w

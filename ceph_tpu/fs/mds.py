@@ -1142,15 +1142,20 @@ class MDSDaemon:
             # src dirfrag by now (takeover), else through its owner
             cur = self._dget(ev["sdino"], ev["sname"])
             if cur is not None and cur["ino"] == ev["ent"]["ino"]:
-                try:
-                    self._peer_request(ev["src_owner"], "peer_drm", {
-                        "dino": ev["sdino"], "name": ev["sname"],
-                        "ino": ev["ent"]["ino"]})
-                except (_Err, AttributeError):
-                    # peer dead/unknown, or boot-time replay before the
-                    # messenger exists: complete the ino-guarded
-                    # removal directly (idempotent)
+                if getattr(self, "messenger", None) is None:
+                    # boot-time replay (messenger not built yet):
+                    # complete the ino-guarded removal directly
                     self._drm(ev["sdino"], ev["sname"])
+                else:
+                    try:
+                        self._peer_request(
+                            ev["src_owner"], "peer_drm", {
+                                "dino": ev["sdino"],
+                                "name": ev["sname"],
+                                "ino": ev["ent"]["ino"]})
+                    except _Err:
+                        # peer dead/unknown: direct removal
+                        self._drm(ev["sdino"], ev["sname"])
             if ev.get("replaced"):
                 self._purge_data(ev["replaced"])
 

@@ -536,8 +536,15 @@ class Monitor:
                 # fine-grained mapping override (reference OSDMonitor
                 # osd pg-upmap-items; consumed by the balancer)
                 pgid = pg_t(*cmd["pgid"])
-                pairs = [tuple(int(x) for x in p)
-                         for p in cmd["pairs"]]
+                raw_pairs = cmd["pairs"]
+                if any(len(p) != 2 for p in raw_pairs):
+                    return -errno.EINVAL, {
+                        "error": "pairs must be [from, to] twos"}
+                pairs = [tuple(int(x) for x in p) for p in raw_pairs]
+                tos = [t for _f, t in pairs]
+                if len(set(tos)) != len(tos):
+                    return -errno.EINVAL, {
+                        "error": "duplicate upmap targets"}
                 with self.lock:
                     if pgid.pool not in self.osdmap.pools:
                         return -errno.ENOENT, {

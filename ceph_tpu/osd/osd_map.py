@@ -67,6 +67,11 @@ class OSDMap:
         # override for peering/backfill), upmap items survive remaps of
         # unrelated devices and compose with CRUSH
         self.pg_upmap_items: dict[pg_t, list[tuple[int, int]]] = {}
+        # memo of the raw CRUSH walk per pg — the per-op hot path's
+        # expensive part; pure in (crush, pools), so every structural
+        # mutator below invalidates.  pg_temp/upmap overlays apply
+        # live on top (they may be mutated directly in tests/tools)
+        self._pg_cache: dict[pg_t, list[int]] = {}
         self.ec_profiles: dict[str, dict[str, str]] = {}
         # client fencing (reference OSDMap blacklist, consumed by
         # ManagedLock): messenger entity -> expiry unix time.  OSDs
@@ -108,10 +113,15 @@ class OSDMap:
         return weight
 
     def pg_to_raw_osds(self, pgid: pg_t) -> list[int]:
+        hit = self._pg_cache.get(pgid)
+        if hit is not None:
+            return list(hit)
         pool = self.pools[pgid.pool]
         x = crush_hash32(pgid.pool, pgid.seed)
-        return self.crush.do_rule(pool.crush_rule, x, pool.size,
-                                  weight_of=self._weight_of())
+        out = self.crush.do_rule(pool.crush_rule, x, pool.size,
+                                 weight_of=self._weight_of())
+        self._pg_cache[pgid] = list(out)
+        return out
 
     def pg_to_raw_upmap_osds(self, pgid: pg_t) -> list[int]:
         """Raw crush result with pg_upmap_items applied, BEFORE any
@@ -161,6 +171,7 @@ class OSDMap:
         self.osds[osd_id] = OSDInfo(osd_id, up=False, in_=True,
                                     weight=1.0, addr=addr)
         self.crush.add_osd(osd_id, weight, host)
+        self._pg_cache.clear()
 
     def set_osd_up(self, osd_id: int, addr: tuple[str, int] | None = None
                    ) -> None:
@@ -168,14 +179,17 @@ class OSDMap:
         o.up = True
         if addr:
             o.addr = addr
+        self._pg_cache.clear()
 
     def set_osd_down(self, osd_id: int) -> None:
         if osd_id in self.osds:
             self.osds[osd_id].up = False
+        self._pg_cache.clear()
 
     def set_osd_out(self, osd_id: int) -> None:
         if osd_id in self.osds:
             self.osds[osd_id].in_ = False
+        self._pg_cache.clear()
 
     def create_pool(self, name: str, type_: PoolType, size: int,
                     pg_num: int, crush_rule: int,
@@ -193,6 +207,7 @@ class OSDMap:
 
     def bump_epoch(self) -> int:
         self.epoch += 1
+        self._pg_cache.clear()
         return self.epoch
 
     # -- wire form (mon -> everyone; reference OSDMap::encode) --------------

@@ -25,20 +25,19 @@ def main(argv=None) -> int:
     except (AttributeError, ValueError):
         pass
     ap = argparse.ArgumentParser(prog="crushtool")
-    ap.add_argument("--compile", metavar="FILE",
-                    help="parse + validate; prints a summary")
-    ap.add_argument("--decompile", metavar="FILE",
-                    help="parse then re-emit canonical text")
-    ap.add_argument("--test", metavar="FILE",
-                    help="run placement checks on a rule")
+    g = ap.add_mutually_exclusive_group(required=True)
+    g.add_argument("--compile", metavar="FILE",
+                   help="parse + validate; prints a summary")
+    g.add_argument("--decompile", metavar="FILE",
+                   help="parse then re-emit canonical text")
+    g.add_argument("--test", metavar="FILE",
+                   help="run placement checks on a rule")
     ap.add_argument("--rule", type=int, default=0)
     ap.add_argument("--num-rep", type=int, default=3)
     ap.add_argument("--inputs", type=int, default=1024)
     args = ap.parse_args(argv)
 
     path = args.compile or args.decompile or args.test
-    if not path:
-        ap.error("one of --compile/--decompile/--test is required")
     try:
         with open(path) as f:
             compiled = compile_text(f.read())

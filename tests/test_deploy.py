@@ -1,0 +1,81 @@
+"""cephadm-role deployer (tools/deploy.py): spec -> processes, unit
+records, per-daemon stop/start on the surviving store, rm-cluster."""
+
+import json
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+
+def run_deploy(*argv, timeout=300):
+    return subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.tools.deploy", *argv],
+        capture_output=True, text=True, timeout=timeout)
+
+
+@pytest.fixture()
+def cluster_dir(tmp_path):
+    spec = tmp_path / "spec.json"
+    spec.write_text(json.dumps({
+        "mons": 1, "osds": 3, "objectstore": "filestore", "rgw": 1}))
+    d = tmp_path / "cluster"
+    r = run_deploy("apply", str(spec), "--dir", str(d), timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    yield d, r.stdout
+    run_deploy("rm-cluster", "--dir", str(d))
+
+
+def test_apply_ls_io_stop_start(cluster_dir):
+    d, out = cluster_dir
+    rgw_line = next(ln for ln in out.splitlines()
+                    if ln.startswith("rgw.0 serving"))
+    base = rgw_line.split()[-1]
+    # all units running, unit files recorded
+    r = run_deploy("ls", "--dir", str(d))
+    units = [json.loads(ln) for ln in r.stdout.splitlines()]
+    assert {u["name"] for u in units} == \
+        {"mon.0", "osd.0", "osd.1", "osd.2", "rgw.0"}
+    assert all(u["state"] == "running" for u in units)
+    # IO through the deployed gateway
+    req = urllib.request.Request(base + "/db", method="PUT")
+    assert urllib.request.urlopen(req, timeout=90).status == 200
+    req = urllib.request.Request(base + "/db/k", data=b"unit bytes",
+                                 method="PUT")
+    assert urllib.request.urlopen(req, timeout=90).status == 200
+    # stop one OSD; degraded read still works; restart it
+    assert run_deploy("stop", "--dir", str(d),
+                      "--name", "osd.2").returncode == 0
+    time.sleep(0.5)
+    with urllib.request.urlopen(base + "/db/k", timeout=90) as resp:
+        assert resp.read() == b"unit bytes"
+    assert run_deploy("start", "--dir", str(d),
+                      "--name", "osd.2").returncode == 0
+    r = run_deploy("ls", "--dir", str(d))
+    osd2 = next(json.loads(ln) for ln in r.stdout.splitlines()
+                if json.loads(ln)["name"] == "osd.2")
+    assert osd2["state"] == "running"
+
+
+def test_rm_cluster_removes_everything(tmp_path):
+    spec = tmp_path / "s.json"
+    spec.write_text(json.dumps({"mons": 1, "osds": 1,
+                                "objectstore": "memstore"}))
+    d = tmp_path / "c"
+    r = run_deploy("apply", str(spec), "--dir", str(d), timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    pids = [json.loads(ln)["pid"] for ln in
+            run_deploy("ls", "--dir", str(d)).stdout.splitlines()]
+    assert run_deploy("rm-cluster", "--dir", str(d)).returncode == 0
+    assert not d.exists()
+    import os
+    time.sleep(0.5)
+    for pid in pids:
+        try:
+            os.kill(pid, 0)
+            alive = True
+        except OSError:
+            alive = False
+        assert not alive, f"pid {pid} survived rm-cluster"
