@@ -201,8 +201,11 @@ def _parse_rule(cm, rule_types, lines, i, err, resolve_item):
             pass                          # legacy fields: accepted
         elif t[0] == "step":
             if t[1] == "take":
-                resolve_item(lineno, t[2])   # unknown target: err here,
-                steps.append(Step(op="take", item=t[2]))   # not at map time
+                # resolve to the numeric id NOW: unknown targets error
+                # with a line number, and device-name takes work at
+                # map time (do_rule only name-resolves buckets)
+                steps.append(Step(op="take",
+                                  item=resolve_item(lineno, t[2])))
             elif t[1] == "emit":
                 steps.append(Step(op="emit"))
             elif t[1] in ("choose", "chooseleaf"):

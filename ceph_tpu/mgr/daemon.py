@@ -30,6 +30,9 @@ class MgrModule:
     def tick(self) -> None:
         """Called every run_interval while the mgr is active."""
 
+    def shutdown(self) -> None:
+        """Optional teardown (servers, files) at mgr shutdown."""
+
     # convenience passthroughs
     def get_osdmap(self) -> OSDMap:
         return self.mgr.osdmap
@@ -83,6 +86,11 @@ class MgrDaemon:
 
     def shutdown(self) -> None:
         self._stop.set()
+        for mod in self.modules:
+            try:
+                mod.shutdown()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
         self.messenger.shutdown()
 
     def _rotate_mon(self) -> None:

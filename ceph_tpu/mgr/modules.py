@@ -1,4 +1,5 @@
-"""Built-in mgr modules: health, balancer, pg_autoscaler.
+"""Built-in mgr modules: health, balancer, pg_autoscaler, telemetry,
+devicehealth, dashboard.
 
 Reference analogs: the mgr health aggregation (src/mgr/DaemonHealth*),
 pybind/mgr/balancer (upmap mode re-expressed over pg_temp, the map's
@@ -169,4 +170,173 @@ class PgAutoscalerModule(MgrModule):
             "HEALTH_WARN" if warns else "HEALTH_OK", warns)
 
 
-DEFAULT_MODULES = [HealthModule, BalancerModule, PgAutoscalerModule]
+class TelemetryModule(MgrModule):
+    """Periodic anonymized cluster report (reference pybind/mgr/
+    telemetry — there it phones home; here the report is exposed on
+    the module and, when a report path is set, written as JSON for an
+    operator to forward)."""
+
+    name = "telemetry"
+    run_interval = 5.0
+    report_path: str | None = None       # set by operator/tests
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self.last_report: dict | None = None
+
+    def compile_report(self) -> dict:
+        import time as _time
+        m = self.get_osdmap()
+        pools = list(m.pools.values())
+        return {
+            "report_timestamp": _time.time(),
+            "osdmap_epoch": m.epoch,
+            "osds": {"total": len(m.osds),
+                     "up": sum(1 for o in m.osds.values() if o.up),
+                     "in": sum(1 for o in m.osds.values() if o.in_)},
+            "pools": {"total": len(pools),
+                      "replicated": sum(1 for p in pools
+                                        if not p.is_erasure()),
+                      "erasure": sum(1 for p in pools
+                                     if p.is_erasure()),
+                      "pg_total": sum(p.pg_num for p in pools)},
+            "ec_profiles": sorted(
+                {p.erasure_code_profile for p in pools
+                 if p.is_erasure()}),
+            "health": self.mgr.health_summary().get("status"),
+        }
+
+    def tick(self) -> None:
+        self.last_report = self.compile_report()
+        if self.report_path:
+            import json as _json
+            with open(self.report_path, "w") as f:
+                _json.dump(self.last_report, f, indent=2)
+
+
+class DeviceHealthModule(MgrModule):
+    """Failing-device early warning (reference pybind/mgr/devicehealth,
+    reduced: no SMART source here, so the signal is FLAPPING — an OSD
+    that bounces down repeatedly inside the window is predicted
+    unhealthy and surfaced before it dies for good)."""
+
+    name = "devicehealth"
+    run_interval = 1.0
+    window_s = 600.0
+    flap_threshold = 3
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self._was_up: dict[int, bool] = {}
+        self._downs: dict[int, list[float]] = {}
+
+    def tick(self) -> None:
+        import time as _time
+        m = self.get_osdmap()
+        now = _time.time()
+        warns = []
+        for o in m.osds.values():
+            prev = self._was_up.get(o.id)
+            if prev is True and not o.up:
+                self._downs.setdefault(o.id, []).append(now)
+            self._was_up[o.id] = o.up
+        for osd_id, downs in self._downs.items():
+            recent = [t for t in downs if now - t < self.window_s]
+            self._downs[osd_id] = recent
+            if len(recent) >= self.flap_threshold:
+                warns.append(
+                    f"osd.{osd_id} flapped {len(recent)}x in "
+                    f"{int(self.window_s)}s: possible failing device")
+        self.mgr.set_health(
+            self.name, "HEALTH_WARN" if warns else "HEALTH_OK", warns)
+
+
+class DashboardModule(MgrModule):
+    """Read-only cluster dashboard (reference pybind/mgr/dashboard,
+    reduced to the observability core): an HTTP endpoint serving an
+    HTML summary plus /api/health, /api/osds, /api/pools JSON."""
+
+    name = "dashboard"
+    run_interval = 3600.0                # serving is thread-driven
+    port = 0                             # 0 = ephemeral
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        import http.server
+        import json as _json
+        import threading as _threading
+        module = self
+
+        class _H(http.server.BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: A003
+                pass
+
+            def _json(self, obj):
+                body = _json.dumps(obj, indent=2).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                m = module.get_osdmap()
+                if self.path == "/api/health":
+                    self._json(module.mgr.health_summary())
+                elif self.path == "/api/osds":
+                    self._json([{"id": o.id, "up": o.up, "in": o.in_,
+                                 "addr": list(o.addr or ())}
+                                for o in m.osds.values()])
+                elif self.path == "/api/pools":
+                    self._json([{"name": p.name, "id": p.id,
+                                 "type": ("erasure" if p.is_erasure()
+                                          else "replicated"),
+                                 "size": p.size, "pg_num": p.pg_num}
+                                for p in m.pools.values()])
+                elif self.path == "/":
+                    from html import escape as _esc
+                    h = module.mgr.health_summary()
+                    up = sum(1 for o in m.osds.values() if o.up)
+                    rows = "".join(
+                        f"<tr><td>{_esc(p.name)}</td><td>{p.size}</td>"
+                        f"<td>{p.pg_num}</td></tr>"
+                        for p in m.pools.values())
+                    body = (
+                        "<html><head><title>ceph-tpu</title></head>"
+                        "<body><h1>ceph-tpu dashboard</h1>"
+                        f"<p>health: "
+                        f"<b>{_esc(str(h.get('status')))}</b></p>"
+                        f"<p>epoch {m.epoch}; {up}/{len(m.osds)} "
+                        "osds up</p>"
+                        "<table border=1><tr><th>pool</th><th>size"
+                        "</th><th>pg_num</th></tr>"
+                        f"{rows}</table></body></html>").encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+
+        import http.server as _hs
+        self.httpd = _hs.ThreadingHTTPServer(("127.0.0.1", self.port),
+                                             _H)
+        self.addr = self.httpd.server_address
+        _threading.Thread(target=self.httpd.serve_forever,
+                          daemon=True,
+                          name="mgr-dashboard").start()
+
+    def tick(self) -> None:
+        pass
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+DEFAULT_MODULES = [HealthModule, BalancerModule, PgAutoscalerModule,
+                   TelemetryModule, DeviceHealthModule]
+

@@ -70,34 +70,18 @@ def _alive(pid: int) -> bool:
 
 
 def _spawn(root: Path, name: str, argv: list[str]) -> str:
-    """Start one daemon process, wait for READY, record the unit."""
+    """Start one daemon process, wait for READY (shared handshake
+    reader, proc_cluster.wait_ready), record the unit."""
+    from .proc_cluster import wait_ready
     log = open(root / f"{name}.log", "ab")
     proc = subprocess.Popen(
         [sys.executable, "-m", "ceph_tpu.tools.daemon_main", *argv],
         stdout=subprocess.PIPE, stderr=log)
-    import select
-    buf = b""
-    deadline = time.time() + 120
-    addr = ""
-    fd = proc.stdout.fileno()
-    while time.time() < deadline:
-        if proc.poll() is not None:
-            raise RuntimeError(f"{name} died at boot "
-                               f"(rc={proc.returncode}; see "
-                               f"{root / (name + '.log')})")
-        r, _, _ = select.select([fd], [], [], 0.2)
-        if r:
-            chunk = os.read(fd, 4096)
-            buf += chunk
-        *complete, _partial = buf.split(b"\n")
-        ready = next((ln for ln in complete
-                      if ln.startswith(b"READY")), None)
-        if ready:
-            addr = ready.split()[1].decode()
-            break
-    else:
+    try:
+        addr = wait_ready(proc, name)
+    except RuntimeError as e:
         proc.kill()
-        raise RuntimeError(f"{name} not ready in 120s")
+        raise RuntimeError(f"{e} (see {root / (name + '.log')})") from e
     _write_unit(root, name, argv, proc.pid, addr)
     return addr
 
@@ -105,17 +89,20 @@ def _spawn(root: Path, name: str, argv: list[str]) -> str:
 def cmd_apply(args) -> int:
     root = Path(args.dir)
     root.mkdir(parents=True, exist_ok=True)
+    existing = _load_units(root)
+    if existing:
+        # a second apply would overwrite the unit records and orphan
+        # the running daemons beyond stop/rm-cluster's reach
+        print(f"cluster dir {root} already has "
+              f"{len(existing)} unit(s); run rm-cluster first",
+              file=sys.stderr)
+        return 1
     spec = json.loads(Path(args.spec).read_text())
     (root / "spec.json").write_text(json.dumps(spec, indent=2))
     n_mons = int(spec.get("mons", 1))
     # fixed mon ports recorded in the cluster dir (the monmap role)
-    import socket
-    ports = []
-    for _ in range(n_mons):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        ports.append(s.getsockname()[1])
-        s.close()
+    from .proc_cluster import _free_ports
+    ports = _free_ports(n_mons)
     mon_addrs = ",".join(f"127.0.0.1:{p}" for p in ports)
     (root / "monmap.json").write_text(json.dumps(
         {"mons": mon_addrs.split(",")}))

@@ -33,6 +33,34 @@ from pathlib import Path
 from ..rados import RadosClient
 
 
+def wait_ready(proc: subprocess.Popen, what: str,
+               timeout: float = 120.0) -> str:
+    """Wait for daemon_main's one-line READY handshake on a raw-fd
+    pipe (buffered wrappers can strand the line — see _wait_ready's
+    original note).  Scans for READY BEFORE checking liveness so a
+    daemon that prints READY and exits still reports its address.
+    Shared by ProcCluster and the cephadm-role deployer."""
+    import os
+    import select
+    fd = proc.stdout.fileno()
+    buf = b""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        *complete, _partial = buf.split(b"\n")
+        for line in complete:
+            if line.startswith(b"READY"):
+                return line.split()[1].decode()
+        if proc.poll() is not None:
+            raise RuntimeError(f"{what} died at boot "
+                               f"(rc={proc.returncode})")
+        r, _, _ = select.select([fd], [], [], 0.2)
+        if r:
+            chunk = os.read(fd, 4096)
+            if chunk:
+                buf += chunk
+    raise RuntimeError(f"{what} not ready in {timeout}s")
+
+
 def _free_ports(n: int) -> list[int]:
     """Reserve n distinct loopback ports (bind-then-release; the race
     window on a dev box is acceptable for test clusters — the reference
@@ -81,28 +109,7 @@ class ProcCluster:
             text=True)
 
     def _wait_ready(self, proc: subprocess.Popen, what: str) -> str:
-        import os
-        import select
-        # raw-fd reads: select+readline on the buffered wrapper can
-        # strand a READY line in the Python-level buffer behind a
-        # stray warning line, spinning until the timeout
-        fd = proc.stdout.fileno()
-        buf = ""
-        deadline = time.time() + self.boot_timeout
-        while time.time() < deadline:
-            *complete, _partial = buf.split("\n")  # only whole lines:
-            for line in complete:                  # a half-written port
-                if line.startswith("READY"):       # must not parse
-                    return line.split()[1]
-            if proc.poll() is not None:
-                raise RuntimeError(f"{what} died at boot "
-                                   f"(rc={proc.returncode})")
-            r, _, _ = select.select([fd], [], [], 0.2)
-            if r:
-                chunk = os.read(fd, 4096)
-                if chunk:
-                    buf += chunk.decode(errors="replace")
-        raise RuntimeError(f"{what} not ready in {self.boot_timeout}s")
+        return wait_ready(proc, what, self.boot_timeout)
 
     def start(self) -> "ProcCluster":
         try:
