@@ -109,5 +109,5 @@ class ClsContext:
 
 # -- built-in classes --------------------------------------------------------
 
-from . import (cls_journal, cls_lock, cls_numops,  # noqa: E402,F401
-               cls_refcount, cls_rgw)
+from . import (cls_journal, cls_lock, cls_log,  # noqa: E402,F401
+               cls_numops, cls_refcount, cls_rgw, cls_user)

@@ -1,0 +1,83 @@
+"""cls_user-role: per-user account object class.
+
+Re-expresses the slice of reference src/cls/user/cls_user.cc RGW
+consumes: a user header object holding per-bucket usage stats
+(entries, bytes) updated server-side as bucket indexes change, plus
+quota fields — the data the reference's RGWQuotaHandler reads before
+admitting writes (src/rgw/rgw_quota.cc).
+
+Layout: {"buckets": {bucket: {"objects": int, "bytes": int}},
+"quota": {"max_objects": int|-1, "max_bytes": int|-1}}.
+"""
+
+from __future__ import annotations
+
+import json
+
+from . import ClsError, register_class
+
+
+def _load(ctx) -> dict:
+    raw = ctx.read()
+    if not raw:
+        return {"buckets": {}, "quota": {"max_objects": -1,
+                                         "max_bytes": -1}}
+    try:
+        return json.loads(raw.decode())
+    except ValueError as e:
+        raise ClsError(5, f"corrupt user object: {e}") from e
+
+
+def _store(ctx, d: dict) -> None:
+    ctx.write_full(json.dumps(d, separators=(",", ":")).encode())
+
+
+def add_stats(ctx, inp: bytes) -> bytes:
+    """input: {"bucket": str, "objects": +/-int, "bytes": +/-int} —
+    atomic server-side delta (reference cls_user_add_bucket /
+    cls_user_update_buckets)."""
+    req = json.loads(inp.decode())
+    d = _load(ctx)
+    b = d["buckets"].setdefault(req["bucket"],
+                                {"objects": 0, "bytes": 0})
+    b["objects"] = max(0, b["objects"] + int(req.get("objects", 0)))
+    b["bytes"] = max(0, b["bytes"] + int(req.get("bytes", 0)))
+    _store(ctx, d)
+    return b""
+
+
+def rm_bucket(ctx, inp: bytes) -> bytes:
+    req = json.loads(inp.decode())
+    d = _load(ctx)
+    d["buckets"].pop(req["bucket"], None)
+    _store(ctx, d)
+    return b""
+
+
+def get_header(ctx, _inp: bytes) -> bytes:
+    """-> the whole user record incl. totals."""
+    d = _load(ctx)
+    totals = {"objects": sum(b["objects"]
+                             for b in d["buckets"].values()),
+              "bytes": sum(b["bytes"] for b in d["buckets"].values())}
+    return json.dumps({**d, "totals": totals}).encode()
+
+
+def set_quota(ctx, inp: bytes) -> bytes:
+    """input: {"max_objects": int|-1, "max_bytes": int|-1} (-1 =
+    unlimited)."""
+    req = json.loads(inp.decode())
+    d = _load(ctx)
+    for k in ("max_objects", "max_bytes"):
+        if k in req:
+            d["quota"][k] = int(req[k])
+    _store(ctx, d)
+    return b""
+
+
+register_class("user", {
+    "add_stats": add_stats,
+    "rm_bucket": rm_bucket,
+    "get_header": get_header,
+    "set_quota": set_quota,
+})

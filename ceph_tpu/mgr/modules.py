@@ -210,8 +210,11 @@ class TelemetryModule(MgrModule):
         self.last_report = self.compile_report()
         if self.report_path:
             import json as _json
-            with open(self.report_path, "w") as f:
-                _json.dump(self.last_report, f, indent=2)
+            import os as _os
+            tmp = self.report_path + ".tmp"
+            with open(tmp, "w") as f:      # atomic swap: a reader
+                _json.dump(self.last_report, f, indent=2)   # never
+            _os.replace(tmp, self.report_path)   # sees partial JSON
 
 
 class DeviceHealthModule(MgrModule):

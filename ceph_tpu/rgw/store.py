@@ -64,7 +64,8 @@ def _version_oid(bucket: str, version_id: str, key: str) -> str:
 
 class RGWStore:
     def __init__(self, client, ec_profile: str | None = None,
-                 pg_num: int = 8, modlog: bool = False):
+                 pg_num: int = 8, modlog: bool = False,
+                 usage_log: bool = False):
         self.client = client
         self._ensure_pools(ec_profile, pg_num)
         self.meta = client.open_ioctx(META_POOL)
@@ -80,6 +81,9 @@ class RGWStore:
         self.modlog_enabled = modlog
         if modlog:
             self.meta.execute(MODLOG_OBJ, "journal", "create", b"")
+        # usage/ops log (reference rgw_enable_usage_log, default off):
+        # one cls_log append per mutation when enabled
+        self.usage_log_enabled = usage_log
         # bucket-meta rows are read-modify-written whole (versioning/
         # acl/lifecycle share one row); concurrent HTTP handler threads
         # must not interleave their RMWs or the second write silently
@@ -123,6 +127,99 @@ class RGWStore:
             entry["key"] = key
         self.meta.execute(MODLOG_OBJ, "journal", "append",
                           json.dumps({"entry": entry}).encode())
+
+    # -- user accounting + quotas (cls_user; reference rgw_quota.cc +
+    #    cls_user bucket stats) + usage log (cls_log; rgw_usage.cc) ---------
+
+    @staticmethod
+    def _user_oid(user: str) -> str:
+        return f"user.{user}"
+
+    def _user_stats(self, user: str | None, bucket: str,
+                    d_objects: int, d_bytes: int) -> None:
+        """Server-side stats delta on the owner's account object.
+        Accounting tracks the CURRENT index view (archived version
+        rows and version surgery are not separately charged — noted
+        deviation from the reference's full-olh accounting)."""
+        if not user or (d_objects == 0 and d_bytes == 0):
+            return
+        self.meta.execute(self._user_oid(user), "user", "add_stats",
+                          json.dumps({"bucket": bucket,
+                                      "objects": d_objects,
+                                      "bytes": d_bytes}).encode())
+
+    def _account_overwrite(self, bucket: str, key: str | None,
+                           cur: dict | None, cur_owner: str | None,
+                           new_owner: str | None,
+                           new_bytes: int) -> None:
+        """Post-success accounting for a write that displaced `cur`:
+        release the OLD owner's charge and charge the NEW owner — a
+        cross-owner overwrite must not leave the previous owner paying
+        for bytes that no longer exist (and the clamp in cls_user must
+        never eat the new owner's charge)."""
+        if cur is not None and cur_owner == new_owner:
+            self._user_stats(new_owner, bucket, 0,
+                             new_bytes - cur.get("size", 0))
+        else:
+            if cur is not None:
+                self._user_stats(cur_owner, bucket, -1,
+                                 -cur.get("size", 0))
+            self._user_stats(new_owner, bucket, 1, new_bytes)
+        self._usage(new_owner, "put_obj", bucket, key, new_bytes)
+
+    def get_user_header(self, user: str) -> dict:
+        raw = self.meta.execute(self._user_oid(user), "user",
+                                "get_header", b"")
+        return json.loads(raw.decode())
+
+    def set_user_quota(self, user: str, max_objects: int = -1,
+                       max_bytes: int = -1) -> None:
+        self.meta.execute(self._user_oid(user), "user", "set_quota",
+                          json.dumps({"max_objects": max_objects,
+                                      "max_bytes": max_bytes}).encode())
+
+    def _quota_gate(self, user: str | None, add_objects: int,
+                    add_bytes: int) -> None:
+        """Admit or 403 a write against the owner's quota (reference
+        RGWQuotaHandler::check_quota before every put)."""
+        if not user:
+            return
+        hdr = self.get_user_header(user)
+        q = hdr.get("quota", {})
+        t = hdr.get("totals", {})
+        if q.get("max_objects", -1) >= 0 and \
+                t.get("objects", 0) + add_objects > q["max_objects"]:
+            raise RGWError(403, "QuotaExceeded",
+                           f"user {user} object quota")
+        if q.get("max_bytes", -1) >= 0 and \
+                t.get("bytes", 0) + add_bytes > q["max_bytes"]:
+            raise RGWError(403, "QuotaExceeded",
+                           f"user {user} byte quota")
+
+    def _usage(self, user: str | None, op: str, bucket: str,
+               key: str | None, nbytes: int) -> None:
+        if not self.usage_log_enabled:
+            return
+        entry = {"user": user or "anonymous", "op": op,
+                 "bucket": bucket, "bytes": nbytes}
+        if key is not None:
+            entry["key"] = key
+        self.meta.execute("rgw_usagelog", "log", "add", json.dumps(
+            {"ts": time.time(), "entry": entry}).encode())
+
+    def get_usage(self, from_ts: float = 0.0, to_ts: float = 1e18,
+                  marker: str = "", max_entries: int = 256) -> dict:
+        raw = self.meta.execute("rgw_usagelog", "log", "list",
+                                json.dumps({"from_ts": from_ts,
+                                            "to_ts": to_ts,
+                                            "marker": marker,
+                                            "max": max_entries}
+                                           ).encode())
+        return json.loads(raw.decode())
+
+    def trim_usage(self, to_ts: float) -> None:
+        self.meta.execute("rgw_usagelog", "log", "trim",
+                          json.dumps({"to_ts": to_ts}).encode())
 
     # -- buckets -------------------------------------------------------------
 
@@ -321,6 +418,11 @@ class RGWStore:
             raise RGWError(409, "BucketNotEmpty",
                            f"{bucket}: object versions remain")
         self._modlog("sync_bucket", bucket)
+        owner = (self._bucket_meta(bucket) or {}).get("owner")
+        if owner:
+            self.meta.execute(self._user_oid(owner), "user",
+                              "rm_bucket",
+                              json.dumps({"bucket": bucket}).encode())
         self._cls(self.meta, BUCKETS_OBJ, "dir_rm", {"key": bucket})
         for obj in (f"index.{bucket}", f"uploads.{bucket}",
                     f"versions.{bucket}"):
@@ -460,6 +562,16 @@ class RGWStore:
         bmeta = self._bucket_meta(bucket)
         if bmeta is None:
             raise RGWError(404, "NoSuchBucket", bucket)
+        owner = (extra or {}).get("owner") or bmeta.get("owner")
+        cur = self._current_meta(bucket, key)
+        cur_owner = (cur or {}).get("owner") or bmeta.get("owner")
+        same = (cur is None or cur_owner == owner)
+        # quota admits the NEW owner's growth; a same-owner overwrite
+        # only pays the size delta
+        self._quota_gate(owner,
+                         (0 if cur else 1) if same else 1,
+                         (len(body) - (cur or {}).get("size", 0))
+                         if same else len(body))
         etag = hashlib.md5(body).hexdigest()
         self._modlog("sync", bucket, key)
         if bmeta.get("versioning") == "Enabled":
@@ -471,10 +583,13 @@ class RGWStore:
             self._archive_version(bucket, key, meta, vid)
             self._cls(self.meta, f"index.{bucket}", "dir_add", {
                 "key": key, "meta": {**meta, "version_id": vid}})
+            self._account_overwrite(bucket, key, cur, cur_owner,
+                                    owner, len(body))
             self._modlog("sync", bucket, key)   # post-success
             return etag
         suspended = bool(bmeta.get("versioning"))   # "" = never versioned
-        reap = self._displaced_manifests(bucket, key, suspended)
+        reap = self._displaced_manifests(bucket, key, suspended,
+                                         cur=cur)
         meta = {"size": len(body), "etag": etag, "mtime": time.time(),
                 **(extra or {})}
         self.data.write_full(_data_oid(bucket, key), body)
@@ -487,6 +602,8 @@ class RGWStore:
                                   {**meta, "null_data": True}, "null")
         for m in reap:
             self._reap_manifest(bucket, m)
+        self._account_overwrite(bucket, key, cur, cur_owner, owner,
+                                len(body))
         self._modlog("sync", bucket, key)       # post-success
         return etag
 
@@ -529,6 +646,8 @@ class RGWStore:
         vmeta = self._version_row(bucket, key, version_id)
         if vmeta is None:
             raise RGWError(404, "NoSuchVersion", version_id)
+        bmeta = self._bucket_meta(bucket) or {}
+        pre_cur = self._current_meta(bucket, key)
         self._modlog("sync", bucket, key)
         try:
             self._cls(self.meta, f"versions.{bucket}", "dir_rm",
@@ -578,6 +697,24 @@ class RGWStore:
                               {"key": key})
                 except RadosError as e:
                     self._not_found(e)
+        # CURRENT-view accounting: deleting the current version (or
+        # promoting a different-size predecessor) changes the index
+        # view the user stats track — without this, version surgery
+        # permanently leaks quota
+        post_cur = self._current_meta(bucket, key)
+        if (pre_cur is None) != (post_cur is None) or (
+                pre_cur is not None and post_cur is not None and
+                (pre_cur.get("size"), pre_cur.get("owner")) !=
+                (post_cur.get("size"), post_cur.get("owner"))):
+            default_owner = bmeta.get("owner")
+            if pre_cur is not None:
+                self._user_stats(
+                    pre_cur.get("owner") or default_owner, bucket,
+                    -1, -pre_cur.get("size", 0))
+            if post_cur is not None:
+                self._user_stats(
+                    post_cur.get("owner") or default_owner, bucket,
+                    1, post_cur.get("size", 0))
         self._modlog("sync", bucket, key)       # post-success
 
     def _version_row(self, bucket: str, key: str,
@@ -591,7 +728,8 @@ class RGWStore:
         return json.loads(raw.decode())
 
     def _displaced_manifests(self, bucket: str, key: str,
-                             suspended: bool) -> list[dict]:
+                             suspended: bool,
+                             cur: dict | None = None) -> list[dict]:
         """Manifests whose LAST reference disappears when a
         non-versioned write/delete displaces the current object: the
         current index row's manifest (unless its own version row
@@ -600,7 +738,8 @@ class RGWStore:
         row's manifest.  Reaping anything else would destroy an
         archived version's data; reaping less leaks parts forever."""
         out: dict[str, dict] = {}
-        cur = self._current_meta(bucket, key)
+        if cur is None:
+            cur = self._current_meta(bucket, key)
         if cur and cur.get("multipart") and not cur.get("version_id"):
             out[cur["multipart"]["upload_id"]] = cur["multipart"]
         if suspended:
@@ -659,13 +798,13 @@ class RGWStore:
         bmeta = self._bucket_meta(bucket)
         if bmeta is None:
             raise RGWError(404, "NoSuchBucket", bucket)
-        suspended_or_versioned = bool(bmeta.get("versioning"))
-        if not suspended_or_versioned and \
-                self._current_meta(bucket, key) is None:
-            # validate BEFORE logging: a failed op must not feed the
-            # mod-log (active-active agents would ping-pong spurious
-            # entries forever)
+        cur = self._current_meta(bucket, key)
+        if cur is None and bmeta.get("versioning") != "Enabled":
+            # validate BEFORE logging (both plain and Suspended paths
+            # 404 on an absent key): a failed op must not feed the
+            # mod-log or the usage/stats ledgers
             raise RGWError(404, "NoSuchKey", key)
+        owner = (cur or {}).get("owner") or bmeta.get("owner")
         self._modlog("sync", bucket, key)
         if bmeta.get("versioning") == "Enabled":
             # versioned delete = insert a delete marker as the new
@@ -680,16 +819,26 @@ class RGWStore:
                           {"key": key})
             except RadosError as e:
                 self._not_found(e)
+            if cur is not None:
+                self._user_stats(owner, bucket, -1,
+                                 -cur.get("size", 0))
+            self._usage(owner, "delete_obj", bucket, key,
+                        (cur or {}).get("size", 0))
             self._modlog("sync", bucket, key)   # post-success
             return
         suspended = bool(bmeta.get("versioning"))
-        reap = self._displaced_manifests(bucket, key, suspended)
+        reap = self._displaced_manifests(bucket, key, suspended,
+                                         cur=cur)
         try:
             self._cls(self.meta, f"index.{bucket}", "dir_rm",
                       {"key": key})
         except RadosError as e:
             self._not_found(e)
             raise RGWError(404, "NoSuchKey", key) from e
+        if cur is not None:
+            self._user_stats(owner, bucket, -1, -cur.get("size", 0))
+        self._usage(owner, "delete_obj", bucket, key,
+                    (cur or {}).get("size", 0))
         if suspended:
             # S3: DELETE on a Suspended bucket replaces the null
             # version with a null DELETE MARKER (the displaced null
@@ -807,13 +956,21 @@ class RGWStore:
             md5cat += bytes.fromhex(meta["etag"])
             manifest.append([num, meta["size"]])
             total += meta["size"]
+        bmeta = self._bucket_meta(bucket) or {}
+        owner = (extra or {}).get("owner") or bmeta.get("owner")
+        cur = self._current_meta(bucket, key)
+        cur_owner = (cur or {}).get("owner") or bmeta.get("owner")
+        same = (cur is None or cur_owner == owner)
+        self._quota_gate(owner,
+                         (0 if cur else 1) if same else 1,
+                         (total - (cur or {}).get("size", 0))
+                         if same else total)
         self._modlog("sync", bucket, key)   # validated: will mutate
         etag = f"{hashlib.md5(md5cat).hexdigest()}-{len(parts)}"
         obj_meta = {"size": total, "etag": etag, "mtime": time.time(),
                     "multipart": {"upload_id": upload_id,
                                   "parts": manifest},
                     **(extra or {})}
-        bmeta = self._bucket_meta(bucket) or {}
         if bmeta.get("versioning") == "Enabled":
             # S3: CompleteMultipartUpload on a versioned bucket mints
             # a new object version like any PUT; the overwritten
@@ -845,6 +1002,8 @@ class RGWStore:
                 except RadosError:
                     pass
         self._rm_upload_bookkeeping(bucket, key, upload_id)
+        self._account_overwrite(bucket, key, cur, cur_owner, owner,
+                                total)
         self._modlog("sync", bucket, key)   # post-success (see _modlog)
         return etag
 

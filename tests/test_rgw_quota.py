@@ -1,0 +1,143 @@
+"""cls_user-backed account stats + quota enforcement and the
+cls_log-backed usage log (reference src/cls/user, src/cls/log,
+rgw_quota.cc, rgw_usage.cc)."""
+
+import pytest
+
+from ceph_tpu.rgw.store import RGWError, RGWStore
+from ceph_tpu.tools.vstart import Cluster
+
+
+@pytest.fixture(scope="module")
+def store():
+    with Cluster(n_osds=3) as c:
+        yield RGWStore(c.client(), usage_log=True)
+
+
+def test_user_stats_track_current_view(store):
+    store.create_bucket("acct", owner="alice")
+    store.put_object("acct", "a", b"x" * 100,
+                     extra={"owner": "alice"})
+    store.put_object("acct", "b", b"y" * 50, extra={"owner": "alice"})
+    hdr = store.get_user_header("alice")
+    assert hdr["totals"] == {"objects": 2, "bytes": 150}
+    # overwrite: object count stays, bytes reflect the new size
+    store.put_object("acct", "a", b"z" * 10, extra={"owner": "alice"})
+    hdr = store.get_user_header("alice")
+    assert hdr["totals"] == {"objects": 2, "bytes": 60}
+    store.delete_object("acct", "a")
+    hdr = store.get_user_header("alice")
+    assert hdr["totals"] == {"objects": 1, "bytes": 50}
+
+
+def test_quota_enforced(store):
+    store.create_bucket("qb", owner="bob")
+    store.set_user_quota("bob", max_objects=2, max_bytes=1000)
+    store.put_object("qb", "one", b"a" * 100, extra={"owner": "bob"})
+    store.put_object("qb", "two", b"b" * 100, extra={"owner": "bob"})
+    # object quota: third object refused
+    with pytest.raises(RGWError) as ei:
+        store.put_object("qb", "three", b"c", extra={"owner": "bob"})
+    assert ei.value.code == "QuotaExceeded"
+    # overwrite stays within object count: allowed
+    store.put_object("qb", "one", b"a" * 200, extra={"owner": "bob"})
+    # byte quota: growing past 1000 refused
+    with pytest.raises(RGWError) as ei:
+        store.put_object("qb", "two", b"b" * 2000,
+                         extra={"owner": "bob"})
+    assert ei.value.code == "QuotaExceeded"
+    # delete frees quota
+    store.delete_object("qb", "one")
+    store.put_object("qb", "three", b"c", extra={"owner": "bob"})
+
+
+def test_multipart_counts_against_quota(store):
+    store.create_bucket("mpq", owner="carol")
+    store.set_user_quota("carol", max_bytes=100_000)
+    uid = store.init_multipart("mpq", "big")
+    store.upload_part("mpq", "big", uid, 1, b"A" * 70000)
+    store.upload_part("mpq", "big", uid, 2, b"B" * 40000)
+    parts = [(n, m["etag"]) for n, m in store.list_parts("mpq", "big",
+                                                         uid)]
+    with pytest.raises(RGWError) as ei:       # 110000 > 100000
+        store.complete_multipart("mpq", "big", uid, parts,
+                                 extra={"owner": "carol"})
+    assert ei.value.code == "QuotaExceeded"
+    store.set_user_quota("carol", max_bytes=-1)
+    store.complete_multipart("mpq", "big", uid, parts,
+                             extra={"owner": "carol"})
+    hdr = store.get_user_header("carol")
+    assert hdr["totals"]["bytes"] == 110000
+
+
+def test_usage_log_records_and_trims(store):
+    store.create_bucket("ub", owner="dave")
+    store.put_object("ub", "k1", b"data", extra={"owner": "dave"})
+    store.delete_object("ub", "k1")
+    out = store.get_usage()
+    ops = [(e["user"], e["op"]) for _k, _ts, e in out["entries"]
+           if e["bucket"] == "ub"]
+    assert ("dave", "put_obj") in ops
+    assert ("dave", "delete_obj") in ops
+    # trim everything so far; the log drains
+    last_ts = max(ts for _k, ts, _e in out["entries"])
+    store.trim_usage(last_ts + 1.0)
+    left = [e for _k, _ts, e in store.get_usage()["entries"]
+            if e["bucket"] == "ub"]
+    assert left == []
+
+
+def test_cross_owner_overwrite_moves_charge(store):
+    """B overwriting A's object must release A's charge and charge B —
+    not leave A paying for bytes that no longer exist."""
+    store.create_bucket("xo", owner="ann")
+    store.put_object("xo", "doc", b"a" * 1000, extra={"owner": "ann"})
+    assert store.get_user_header("ann")["totals"] == \
+        {"objects": 1, "bytes": 1000}
+    store.put_object("xo", "doc", b"b" * 10, extra={"owner": "ben"})
+    assert store.get_user_header("ann")["totals"] == \
+        {"objects": 0, "bytes": 0}
+    assert store.get_user_header("ben")["totals"] == \
+        {"objects": 1, "bytes": 10}
+
+
+def test_version_surgery_adjusts_current_view(store):
+    """Deleting the CURRENT version releases its quota charge (and a
+    promoted predecessor re-charges at its own size)."""
+    store.create_bucket("vs", owner="zoe")
+    store.set_versioning("vs", "Enabled")
+    store.put_object("vs", "k", b"1" * 100, extra={"owner": "zoe"})
+    store.put_object("vs", "k", b"2" * 300, extra={"owner": "zoe"})
+    assert store.get_user_header("zoe")["totals"]["bytes"] == 300
+    cur_vid = store.head_object("vs", "k")["version_id"]
+    store.delete_object_version("vs", "k", cur_vid)
+    # predecessor (100 bytes) promoted to current
+    assert store.get_user_header("zoe")["totals"] == \
+        {"objects": 1, "bytes": 100}
+    vid2 = store.head_object("vs", "k")["version_id"]
+    store.delete_object_version("vs", "k", vid2)
+    assert store.get_user_header("zoe")["totals"] == \
+        {"objects": 0, "bytes": 0}
+
+
+def test_failed_delete_logs_nothing(store):
+    """A 404 delete on a Suspended bucket must not feed the usage log
+    or the stats (failed ops leave no ledger entries)."""
+    store.create_bucket("sus", owner="flo")
+    store.set_versioning("sus", "Suspended")
+    before = len(store.get_usage(max_entries=10000)["entries"])
+    with pytest.raises(RGWError):
+        store.delete_object("sus", "never-existed")
+    after = len(store.get_usage(max_entries=10000)["entries"])
+    assert after == before
+    assert store.get_user_header("flo")["totals"] == \
+        {"objects": 0, "bytes": 0}
+
+
+def test_bucket_delete_drops_stats_row(store):
+    store.create_bucket("gone", owner="erin")
+    store.put_object("gone", "x", b"1", extra={"owner": "erin"})
+    assert store.get_user_header("erin")["buckets"].get("gone")
+    store.delete_object("gone", "x")
+    store.delete_bucket("gone")
+    assert "gone" not in store.get_user_header("erin")["buckets"]
