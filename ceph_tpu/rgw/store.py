@@ -84,6 +84,8 @@ class RGWStore:
         # usage/ops log (reference rgw_enable_usage_log, default off):
         # one cls_log append per mutation when enabled
         self.usage_log_enabled = usage_log
+        # bucket notifications (rgw/notify.py), opt-in
+        self.notify = None
         # bucket-meta rows are read-modify-written whole (versioning/
         # acl/lifecycle share one row); concurrent HTTP handler threads
         # must not interleave their RMWs or the second write silently
@@ -220,6 +222,19 @@ class RGWStore:
     def trim_usage(self, to_ts: float) -> None:
         self.meta.execute("rgw_usagelog", "log", "trim",
                           json.dumps({"to_ts": to_ts}).encode())
+
+    def enable_notifications(self, push_interval: float = 0.25):
+        """Attach the notification manager (reference rgw_notify);
+        returns it for topic/binding admin."""
+        from .notify import NotificationManager
+        if self.notify is None:
+            self.notify = NotificationManager(self, push_interval)
+        return self.notify
+
+    def _publish(self, bucket: str, key: str, event: str,
+                 size: int = 0) -> None:
+        if self.notify is not None:
+            self.notify.publish(bucket, key, event, size)
 
     # -- buckets -------------------------------------------------------------
 
@@ -585,6 +600,8 @@ class RGWStore:
                 "key": key, "meta": {**meta, "version_id": vid}})
             self._account_overwrite(bucket, key, cur, cur_owner,
                                     owner, len(body))
+            self._publish(bucket, key, "s3:ObjectCreated:Put",
+                          len(body))
             self._modlog("sync", bucket, key)   # post-success
             return etag
         suspended = bool(bmeta.get("versioning"))   # "" = never versioned
@@ -604,6 +621,7 @@ class RGWStore:
             self._reap_manifest(bucket, m)
         self._account_overwrite(bucket, key, cur, cur_owner, owner,
                                 len(body))
+        self._publish(bucket, key, "s3:ObjectCreated:Put", len(body))
         self._modlog("sync", bucket, key)       # post-success
         return etag
 
@@ -824,6 +842,8 @@ class RGWStore:
                                  -cur.get("size", 0))
             self._usage(owner, "delete_obj", bucket, key,
                         (cur or {}).get("size", 0))
+            self._publish(bucket, key,
+                          "s3:ObjectRemoved:DeleteMarkerCreated")
             self._modlog("sync", bucket, key)   # post-success
             return
         suspended = bool(bmeta.get("versioning"))
@@ -839,6 +859,7 @@ class RGWStore:
             self._user_stats(owner, bucket, -1, -cur.get("size", 0))
         self._usage(owner, "delete_obj", bucket, key,
                     (cur or {}).get("size", 0))
+        self._publish(bucket, key, "s3:ObjectRemoved:Delete")
         if suspended:
             # S3: DELETE on a Suspended bucket replaces the null
             # version with a null DELETE MARKER (the displaced null
@@ -1004,6 +1025,9 @@ class RGWStore:
         self._rm_upload_bookkeeping(bucket, key, upload_id)
         self._account_overwrite(bucket, key, cur, cur_owner, owner,
                                 total)
+        self._publish(bucket, key,
+                      "s3:ObjectCreated:CompleteMultipartUpload",
+                      total)
         self._modlog("sync", bucket, key)   # post-success (see _modlog)
         return etag
 
