@@ -43,6 +43,9 @@ class NotificationManager:
             target=self._push_loop, daemon=True, name="rgw-notify")
         self.push_interval = push_interval
         self.delivered = 0            # observability/tests
+        self._topics_cache: tuple[float, dict] | None = None
+        self._draining: set[str] = set()   # per-topic isolation
+        self._drain_lock = threading.Lock()
         self._pusher.start()
 
     def shutdown(self) -> None:
@@ -58,11 +61,18 @@ class NotificationManager:
         self.meta.execute(
             f"topic.{name}", "journal", "client_register",
             json.dumps({"id": "pusher", "pos": -1}).encode())
+        self._topics_cache = None
 
-    def topics(self) -> dict[str, dict]:
+    def topics(self, max_age: float = 1.0) -> dict[str, dict]:
+        now = time.time()
+        if self._topics_cache is not None and \
+                now - self._topics_cache[0] < max_age:
+            return self._topics_cache[1]
         raw = self.store._cls(self.meta, TOPICS_OBJ, "dir_list",
                               {"max": 10000})
-        return {k: m for k, m in json.loads(raw.decode())["entries"]}
+        out = {k: m for k, m in json.loads(raw.decode())["entries"]}
+        self._topics_cache = (now, out)
+        return out
 
     def delete_topic(self, name: str) -> None:
         try:
@@ -70,6 +80,14 @@ class NotificationManager:
                             {"key": name})
         except Exception:  # noqa: BLE001 - absent already
             pass
+        # the queue dies with the topic: stale bucket bindings keep
+        # matching but publish() filters them against topics(), so
+        # nothing appends to (or leaks in) an orphan journal
+        try:
+            self.meta.remove(f"topic.{name}")
+        except Exception:  # noqa: BLE001
+            pass
+        self._topics_cache = None
 
     # -- bucket bindings (reference bucket notification conf) ---------------
 
@@ -116,18 +134,24 @@ class NotificationManager:
                    for w in wanted)
 
     def publish(self, bucket: str, key: str, event: str,
-                size: int = 0) -> None:
-        meta = self.store._bucket_meta(bucket)
+                size: int = 0, bmeta: dict | None = None) -> None:
+        meta = bmeta if bmeta is not None \
+            else self.store._bucket_meta(bucket)
         if not meta or not meta.get("notifications"):
             return
+        import datetime
+        iso = datetime.datetime.now(datetime.timezone.utc).strftime(
+            "%Y-%m-%dT%H:%M:%S.%fZ")     # S3 carries ISO8601, not epoch
         record = {
             "eventVersion": "2.2", "eventSource": "ceph_tpu:rgw",
-            "eventTime": time.time(), "eventName": event,
+            "eventTime": iso, "eventName": event,
             "s3": {"bucket": {"name": bucket},
                    "object": {"key": key, "size": size}},
         }
+        live = self.topics()
         for cfg in meta["notifications"]:
-            if self._matches(cfg, event, key):
+            if cfg["topic"] in live and \
+                    self._matches(cfg, event, key):
                 self.meta.execute(
                     f"topic.{cfg['topic']}", "journal", "append",
                     json.dumps({"entry": {"cfg_id": cfg.get("id"),
@@ -138,10 +162,28 @@ class NotificationManager:
     def _push_loop(self) -> None:
         while not self._stop.wait(self.push_interval):
             try:
+                # one drain thread per topic: a hung endpoint must not
+                # stall every other topic's delivery for its timeout
                 for name, tmeta in self.topics().items():
-                    self._drain_topic(name, tmeta["endpoint"])
+                    with self._drain_lock:
+                        if name in self._draining:
+                            continue
+                        self._draining.add(name)
+                    threading.Thread(
+                        target=self._drain_guarded,
+                        args=(name, tmeta["endpoint"]), daemon=True,
+                        name=f"rgw-notify-{name}").start()
             except Exception:  # noqa: BLE001 - zone shutting down etc.
                 continue
+
+    def _drain_guarded(self, name: str, endpoint: str) -> None:
+        try:
+            self._drain_topic(name, endpoint)
+        except Exception:  # noqa: BLE001 - topic deleted mid-drain
+            pass
+        finally:
+            with self._drain_lock:
+                self._draining.discard(name)
 
     def _drain_topic(self, name: str, endpoint: str,
                      batch: int = 64) -> None:
@@ -153,21 +195,25 @@ class NotificationManager:
             oid, "journal", "list",
             json.dumps({"after_seq": pos, "max": batch}).encode())
         entries = json.loads(raw.decode())["entries"]
+        last_ok = None
         for seq, entry in entries:
             body = json.dumps({"Records": [entry["record"]]}).encode()
             req = urllib.request.Request(
                 endpoint, data=body, method="POST",
                 headers={"Content-Type": "application/json"})
             try:
-                with urllib.request.urlopen(req, timeout=10) as resp:
-                    if not 200 <= resp.status < 300:
-                        return            # retry this seq next tick
-            except Exception:  # noqa: BLE001 - receiver down:
-                return                    # at-least-once, retry later
-            # position moves only AFTER the 2xx (commit-after-push)
+                # non-2xx raises HTTPError, landing in the except arm
+                with urllib.request.urlopen(req, timeout=10):
+                    pass
+            except Exception:  # noqa: BLE001 - receiver down/erroring:
+                break                     # at-least-once, retry later
+            last_ok = seq
+            self.delivered += 1
+        if last_ok is not None:
+            # ONE commit + trim per drained batch (position only moves
+            # past what actually got a 2xx — commit-after-push)
             self.meta.execute(
                 oid, "journal", "client_update",
-                json.dumps({"id": "pusher", "pos": seq}).encode())
+                json.dumps({"id": "pusher", "pos": last_ok}).encode())
             self.meta.execute(oid, "journal", "trim",
-                              json.dumps({"to_seq": seq}).encode())
-            self.delivered += 1
+                              json.dumps({"to_seq": last_ok}).encode())

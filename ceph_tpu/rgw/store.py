@@ -232,9 +232,9 @@ class RGWStore:
         return self.notify
 
     def _publish(self, bucket: str, key: str, event: str,
-                 size: int = 0) -> None:
+                 size: int = 0, bmeta: dict | None = None) -> None:
         if self.notify is not None:
-            self.notify.publish(bucket, key, event, size)
+            self.notify.publish(bucket, key, event, size, bmeta=bmeta)
 
     # -- buckets -------------------------------------------------------------
 
@@ -601,7 +601,7 @@ class RGWStore:
             self._account_overwrite(bucket, key, cur, cur_owner,
                                     owner, len(body))
             self._publish(bucket, key, "s3:ObjectCreated:Put",
-                          len(body))
+                          len(body), bmeta=bmeta)
             self._modlog("sync", bucket, key)   # post-success
             return etag
         suspended = bool(bmeta.get("versioning"))   # "" = never versioned
@@ -621,7 +621,8 @@ class RGWStore:
             self._reap_manifest(bucket, m)
         self._account_overwrite(bucket, key, cur, cur_owner, owner,
                                 len(body))
-        self._publish(bucket, key, "s3:ObjectCreated:Put", len(body))
+        self._publish(bucket, key, "s3:ObjectCreated:Put", len(body),
+                      bmeta=bmeta)
         self._modlog("sync", bucket, key)       # post-success
         return etag
 
@@ -733,6 +734,8 @@ class RGWStore:
                 self._user_stats(
                     post_cur.get("owner") or default_owner, bucket,
                     1, post_cur.get("size", 0))
+        self._publish(bucket, key, "s3:ObjectRemoved:Delete",
+                      bmeta=bmeta)
         self._modlog("sync", bucket, key)       # post-success
 
     def _version_row(self, bucket: str, key: str,
@@ -843,7 +846,8 @@ class RGWStore:
             self._usage(owner, "delete_obj", bucket, key,
                         (cur or {}).get("size", 0))
             self._publish(bucket, key,
-                          "s3:ObjectRemoved:DeleteMarkerCreated")
+                          "s3:ObjectRemoved:DeleteMarkerCreated",
+                          bmeta=bmeta)
             self._modlog("sync", bucket, key)   # post-success
             return
         suspended = bool(bmeta.get("versioning"))
@@ -859,7 +863,8 @@ class RGWStore:
             self._user_stats(owner, bucket, -1, -cur.get("size", 0))
         self._usage(owner, "delete_obj", bucket, key,
                     (cur or {}).get("size", 0))
-        self._publish(bucket, key, "s3:ObjectRemoved:Delete")
+        self._publish(bucket, key, "s3:ObjectRemoved:Delete",
+                      bmeta=bmeta)
         if suspended:
             # S3: DELETE on a Suspended bucket replaces the null
             # version with a null DELETE MARKER (the displaced null
@@ -1027,7 +1032,7 @@ class RGWStore:
                                 total)
         self._publish(bucket, key,
                       "s3:ObjectCreated:CompleteMultipartUpload",
-                      total)
+                      total, bmeta=bmeta)
         self._modlog("sync", bucket, key)   # post-success (see _modlog)
         return etag
 
