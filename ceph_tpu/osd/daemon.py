@@ -1379,9 +1379,11 @@ class OSDDaemon:
                               f"failed: {e!r}")
         return complete
 
-    WRITE_OPS = {"write", "writefull", "truncate", "delete", "setxattr",
+    WRITE_OPS = {"write", "writefull", "append", "zero", "create",
+                 "truncate", "delete", "setxattr", "rmxattr",
                  "call", "notify", "watch", "unwatch",
-                 "omapsetkeys", "omaprmkeys", "omapclear", "omapsetheader"}
+                 "omapsetkeys", "omaprmkeys", "omapclear",
+                 "omapsetheader"}
 
     @staticmethod
     def _caps_can_write(caps: str) -> bool:
@@ -1494,6 +1496,38 @@ class OSDDaemon:
         read_payload = b""
         result = 0
         out_meta: list = []
+        # op-vector OVERLAY: later ops in one compound message must see
+        # the staged effects of earlier ones (reference do_osd_ops runs
+        # the vector against the evolving object state).  vsize/vexists
+        # None = not yet consulted; vattrs holds staged xattr values
+        # (None = staged removal).
+        vsize: int | None = None
+        vexists: bool | None = None
+        vattrs: dict[str, bytes | None] = {}
+        vtrunc: int | None = None        # staged truncate_to (the txn
+        # holds ONE truncate value applied after writes, so an op that
+        # extends past it must raise it or be clipped)
+
+        def cur_exists() -> bool:
+            nonlocal vexists
+            if vexists is None:
+                vexists = self._object_exists(state, msg.oid)
+            return vexists
+
+        def cur_size():
+            nonlocal vsize, vexists
+            if vsize is None:
+                vsize = self._stat_logical(state, msg.oid)
+                vexists = vsize is not None
+            return vsize
+
+        def cur_xattr(key: str):
+            if key in vattrs:
+                return vattrs[key]
+            from ..cls import ClsContext
+            ctx = ClsContext(self, state, msg.pgid.pgid, msg.oid)
+            return ctx.getxattr(key)
+
         for op in msg.ops:
             name = op[0]
             if name == "write":
@@ -1502,6 +1536,11 @@ class OSDDaemon:
                           np.frombuffer(msg.data[data_off:data_off + ln],
                                         dtype=np.uint8))
                 data_off += ln
+                vsize = max(cur_size() or 0, off + ln)
+                vexists = True
+                if vtrunc is not None and off + ln > vtrunc:
+                    txn.truncate(msg.oid, off + ln)
+                    vtrunc = off + ln
             elif name == "writefull":
                 _, ln = op
                 txn.write(msg.oid, 0,
@@ -1509,14 +1548,74 @@ class OSDDaemon:
                                         dtype=np.uint8))
                 txn.truncate(msg.oid, ln)  # clip any previous tail
                 data_off += ln
+                vsize, vexists, vtrunc = ln, True, ln
             elif name == "truncate":
                 txn.truncate(msg.oid, op[1])
+                vsize = vtrunc = op[1]
+            elif name == "append":
+                # reference CEPH_OSD_OP_APPEND: write at current size
+                _, ln = op
+                size = cur_size() or 0
+                txn.write(msg.oid, size,
+                          np.frombuffer(msg.data[data_off:data_off + ln],
+                                        dtype=np.uint8))
+                data_off += ln
+                vsize, vexists = size + ln, True
+                if vtrunc is not None and size + ln > vtrunc:
+                    txn.truncate(msg.oid, size + ln)
+                    vtrunc = size + ln
+            elif name == "zero":
+                # reference CEPH_OSD_OP_ZERO: logical zeros, no size
+                # change; on a nonexistent object it is a successful
+                # no-op (PrimaryLogPG ZERO: !obs.exists -> result 0)
+                _, off, ln = op
+                size = cur_size()
+                if size is not None and off < size:
+                    txn.write(msg.oid, off,
+                              np.zeros(min(ln, size - off),
+                                       dtype=np.uint8))
+            elif name == "create":
+                # reference CEPH_OSD_OP_CREATE: op[1] truthy = excl
+                if cur_exists():
+                    if len(op) > 1 and op[1]:
+                        result = -errno.EEXIST
+                        break
+                else:
+                    txn.write(msg.oid, 0,
+                              np.zeros(0, dtype=np.uint8))
+                    vsize, vexists = 0, True
             elif name == "delete":
                 txn.delete(msg.oid)
+                vsize, vexists, vattrs = None, False, {}
+            elif name == "rmxattr":
+                # reference: rmxattr on a nonexistent object is ENOENT
+                # (it must not materialize a phantom object)
+                if not cur_exists():
+                    result = -errno.ENOENT
+                    break
+                txn.setattr(msg.oid, op[1], None)
+                vattrs[op[1]] = None
+            elif name == "getxattr":
+                val = cur_xattr(op[1])
+                if val is None:
+                    result = -errno.ENODATA
+                    break
+                read_payload += bytes(val)
+            elif name == "cmpxattr":
+                # reference CEPH_OSD_OP_CMPXATTR (EQ): guard ops on an
+                # xattr's current value; mismatch cancels the op
+                _, key, ln = op
+                want = bytes(msg.data[data_off:data_off + ln])
+                data_off += ln
+                have = cur_xattr(key)
+                if have is None or bytes(have) != want:
+                    result = -errno.ECANCELED
+                    break
             elif name == "setxattr":
                 _, key, ln = op
-                txn.setattr(msg.oid, key,
-                            bytes(msg.data[data_off:data_off + ln]))
+                val = bytes(msg.data[data_off:data_off + ln])
+                txn.setattr(msg.oid, key, val)
+                vattrs[key] = val
                 data_off += ln
             elif name == "read":
                 _, off, ln = op

@@ -152,6 +152,31 @@ class IoCtx:
     def setxattr(self, name: str, key: str, value: bytes) -> None:
         self._submit(name, [["setxattr", key, len(value)]], bytes(value))
 
+    def getxattr(self, name: str, key: str) -> bytes:
+        return bytes(self._submit(name, [["getxattr", key]]))
+
+    def rmxattr(self, name: str, key: str) -> None:
+        self._submit(name, [["rmxattr", key]])
+
+    def cmpxattr(self, name: str, key: str, value: bytes) -> None:
+        """Guard: raises RadosError(ECANCELED) unless the xattr
+        currently equals `value` (reference rados_cmpxattr EQ)."""
+        self._submit(name, [["cmpxattr", key, len(value)]],
+                     bytes(value))
+
+    def append(self, name: str, data: bytes) -> None:
+        """reference rados_append: write at the current size."""
+        self._submit(name, [["append", len(data)]], bytes(data))
+
+    def zero(self, name: str, off: int, length: int) -> None:
+        """reference rados_zero: logical zeros over a range."""
+        self._submit(name, [["zero", off, length]])
+
+    def create(self, name: str, exclusive: bool = True) -> None:
+        """reference rados_create: make an empty object; exclusive
+        raises EEXIST if it already exists."""
+        self._submit(name, [["create", 1 if exclusive else 0]])
+
     # -- omap (reference rados_omap_* / ObjectWriteOperation omap ops;
     #    OSD-side: the OMAP cases of PrimaryLogPG::do_osd_ops) ---------------
 

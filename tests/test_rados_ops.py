@@ -1,0 +1,112 @@
+"""Round-5 rados opcodes: append / zero / create(excl) / getxattr /
+rmxattr / cmpxattr (reference PrimaryLogPG::do_osd_ops CEPH_OSD_OP_*
+cases), on both replicated and EC pools."""
+
+import errno
+
+import pytest
+
+from ceph_tpu.rados.client import RadosError
+from ceph_tpu.tools.vstart import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with Cluster(n_osds=4) as c:
+        cl = c.client()
+        cl.create_pool("repl", pg_num=4, size=2)
+        cl.set_ec_profile("op21", {"plugin": "jerasure", "k": "2",
+                                   "m": "1", "stripe_unit": "1024"})
+        cl.create_pool("ecp", "erasure", erasure_code_profile="op21",
+                       pg_num=4)
+        yield c, cl
+
+
+@pytest.fixture(scope="module", params=["repl", "ecp"])
+def io(cluster, request):
+    _c, cl = cluster
+    return cl.open_ioctx(request.param)
+
+
+def test_create_exclusive(io):
+    io.create("cx")
+    assert bytes(io.read("cx")) == b""
+    with pytest.raises(RadosError) as ei:
+        io.create("cx")
+    assert ei.value.errno == errno.EEXIST
+    io.create("cx", exclusive=False)     # idempotent without excl
+
+
+def test_append(io):
+    io.create("ap", exclusive=False)
+    io.append("ap", b"hello ")
+    io.append("ap", b"world")
+    assert bytes(io.read("ap")) == b"hello world"
+
+
+def test_zero_inside_and_past_eof(io):
+    io.write_full("zr", b"hello world")
+    io.zero("zr", 2, 3)
+    assert bytes(io.read("zr")) == b"he\0\0\0 world"
+    io.zero("zr", 9, 100)                # clipped at EOF, no growth
+    assert bytes(io.read("zr")) == b"he\0\0\0 wor\0\0"
+    # reference ZERO semantics: nonexistent object -> successful no-op
+    io.zero("absent", 0, 10)
+    with pytest.raises(RadosError) as ei:
+        io.read("absent")
+    assert ei.value.errno == errno.ENOENT
+
+
+def test_xattr_get_rm_cmp(io):
+    io.write_full("xa", b"body")
+    io.setxattr("xa", "color", b"blue")
+    assert io.getxattr("xa", "color") == b"blue"
+    io.cmpxattr("xa", "color", b"blue")  # guard passes
+    with pytest.raises(RadosError) as ei:
+        io.cmpxattr("xa", "color", b"red")
+    assert ei.value.errno == errno.ECANCELED
+    io.rmxattr("xa", "color")
+    with pytest.raises(RadosError) as ei:
+        io.getxattr("xa", "color")
+    assert ei.value.errno == errno.ENODATA
+
+
+def test_rmxattr_nonexistent_is_enoent(io):
+    """rmxattr must not materialize a phantom object."""
+    with pytest.raises(RadosError) as ei:
+        io.rmxattr("ghost", "k")
+    assert ei.value.errno == errno.ENOENT
+    with pytest.raises(RadosError):
+        io.read("ghost")                 # still absent
+
+
+def test_compound_vector_sees_staged_state(io):
+    """Later ops in ONE compound message observe earlier ops' staged
+    effects (reference do_osd_ops evolves the object state through the
+    vector)."""
+    # two appends in one message: sequential, not overlapping
+    io._submit("cv", [["create", 0], ["append", 3], ["append", 3]],
+               b"AAABBB")
+    assert bytes(io.read("cv")) == b"AAABBB"
+    # setxattr then cmpxattr in one message: guard sees the staged value
+    io._submit("cv", [["setxattr", "v", 1], ["cmpxattr", "v", 1],
+                      ["append", 1]], b"22C")
+    assert bytes(io.read("cv")) == b"AAABBBC"
+    # writefull then append: append lands at the NEW size
+    io._submit("cv", [["writefull", 2], ["append", 2]], b"xxyy")
+    assert bytes(io.read("cv")) == b"xxyy"
+
+
+def test_cmpxattr_guards_compound_op(io):
+    """The reference pattern: cmpxattr as the first op of a compound
+    guards the write that follows — mismatch cancels the whole op."""
+    io.write_full("gd", b"v1")
+    io.setxattr("gd", "ver", b"1")
+    io._submit("gd", [["cmpxattr", "ver", 1], ["writefull", 2]],
+               b"1" + b"v2")
+    assert bytes(io.read("gd")) == b"v2"
+    with pytest.raises(RadosError) as ei:
+        io._submit("gd", [["cmpxattr", "ver", 1], ["writefull", 2]],
+                   b"9" + b"XX")
+    assert ei.value.errno == errno.ECANCELED
+    assert bytes(io.read("gd")) == b"v2"   # guarded write not applied
