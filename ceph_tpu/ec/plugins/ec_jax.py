@@ -88,6 +88,7 @@ class ErasureCodeJax(ErasureCode):
         self._enc_bitmat32 = jnp.asarray(
             bs._w32_bitmat(self.matrix[self.k:]), dtype=jnp.int8) \
             if self._use_w32 else None
+        self._fused_point: dict | None = None   # lazy autotune result
         super().init(profile)
 
     def get_alignment(self) -> int:
@@ -135,30 +136,46 @@ class ErasureCodeJax(ErasureCode):
                 "uses Mosaic bitcasts); use encode_chunks_device on CPU")
         return bs.gf_bitmatmul_w32(self._enc_bitmat32, words, self.m)
 
+    def fused_point(self) -> dict:
+        """The fused kernel's (tile, wb, packed) operating point for
+        this device, resolved lazily through the ops/autotune cache
+        (first fused call on a fresh accelerator pays the sweep; CPU
+        and opted-out runs get the static defaults)."""
+        if self._fused_point is None:
+            from ...ops import autotune
+            try:
+                self._fused_point = autotune.fused_operating_point(
+                    self.k, self.m, mat=self.matrix[self.k:],
+                    bitmat32=self._enc_bitmat32)
+            except Exception:  # noqa: BLE001 — tuning must never
+                self._fused_point = autotune.default_point()  # break IO
+        return self._fused_point
+
     def encode_words_with_crc(self, words, tile: int | None = None,
                               wb: int | None = None):
-        """Device-resident fused parity + per-tile crc L-bits over
-        word-packed input at the headline operating point (the hier-crc
-        kernel; see ops/crc32c_linear.subblock_crc_bits_w32).  words
-        (k, W) int32; W bytes per shard must be a tile multiple.
-        Returns (parity (m, W) int32, crc L-bits ((W*4//tile)*rows, 32)
-        int32) — the write path's checksum-and-parity-in-one-launch
-        (reference analog: plugin encode + ECUtil.cc:172 HashInfo
-        append, two separate passes there)."""
+        """Device-resident fused parity + crc over word-packed input at
+        the autotuned operating point (the hier-crc kernel with the
+        device-side log-depth combine; see
+        ops/bitsliced.gf_encode_with_crc_w32_fold).  words (k, W)
+        int32; W bytes per shard must be a tile multiple.  Returns
+        (parity (m, W) int32, crc L-bits (k+m, 32) int32 — ONE
+        combined L per shard, fold with crc32c_linear.fold_run_crc) —
+        the write path's checksum-and-parity-in-one-launch (reference
+        analog: plugin encode + ECUtil.cc:172 HashInfo append, two
+        separate passes there)."""
         import jax.numpy as jnp
         bs = _ops()
         from ...ops import crc32c_linear as cl
         if not self._use_w32:
             raise RuntimeError(
                 "encode_words_with_crc requires a TPU backend")
-        tile = tile or bs.FUSED_TILE_HIER
-        wb = wb or bs.FUSED_WB
+        point = self.fused_point()
+        tile = tile or point["tile"]
+        wb = wb or point["wb"]
         cmat_sub = jnp.asarray(cl.crc_tile_matrix_w32(wb))
-        combine = jnp.asarray(
-            cl.crc_combine_matrix(tile // 4 // wb, 4 * wb))
-        return bs.gf_encode_with_crc_pallas_w32_hier(
-            self._enc_bitmat32, cmat_sub, combine, words, self.m,
-            tile=tile, wb=wb)
+        return bs.gf_encode_with_crc_w32_fold(
+            self._enc_bitmat32, cmat_sub, words, self.m,
+            tile=tile, wb=wb, packed=point["packed"])
 
     def encode_stripes(self, stripes):
         """Batched encode: (B, k, C) -> (B, m, C), one kernel launch.
@@ -178,24 +195,32 @@ class ErasureCodeJax(ErasureCode):
 
     def encode_extents_with_crc(self, runs: list[np.ndarray]):
         """Multi-extent fused launch: every run of a pipeline drain gets
-        parity + per-tile crc L-vectors from ONE kernel call (w32 on
-        TPU — the headline kernel, not the 4x-slower byte variant).
+        parity + ONE device-combined crc L per shard from ONE kernel
+        call (w32 on TPU — the headline kernel, not the 4x-slower byte
+        variant), at the autotuned operating point.
 
-        Returns per-run (parity (m, Wi), tile_ls, tail_bytes, tile);
-        fold each with fold_extent_crcs, chaining seeds per object.
+        Returns per-run (parity (m, Wi), l (k+m,) uint32, tail_bytes,
+        body_bytes); fold each with fold_extent_crcs, chaining seeds
+        per object.
         """
         from ...ops import bitsliced as bs
+        point = self.fused_point() if self._use_w32 else None
         return bs.gf_encode_extents_with_crc(
             self._enc_bitmat, self._enc_bitmat32, runs, self.m,
-            use_w32=self._use_w32)
+            use_w32=self._use_w32,
+            tile=point["tile"] if point else None,
+            wb=point["wb"] if point else None,
+            packed=point["packed"] if point else False)
 
-    def fold_extent_crcs(self, tile_ls, tail_bytes, seeds: list[int],
-                         tile: int) -> list[int]:
-        """Host fold of one run's kernel crc output into cumulative
-        shard crcs with per-shard seeds (the hinfo chain)."""
+    def fold_extent_crcs(self, l, tail_bytes, seeds: list[int],
+                         body_bytes: int) -> list[int]:
+        """Host fold of one run's device-combined L-vectors into
+        cumulative shard crcs with per-shard seeds (the hinfo chain):
+        O(1) combines per shard — one seed-advance plus the sub-block
+        tail — no per-tile Python loop."""
         from ...ops import crc32c_linear as cl
-        return [cl.fold_tile_crcs(tile_ls[s], tile, seeds[s],
-                                  tail_bytes[s].tobytes())
+        return [cl.fold_run_crc(int(l[s]), body_bytes, seeds[s],
+                                tail_bytes[s].tobytes())
                 for s in range(self.k + self.m)]
 
     def encode_chunks_with_crc(self, chunks: np.ndarray,
@@ -212,9 +237,9 @@ class ErasureCodeJax(ErasureCode):
         chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
         if seeds is None:
             seeds = [0xFFFFFFFF] * (self.k + self.m)
-        [(parity, tile_ls, tail_bytes, tile)] = \
+        [(parity, l, tail_bytes, body_bytes)] = \
             self.encode_extents_with_crc([chunks])
-        crcs = self.fold_extent_crcs(tile_ls, tail_bytes, seeds, tile)
+        crcs = self.fold_extent_crcs(l, tail_bytes, seeds, body_bytes)
         return np.asarray(parity), crcs
 
     # -- decode -------------------------------------------------------------

@@ -1,0 +1,260 @@
+"""Cached autotuner for the fused parity+crc kernel's operating point.
+
+The fused kernel has three knobs with hardware-dependent optima:
+`tile` (bytes per grid step — DMA granularity vs VMEM pressure), `wb`
+(crc sub-block words — the crc matmul's M dimension is (k+m) * tile/4/wb,
+so wb trades MXU row utilization against matrix VMEM), and `packed`
+(the 4-bits-per-pass crc extraction, whose strided sublane slice only
+lowers on some Mosaic generations).  tools/fused_tile_sweep.py used to
+sweep these by hand and the winners were frozen into
+bitsliced.FUSED_TILE_HIER / FUSED_WB; this module replaces the
+hardcoded constants with a measured, per-device choice:
+
+  * the sweep runs at plugin init (first fused encode) on accelerator
+    backends only — CPU/interpret callers get the static defaults;
+  * every candidate is first VALIDATED bit-exactly against the host
+    crc32c and parity oracles, so a variant that miscompiles or
+    misbehaves on this Mosaic generation is skipped, never shipped;
+  * results persist in a JSON cache keyed by (platform, device_kind,
+    k, m), so only the first init on a given device pays the sweep;
+  * a wall-clock budget (CEPH_TPU_AUTOTUNE_BUDGET_S, default 75 s)
+    bounds init latency — candidates are ordered best-guess-first and
+    the sweep keeps the best fully-measured point when time runs out.
+
+Env knobs: CEPH_TPU_AUTOTUNE=0 disables sweeping (cache hits are still
+honored); CEPH_TPU_AUTOTUNE_CACHE overrides the cache path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+# candidate space: tiles around the headline kernel's W32_TILE, wb
+# spanning crc-matmul M from ~(k+m)*32 to ~(k+m)*256
+SWEEP_TILES = (32768, 65536, 131072, 262144)
+SWEEP_WBS = (256, 512, 1024)
+
+# measurement input: bytes per shard (multiple of every sweep tile)
+MEASURE_BYTES = 1 << 21
+MEASURE_ITERS = (5, 15)
+ROOFLINE_BPS = 1e12           # same elision gate as bench.py
+
+_lock = threading.Lock()
+
+
+def default_point() -> dict:
+    from . import bitsliced as bs
+    return {"tile": bs.FUSED_TILE_HIER, "wb": bs.FUSED_WB,
+            "packed": False}
+
+
+def _cache_path() -> Path:
+    env = os.environ.get("CEPH_TPU_AUTOTUNE_CACHE")
+    if env:
+        return Path(env)
+    return Path.home() / ".cache" / "ceph_tpu" / "autotune.json"
+
+
+def _load_cache() -> dict:
+    try:
+        data = json.loads(_cache_path().read_text())
+        if data.get("version") == 1:
+            return data
+    except (OSError, ValueError):
+        pass
+    return {"version": 1, "entries": {}}
+
+
+def _save_cache(data: dict) -> None:
+    """Atomic, best-effort: a read-only home dir must not break init."""
+    try:
+        path = _cache_path()
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(data, indent=1, sort_keys=True))
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+def _device_key(k: int, m: int) -> str:
+    import jax
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "?")
+    # the jax/jaxlib version is part of the key: the packed variant's
+    # lowering is Mosaic-generation-dependent, so a point validated on
+    # one runtime must NOT be trusted (unvalidated) on another — an
+    # upgrade simply re-sweeps
+    return (f"{dev.platform}/{kind}/jax{jax.__version__}"
+            f"/fused_w32/k{k}m{m}")
+
+
+def candidates(k: int, m: int, tiles=None, wbs=None) -> list[dict]:
+    """Legal (tile, wb, packed) points, best-guess-first: the frozen
+    default leads so a budget-capped sweep still measures a baseline."""
+    r = k + m
+    out = []
+    for tile in tiles or SWEEP_TILES:
+        wt = tile // 4
+        for wb in wbs or SWEEP_WBS:
+            if wt % wb:
+                continue
+            s = wt // wb
+            if (r * s) % 8:      # lsub out-block sublane alignment
+                continue
+            for packed in (False, True):
+                out.append({"tile": tile, "wb": wb, "packed": packed})
+    dflt = default_point()
+    out.sort(key=lambda c: (c["tile"] != dflt["tile"],
+                            c["wb"] != dflt["wb"], c["packed"]))
+    return out
+
+
+def _validate(mat: np.ndarray, bitmat32, cand: dict) -> bool:
+    """Bit-exactness gate: one small fused launch vs the host parity
+    and crc32c oracles.  A candidate that fails to compile, lower, or
+    match (e.g. the packed extraction's strided slice on an older
+    Mosaic) is rejected here — never silently shipped."""
+    import jax.numpy as jnp
+
+    from ..common import crc32c as _crc
+    from ..ec import gf
+    from . import bitsliced as bs
+    from . import crc32c_linear as cl
+    m_, k = mat.shape
+    tile, wb = cand["tile"], cand["wb"]
+    rng = np.random.default_rng(0xC5C)
+    chunks = rng.integers(0, 256, (k, tile), dtype=np.uint8)
+    words = jnp.asarray(chunks.view("<u4").view(np.int32))
+    cmat_sub = jnp.asarray(cl.crc_tile_matrix_w32(wb))
+    try:
+        par_w, lbits = bs.gf_encode_with_crc_w32_fold(
+            bitmat32, cmat_sub, words, m_, tile=tile, wb=wb,
+            packed=cand["packed"])
+        parity = np.asarray(par_w).view("<u4").view(np.uint8) \
+            .reshape(m_, tile)
+        ls = cl.bits_to_u32(np.asarray(lbits))
+    except Exception:  # noqa: BLE001 — any lowering/compile failure
+        return False
+    if not np.array_equal(parity, gf.gf_matvec(mat, chunks)):
+        return False
+    allsh = np.concatenate([chunks, parity], axis=0)
+    return all(
+        cl.fold_run_crc(int(ls[s]), tile, 0xFFFFFFFF)
+        == _crc.crc32c(allsh[s].tobytes(), 0xFFFFFFFF)
+        for s in range(k + m_))
+
+
+def _measure(bitmat32, k: int, m: int, cand: dict) -> float:
+    """Short chained-fori slope timing (bench.py's anti-elision method,
+    scaled down): returns input bytes/sec, 0.0 on a gated sample."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from . import bitsliced as bs
+    from . import crc32c_linear as cl
+    tile, wb = cand["tile"], cand["wb"]
+    rng = np.random.default_rng(0x7E5)
+    flat = rng.integers(0, 256, (k, MEASURE_BYTES), dtype=np.uint8)
+    x0 = jnp.asarray(flat.view(np.int32))
+    cmat_sub = jnp.asarray(cl.crc_tile_matrix_w32(wb))
+
+    def step(x):
+        par, lbits = bs.gf_encode_with_crc_w32_fold(
+            bitmat32, cmat_sub, x, m, tile=tile, wb=wb,
+            packed=cand["packed"])
+        return par ^ jnp.sum(lbits)      # crc feeds the chain: no DCE
+
+    def make(iters):
+        @jax.jit
+        def f(x):
+            def body(i, x):
+                return x.at[:m, :].set(x[:m, :] ^ step(x))
+            return lax.fori_loop(0, iters, body, x)
+        return f
+
+    lo_i, hi_i = MEASURE_ITERS
+    f_lo, f_hi = make(lo_i), make(hi_i)
+    jax.block_until_ready(f_lo(x0))
+    jax.block_until_ready(f_hi(x0))
+    best = []
+    for rep in range(2):
+        v = jax.block_until_ready(x0 ^ (rep + 1))
+        t0 = time.perf_counter()
+        jax.block_until_ready(f_lo(v))
+        lo = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        jax.block_until_ready(f_hi(v))
+        hi = time.perf_counter() - t0
+        dt = (hi - lo) / (hi_i - lo_i)
+        if dt > 0 and k * MEASURE_BYTES / dt < ROOFLINE_BPS:
+            best.append(k * MEASURE_BYTES / dt)
+    best.sort()
+    return best[len(best) // 2] if best else 0.0
+
+
+def fused_operating_point(k: int, m: int, mat: np.ndarray | None = None,
+                          bitmat32=None, tiles=None, wbs=None,
+                          force: bool = False,
+                          report: list | None = None) -> dict:
+    """The (tile, wb, packed) point the fused encode+crc path should
+    run at on THIS device, sweeping and caching on first use.
+
+    `mat` (m, k) GF(2^8) generator rows and `bitmat32` (its
+    _w32_bitmat device array) enable the sweep; without them (or on
+    CPU, or with CEPH_TPU_AUTOTUNE=0) the cached or default point is
+    returned as-is.  `report`, when given, collects per-candidate
+    (cand, gbps|None) tuples for the sweep CLI."""
+    import jax
+    if jax.default_backend() == "cpu":
+        return default_point()
+    with _lock:
+        key = _device_key(k, m)
+        cache = _load_cache()
+        hit = cache["entries"].get(key)
+        if hit is not None and not force:
+            return {kk: hit[kk] for kk in ("tile", "wb", "packed")}
+        if os.environ.get("CEPH_TPU_AUTOTUNE", "1") == "0" or \
+                mat is None or bitmat32 is None:
+            return default_point()
+        budget = float(os.environ.get("CEPH_TPU_AUTOTUNE_BUDGET_S", "75"))
+        t0 = time.perf_counter()
+        best, best_rate = None, 0.0
+        tried = 0
+        for cand in candidates(k, m, tiles, wbs):
+            # honor the budget once ANY candidate has been attempted —
+            # even if every sample so far was roofline-gated to 0.0 —
+            # so a noisy/elision-prone runtime cannot turn plugin init
+            # into an unbounded 24-candidate sweep
+            if tried and time.perf_counter() - t0 > budget:
+                break
+            tried += 1
+            if not _validate(mat, bitmat32, cand):
+                if report is not None:
+                    report.append((cand, None))
+                continue
+            rate = _measure(bitmat32, k, m, cand)
+            if report is not None:
+                report.append((cand, rate))
+            if rate > best_rate:
+                best, best_rate = cand, rate
+        if best is None:
+            # nothing validated/measured: cache the DEFAULT as this
+            # device's point so every later init doesn't re-pay the
+            # full failed sweep ("only the first init pays" must hold
+            # exactly where the sweep is most expensive); gbps 0.0
+            # marks it as a failure sentinel, and --force re-sweeps
+            best, best_rate = default_point(), 0.0
+        cache["entries"][key] = {**best,
+                                 "gbps": round(best_rate / 1e9, 3),
+                                 "when": time.strftime(
+                                     "%Y-%m-%dT%H:%M:%S")}
+        _save_cache(cache)
+        return best

@@ -493,21 +493,70 @@ FUSED_WB = 512       # hier-crc sub-block, words (2 KiB); lane multiple
 FUSED_TILE_HIER = W32_TILE   # hier matrices are tile-size-independent
 
 
-def _make_gf_crc_kernel_w32_hier(interpret: bool, wb: int):
+def _make_gf_crc_kernel_w32_hier(interpret: bool, wb: int,
+                                 packed: bool = False):
     def _kern(bitmat_ref, cmat_sub_ref, in_ref, par_ref, lsub_ref):
         """Fused parity + level-1 hierarchical crc at the headline
         kernel's tile: the same VMEM-resident words feed the MXU parity
         matmul and the sub-block crc matmuls (see
         crc32c_linear.subblock_crc_bits_w32 for why the flat crc matmul
-        capped the fused tile at 2 KiB)."""
+        capped the fused tile at 2 KiB).  `packed` selects the
+        4-bits-per-pass crc extraction (subblock_crc_bits_w32_packed) —
+        autotune-gated, as its strided sublane slice is generation-
+        dependent in Mosaic."""
         from . import crc32c_linear as cl
         w = in_ref[:]                                  # (k, Wt) i32
         par_words = _w32_parity_words(bitmat_ref[:], w, interpret)
         par_ref[:] = par_words
         allw = jnp.concatenate([w, par_words], axis=0)  # (k+m, Wt)
-        lsub_ref[:] = cl.subblock_crc_bits_w32(
-            allw, cmat_sub_ref[:], wb)                  # ((k+m)*S, 32)
+        if packed:
+            lsub = cl.subblock_crc_bits_w32_packed(
+                allw, cmat_sub_ref[:], wb, interpret)
+        else:
+            lsub = cl.subblock_crc_bits_w32(
+                allw, cmat_sub_ref[:], wb)              # ((k+m)*S, 32)
+        lsub_ref[:] = lsub
     return _kern
+
+
+def _fused_hier_call(bitmat32, cmat_sub, words, m: int, tile: int,
+                     wb: int, interpret: bool, packed: bool = False):
+    """Raw pallas_call of the hier fused kernel over a byte-axis grid
+    with double-buffered input blocks (the `parallel` dimension
+    semantics let Mosaic overlap each block's HBM->VMEM DMA with the
+    previous block's MXU work — the launch is a pipeline, not one
+    VMEM-resident tile).  Returns (parity (m, W) i32, lsub
+    ((W*4//tile) * (k+m) * S, 32) i32 per-SUB-BLOCK L-bits, row-major
+    [tile, shard, sub]) — callers choose the combine (per-tile level-2
+    for the legacy contract, whole-extent log-fold for the device-side
+    combine path)."""
+    k, wtot = words.shape
+    wt = tile // 4
+    assert wtot % wt == 0, (wtot, wt)
+    assert wt % wb == 0, (wt, wb)
+    s = wt // wb
+    r = k + m
+    assert (r * s) % 8 == 0, (r, s)     # lsub out-block sublane align
+    grid = (wtot // wt,)
+    return pl.pallas_call(
+        _make_gf_crc_kernel_w32_hier(interpret, wb, packed),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((32 * m, 32 * k), lambda t: (0, 0)),
+            pl.BlockSpec((32 * wb, 32), lambda t: (0, 0)),
+            pl.BlockSpec((k, wt), lambda t: (0, t)),
+        ],
+        out_specs=[
+            pl.BlockSpec((m, wt), lambda t: (0, t)),
+            pl.BlockSpec((r * s, 32), lambda t: (t, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, wtot), jnp.int32),
+            jax.ShapeDtypeStruct(((wtot // wt) * r * s, 32), jnp.int32),
+        ],
+        interpret=interpret,
+        **_parallel_grid(1, interpret),
+    )(bitmat32.astype(jnp.int8), cmat_sub, words)
 
 
 @functools.partial(jax.jit, static_argnames=("m", "tile", "wb",
@@ -527,37 +576,49 @@ def gf_encode_with_crc_pallas_w32_hier(bitmat32, cmat_sub, combine,
     from . import crc32c_linear as cl
     k, wtot = words.shape
     wt = tile // 4
-    assert wtot % wt == 0, (wtot, wt)
-    assert wt % wb == 0, (wt, wb)
     s = wt // wb
     r = k + m
-    assert (r * s) % 8 == 0, (r, s)     # lsub out-block sublane align
-    grid = (wtot // wt,)
     rows = _crc_rows(r)
-    parity, lsub = pl.pallas_call(
-        _make_gf_crc_kernel_w32_hier(interpret, wb),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((32 * m, 32 * k), lambda t: (0, 0)),
-            pl.BlockSpec((32 * wb, 32), lambda t: (0, 0)),
-            pl.BlockSpec((k, wt), lambda t: (0, t)),
-        ],
-        out_specs=[
-            pl.BlockSpec((m, wt), lambda t: (0, t)),
-            pl.BlockSpec((r * s, 32), lambda t: (t, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((m, wtot), jnp.int32),
-            jax.ShapeDtypeStruct(((wtot // wt) * r * s, 32), jnp.int32),
-        ],
-        interpret=interpret,
-        **_parallel_grid(1, interpret),
-    )(bitmat32.astype(jnp.int8), cmat_sub, words)
+    parity, lsub = _fused_hier_call(bitmat32, cmat_sub, words, m,
+                                    tile, wb, interpret)
     crc = cl.combine_subblock_crcs(lsub, combine, r, s)  # (nt, r, 32)
     pad = rows - r
     if pad:
         crc = jnp.pad(crc, ((0, 0), (0, pad), (0, 0)))
     return parity, crc.reshape(-1, 32)
+
+
+@functools.partial(jax.jit, static_argnames=("m", "tile", "wb",
+                                             "interpret", "packed"))
+def gf_encode_with_crc_w32_fold(bitmat32, cmat_sub, words, m: int,
+                                tile: int = FUSED_TILE_HIER,
+                                wb: int = FUSED_WB,
+                                interpret: bool = False,
+                                packed: bool = False):
+    """The device-side-combine fused launch: parity AND one 32-bit
+    crc32c L-vector per shard from a single dispatch.
+
+    words (k, W) i32, W bytes a `tile` multiple; cmat_sub from
+    crc_tile_matrix_w32(wb).  Returns (parity (m, W) i32, L-bits
+    (k+m, 32) i32).  The kernel streams byte-axis blocks (double-
+    buffered DMA, see _fused_hier_call) emitting per-sub-block
+    L-vectors; the across-extent log-depth combine
+    (crc32c_linear.combine_crcs_pow2) runs inside this same jit, so
+    the host sees ONE L per shard and pays a single seed-advance per
+    extent (fold_run_crc) instead of the old O(ntiles) Python loop."""
+    from . import crc32c_linear as cl
+    parity, lb = _hier_lsub_core(bitmat32, cmat_sub, words, m,
+                                 tile, wb, interpret, packed)
+    # fold the whole extent's sub-block Ls in log2(nsub) matmuls
+    return parity, cl.combine_crcs_pow2(lb, 4 * wb)
+
+
+@functools.partial(jax.jit, static_argnames=("block_bytes",))
+def _combine_run(lbits, block_bytes: int):
+    """jit shell over combine_crcs_pow2 for the per-run folds of the
+    extents path (cached per (shape, block_bytes))."""
+    from . import crc32c_linear as cl
+    return cl.combine_crcs_pow2(lbits, block_bytes)
 
 
 @functools.partial(jax.jit, static_argnames=("m", "tile"))
@@ -583,24 +644,57 @@ def gf_encode_with_crc_xla(bitmat, cmat, chunks, m: int,
     return parity, jnp.stack(crcs)
 
 
+def _hier_lsub_core(bitmat32, cmat_sub, words, m: int, tile: int,
+                    wb: int, interpret: bool, packed: bool):
+    """Hier launch + re-layout: (parity, per-sub-block L-bits reordered
+    [tile, shard, sub] -> (k+m, total_sub_blocks, 32) stream order).
+    Shared by the single-extent fold entry and the extents path."""
+    k, wtot = words.shape
+    wt = tile // 4
+    s = wt // wb
+    r = k + m
+    nt = wtot // wt
+    parity, lsub = _fused_hier_call(bitmat32, cmat_sub, words, m,
+                                    tile, wb, interpret, packed)
+    lb = lsub.reshape(nt, r, s, 32).transpose(1, 0, 2, 3) \
+        .reshape(r, nt * s, 32)
+    return parity, lb
+
+
+_fused_hier_lsub = functools.partial(jax.jit, static_argnames=(
+    "m", "tile", "wb", "interpret", "packed"))(_hier_lsub_core)
+
+
 def gf_encode_extents_with_crc(bitmat, bitmat32, runs, m: int,
                                use_w32: bool | None = None,
                                force_xla: bool | None = None,
-                               interpret: bool = False):
-    """Multi-extent fused launch: parity + per-tile crc L-vectors for a
-    whole pipeline drain in ONE kernel call (lifting the round-1
-    restriction that only a single-op drain could fuse).
+                               interpret: bool = False,
+                               tile: int | None = None,
+                               wb: int | None = None,
+                               packed: bool = False):
+    """Multi-extent fused launch: parity + ONE device-combined crc
+    L-vector per shard per run, for a whole pipeline drain in one
+    kernel call (lifting the round-1 restriction that only a single-op
+    drain could fuse).
 
     Each run (k, Wi) uint8 is zero-padded to a tile multiple and the
     padded runs concatenate along the byte axis, so every run starts
-    tile-aligned: its body tiles' crcs come straight out of the kernel
-    and only the sub-tile tail (data rows from the input, parity rows
-    from the launch output) folds on host.  Zero padding is benign for
-    parity (linear code) and the padded tile's crc row is simply unused.
+    tile-aligned.  The launch emits per-block L-vectors (hier kernel:
+    2 KiB sub-blocks; flat/XLA: 2 KiB tiles); each run's full blocks
+    fold ON DEVICE (combine_crcs_pow2, log-depth int8 matmuls) into a
+    single L per shard, and only the sub-BLOCK tail (data rows from the
+    input, parity rows from the launch output) reaches the host.  Zero
+    padding is benign for parity (linear code) and the padded block's
+    L-row is simply unused.
 
-    Returns a list of (parity (m, Wi) uint8, tile_ls (k+m, ntiles) u32,
-    tail_bytes (k+m, tail_len) uint8, tile) per run — fold with
-    crc32c_linear.fold_tile_crcs seeded per shard.
+    `tile`/`wb`/`packed` override the hier kernel's operating point
+    (fed by ops/autotune via the plugin); defaults keep the static
+    FUSED_TILE_HIER/FUSED_WB constants.
+
+    Returns a list of (parity (m, Wi) uint8, l (k+m,) uint32 over the
+    run's body, tail_bytes (k+m, tail_len) uint8, body_bytes) per run —
+    fold with crc32c_linear.fold_run_crc seeded per shard: O(1) host
+    combines per extent, no per-tile Python loop.
     """
     from . import crc32c_linear as cl
     if force_xla is None:
@@ -609,42 +703,55 @@ def gf_encode_extents_with_crc(bitmat, bitmat32, runs, m: int,
         use_w32 = not force_xla
     runs = [np.ascontiguousarray(r, dtype=np.uint8) for r in runs]
     k = runs[0].shape[0]
+    r_tot = k + m
+    tile_hier = tile or FUSED_TILE_HIER
+    wb = wb or FUSED_WB
     # operating point: big sequential drains ride the hier-crc kernel at
-    # the headline tile (FUSED_TILE_HIER); small/mixed drains keep the
-    # flat 2 KiB tile where padding waste would dominate
+    # the autotuned tile; small/mixed drains keep the flat 2 KiB tile
+    # where padding waste would dominate
     tile = FUSED_TILE
+    hier = False
     if use_w32 and not force_xla and \
-            min(r.shape[1] for r in runs) >= FUSED_TILE_HIER:
-        tile = FUSED_TILE_HIER
-    meta = []           # (width, body) per run
+            min(r.shape[1] for r in runs) >= tile_hier:
+        tile = tile_hier
+        hier = True
+    meta = []           # width per run
     padded = []
     for r in runs:
         w = r.shape[1]
-        body = (w // tile) * tile
         pad = -w % tile
-        meta.append((w, body))
+        meta.append(w)
         padded.append(np.pad(r, ((0, 0), (0, pad))) if pad else r)
     big = np.concatenate(padded, axis=1)               # (k, ntiles*tile)
     ntiles_total = big.shape[1] // tile
-    rows = _crc_rows(k + m)
+    rows = _crc_rows(r_tot)
     if force_xla:
         cmat = jnp.asarray(cl.crc_tile_matrix(tile))
         parity_big, crc_bits = gf_encode_with_crc_xla(
             bitmat, cmat, jnp.asarray(big), m)
-        crc_bits = np.asarray(crc_bits)                # (ntiles, k+m, 32)
-    elif use_w32 and tile == FUSED_TILE_HIER:
-        wt, wb = tile // 4, FUSED_WB
+        lb_all = jnp.transpose(crc_bits, (1, 0, 2))    # (r, ntiles, 32)
+        block_bytes = tile
+    elif not use_w32:
+        # byte-path Pallas kernel (TPU without the w32 layout): per-tile
+        # L rows, device-combined per run below like the flat w32 path
+        cmat = jnp.asarray(cl.crc_tile_matrix(tile))
+        parity_big, crc_flat = gf_encode_with_crc_pallas(
+            bitmat, cmat, jnp.asarray(big), m)
+        parity_big = np.asarray(parity_big)
+        lb_all = jnp.transpose(
+            crc_flat.reshape(ntiles_total, rows, 32)[:, :r_tot],
+            (1, 0, 2))                                 # (r, ntiles, 32)
+        block_bytes = tile
+    elif hier:
         cmat_sub = jnp.asarray(cl.crc_tile_matrix_w32(wb))
-        combine = jnp.asarray(cl.crc_combine_matrix(wt // wb, 4 * wb))
         words = big.view("<u4").view(np.int32)
-        par_words, crc_flat = gf_encode_with_crc_pallas_w32_hier(
-            bitmat32, cmat_sub, combine, jnp.asarray(words), m,
-            tile=tile, wb=wb, interpret=interpret)
+        par_words, lb_all = _fused_hier_lsub(
+            bitmat32, cmat_sub, jnp.asarray(words), m, tile, wb,
+            interpret, packed)                         # (r, nsub, 32)
         parity_big = np.asarray(par_words).view("<u4").view(np.uint8) \
             .reshape(m, big.shape[1])
-        crc_bits = np.asarray(crc_flat).reshape(
-            ntiles_total, rows, 32)[:, :k + m]
-    elif use_w32:
+        block_bytes = 4 * wb
+    else:
         wt = tile // 4
         cmat32 = jnp.asarray(cl.crc_tile_matrix_w32(wt))
         words = big.view("<u4").view(np.int32)
@@ -652,29 +759,40 @@ def gf_encode_extents_with_crc(bitmat, bitmat32, runs, m: int,
             bitmat32, cmat32, jnp.asarray(words), m, interpret=interpret)
         parity_big = np.asarray(par_words).view("<u4").view(np.uint8) \
             .reshape(m, big.shape[1])
-        crc_bits = np.asarray(crc_flat).reshape(
-            ntiles_total, rows, 32)[:, :k + m]
-    else:
-        cmat = jnp.asarray(cl.crc_tile_matrix(tile))
-        parity_big, crc_flat = gf_encode_with_crc_pallas(
-            bitmat, cmat, jnp.asarray(big), m)
-        crc_bits = np.asarray(crc_flat).reshape(
-            ntiles_total, rows, 32)[:, :k + m]
-    parity_big = np.asarray(parity_big)
-    tile_ls_all = cl.bits_to_u32(crc_bits).T           # (k+m, ntiles)
+        lb_all = jnp.transpose(
+            crc_flat.reshape(ntiles_total, rows, 32)[:, :r_tot],
+            (1, 0, 2))                                 # (r, ntiles, 32)
+        block_bytes = tile
+    if force_xla:
+        parity_big = np.asarray(parity_big)
     out = []
     coff = 0
-    toff = 0
-    for (w, body), pr in zip(meta, padded):
+    for w, pr in zip(meta, padded):
         par = parity_big[:, coff:coff + w]
-        tls = tile_ls_all[:, toff:toff + body // tile]
+        nb = w // block_bytes                 # full blocks = run body
+        body = nb * block_bytes
+        if nb:
+            boff = coff // block_bytes
+            lb_run = lb_all[:, boff:boff + nb]
+            # zero-PREFIX pad to the next power of two before the
+            # jitted combine: L(0^n || B) = L(B), so the pad is free,
+            # and it collapses the jit-cache key space from "every
+            # distinct extent length" to ~log2 shapes (a drain of
+            # varied object sizes must not recompile per length)
+            nb2 = 1 << (nb - 1).bit_length()
+            if nb2 != nb:
+                lb_run = jnp.pad(lb_run, ((0, 0), (nb2 - nb, 0),
+                                          (0, 0)))
+            lbits = _combine_run(lb_run, block_bytes)
+            l = cl.bits_to_u32(np.asarray(lbits))      # (k+m,) u32
+        else:
+            l = np.zeros(r_tot, dtype=np.uint32)
         tail_data = pr[:, body:w]
         tail_par = par[:, body:w]
         tail_bytes = np.concatenate([tail_data, tail_par], axis=0) \
-            if w > body else np.zeros((k + m, 0), dtype=np.uint8)
-        out.append((par, tls, tail_bytes, tile))
+            if w > body else np.zeros((r_tot, 0), dtype=np.uint8)
+        out.append((par, l, tail_bytes, body))
         coff += pr.shape[1]
-        toff += pr.shape[1] // tile
     return out
 
 

@@ -105,6 +105,66 @@ def crc_combine_matrix(s: int, block_bytes: int) -> np.ndarray:
     return out.reshape(s * 32, 32)
 
 
+def combine_crcs_pow2(lbits, block_bytes: int):
+    """Log-depth GF(2) combine of per-block L-vectors into one L per
+    shard — the device-side replacement for the host fold_tile_crcs
+    loop (each launch returns ONE 32-bit L per shard; the host pays a
+    single seed-advance per extent).
+
+    lbits: (r, T, 32) int32 0/1, block t of shard r' in time order;
+    block_bytes: bytes per block.  Returns (r, 32) int32 0/1 =
+    L(B_0||...||B_{T-1}) per shard.
+
+    Each level pairs adjacent equal-size blocks with ONE int8 matmul
+    against crc_combine_matrix(2, bytes) — L(B1||B2) = A_{|B2|} L(B1)
+    ^ L(B2) — then doubles the block size, so depth is ceil(log2 T)
+    and total work is ~2T tiny (., 64)x(64, 32) MACs.  An odd level is
+    evened by PREPENDING a virtual zero block: L(0^n) = 0 and
+    L(0^n || B) = A_{|B|}·0 ^ L(B) = L(B), so a zero PREFIX never
+    changes the combined L (a zero suffix would).  Runs as plain XLA
+    (inside the launch's jit, outside the Pallas kernel: the
+    (r*T, 32) -> (r, T*32) sublane-to-lane relayouts a log-depth
+    combine needs do not lower in Mosaic, and at 32 bits per block the
+    extra HBM round-trip is noise)."""
+    import jax
+    import jax.numpy as jnp
+    r, t, _ = lbits.shape
+    if t == 0:
+        return jnp.zeros((r, 32), dtype=jnp.int32)
+    lbits = lbits.astype(jnp.int8)
+    bb = block_bytes
+    while t > 1:
+        if t % 2:
+            lbits = jnp.concatenate(
+                [jnp.zeros((r, 1, 32), dtype=lbits.dtype), lbits], axis=1)
+            t += 1
+        pairs = jnp.concatenate(
+            [lbits[:, 0::2], lbits[:, 1::2]], axis=2)     # (r, t/2, 64)
+        mat = jnp.asarray(crc_combine_matrix(2, bb), dtype=jnp.int8)
+        prod = jax.lax.dot_general(
+            pairs.reshape(r * (t // 2), 64), mat,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32) & 1
+        lbits = prod.reshape(r, t // 2, 32).astype(jnp.int8)
+        t //= 2
+        bb *= 2
+    return lbits[:, 0].astype(jnp.int32)
+
+
+def fold_run_crc(lbody: int, body_bytes: int, seed: int,
+                 tail: bytes = b"") -> int:
+    """O(1) host fold of one run: the device-combined body L plus an
+    optional sub-block tail, re-seeded.  crc = A_{n}(seed) ^
+    (A_{|tail|}(L_body) ^ L(tail)) — one seed-advance per extent,
+    replacing the per-tile fold_tile_crcs Python loop."""
+    acc = int(lbody) & 0xFFFFFFFF
+    n = body_bytes
+    if tail:
+        acc = _crc.crc32c_zeros(acc, len(tail)) ^ _crc.crc32c(tail, 0)
+        n += len(tail)
+    return _crc.crc32c_zeros(seed & 0xFFFFFFFF, n) ^ acc
+
+
 def subblock_crc_bits_w32(words, cmat_sub, wb: int):
     """Level 1 of the hierarchical tile crc, MXU-friendly.
 
@@ -142,6 +202,49 @@ def subblock_crc_bits_w32(words, cmat_sub, wb: int):
              for i in range(4 * g, 4 * g + 4)], axis=1)   # (r*s, 4wb)
         acc = acc + jax.lax.dot_general(
             cat, cmat_sub[4 * g * wb:(4 * g + 4) * wb],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+    return acc & 1
+
+
+def subblock_crc_bits_w32_packed(words, cmat_sub, wb: int,
+                                 interpret: bool = False):
+    """Packed-extraction twin of subblock_crc_bits_w32: same output,
+    1/4 the VPU bit-extraction work.
+
+    The planar variant extracts the 32 word-bits one at a time (32
+    shift+mask passes over the full (r*S, wb) block).  Here the crc
+    reuses the parity path's packed-mask trick: `(w >> i) & 0x01010101`
+    pulls bit i of all FOUR bytes per word in one pass, and the free
+    Mosaic sublane bitcast exposes them as byte rows — 8 passes total.
+    The bitcast row 4q+b holds bit i of byte b of sub-block q, i.e.
+    word-bit 8b+i, whose crc contribution at word position t is
+    cmat_sub row (8b+i)*wb + t: de-interleaving the byte offset with a
+    strided sublane slice and re-stacking the four slices along the
+    contraction axis makes the matmul shapes identical to the planar
+    variant ((r*S, 4wb) x (4wb, 32) per bit-of-byte i).
+
+    The strided sublane slice is the lowering risk (Mosaic support for
+    stride-4 second-minor slices varies by generation), so this
+    variant is only selected by the autotuner after a bit-exactness
+    check against the host crc on real hardware."""
+    import jax
+    import jax.numpy as jnp
+    from .bitsliced import _words_to_bytes
+    r, wt = words.shape
+    s = wt // wb
+    w2 = words.reshape(r * s, wb)
+    mask = jnp.int32(0x01010101)
+    acc = jnp.zeros((r * s, 32), dtype=jnp.int32)
+    for i in range(8):
+        plane = _words_to_bytes((w2 >> i) & mask, interpret)  # (4rS, wb)
+        cat = jnp.concatenate(
+            [plane[b::4] for b in range(4)], axis=1)          # (rS, 4wb)
+        ccat = jnp.concatenate(
+            [cmat_sub[(8 * b + i) * wb:(8 * b + i + 1) * wb]
+             for b in range(4)], axis=0)                      # (4wb, 32)
+        acc = acc + jax.lax.dot_general(
+            cat, ccat,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.int32)
     return acc & 1

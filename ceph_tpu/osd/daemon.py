@@ -1507,6 +1507,10 @@ class OSDDaemon:
         vtrunc: int | None = None        # staged truncate_to (the txn
         # holds ONE truncate value applied after writes, so an op that
         # extends past it must raise it or be clipped)
+        vbase_dropped = False            # a delete ran in this vector:
+        # the committed object state (size, xattrs) is gone for good,
+        # even if a later op recreates the object — consult only the
+        # staged views from then on
 
         def cur_exists() -> bool:
             nonlocal vexists
@@ -1516,7 +1520,17 @@ class OSDDaemon:
 
         def cur_size():
             nonlocal vsize, vexists
+            if vexists is False:
+                # known absent (a staged delete/earlier miss), which is
+                # DISTINCT from vsize None = "not yet consulted": the op
+                # vector must see the evolving state, not re-read the
+                # committed pre-delete object (reference do_osd_ops runs
+                # later ops against the mutated obs)
+                return None
             if vsize is None:
+                if vbase_dropped:
+                    return None  # recreated post-delete but size never
+                    # staged: committed state is dead, nothing to read
                 vsize = self._stat_logical(state, msg.oid)
                 vexists = vsize is not None
             return vsize
@@ -1524,6 +1538,11 @@ class OSDDaemon:
         def cur_xattr(key: str):
             if key in vattrs:
                 return vattrs[key]
+            if vexists is False or vbase_dropped:
+                # known absent OR recreated after an in-vector delete:
+                # the committed xattrs died with the delete — a
+                # fall-through read would resurrect pre-delete values
+                return None
             from ..cls import ClsContext
             ctx = ClsContext(self, state, msg.pgid.pgid, msg.oid)
             return ctx.getxattr(key)
@@ -1587,6 +1606,7 @@ class OSDDaemon:
             elif name == "delete":
                 txn.delete(msg.oid)
                 vsize, vexists, vattrs = None, False, {}
+                vbase_dropped = True
             elif name == "rmxattr":
                 # reference: rmxattr on a nonexistent object is ENOENT
                 # (it must not materialize a phantom object)
@@ -1619,13 +1639,33 @@ class OSDDaemon:
                 data_off += ln
             elif name == "read":
                 _, off, ln = op
-                if not self._object_exists(state, msg.oid):
+                # existence through the staged view: a read after an
+                # in-message delete is ENOENT even though the committed
+                # object still exists until the txn applies
+                if not cur_exists():
                     result = -errno.ENOENT
                     break
-                data = be.read(msg.oid, off, ln if ln > 0 else None)
-                read_payload += data.tobytes() if data is not None else b""
+                if vbase_dropped:
+                    # the committed bytes died with the in-vector
+                    # delete: serve the staged recreate only (zeros
+                    # base + this message's writes), never the
+                    # pre-delete store content
+                    size = cur_size() or 0
+                    end = size if ln <= 0 else min(off + ln, size)
+                    buf = np.zeros(max(end - off, 0), dtype=np.uint8)
+                    objop = txn.ops.get(msg.oid)
+                    for w in (objop.writes if objop else []):
+                        lo, hi = max(off, w.offset), min(end, w.end)
+                        if lo < hi:
+                            buf[lo - off:hi - off] = \
+                                w.data[lo - w.offset:hi - w.offset]
+                    read_payload += buf.tobytes()
+                else:
+                    data = be.read(msg.oid, off, ln if ln > 0 else None)
+                    read_payload += data.tobytes() \
+                        if data is not None else b""
             elif name == "stat":
-                size = self._stat_logical(state, msg.oid)
+                size = cur_size()
                 if size is None:
                     result = -errno.ENOENT
                 else:

@@ -352,8 +352,21 @@ class ECBackend:
                                 chunk))
                 return 0
 
+            def reset_hinfo(oid):
+                """Delete-then-recreate: swap a FRESH hinfo into the
+                projected chain so THIS op and later queued ops seed
+                from the recreate, while earlier in-flight ops keep
+                folding onto the instance they planned against (refs
+                bookkeeping rides the same cache entry)."""
+                h = HashInfo.make(self.n)
+                proj = self._projected.get(oid)
+                if proj is not None:
+                    proj["hinfo"] = h
+                return h
+
             op.plan = ect.get_write_plan(
-                self.sinfo, op.txn, get_hinfo, get_size)
+                self.sinfo, op.txn, get_hinfo, get_size,
+                reset_hinfo=reset_hinfo)
             self.waiting_state.pop(0)
             op.state = "reading"
             self.waiting_reads.append(op)
@@ -510,13 +523,16 @@ class ECBackend:
                 results = self.ec_impl.encode_extents_with_crc(
                     [runs[i] for i in fused_idx])
                 sim_hash: dict[hobject_t, list[int]] = {}
-                for i, (par, tls, tail, tile) in zip(fused_idx, results):
+                # per-run fold is O(1) combines per shard: the launch
+                # already device-combined each run's body into one L
+                for i, (par, l, tail, body_bytes) in zip(fused_idx,
+                                                         results):
                     op, oid, e, _ = work[i]
                     hinfo = op.plan.hash_infos[oid]
                     seeds = sim_hash.get(
                         oid, list(hinfo.cumulative_shard_hashes))
                     crcs = self.ec_impl.fold_extent_crcs(
-                        tls, tail, seeds, tile)
+                        l, tail, seeds, body_bytes)
                     sim_hash[oid] = crcs
                     crcs_by_op[id(op)][(oid, e.off)] = crcs
                     parities[i] = np.asarray(par)

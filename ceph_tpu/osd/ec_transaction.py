@@ -132,11 +132,13 @@ def _merge_extents(extents: list[Extent]) -> list[Extent]:
 
 
 def get_write_plan(sinfo: StripeInfo, txn: PGTransaction,
-                   get_hinfo, get_size) -> WritePlan:
+                   get_hinfo, get_size, reset_hinfo=None) -> WritePlan:
     """Round writes out to stripe bounds; extents not fully covered by
     the new data and inside the current object need an RMW pre-read
     (reference ECTransaction get_write_plan semantics exercised by
-    src/test/osd/test_ec_transaction.cc:29-85)."""
+    src/test/osd/test_ec_transaction.cc:29-85).  `reset_hinfo(oid)`,
+    when given, must swap a FRESH HashInfo into the caller's projected
+    chain and return it (used for delete-then-recreate vectors)."""
     plan = WritePlan()
     for oid, op in txn.ops.items():
         size = get_size(oid)
@@ -144,6 +146,26 @@ def get_write_plan(sinfo: StripeInfo, txn: PGTransaction,
         plan.hash_infos[oid] = get_hinfo(oid)
         if op.delete and not op.writes:
             continue
+        if op.delete:
+            # delete-then-recreate in one vector (reference do_osd_ops
+            # evolves obs through the vector; the replicated backend's
+            # _to_store_txn already recreates): the plan must see the
+            # FRESH object — no RMW pre-reads of pre-delete bytes, size
+            # 0, reset hinfo.  `reset_hinfo` swaps a NEW instance into
+            # the caller's projected chain so this op and later queued
+            # ops seed from the recreate, while earlier in-flight ops
+            # keep folding onto the instance they already planned
+            # against (mutating the shared one in place would corrupt
+            # their crc chains).  Rollback still restores the old
+            # object from the generation kept at commit time.
+            size = 0
+            plan.sizes[oid] = 0
+            if reset_hinfo is not None:
+                plan.hash_infos[oid] = reset_hinfo(oid)
+            else:
+                old = plan.hash_infos[oid]
+                plan.hash_infos[oid] = HashInfo.make(
+                    len(old.cumulative_shard_hashes))
         will, read = [], []
         for w in op.writes:
             start = sinfo.logical_to_prev_stripe_offset(w.offset)
@@ -217,8 +239,12 @@ def generate_transactions(
                                    shard_oid(oid, s, generation=gen))
                 else:
                     txns[s].remove(shard_oid(oid, s))
-            continue
-        if keep_gen:
+            if not op.writes:
+                continue
+            # delete-then-recreate: the writes below land on the fresh
+            # (vacated) object name — no clone, the rename/remove above
+            # already made the generation the rollback snapshot
+        elif keep_gen:
             for s in range(n_shards):
                 txns[s].clone(shard_oid(oid, s),
                               shard_oid(oid, s, generation=gen))

@@ -115,10 +115,17 @@ class SSTWriter:
 
 class SSTReader:
     """Sparse-index reader; keeps the fd open so compaction can unlink
-    the file under live iterators (POSIX keeps the inode alive)."""
+    the file under live iterators (POSIX keeps the inode alive).
+
+    `pins` counts live LsmDB iterators holding this reader; `retired`
+    marks it dropped by compaction.  A retired reader is closed by the
+    DB as soon as its pin count reaches zero — deterministic fd
+    release instead of relying on CPython refcounting GC."""
 
     def __init__(self, path: Path):
         self.path = path
+        self.pins = 0
+        self.retired = False
         self.f = open(path, "rb")
         self.f.seek(0, os.SEEK_END)
         end = self.f.tell()
@@ -187,6 +194,49 @@ class SSTReader:
         self.f.close()
 
 
+class _RangeScan:
+    """Iterator over a merged range scan holding SSTReader pins.
+
+    A plain generator's `finally` can NOT carry the unpin: pins are
+    taken eagerly (the snapshot — and the readers' liveness — is fixed
+    at iterate_range() call time), but closing a never-started
+    generator skips its try block entirely, so an iterator that is
+    created and then abandoned would leak its pins forever.  This
+    class releases exactly once on whichever comes first: exhaustion,
+    explicit close(), or __del__ (refcount-prompt on CPython; on other
+    runtimes LsmDB.close() still sweeps parked readers)."""
+
+    def __init__(self, db, sources, end, pinned):
+        self._db = db
+        self._pinned = pinned
+        self._gen = db._merge(sources, end)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self._gen)
+        except BaseException:
+            self._release()
+            raise
+
+    def close(self) -> None:
+        self._gen.close()
+        self._release()
+
+    def _release(self) -> None:
+        pinned, self._pinned = self._pinned, None
+        if pinned:
+            self._db._unpin(pinned)
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self._release()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
 # ----------------------------------------------------------------------------
 # LsmDB
 # ----------------------------------------------------------------------------
@@ -213,6 +263,8 @@ class LsmDB(KeyValueDB):
         # min key, non-overlapping
         self._levels: list[list[dict]] = [[]]
         self._readers: dict[str, SSTReader] = {}
+        self._retired: list[SSTReader] = []   # dropped by compaction,
+        # still pinned by live iterators; closed on last unpin/close
         self._next_seq = 1
         # observability: compaction I/O must stay bounded (the whole
         # point vs LogDB) — tests assert on these
@@ -402,30 +454,41 @@ class LsmDB(KeyValueDB):
     def iterate(self, prefix=b""):
         prefix = bytes(prefix)
         end = self._prefix_end(prefix) if prefix else None
-        yield from self.iterate_range(prefix, end)
+        return self.iterate_range(prefix, end)
 
     def iterate_range(self, start: bytes = b"", end: bytes | None = None):
         """Merged range scan [start, end).  Consistent over the version
-        at call time: iterators hold SSTReader fds, so compaction can
-        retire files underneath without disturbing the scan."""
+        at call time (the snapshot is taken HERE, not at first next()):
+        every SSTReader the scan touches is pinned, so compaction can
+        retire files underneath without disturbing the scan, and the
+        retired reader's fd closes deterministically when the last
+        pinning iterator finishes (generator exhaustion or .close())."""
         with self._lock:
             sources = []
+            pinned: list[SSTReader] = []
+
+            def _pin(name: str) -> SSTReader:
+                r = self._readers[name]
+                r.pins += 1
+                pinned.append(r)
+                return r
+
             # recency rank: memtable 0, L0 newest 1.., deeper levels last
             mem_items = sorted(
                 (k, v) for k, v in self._mem.items() if k >= start)
             sources.append((0, iter(mem_items)))
             rank = 1
             for fe in reversed(self._levels[0]):
-                sources.append(
-                    (rank, self._readers[fe["name"]].scan(start)))
+                sources.append((rank, _pin(fe["name"]).scan(start)))
                 rank += 1
             for lvl in self._levels[1:]:
-                its = [self._readers[fe["name"]].scan(start)
+                its = [_pin(fe["name"]).scan(start)
                        for fe in lvl if fe["_max"] >= start]
                 for it in its:
                     sources.append((rank, it))
                 rank += 1
-        yield from self._merge(sources, end)
+
+        return _RangeScan(self, sources, end, pinned)
 
     @staticmethod
     def _merge(sources, end):
@@ -444,6 +507,25 @@ class LsmDB(KeyValueDB):
             self._wal_f.close()
             for r in self._readers.values():
                 r.close()
+            # compaction-retired readers kept alive for in-flight
+            # iterators: close() is terminal, release them all
+            for r in self._retired:
+                r.close()
+            self._retired.clear()
+
+    def _unpin(self, readers: list[SSTReader]) -> None:
+        """Iterator teardown: drop pins; close retired readers whose
+        last pin just left (the deterministic half of the fd lifecycle
+        — see SSTReader.pins)."""
+        with self._lock:
+            for r in readers:
+                r.pins -= 1
+                if r.retired and r.pins == 0:
+                    r.close()
+                    try:
+                        self._retired.remove(r)
+                    except ValueError:
+                        pass
 
     # -- flush / compaction -------------------------------------------------
 
@@ -556,11 +638,20 @@ class LsmDB(KeyValueDB):
             keep + new_files, key=lambda fe: fe["min"])
         self._write_manifest()           # commit point
         for fe in up_files + overlap:
-            # drop our reference and unlink; live iterators still hold
-            # the SSTReader (refcount keeps its fd/inode alive), so
-            # in-flight scans finish against the retired file
-            self._readers.pop(fe["name"], None)
+            # retire the reader and unlink the file; the inode stays
+            # alive behind the open fd, so in-flight scans finish
+            # against the retired file.  Unpinned readers close NOW;
+            # pinned ones park in _retired and close on last unpin (or
+            # LsmDB.close()) — no fd accumulation on non-refcounting
+            # runtimes across long compaction histories
+            rd = self._readers.pop(fe["name"], None)
             (self.dir / fe["name"]).unlink(missing_ok=True)
+            if rd is not None:
+                rd.retired = True
+                if rd.pins == 0:
+                    rd.close()
+                else:
+                    self._retired.append(rd)
         self.stats["compactions"] += 1
         self.stats["compact_bytes_in"] += bytes_in
         self.stats["max_compact_bytes"] = max(

@@ -1,112 +1,68 @@
-"""Sweep the fused parity+crc w32 kernel's tile size on real hardware.
+"""Sweep CLI for the fused parity+crc kernel's operating point.
 
-The fused kernel (ops/bitsliced.py gf_encode_with_crc_pallas_w32) had
-never been tuned at the headline kernel's operating point: FUSED_TILE
-was 2048 bytes while the bare-encode W32_TILE is 131072.  The fused
-kernel's crc L-matrix (cmat32, one 32-bit row per input BIT of the
-tile) costs 1 KiB of VMEM per byte of tile, so the tile cannot simply
-be raised to W32_TILE — this sweep finds the knee.
+This used to be a hand-run script whose winners were frozen into
+bitsliced.FUSED_TILE_HIER / FUSED_WB; the machinery now lives in
+ops/autotune.py, which the jax plugin consults at init (validated,
+measured, cached per device).  This CLI drives the same sweep
+explicitly, prints the per-candidate table, and refreshes the cache —
+use it to inspect WHY the plugin picked its point, or to re-tune after
+a runtime/hardware change.
 
-Usage: python -m ceph_tpu.tools.fused_tile_sweep [tiles...]
+Usage: python -m ceph_tpu.tools.fused_tile_sweep [--keep-cache] [tiles...]
+
+By default the sweep is forced (the cache entry is refreshed); pass
+--keep-cache to only print the cached point without re-measuring.
+Candidates that fail the bit-exactness validation (e.g. the packed
+extraction on a Mosaic generation without strided sublane slices)
+print as INVALID.
 """
 import sys
-import time
 
 import numpy as np
-import jax
-import jax.numpy as jnp
-from jax import lax
 
 from ..ec.registry import ErasureCodePluginRegistry
-from ..ops import bitsliced as bs
-from ..ops import crc32c_linear as cl
+from ..ops import autotune
 
-K, M, SIZE, BATCH = 8, 3, 1 << 20, 32
-
-
-def slope_rate(step, x0, iters_lo=20, iters_hi=60):
-    """bench.py-style chained fori_loop slope timing (crc feeds the
-    chain so neither output can be dead-code-eliminated)."""
-    def make(iters):
-        @jax.jit
-        def f(x):
-            def body(i, x):
-                r = step(x)
-                return x.at[:M, :].set(x[:M, :] ^ r)
-            return lax.fori_loop(0, iters, body, x)
-        return f
-
-    f_lo, f_hi = make(iters_lo), make(iters_hi)
-    jax.block_until_ready(f_lo(x0))
-    jax.block_until_ready(f_hi(x0))
-    best = []
-    for rep in range(3):
-        v = jax.block_until_ready(x0 ^ (rep + 1))
-        t0 = time.perf_counter()
-        jax.block_until_ready(f_lo(v))
-        lo = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        jax.block_until_ready(f_hi(v))
-        hi = time.perf_counter() - t0
-        dt = (hi - lo) / (iters_hi - iters_lo)
-        # same roofline elision gate as bench.py: an above-1TB/s slope
-        # is a silently-elided pass, not a fast kernel
-        if dt > 0 and BATCH * SIZE / dt < 1e12:
-            best.append(BATCH * SIZE / dt)
-    best.sort()
-    return best[len(best) // 2] if best else 0.0
+K, M = 8, 3
 
 
 def main():
+    known = {"--keep-cache"}
+    unknown = [a for a in sys.argv[1:]
+               if a.startswith("-") and a not in known]
+    if unknown:
+        print(f"unknown option(s): {' '.join(unknown)} — this tool now "
+              "drives ops/autotune (the old --flat mode is gone; the "
+              "flat 2 KiB kernel is not a tuning candidate).  "
+              "Usage: fused_tile_sweep [--keep-cache] [tiles...]")
+        raise SystemExit(2)
     tiles = [int(t) for t in sys.argv[1:]
-             if not t.startswith("-")] or [2048, 4096, 8192, 16384]
+             if not t.startswith("-")] or None
     reg = ErasureCodePluginRegistry.instance()
     codec = reg.factory("jax", {"k": str(K), "m": str(M),
                                 "technique": "cauchy"})
-    rng = np.random.default_rng(0)
-    flat = rng.integers(0, 256, (K, BATCH * SIZE // K), dtype=np.uint8)
-    words = jnp.asarray(flat.view(np.int32))
-    codec.encode_words(words)            # build bitmats
-    bitmat32 = codec._enc_bitmat32
-
-    flat_mode = "--flat" in sys.argv
-    for tile in tiles:
-        wt = tile // 4
-        if flat_mode:
-            try:
-                cmat32 = jnp.asarray(cl.crc_tile_matrix_w32(wt))
-
-                def step(x, cmat32=cmat32, tile=tile):
-                    par, crc = bs.gf_encode_with_crc_pallas_w32(
-                        bitmat32, cmat32, x, M, tile=tile)
-                    return par ^ jnp.sum(crc)   # crc feeds chain: no DCE
-
-                rate = slope_rate(step, words)
-                print(f"flat tile={tile:6d}  {rate / 1e9:7.2f} GB/s  "
-                      f"(cmat {wt * 32 * 32 * 4 / 2**20:.1f} MiB)")
-            except Exception as e:  # noqa: BLE001
-                print(f"flat tile={tile:6d}  FAILED: {type(e).__name__}: "
-                      f"{str(e)[:200]}")
-            continue
-        for wb in (256, 512, 1024):
-            if wt % wb:
-                continue
-            try:
-                cmat_sub = jnp.asarray(cl.crc_tile_matrix_w32(wb))
-                combine = jnp.asarray(
-                    cl.crc_combine_matrix(wt // wb, 4 * wb))
-
-                def step(x, cs=cmat_sub, cb=combine, tile=tile, wb=wb):
-                    par, crc = bs.gf_encode_with_crc_pallas_w32_hier(
-                        bitmat32, cs, cb, x, M, tile=tile, wb=wb)
-                    return par ^ jnp.sum(crc)   # crc feeds chain: no DCE
-
-                rate = slope_rate(step, words)
-                print(f"hier tile={tile:6d} wb={wb:5d}  "
-                      f"{rate / 1e9:7.2f} GB/s")
-            except Exception as e:  # noqa: BLE001
-                print(f"hier tile={tile:6d} wb={wb:5d}  FAILED: "
-                      f"{type(e).__name__}: {str(e)[:200]}")
+    import jax
+    if jax.default_backend() == "cpu":
+        print("backend is cpu: the fused w32 kernel is TPU-only; "
+              f"static default point = {autotune.default_point()}")
+        return
+    if "--keep-cache" in sys.argv:
+        print(f"cached/current point: {codec.fused_point()}")
+        print(f"cache file: {autotune._cache_path()}")
+        return
+    report: list = []
+    best = autotune.fused_operating_point(
+        K, M, mat=codec.matrix[K:], bitmat32=codec._enc_bitmat32,
+        tiles=tiles, force=True, report=report)
+    for cand, rate in report:
+        tag = (f"tile={cand['tile']:6d} wb={cand['wb']:5d} "
+               f"packed={int(cand['packed'])}")
+        if rate is None:
+            print(f"{tag}  INVALID (failed compile or bit-exactness)")
+        else:
+            print(f"{tag}  {rate / 1e9:7.2f} GB/s")
+    print(f"best: {best}")
+    print(f"cache file: {autotune._cache_path()}")
 
 
 if __name__ == "__main__":

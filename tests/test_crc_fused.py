@@ -214,54 +214,167 @@ def test_crc_combine_matrix_matches_fold():
 
 
 def test_multi_extent_hier_dispatch_interpret():
-    """gf_encode_extents_with_crc's hier branch (runs >= FUSED_TILE_HIER
+    """gf_encode_extents_with_crc's hier branch (runs >= the hier tile
     select the headline-tile hier kernel) driven end-to-end in interpret
-    mode — the production TPU drain path for big sequential writes."""
+    mode — the production TPU drain path for big sequential writes.
+    The new contract: one device-combined L per shard per run plus a
+    sub-BLOCK (not sub-tile) tail, folded in O(1) host combines."""
     import jax.numpy as jnp
     from ceph_tpu.ops import bitsliced as bs
     from ceph_tpu.ec import gf
 
     k, m = 4, 2
+    tile, wb = 4096, 128          # s = 8, (k+m)*s = 48: sublane-aligned
     mat = gf.cauchy_rs_matrix(k, m)[k:]
     bitmat = jnp.asarray(bs.interleave_bitmatrix(mat), dtype=jnp.int8)
     bitmat32 = jnp.asarray(bs._w32_bitmat(mat), dtype=jnp.int8)
     rng = np.random.default_rng(10)
-    widths = [bs.FUSED_TILE_HIER, bs.FUSED_TILE_HIER + 513]  # tail fold
+    widths = [tile * 2, tile + 513]       # second run: odd tail fold
     runs = [rng.integers(0, 256, (k, w), dtype=np.uint8) for w in widths]
     results = bs.gf_encode_extents_with_crc(
         bitmat, bitmat32, runs, m, use_w32=True, force_xla=False,
-        interpret=True)
+        interpret=True, tile=tile, wb=wb)
     seeds = [0xFFFFFFFF] * (k + m)
-    for run, (par, tls, tail, tile) in zip(runs, results):
-        assert tile == bs.FUSED_TILE_HIER
+    for run, (par, l, tail, body) in zip(runs, results):
+        w = run.shape[1]
+        assert body == (w // (4 * wb)) * 4 * wb   # sub-block granular
+        assert tail.shape[1] == w - body < 4 * wb
         np.testing.assert_array_equal(
             np.asarray(par), gf.gf_matvec(mat, run))
         allsh = np.concatenate([run, np.asarray(par)], axis=0)
         for s in range(k + m):
-            got = cl.fold_tile_crcs(tls[s], tile, seeds[s],
-                                    tail[s].tobytes())
+            got = cl.fold_run_crc(int(l[s]), body, seeds[s],
+                                  tail[s].tobytes())
             assert got == C.crc32c(allsh[s].tobytes(), seeds[s]), \
                 f"shard {s}"
 
 
 def test_multi_extent_fused_launch():
-    """gf_encode_extents_with_crc: several runs of different (unaligned)
-    lengths in one launch; per-run parity and seed-chained crcs must
-    match the reference byte path."""
+    """gf_encode_extents_with_crc: several runs of different (unaligned,
+    including odd and sub-block) lengths in one launch; per-run parity
+    and seed-CHAINED crcs (each run folds onto the previous run's
+    outputs, the hinfo append chain) must match the reference byte
+    path byte-for-byte."""
     codec = REG.factory("jax", {"k": "4", "m": "2"})
     rng = np.random.default_rng(7)
-    widths = [2048 * 2, 100, 2048 + 513, 4096]
+    widths = [2048 * 2, 100, 2048 + 513, 4096, 1, 2048 * 3 + 1]
     runs = [rng.integers(0, 256, (4, w), dtype=np.uint8) for w in widths]
     results = codec.encode_extents_with_crc(runs)
     assert len(results) == len(runs)
     # chain crcs across runs as one object's appends
     seeds = [0xFFFFFFFF] * 6
-    for run, (par, tls, tail, tile) in zip(runs, results):
+    for run, (par, l, tail, body) in zip(runs, results):
         np.testing.assert_array_equal(
             np.asarray(par), codec.encode_chunks(run))
-        crcs = codec.fold_extent_crcs(tls, tail, seeds, tile)
+        crcs = codec.fold_extent_crcs(l, tail, seeds, body)
         allsh = np.concatenate([run, np.asarray(par)], axis=0)
         for s in range(6):
             want = C.crc32c(allsh[s].tobytes(), seeds[s])
             assert crcs[s] == want, f"shard {s}"
+        seeds = crcs
+
+
+@pytest.mark.parametrize("nblocks", [1, 2, 3, 5, 8, 13])
+def test_combine_crcs_pow2_matches_host_fold(nblocks):
+    """The device-side log-depth combine == the sequential host fold,
+    for even AND odd block counts (odd levels prepend a virtual zero
+    block, which must not change the combined L)."""
+    import jax.numpy as jnp
+    bb = 64
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 256, nblocks * bb, dtype=np.uint8)
+    cmat = cl.crc_tile_matrix(bb)
+    ls = []
+    for t in range(nblocks):
+        block = data[t * bb:(t + 1) * bb]
+        bits = np.unpackbits(block[None, :], axis=0, bitorder="little")
+        lb = np.asarray(cl.tile_crc_bits(
+            jnp.asarray(bits.astype(np.int8)), jnp.asarray(cmat)))
+        ls.append(lb[0])
+    lbits = jnp.asarray(np.stack(ls)[None].astype(np.int32))
+    comb = np.asarray(cl.combine_crcs_pow2(lbits, bb))
+    l = int(cl.bits_to_u32(comb)[0])
+    assert cl.fold_run_crc(l, nblocks * bb, 0xFFFFFFFF) == \
+        C.crc32c(data.tobytes(), 0xFFFFFFFF)
+
+
+def test_fold_run_crc_degenerate_cases():
+    """O(1) host fold edge cases: empty body (tail-only run), empty
+    tail, and both empty must all reduce to plain crc32c."""
+    rng = np.random.default_rng(12)
+    tail = rng.integers(0, 256, 100, dtype=np.uint8).tobytes()
+    assert cl.fold_run_crc(0, 0, 0xFFFFFFFF, tail) == \
+        C.crc32c(tail, 0xFFFFFFFF)
+    assert cl.fold_run_crc(0, 0, 0x1234) == \
+        C.crc32c(b"", 0x1234)
+
+
+@pytest.mark.parametrize("packed", [False, True])
+def test_device_fold_launch_interpret(packed):
+    """gf_encode_with_crc_w32_fold (the bench/write-path launch): one
+    L per shard per dispatch, multi-tile extents, both crc extraction
+    variants (planar and packed), bit-exact against the host crc32c
+    with a caller seed."""
+    import jax.numpy as jnp
+    from ceph_tpu.ops import bitsliced as bs
+    from ceph_tpu.ec import gf
+
+    k, m = 4, 2
+    tile, wb = 4096, 128
+    n = tile * 3                  # multi-tile extent
+    mat = gf.cauchy_rs_matrix(k, m)[k:]
+    bitmat32 = jnp.asarray(bs._w32_bitmat(mat), dtype=jnp.int8)
+    cmat_sub = jnp.asarray(cl.crc_tile_matrix_w32(wb))
+    rng = np.random.default_rng(13)
+    chunks = rng.integers(0, 256, (k, n), dtype=np.uint8)
+    words = jnp.asarray(chunks.view("<u4").view(np.int32))
+    par_w, lbits = bs.gf_encode_with_crc_w32_fold(
+        bitmat32, cmat_sub, words, m, tile=tile, wb=wb,
+        interpret=True, packed=packed)
+    assert lbits.shape == (k + m, 32)     # ONE L per shard per launch
+    parity = np.asarray(par_w).view("<u4").view(np.uint8).reshape(m, n)
+    np.testing.assert_array_equal(parity, gf.gf_matvec(mat, chunks))
+    ls = cl.bits_to_u32(np.asarray(lbits))
+    allsh = np.concatenate([chunks, parity], axis=0)
+    for s in range(k + m):
+        for seed in (0xFFFFFFFF, 0, 0xDEAD):
+            got = cl.fold_run_crc(int(ls[s]), n, seed)
+            assert got == C.crc32c(allsh[s].tobytes(), seed), \
+                f"shard {s} seed {seed:#x}"
+
+
+def test_packed_subblock_extraction_matches_planar():
+    """subblock_crc_bits_w32_packed (4 bits per VPU pass) must produce
+    exactly the planar variant's L-bit matrix."""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(14)
+    r, wb, s = 5, 32, 4
+    wt = wb * s
+    chunks = rng.integers(0, 256, (r, 4 * wt), dtype=np.uint8)
+    words = jnp.asarray(chunks.view("<u4").view(np.int32))
+    cmat_sub = jnp.asarray(cl.crc_tile_matrix_w32(wb))
+    planar = np.asarray(cl.subblock_crc_bits_w32(words, cmat_sub, wb))
+    packed = np.asarray(cl.subblock_crc_bits_w32_packed(
+        words, cmat_sub, wb, interpret=True))
+    np.testing.assert_array_equal(planar, packed)
+
+
+@pytest.mark.parametrize("n_bytes", [2047, 2048 + 1, 2048 * 4 + 100])
+def test_fused_odd_tails_chained_seeds(n_bytes):
+    """Odd tail lengths through the plugin path with per-shard chained
+    seeds (three consecutive appends of the same odd-sized extent, each
+    seeded by the previous crcs — the HashInfo evolution)."""
+    k, m = 4, 2
+    codec = REG.factory("jax", {"k": str(k), "m": str(m)})
+    rng = np.random.default_rng(15)
+    seeds = [0xFFFFFFFF] * (k + m)
+    streams = [b""] * (k + m)
+    for _ in range(3):
+        chunks = rng.integers(0, 256, (k, n_bytes), dtype=np.uint8)
+        parity, crcs = codec.encode_chunks_with_crc(chunks, seeds=seeds)
+        allsh = np.concatenate([chunks, parity], axis=0)
+        for s in range(k + m):
+            streams[s] += allsh[s].tobytes()
+            assert crcs[s] == C.crc32c(streams[s], 0xFFFFFFFF), \
+                f"shard {s}"
         seeds = crcs

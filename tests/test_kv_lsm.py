@@ -163,6 +163,72 @@ def test_iterator_survives_compaction(tmp_path):
     db.close()
 
 
+def test_retired_readers_close_deterministically(tmp_path):
+    """Compaction must not leak retired SSTReader fds: readers with no
+    iterator pins close at retire time; readers pinned by a live scan
+    park in _retired and close when the last pinning iterator drains —
+    no reliance on refcounting GC, and close() sweeps the rest."""
+    db = small_db(tmp_path / "db")
+    for i in range(800):
+        db.set(f"s{i:04d}".encode(), os.urandom(32))
+    it = db.iterate(b"s")
+    head = [next(it) for _ in range(10)]
+    assert any(r.pins for r in db._readers.values())
+    # churn hard enough to retire the files the iterator is reading
+    for i in range(800):
+        db.set(f"s{i:04d}".encode(), os.urandom(32))
+    db.compact()
+    parked = list(db._retired)
+    assert parked                          # pinned victims parked open
+    assert all(not r.f.closed for r in parked)
+    rest = list(it)                        # drain: last unpin closes
+    assert len(head) + len(rest) == 800
+    assert db._retired == []
+    assert all(r.f.closed for r in parked)
+    # a retire with NO pins closes immediately, nothing parks
+    for i in range(800):
+        db.set(f"t{i:04d}".encode(), os.urandom(32))
+    db.compact()
+    assert db._retired == []
+    db.close()
+
+
+def test_abandoned_iterator_releases_pins(tmp_path):
+    """An iterator that is created but never started (or dropped
+    mid-scan) must still release its reader pins when collected — a
+    generator's finally would never run for the never-started case."""
+    db = small_db(tmp_path / "db")
+    for i in range(300):
+        db.set(f"s{i:04d}".encode(), os.urandom(32))
+    it = db.iterate(b"s")                  # never started
+    assert any(r.pins for r in db._readers.values())
+    del it                                 # CPython: prompt __del__
+    assert not any(r.pins for r in db._readers.values())
+    it2 = db.iterate(b"s")
+    next(it2)                              # started, then abandoned
+    del it2
+    assert not any(r.pins for r in db._readers.values())
+    db.close()
+
+
+def test_close_sweeps_parked_readers(tmp_path):
+    """LsmDB.close() must close compaction-retired readers still pinned
+    by an abandoned iterator (the terminal fd sweep)."""
+    db = small_db(tmp_path / "db")
+    for i in range(800):
+        db.set(f"s{i:04d}".encode(), os.urandom(32))
+    it = db.iterate(b"s")
+    next(it)
+    for i in range(800):
+        db.set(f"s{i:04d}".encode(), os.urandom(32))
+    db.compact()
+    parked = list(db._retired)
+    assert parked
+    db.close()                             # iterator never drained
+    assert all(r.f.closed for r in parked)
+    assert db._retired == []
+
+
 def test_crash_mid_compaction_orphan_gc(tmp_path):
     db = small_db(tmp_path / "db")
     for i in range(300):

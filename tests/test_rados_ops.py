@@ -97,6 +97,52 @@ def test_compound_vector_sees_staged_state(io):
     assert bytes(io.read("cv")) == b"xxyy"
 
 
+def test_delete_in_compound_sees_absent(io):
+    """After a delete in a compound vector, later ops see the object as
+    ABSENT — 'known absent' is distinct from 'not yet consulted', so
+    nothing re-reads the committed pre-delete state (reference
+    do_osd_ops runs the vector against the evolving obs)."""
+    io.write_full("dl", b"0123456789")
+    io.setxattr("dl", "tag", b"old")
+    # delete then append in ONE message: the append lands at offset 0,
+    # not at the committed size 10
+    io._submit("dl", [["delete"], ["append", 3]], b"new")
+    assert bytes(io.read("dl")) == b"new"
+    with pytest.raises(RadosError):      # delete dropped the xattrs too
+        io.getxattr("dl", "tag")
+    # delete then getxattr: the staged state has no xattrs -> ENODATA,
+    # and the failed compound applies NOTHING
+    io.setxattr("dl", "tag", b"old2")
+    with pytest.raises(RadosError) as ei:
+        io._submit("dl", [["delete"], ["getxattr", "tag"]])
+    assert ei.value.errno == errno.ENODATA
+    assert bytes(io.read("dl")) == b"new"          # txn aborted whole
+    assert io.getxattr("dl", "tag") == b"old2"
+    # delete then stat: ENOENT through the staged view
+    with pytest.raises(RadosError) as ei:
+        io._submit("dl", [["delete"], ["stat"]])
+    assert ei.value.errno == errno.ENOENT
+    # delete, recreate, THEN getxattr: the recreate must not resurrect
+    # committed pre-delete xattrs (the base died with the delete)
+    with pytest.raises(RadosError) as ei:
+        io._submit("dl", [["delete"], ["create", 0],
+                          ["getxattr", "tag"]])
+    assert ei.value.errno == errno.ENODATA
+    assert io.getxattr("dl", "tag") == b"old2"     # aborted, unchanged
+    # delete / recreate / read in ONE vector: the read must serve the
+    # staged recreate bytes, never the committed pre-delete content
+    out = io._submit("dl", [["delete"], ["append", 4], ["read", 0, 4]],
+                     b"mint")
+    assert bytes(out) == b"mint"
+    assert bytes(io.read("dl")) == b"mint"
+    # delete then zero: zero of an absent object is a no-op; the
+    # delete itself commits
+    io._submit("dl", [["delete"], ["zero", 0, 4]])
+    with pytest.raises(RadosError) as ei:
+        io.read("dl")
+    assert ei.value.errno == errno.ENOENT
+
+
 def test_cmpxattr_guards_compound_op(io):
     """The reference pattern: cmpxattr as the first op of a compound
     guards the write that follows — mismatch cancels the whole op."""
