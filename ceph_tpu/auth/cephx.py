@@ -34,11 +34,20 @@ import json
 import os
 import time
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:          # optional dep: auth protocol still
+    AESGCM = None            # importable, sealing raises at use
 
 
 class AuthError(Exception):
     pass
+
+
+def _require_aead():
+    if AESGCM is None:
+        raise AuthError(
+            "cephx sealed payloads need the 'cryptography' package")
 
 
 FRESHNESS_WINDOW = 120.0   # seconds of clock skew tolerated
@@ -61,12 +70,14 @@ def derive_key(base: bytes, *parts) -> bytes:
 
 
 def _seal(key: bytes, payload: dict) -> str:
+    _require_aead()
     nonce = os.urandom(12)
     ct = AESGCM(key).encrypt(nonce, json.dumps(payload).encode(), b"")
     return base64.b64encode(nonce + ct).decode()
 
 
 def _unseal(key: bytes, blob: str) -> dict:
+    _require_aead()
     try:
         raw = base64.b64decode(blob)
         pt = AESGCM(key).decrypt(raw[:12], raw[12:], b"")

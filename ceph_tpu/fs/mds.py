@@ -488,7 +488,17 @@ class MDSDaemon:
         """Cross-rank rename: this rank owns dst; the src dentry is
         removed THROUGH its owner.  The intent is journaled here, so a
         crash between the local link and the peer removal replays to
-        completion — never a doubled entry that stays."""
+        completion — never a doubled entry that stays.
+
+        Lock order: the dst dir lock covers ONLY the journal append and
+        the local dst link; the peer_drm call runs after it is
+        released.  Holding it across the peer request inverted the
+        distributed lock order — two opposite-direction cross-rank
+        renames each held their own dst dir lock while the peer's
+        handler blocked on taking it, stalling both until the 10s peer
+        timeout.  Releasing first is safe: the journaled intent already
+        commits the rename, and _handle_peer_drm is ino-guarded, so a
+        racing local mutation of the src dentry is never clobbered."""
         sdino, sname = self._split(a["src"])
         ddino, dname = self._split(a["dst"])
         with self._dir_lock(ddino):
@@ -507,10 +517,10 @@ class MDSDaemon:
                   "replaced": replaced, "src_owner": src_owner}
             seq = self.mdlog.append(ev)
             self._dset(ddino, dname, ent)
-            # if the peer call fails the intent stays pending and the
-            # removal completes on replay/takeover
-            self._peer_request(src_owner, "peer_drm", {
-                "dino": sdino, "name": sname, "ino": ent["ino"]})
+        # if the peer call fails the intent stays pending and the
+        # removal completes on replay/takeover
+        self._peer_request(src_owner, "peer_drm", {
+            "dino": sdino, "name": sname, "ino": ent["ino"]})
         if replaced is not None:
             self._purge_data(replaced)
         self.mdlog.mark_done(seq)

@@ -4,8 +4,9 @@ devicehealth, dashboard.
 Reference analogs: the mgr health aggregation (src/mgr/DaemonHealth*),
 pybind/mgr/balancer (upmap mode re-expressed over pg_temp, the map's
 explicit acting-set override), and pybind/mgr/pg_autoscaler (advisory
-here: pools do not split PGs, so the module recommends instead of
-mutating — surfaced through the health model).
+by default; pools that opt in with pg_autoscale_mode=on get real
+pg_num increases issued through the mon, which the OSDs execute as
+live PG splits).
 """
 
 from __future__ import annotations
@@ -136,14 +137,22 @@ class BalancerModule(MgrModule):
 
 
 class PgAutoscalerModule(MgrModule):
-    """Recommend pg_num per pool (advisory; reference
-    pybind/mgr/pg_autoscaler): target ~quarter of the reference's 100
-    PGs per OSD, power of two, surfaced as a health warning when a
-    pool is far off."""
+    """Recommend — and, for opted-in pools, APPLY — pg_num per pool
+    (reference pybind/mgr/pg_autoscaler): target ~quarter of the
+    reference's 100 PGs per OSD, power of two.
+
+    Pools default to advisory mode (a health warning when far off).
+    A pool with pg_autoscale_mode=on (`ceph osd pool set <pool>
+    pg_autoscale_mode on`) gets real `osd pool set pg_num` commands:
+    the mon commits the increase through Paxos and the OSDs split the
+    PGs live.  Growth only (PG merge is unsupported), stepped at most
+    `max_step`x per tick so one tick never floods the cluster with
+    every split at once."""
 
     name = "pg_autoscaler"
     run_interval = 2.0
     target_pgs_per_osd = 32
+    max_step = 4           # per-tick growth factor cap (power of two)
 
     def recommendations(self) -> dict[str, int]:
         m = self.get_osdmap()
@@ -161,6 +170,15 @@ class PgAutoscalerModule(MgrModule):
         warns = []
         for p in m.pools.values():
             want = recs.get(p.name, p.pg_num)
+            mode = getattr(p, "pg_autoscale_mode", "warn")
+            if mode == "on" and want > p.pg_num and p.pg_num and \
+                    p.pg_num & (p.pg_num - 1) == 0:
+                target = min(want, p.pg_num * self.max_step)
+                r, _out = self.mon_command({
+                    "prefix": "osd pool set", "pool": p.name,
+                    "var": "pg_num", "val": str(target)})
+                if r == 0:
+                    continue   # acted; re-evaluate next tick
             if want >= 4 * p.pg_num or p.pg_num >= 4 * want:
                 warns.append(
                     f"pool {p.name!r} pg_num {p.pg_num} far from "

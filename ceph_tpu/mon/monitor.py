@@ -53,7 +53,7 @@ DEFAULT_EC_PROFILE = {"plugin": "jax", "k": "2", "m": "1",
 # treats auth read as a privileged grant)
 READONLY_COMMANDS = {
     "osd erasure-code-profile get", "osd erasure-code-profile ls",
-    "osd pool ls", "status", "osd tree", "mon stat",
+    "osd pool ls", "osd pool get", "status", "osd tree", "mon stat",
     "config get", "config dump",
     "fs ls", "fs dump", "mgr dump",
 }
@@ -465,6 +465,10 @@ class Monitor:
                 return 0, {"profiles": sorted(self.osdmap.ec_profiles)}
             if prefix == "osd pool create":
                 return self._cmd_pool_create(cmd)
+            if prefix == "osd pool set":
+                return self._cmd_pool_set(cmd)
+            if prefix == "osd pool get":
+                return self._cmd_pool_get(cmd)
             if prefix == "osd pool ls":
                 return 0, {"pools": [p.name
                                      for p in self.osdmap.pools.values()]}
@@ -861,6 +865,74 @@ class Monitor:
             self.osdmap.bump_epoch()
             self._propose_current()
         return 0, {"pool_id": pool.id, "stripe_width": pool.stripe_width}
+
+    # -- pool mutation: PG split entry point (reference OSDMonitor
+    #    prepare_command "osd pool set ... pg_num") ------------------------
+
+    def _cmd_pool_set(self, cmd: dict) -> tuple[int, dict]:
+        """`osd pool set <pool> <var> <val>`.  pg_num is the PG-split
+        trigger: validated here (growth only, power-of-two stepping),
+        committed through Paxos as a map epoch every subscriber applies
+        — OSDs split their local collections on receipt, clients
+        retarget by the new pg_num."""
+        name = cmd["pool"]
+        var = cmd["var"]
+        val = cmd["val"]
+        with self.lock:
+            pool = self.osdmap.lookup_pool(name)
+            if pool is None:
+                return -errno.ENOENT, {"error": f"no pool {name}"}
+            if var == "pg_autoscale_mode":
+                if val not in ("on", "warn"):
+                    return -errno.EINVAL, {
+                        "error": f"pg_autoscale_mode must be on|warn, "
+                                 f"not {val!r}"}
+                pool.pg_autoscale_mode = val
+                self.osdmap.bump_epoch()
+                self._propose_current()
+                return 0, {"pool": name, "pg_autoscale_mode": val}
+            if var != "pg_num":
+                return -errno.EINVAL, {
+                    "error": f"unsettable pool var {var!r}"}
+            try:
+                n = int(val)
+            except (TypeError, ValueError):
+                return -errno.EINVAL, {"error": f"bad pg_num {val!r}"}
+            if n == pool.pg_num:
+                return 0, {"pool": name, "pg_num": n,
+                           "epoch": self.osdmap.epoch}
+            if n < pool.pg_num:
+                return -errno.EINVAL, {
+                    "error": f"pg_num {n} < {pool.pg_num}: PGs grow "
+                             f"monotonically (merge unsupported)"}
+            if n & (n - 1) or pool.pg_num & (pool.pg_num - 1):
+                # the ps-bits rehash rule (child = hash mod new_pg_num)
+                # assigns each parent's objects exactly to {parent +
+                # i*old_pg_num} only when both counts are powers of two
+                return -errno.EINVAL, {
+                    "error": f"pg_num must step between powers of two "
+                             f"({pool.pg_num} -> {n})"}
+            self.osdmap.set_pool_pg_num(pool.id, n)
+            self.osdmap.bump_epoch()
+            self._propose_current()
+            return 0, {"pool": name, "pg_num": n,
+                       "epoch": self.osdmap.epoch}
+
+    def _cmd_pool_get(self, cmd: dict) -> tuple[int, dict]:
+        name = cmd["pool"]
+        pool = self.osdmap.lookup_pool(name)
+        if pool is None:
+            return -errno.ENOENT, {"error": f"no pool {name}"}
+        fields = {"pg_num": pool.pg_num, "size": pool.size,
+                  "min_size": pool.min_size,
+                  "pg_autoscale_mode": pool.pg_autoscale_mode,
+                  "erasure_code_profile": pool.erasure_code_profile}
+        var = cmd.get("var")
+        if var is None:
+            return 0, {"pool": name, **fields}
+        if var not in fields:
+            return -errno.EINVAL, {"error": f"unknown pool var {var!r}"}
+        return 0, {"pool": name, var: fields[var]}
 
     def _cmd_status(self) -> tuple[int, dict]:
         with self.lock:

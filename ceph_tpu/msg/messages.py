@@ -274,15 +274,18 @@ class MOSDECSubOpRead(Message):
     type_id = 110
 
     def __init__(self, pgid: spg_t, tid: int, oid: hobject_t,
-                 off: int, length: int, want_attrs: bool = False):
+                 off: int, length: int, want_attrs: bool = False,
+                 want_omap: bool = False):
         super().__init__()
         self.pgid, self.tid, self.oid = pgid, tid, oid
         self.off, self.length, self.want_attrs = off, length, want_attrs
+        self.want_omap = want_omap
 
     def to_meta(self):
         return {"pgid": spg_to_json(self.pgid), "tid": self.tid,
                 "oid": hobj_to_json(self.oid), "off": self.off,
-                "len": self.length, "attrs": self.want_attrs}
+                "len": self.length, "attrs": self.want_attrs,
+                "omap": self.want_omap}
 
     def decode_wire(self, meta, data):
         self.pgid = spg_from_json(meta["pgid"])
@@ -290,6 +293,7 @@ class MOSDECSubOpRead(Message):
         self.oid = hobj_from_json(meta["oid"])
         self.off, self.length = meta["off"], meta["len"]
         self.want_attrs = meta["attrs"]
+        self.want_omap = meta.get("omap", False)
 
 
 @register_message
@@ -298,18 +302,30 @@ class MOSDECSubOpReadReply(Message):
 
     def __init__(self, pgid: spg_t, tid: int, shard: int, result: int,
                  data: bytes = b"", attrs: dict[str, bytes] | None = None,
-                 size: int = -1):
+                 size: int = -1,
+                 omap: dict[bytes, bytes] | None = None,
+                 omap_header: bytes = b""):
         super().__init__()
         self.pgid, self.tid, self.shard, self.result = \
             pgid, tid, shard, result
         self.data = data
         self.attrs = attrs or {}
         self.size = size  # shard object size; -1 = absent
+        # omap rides only when the read asked want_omap (replicated
+        # backfill pulls whole-object state across OSDs on PG split)
+        self.omap = omap or {}
+        self.omap_header = omap_header
 
     def to_meta(self):
-        # attrs ride the data segment after the read payload
-        self._attr_blob = json.dumps(
-            {k: v.hex() for k, v in self.attrs.items()}).encode()
+        # attrs (+ optional omap) ride the data segment after the
+        # read payload
+        blob = {"a": {k: v.hex() for k, v in self.attrs.items()}}
+        if self.omap:
+            blob["o"] = {k.hex(): v.hex()
+                         for k, v in self.omap.items()}
+        if self.omap_header:
+            blob["oh"] = self.omap_header.hex()
+        self._attr_blob = json.dumps(blob).encode()
         return {"pgid": spg_to_json(self.pgid), "tid": self.tid,
                 "shard": self.shard, "result": self.result,
                 "dlen": len(self.data), "size": self.size}
@@ -329,8 +345,14 @@ class MOSDECSubOpReadReply(Message):
         self.size = meta["size"]
         dlen = meta["dlen"]
         self.data = data[:dlen]
+        blob = json.loads(data[dlen:].decode())
+        if "a" not in blob:      # pre-omap layout: the blob IS attrs
+            blob = {"a": blob}
         self.attrs = {k: bytes.fromhex(v)
-                      for k, v in json.loads(data[dlen:].decode()).items()}
+                      for k, v in blob["a"].items()}
+        self.omap = {bytes.fromhex(k): bytes.fromhex(v)
+                     for k, v in blob.get("o", {}).items()}
+        self.omap_header = bytes.fromhex(blob.get("oh", ""))
 
 
 # -- heartbeat / mon ---------------------------------------------------------

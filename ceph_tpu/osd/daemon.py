@@ -28,6 +28,7 @@ import time
 
 import numpy as np
 
+from ..crush.hash import crush_hash32
 from ..ec import ErasureCodeError, ErasureCodePluginRegistry, Profile
 from ..msg import Messenger
 from ..msg import messages as M
@@ -436,6 +437,40 @@ class OSDDaemon:
         # PGs whose last recovery pass failed: the steady-state skip
         # must not strand them until an unrelated acting change
         self._pgs_needing_recovery: set = set()
+        # recovery passes currently running (quiescence observable for
+        # tests/operators: 0 + empty needing-recovery = settled)
+        self._recovery_inflight = 0
+        self._split_retry_pending = False
+        # objects recovery proved unrecoverable with every holder
+        # answering (partial writes that never acked, or loss beyond
+        # m).  Latched per PG so they stop holding the PG in
+        # needing-recovery — the reference's "unfound" state; a later
+        # pass re-evaluates (pg_t -> {hobject_t})
+        self._unfound: dict[pg_t, set] = {}
+        # -- PG split state --------------------------------------------
+        # Serializes the local split sweep against shard writes: a
+        # sub-write applied concurrently with the sweep could land an
+        # object in a parent collection after the sweep passed it, and
+        # the shard log mutations (append vs split_out) must not
+        # interleave.  Held only across local store work, never across
+        # RPCs.  Deliberately one OSD-global lock: the work it covers
+        # is Python-level (GIL-bound anyway), and the sweep — the only
+        # long holder — is a one-off pause per split, the analog of
+        # the reference's pg-lock'd PG::split_into.
+        self._split_lock = threading.RLock()
+        # child pg -> parent pg recorded when a pool's pg_num grows
+        # (the ps-bits ancestry): read/stat fall back through it while
+        # a split is settling, and recovery scans ancestor collections
+        # for child objects that still sit on pre-split holders
+        self._split_ancestry: dict[pg_t, pg_t] = {}
+        # (child spg, hobject) moved locally by a split but not yet
+        # confirmed on the child's acting home.  The HOLDER drives
+        # convergence: a child primary that already ran its recovery
+        # pass has no way to learn about objects a lagging holder
+        # re-homes later (acked writes racing the map), so the holder
+        # pushes and retries until each lands.
+        self._split_push_pending: set[tuple[spg_t, hobject_t]] = set()
+        self._split_pusher_armed = False
         self.raw_read_waiters: dict = {}
         # shard-resident replicated PG logs (reference: pglog omap keys
         # in the pg meta collection) + peering RPC plumbing
@@ -586,7 +621,9 @@ class OSDDaemon:
                     waiter(msg)
             elif isinstance(msg, M.MOSDECSubOpRead):
                 self.perf.inc("subop_r")
-                reply = self.stat_shard(msg.pgid, msg.oid, msg.want_attrs) \
+                reply = self.stat_shard(msg.pgid, msg.oid,
+                                        msg.want_attrs,
+                                        msg.want_omap) \
                     if msg.length == 0 else \
                     self._read_reply(msg.pgid, msg.oid, msg.off, msg.length)
                 reply.tid = msg.tid
@@ -632,12 +669,42 @@ class OSDDaemon:
                              self.prev_osdmap.is_up(oid_)):
                 self._hb_last_seen.pop(oid_, None)
                 self._hb_first_ping.pop(oid_, None)
+        # PG split detection: pools whose pg_num grew.  Record the
+        # ps-bits ancestry BEFORE adopting the map so concurrent
+        # reads/stats that miss in a child collection can already fall
+        # back to the parent while the sweep runs.
+        grown: list[tuple[int, int, int]] = []
+        if self.prev_osdmap is not None:
+            for pid, pool in newmap.pools.items():
+                old = self.prev_osdmap.pools.get(pid)
+                if old is not None and pool.pg_num > old.pg_num:
+                    grown.append((pid, old.pg_num, pool.pg_num))
+                    for c in range(old.pg_num, pool.pg_num):
+                        self._split_ancestry[pg_t(pid, c)] = \
+                            pg_t(pid, c % old.pg_num)
+        else:
+            # first map after (re)boot: a split may have committed
+            # while this OSD was down — its collections would still
+            # hold pre-split placement.  Rehash every pool's local
+            # collections (no-op when nothing is misplaced; one
+            # boot-time hash per local object.  A persisted per-pool
+            # pg_num marker could skip this entirely — future work if
+            # boot time on large persistent stores ever matters).
+            grown = [(pid, pool.pg_num, pool.pg_num)
+                     for pid, pool in newmap.pools.items()]
         self.osdmap = newmap
         # refresh acting sets of cached backends; an interval change
         # (acting set differs) forces re-peering before the next op
         # (reference PeeringState start_peering_interval)
+        grown_pools = {pid for pid, _o, _n in grown}
         with self.pg_lock:
             for pgid, state in list(self.pgs.items()):
+                if pgid.pool in grown_pools:
+                    # the split is a new interval for every PG of the
+                    # pool: parents shed objects, children are born —
+                    # rebuild (and re-peer) on next use
+                    self.pgs.pop(pgid, None)
+                    continue
                 up, acting, _, primary = newmap.pg_to_up_acting_osds(pgid)
                 shards = getattr(state.backend, "shards", None) or \
                     getattr(state.backend, "replicas", None)
@@ -651,6 +718,28 @@ class OSDDaemon:
                         shards.n_replicas = len(shards.acting)
                 if primary != self.osd_id:
                     self.pgs.pop(pgid, None)  # primary moved away
+        # a running OSD the map says is down re-announces itself —
+        # heartbeat-grace flaps on a loaded host would otherwise leave
+        # it marked down forever (reference OSD::_committed_osd_maps
+        # re-sends MOSDBoot when !osdmap->is_up(whoami))
+        if not self._hb_stop.is_set() and self.osd_id in newmap.osds \
+                and not newmap.is_up(self.osd_id):
+            try:
+                self.mon_conn.send_message(
+                    M.MOSDBoot(self.osd_id, self.addr))
+            except Exception:  # noqa: BLE001 - mon hunting handles it
+                pass
+        # split local shard collections BEFORE the recovery pass for
+        # this epoch: recovery must see objects in their post-split
+        # homes (remote stragglers are found via ancestor scans)
+        for pid, old_n, new_n in grown:
+            try:
+                self._split_pool_collections(pid, new_n)
+            except Exception:  # noqa: BLE001 - a failed sweep must not
+                # kill dispatch; the misplaced-write/read fallbacks and
+                # recovery retries converge the leftovers
+                import traceback
+                traceback.print_exc()
         self.map_event.set()
         if self.recovery_enabled and newmap.pools and \
                 newmap.epoch not in self._recovered_epochs:
@@ -672,6 +761,60 @@ class OSDDaemon:
         missing, for every PG this OSD leads.  This is the elastic part
         of the system: mark an OSD out -> CRUSH picks replacements ->
         primaries reconstruct the lost shards onto them."""
+        with self.pg_lock:
+            self._recovery_inflight += 1
+        try:
+            self._recover_epoch_inner(epoch, prevmap)
+        finally:
+            with self.pg_lock:
+                self._recovery_inflight -= 1
+        # Convergence timer: a failed/partial recovery (split sources
+        # lagging, a push that timed out, peers briefly saturated) used
+        # to wait for the NEXT map epoch — and a quiet cluster produces
+        # none, stranding the PG until an unrelated acting change.
+        # Retry on a timer until the set drains — but only for PGs
+        # whose acting set is fully up: a retry against a down member
+        # can't complete anyway, the revival bumps an epoch that
+        # recovers normally, and full-scan retry passes against dead
+        # peers starve live traffic mid-thrash.  One pending retry at
+        # a time, 5s apart.
+        if not self._hb_stop.is_set() and epoch == self.osdmap.epoch \
+                and self._retry_could_help():
+            with self.pg_lock:
+                if self._split_retry_pending:
+                    return
+                self._split_retry_pending = True
+
+            def _retry():
+                with self.pg_lock:
+                    self._split_retry_pending = False
+                # recover against the CURRENT epoch: an epoch that
+                # landed inside the retry window must not swallow the
+                # retry (its own pass may already have run and failed
+                # before this timer armed)
+                if not self._hb_stop.is_set() and \
+                        self._pgs_needing_recovery:
+                    self._recover_epoch(self.osdmap.epoch, self.osdmap)
+
+            t = threading.Timer(5.0, _retry)
+            t.daemon = True
+            t.start()
+
+    def _retry_could_help(self) -> bool:
+        """A recovery retry is worth scheduling iff some PG in the
+        needing-recovery set has every acting member up."""
+        from ..crush.map import CRUSH_ITEM_NONE
+        for pgid in list(self._pgs_needing_recovery):
+            try:
+                _, acting, _, _ = self.osdmap.pg_to_up_acting_osds(pgid)
+            except Exception:  # noqa: BLE001
+                continue
+            if acting and all(o != CRUSH_ITEM_NONE and
+                              self.osdmap.is_up(o) for o in acting):
+                return True
+        return False
+
+    def _recover_epoch_inner(self, epoch: int, prevmap=None) -> None:
         import numpy as np
         from ..store.object_store import Transaction
         # peers that time out once in this pass are not probed again:
@@ -724,6 +867,15 @@ class OSDDaemon:
             for oj in self._remote_list(osd, spg,
                                         unreachable=unreachable):
                 names.add(M.hobj_from_json(oj))
+        # keep only names the ps-bits rule assigns to this PG: while a
+        # split settles, a lagging holder's parent collection still
+        # lists objects that now belong to children — recovery/scrub of
+        # the parent must not adopt them back
+        pool = self.osdmap.pools.get(pgid.pool)
+        if pool is not None and pool.pg_num:
+            names = {h for h in names
+                     if crush_hash32(h.key or h.name) % pool.pg_num ==
+                     pgid.seed}
         return names
 
     def _list_pg_objects(self, spg: spg_t) -> list:
@@ -799,19 +951,27 @@ class OSDDaemon:
 
     def _remote_read_full(self, osd: int, spg: spg_t, oid: hobject_t,
                           timeout: float = 3.0,
-                          unreachable: set | None = None):
+                          unreachable: set | None = None,
+                          want_omap: bool = False):
         if self._hb_stop.is_set():
             return None
-        """(data, attrs) of a shard object on a specific OSD, or None.
-        The backfill copy path: a moved shard is fetched from its old
-        holder verbatim instead of being re-decoded."""
+        """(data, attrs) — plus (omap, omap_header) when want_omap —
+        of a shard object on a specific OSD, or None.  The backfill
+        copy path: a moved shard is fetched from its old holder
+        verbatim instead of being re-decoded."""
         if osd == self.osd_id:
             goid = ghobject_t(oid, shard=spg.shard)
             try:
                 data = self.store.read(self._cid(spg), goid)
                 attrs = self.store.getattrs(self._cid(spg), goid)
+                if want_omap:
+                    omap = self.store.omap_get(self._cid(spg), goid)
+                    hdr = self.store.omap_get_header(self._cid(spg),
+                                                     goid)
             except KeyError:
                 return None
+            if want_omap:
+                return np.asarray(data), attrs, omap, hdr
             return np.asarray(data), attrs
         with self.pg_lock:
             self._raw_tid += 1
@@ -822,7 +982,8 @@ class OSDDaemon:
             lambda m: (box.update(msg=m), ev.set())
         try:
             self.conn_to_osd(osd).send_message(
-                M.MOSDECSubOpRead(spg, tid, oid, 0, 0, want_attrs=True))
+                M.MOSDECSubOpRead(spg, tid, oid, 0, 0, want_attrs=True,
+                                  want_omap=want_omap))
         except Exception:  # noqa: BLE001
             return None
         if not ev.wait(timeout):
@@ -832,19 +993,24 @@ class OSDDaemon:
         stat = box["msg"]
         if stat.result != 0 or stat.size < 0:
             return None
-        with self.pg_lock:
-            self._raw_tid += 1
-            tid = self._raw_tid
-        box2: dict = {}
-        ev2 = threading.Event()
-        self.raw_read_waiters[(spg, tid)] = \
-            lambda m: (box2.update(msg=m), ev2.set())
-        self.conn_to_osd(osd).send_message(
-            M.MOSDECSubOpRead(spg, tid, oid, 0, stat.size))
-        if not ev2.wait(timeout) or box2["msg"].result != 0:
-            return None
-        return (np.frombuffer(box2["msg"].data, dtype=np.uint8),
-                stat.attrs)
+        if stat.size == 0:
+            data = np.empty(0, dtype=np.uint8)
+        else:
+            with self.pg_lock:
+                self._raw_tid += 1
+                tid = self._raw_tid
+            box2: dict = {}
+            ev2 = threading.Event()
+            self.raw_read_waiters[(spg, tid)] = \
+                lambda m: (box2.update(msg=m), ev2.set())
+            self.conn_to_osd(osd).send_message(
+                M.MOSDECSubOpRead(spg, tid, oid, 0, stat.size))
+            if not ev2.wait(timeout) or box2["msg"].result != 0:
+                return None
+            data = np.frombuffer(box2["msg"].data, dtype=np.uint8)
+        if want_omap:
+            return data, stat.attrs, stat.omap, stat.omap_header
+        return data, stat.attrs
 
     def _recover_ec_pg(self, pgid: pg_t, acting: list[int],
                        unreachable: set | None = None,
@@ -855,6 +1021,10 @@ class OSDDaemon:
         if state.kind != "ec":
             return
         be = state.backend
+        pool = self.osdmap.pools.get(pgid.pool)
+        if pool is None:
+            return
+        self._unfound.pop(pgid, None)   # re-evaluate each pass
         prevmap = prevmap if prevmap is not None else self.prev_osdmap
         prev_acting = None
         if prevmap is not None and pgid.pool in prevmap.pools:
@@ -863,6 +1033,15 @@ class OSDDaemon:
                     prevmap.pg_to_up_acting_osds(pgid)
             except Exception:  # noqa: BLE001
                 prev_acting = None
+            if pgid.seed >= prevmap.pools[pgid.pool].pg_num:
+                # split child born this interval: the previous map's
+                # CRUSH answer for its seed is not history — force the
+                # full scan so objects are pulled off pre-split holders
+                prev_acting = None
+        if pgid in self._pgs_needing_recovery:
+            # retrying (e.g. split sources lagged last pass): the
+            # steady-state shortcuts would scan nothing new
+            prev_acting = None
         # objects may live on old holders only: list those too.  Map
         # history beyond one epoch isn't kept (the reference consults
         # past_intervals), so when the acting set changed, the shard
@@ -916,6 +1095,18 @@ class OSDDaemon:
                     continue
                 for oj in self._remote_list(osd, spg, timeout=3.0):
                     names.add(M.hobj_from_json(oj))
+        # split child: objects may still sit in ANCESTOR collections on
+        # holders whose local sweep lags — list those too, keeping only
+        # names the ps-bits rule assigns to this child
+        ancestors = self._split_ancestors(pgid) if prev_acting is None \
+            else []
+        names |= self._names_from_ancestors(pgid, ancestors,
+                                            range(be.n), pool.pg_num,
+                                            up_osds, unreachable)
+        if pool.pg_num:
+            names = {h for h in names
+                     if crush_hash32(h.key or h.name) % pool.pg_num ==
+                     pgid.seed}
         all_ok = True
         for oid in names:
             if self._hb_stop.is_set():
@@ -930,18 +1121,45 @@ class OSDDaemon:
                 continue
             if not self._recover_object(pgid, acting, be, prev_acting,
                                         up_osds, oid, missing,
-                                        unreachable):
+                                        unreachable,
+                                        src_pgs=[pgid] + ancestors):
                 all_ok = False
         if all_ok:
             self._pgs_needing_recovery.discard(pgid)
         else:
             self._pgs_needing_recovery.add(pgid)
 
+    def _names_from_ancestors(self, pgid: pg_t, ancestors, shard_ids,
+                              pg_num: int, up_osds,
+                              unreachable) -> set:
+        """Child-PG object names still listed under ancestor
+        collections on any up OSD (their local split sweeps may lag),
+        filtered to the names the ps-bits rule assigns to pgid."""
+        names: set = set()
+        sids = list(shard_ids)
+        for anc in ancestors:
+            for s in sids:
+                aspg = spg_t(anc, s if len(sids) > 1 else NO_SHARD)
+                for osd in up_osds:
+                    if unreachable is not None and osd in unreachable:
+                        continue
+                    for oj in self._remote_list(
+                            osd, aspg, timeout=3.0,
+                            unreachable=unreachable):
+                        h = M.hobj_from_json(oj)
+                        if crush_hash32(h.key or h.name) % pg_num == \
+                                pgid.seed:
+                            names.add(h)
+        return names
+
     def _recover_object(self, pgid, acting, be, prev_acting, up_osds,
-                        oid, missing, unreachable=None) -> bool:
+                        oid, missing, unreachable=None,
+                        src_pgs=None) -> bool:
         """Rebuild one object's missing shards: backfill-by-copy from
         any surviving holder, else reconstruct-from-k (runs under the
-        osd_max_backfills reservation)."""
+        osd_max_backfills reservation).  src_pgs lists the PGs whose
+        collections may hold the shard (the PG itself plus, after a
+        split, its ancestors on not-yet-swept holders)."""
         # 1: backfill-by-copy from wherever the shard still lives
         # (previous holder first, then any up OSD).  A leftover
         # copy from an older interval could be stale, so candidates
@@ -951,6 +1169,7 @@ class OSDDaemon:
         from ..common import crc32c as _crc
         from ..crush.map import CRUSH_ITEM_NONE
         auth_hinfo = be._fetch_hinfo(oid)
+        src_pgs = src_pgs or [pgid]
         still_missing = []
         for s in missing:
             copied = False
@@ -966,8 +1185,13 @@ class OSDDaemon:
             for old in candidates:
                 if unreachable is not None and old in unreachable:
                     continue
-                got = self._remote_read_full(old, spg_t(pgid, s), oid,
-                                             unreachable=unreachable)
+                got = None
+                for src_pg in src_pgs:
+                    got = self._remote_read_full(
+                        old, spg_t(src_pg, s), oid,
+                        unreachable=unreachable)
+                    if got is not None:
+                        break
                 if got is None:
                     continue
                 data, attrs = got
@@ -1007,6 +1231,20 @@ class OSDDaemon:
                           f"of pg {pgid} by copy")
             return True
         if len(still_missing) > be.m:
+            if not unreachable and all(
+                    self.osdmap.is_up(o.id)
+                    for o in self.osdmap.osds.values()):
+                # every holder in the cluster answered and fewer than
+                # k shards exist anywhere: the object is UNFOUND — a
+                # partial write that never acked, or loss beyond m.
+                # Latch it (reference marks unfound rather than
+                # retrying forever); a later pass re-evaluates.
+                self._unfound.setdefault(pgid, set()).add(oid)
+                self.cct.dout("osd", 1,
+                              f"{oid.name}: unfound in pg {pgid} "
+                              f"({len(still_missing)} shards beyond "
+                              f"m={be.m}, all holders answered)")
+                return True
             self.cct.dout("osd", 1,
                           f"{oid.name}: {len(still_missing)} shards "
                           f"unrecoverable in pg {pgid}")
@@ -1021,20 +1259,26 @@ class OSDDaemon:
                           f"{still_missing} of pg {pgid} by decode")
             return True
         except Exception as e:  # noqa: BLE001
+            import traceback
             self.cct.dout("osd", 1,
-                          f"recovery of {oid.name} failed: {e!r}")
+                          f"recovery of {oid.name} failed: {e!r}\n" +
+                          traceback.format_exc())
             return False
 
     def _recover_replicated_pg(self, pgid: pg_t,
                                acting: list[int],
                                prevmap=None) -> None:
         from ..store.object_store import Transaction
+        pool = self.osdmap.pools.get(pgid.pool)
         prevmap = prevmap if prevmap is not None else self.prev_osdmap
+        fresh_child = False
         if prevmap is not None and pgid.pool in prevmap.pools:
+            fresh_child = pgid.seed >= prevmap.pools[pgid.pool].pg_num
             try:
                 _, prev_acting, _, _ = \
                     prevmap.pg_to_up_acting_osds(pgid)
-                if list(prev_acting) == list(acting) and \
+                if not fresh_child and \
+                        list(prev_acting) == list(acting) and \
                         pgid not in self._pgs_needing_recovery and \
                         all(self.osdmap.is_up(o) for o in acting):
                     return   # steady state: nothing moved
@@ -1047,22 +1291,40 @@ class OSDDaemon:
             if osd != self.osd_id and self.osdmap.is_up(osd):
                 for oj in self._remote_list(osd, spg):
                     names.add(M.hobj_from_json(oj))
+        # split child: scan every up OSD's copy of this child plus the
+        # ancestor collections of not-yet-swept holders
+        ancestors = []
+        up_osds = [o.id for o in self.osdmap.osds.values() if o.up]
+        if fresh_child or pgid in self._pgs_needing_recovery:
+            ancestors = self._split_ancestors(pgid)
+            for osd in up_osds:
+                if osd not in acting:
+                    for oj in self._remote_list(osd, spg, timeout=3.0):
+                        names.add(M.hobj_from_json(oj))
+            if pool is not None:
+                names |= self._names_from_ancestors(
+                    pgid, ancestors, [0], pool.pg_num, up_osds, None)
+        if pool is not None and pool.pg_num:
+            names = {h for h in names
+                     if crush_hash32(h.key or h.name) % pool.pg_num ==
+                     pgid.seed}
         all_ok = True
         for oid in names:
             if self._hb_stop.is_set():
                 return
             goid = ghobject_t(oid, shard=NO_SHARD)
-            src = None
-            for osd in acting:
-                if osd == self.osd_id:
-                    try:
-                        self.store.stat(self._cid(spg), goid)
-                        src = self.osd_id
-                        break
-                    except KeyError:
-                        continue
-            if src is None:
-                continue  # remote-source replication is via EC path
+            have_local = True
+            try:
+                self.store.stat(self._cid(spg), goid)
+            except KeyError:
+                have_local = False
+            if not have_local:
+                # pull from any holder — another replica, or (post
+                # split) a pre-split holder's child/ancestor collection
+                if not self._pull_replicated_object(
+                        pgid, spg, oid, goid, ancestors, up_osds):
+                    all_ok = False
+                    continue
             data = self.store.read(self._cid(spg), goid)
             attrs = self.store.getattrs(self._cid(spg), goid)
             omap = self.store.omap_get(self._cid(spg), goid)
@@ -1088,6 +1350,306 @@ class OSDDaemon:
         else:
             self._pgs_needing_recovery.add(pgid)
 
+    def _pull_replicated_object(self, pgid: pg_t, spg: spg_t,
+                                oid: hobject_t, goid: ghobject_t,
+                                ancestors, up_osds) -> bool:
+        """Fetch a whole replicated object (data + xattrs + omap) from
+        any up holder into the local primary collection.  Sources are
+        the PG's own collection on any OSD, then ancestor collections
+        (split holders whose sweep lags)."""
+        from ..store.object_store import Transaction
+        for src_pg in [pgid] + list(ancestors):
+            sspg = spg_t(src_pg, NO_SHARD)
+            for osd in up_osds:
+                if osd == self.osd_id:
+                    continue
+                got = self._remote_read_full(osd, sspg, oid,
+                                             want_omap=True)
+                if got is None:
+                    continue
+                data, attrs, omap, omap_hdr = got
+                txn = Transaction()
+                txn.touch(goid)
+                if data.size:
+                    txn.write(goid, 0, data)
+                if attrs:
+                    txn.setattrs(goid, attrs)
+                if omap:
+                    txn.omap_setkeys(goid, omap)
+                if omap_hdr:
+                    txn.omap_setheader(goid, omap_hdr)
+                self.apply_shard_txn(spg, txn)
+                self.cct.dout("osd", 5,
+                              f"pulled {oid.name} of pg {pgid} from "
+                              f"osd.{osd} ({src_pg})")
+                return True
+        return False
+
+    # -- PG split (reference PG::split_into / OSD::advance_pg splits;
+    #    the ps-bits rule: an object's child PG is hash mod new pg_num,
+    #    so with power-of-two stepping parent seed s scatters exactly
+    #    into {s + i*old_pg_num}) ------------------------------------------
+
+    def _split_pool_collections(self, pool_id: int, new_n: int) -> None:
+        """Rehash every local shard collection of a grown pool: objects
+        whose ps-bits now select a child PG move — data, xattrs, omap,
+        rollback generations, snap clones — together with their PG log
+        entries; the child inherits the parent's info bounds.  Runs
+        under the split lock so no sub-write can slip an object into a
+        parent collection behind the sweep."""
+        with self._split_lock:
+            for cid in list(self.store.list_collections()):
+                if cid.pgid.pool != pool_id or cid.pgid.seed >= new_n:
+                    continue
+                # parents are every pre-existing seed; a child created
+                # moments ago by another pool grow step is covered too
+                # (its objects already rehash to themselves)
+                try:
+                    self._split_shard_collection(cid, new_n)
+                except KeyError:
+                    continue   # collection raced away (pg removal)
+
+    def _split_shard_collection(self, cid: spg_t, new_n: int) -> None:
+        from .pg_log import PG_META_NAME
+        parent_seed = cid.pgid.seed
+        gobjs = self.store.list_objects(cid)
+        moves: dict[int, list[ghobject_t]] = {}
+        for g in gobjs:
+            if g.hobj.name == PG_META_NAME:
+                continue
+            seed = crush_hash32(g.hobj.key or g.hobj.name) % new_n
+            if seed != parent_seed:
+                moves.setdefault(seed, []).append(g)
+        if not moves:
+            return
+        slog = self._shard_log(cid)
+        ptxn = Transaction()
+        for child_seed, goids in sorted(moves.items()):
+            child = spg_t(pg_t(cid.pgid.pool, child_seed), cid.shard)
+            ccid = self._cid(child)
+            ctxn = Transaction()
+            names = {g.hobj.name for g in goids}
+            for g in goids:
+                self._stage_object_copy(cid, ctxn, g)
+                ptxn.remove(g)
+            self.store.queue_transactions(ccid, [ctxn])
+            # the child's shard log inherits the entries of its objects
+            # plus the parent's last_update/les bounds — that history is
+            # what lets child peering fence stale shards exactly like a
+            # parent interval change would
+            moved_entries = [e for e in slog.log.entries
+                             if e.oid.name in names]
+            self._shard_log(child).merge_split(
+                moved_entries, slog.info.last_update,
+                slog.info.last_epoch_started)
+            # holder-driven delivery: this OSD now owes these objects
+            # to the child's acting home (one hobj per name suffices —
+            # the pusher copies every ghobject of the name)
+            by_name: dict[str, hobject_t] = {}
+            for g in goids:
+                by_name.setdefault(g.hobj.name, g.hobj)
+            self._queue_split_push(child, set(by_name.values()))
+            self.cct.dout("osd", 3,
+                          f"split {cid}: {len(goids)} shard objects "
+                          f"-> {child}")
+        slog.split_out({g.hobj.name
+                        for gs in moves.values() for g in gs})
+        self.store.queue_transactions(cid, [ptxn])
+
+    def _stage_object_copy(self, src_cid: spg_t, txn: Transaction,
+                           g: ghobject_t) -> None:
+        """Stage one ghobject's full state (data, xattrs, omap) into a
+        transaction bound for another collection, same ghobject id."""
+        txn.touch(g)
+        data = self.store.read(src_cid, g)
+        if data.size:
+            txn.write(g, 0, data)
+        attrs = self.store.getattrs(src_cid, g)
+        if attrs:
+            txn.setattrs(g, attrs)
+        try:
+            omap = self.store.omap_get(src_cid, g)
+            hdr = self.store.omap_get_header(src_cid, g)
+        except KeyError:
+            omap, hdr = {}, b""
+        if omap:
+            txn.omap_setkeys(g, omap)
+        if hdr:
+            txn.omap_setheader(g, hdr)
+
+    @staticmethod
+    def _txn_hobjs(txn: Transaction) -> set[hobject_t]:
+        out: set[hobject_t] = set()
+        for op in txn.ops:
+            for attr in ("oid", "src", "dst"):
+                goid = getattr(op, attr, None)
+                if goid is not None:
+                    out.add(goid.hobj)
+        return out
+
+    def _migrate_misplaced(self, spg: spg_t,
+                           hobjs: set[hobject_t]) -> None:
+        """Post-apply split routing for writes that raced a pg_num
+        grow: a sub-write issued against the parent PG by a primary on
+        the old map applies verbatim (log append included), then any
+        object that rehashes into a child under THIS osd's map moves
+        immediately.  Caller holds the split lock."""
+        from .pg_log import PG_META_NAME
+        pool = self.osdmap.pools.get(spg.pgid.pool)
+        if pool is None or not pool.pg_num:
+            return
+        for hobj in hobjs:
+            if hobj.name == PG_META_NAME:
+                continue
+            seed = crush_hash32(hobj.key or hobj.name) % pool.pg_num
+            if seed == spg.pgid.seed or spg.pgid.seed >= pool.pg_num:
+                # seed matches, or WE are behind the writer's map (a
+                # child sub-write arriving before our split sweep):
+                # leave it — our own sweep re-homes everything when the
+                # new map lands
+                continue
+            cid = self._cid(spg)
+            child = spg_t(pg_t(spg.pgid.pool, seed), spg.shard)
+            ccid = self._cid(child)
+            goids = [g for g in self.store.list_objects(cid)
+                     if g.hobj.name == hobj.name]
+            if not goids:
+                continue
+            ctxn = Transaction()
+            for g in goids:
+                self._stage_object_copy(cid, ctxn, g)
+            self.store.queue_transactions(ccid, [ctxn])
+            slog = self._shard_log(spg)
+            moved = slog.split_out({hobj.name})
+            self._shard_log(child).merge_split(
+                moved, slog.info.last_update,
+                slog.info.last_epoch_started)
+            ptxn = Transaction()
+            for g in goids:
+                ptxn.remove(g)
+            self.store.queue_transactions(cid, [ptxn])
+            # a write acked through the OLD primary after the child
+            # primary's recovery pass already ran has no other way to
+            # reach the child's acting home — the holder delivers it
+            self._queue_split_push(child, {hobj})
+
+    def _queue_split_push(self, child: spg_t,
+                          hobjs: set[hobject_t]) -> None:
+        """Remember objects this OSD re-homed into a child collection
+        until they are confirmed on the child's acting home, and arm
+        the pusher."""
+        from .pg_log import PG_META_NAME
+        with self.pg_lock:
+            for h in hobjs:
+                if h.name != PG_META_NAME:
+                    self._split_push_pending.add((child, h))
+            if not self._split_push_pending or self._split_pusher_armed:
+                return
+            self._split_pusher_armed = True
+        t = threading.Timer(0.2, self._drain_split_pushes)
+        t.daemon = True
+        t.start()
+
+    def _drain_split_pushes(self) -> None:
+        """Deliver locally re-homed split objects to the child's
+        acting set; whatever cannot land yet (target down, acting
+        hole) retries on a timer until the queue drains."""
+        if self._hb_stop.is_set():
+            with self.pg_lock:
+                self._split_pusher_armed = False
+            return
+        with self.pg_lock:
+            pending = list(self._split_push_pending)
+        for child, hobj in pending:
+            if self._hb_stop.is_set():
+                break
+            try:
+                done = self._push_split_object(child, hobj)
+            except Exception:  # noqa: BLE001 - keep the queue alive
+                done = False
+            if done:
+                with self.pg_lock:
+                    self._split_push_pending.discard((child, hobj))
+        with self.pg_lock:
+            more = bool(self._split_push_pending) and \
+                not self._hb_stop.is_set()
+            if not more:
+                self._split_pusher_armed = False
+        if more:
+            t = threading.Timer(2.0, self._drain_split_pushes)
+            t.daemon = True
+            t.start()
+
+    def _push_split_object(self, child: spg_t, hobj: hobject_t) -> bool:
+        """Copy one re-homed object (all its ghobjects) from the local
+        child collection to where the child PG actually lives under
+        the CURRENT map.  EC: this OSD held shard `child.shard` of the
+        parent, so exactly the same shard of the child is its to
+        deliver.  Replicated: the full object goes to every acting
+        replica.  True = nothing left to deliver."""
+        from ..crush.map import CRUSH_ITEM_NONE
+        pool = self.osdmap.pools.get(child.pgid.pool)
+        if pool is None or child.pgid.seed >= pool.pg_num:
+            return pool is None   # pool gone: drop; map lag: retry
+        cid = self._cid(child)
+        goids = [g for g in self.store.list_objects(cid)
+                 if g.hobj.name == hobj.name]
+        if not goids:
+            return True           # deleted / re-homed again meanwhile
+        try:
+            _, acting, _, _ = self.osdmap.pg_to_up_acting_osds(
+                child.pgid)
+        except Exception:  # noqa: BLE001 - unmapped pg: retry later
+            return False
+        if pool.is_erasure():
+            s = child.shard
+            if s < 0 or s >= len(acting):
+                return True       # shard position no longer exists
+            tgt = acting[s]
+            if tgt == CRUSH_ITEM_NONE or not self.osdmap.is_up(tgt):
+                return False      # hole/down: retry when it heals
+            targets = [tgt]
+        else:
+            targets = [o for o in acting if o != CRUSH_ITEM_NONE and
+                       self.osdmap.is_up(o)]
+            if len(targets) < len(acting) or not targets:
+                return False      # push to the FULL set or retry
+        ok_all = True
+        for tgt in targets:
+            if tgt == self.osd_id:
+                continue          # already local
+            txn = Transaction()
+            for g in goids:
+                self._stage_object_copy(cid, txn, g)
+            if not self._push_shard_txn(tgt, child, txn, timeout=10.0):
+                ok_all = False
+        return ok_all
+
+    def _fallback_spg(self, spg: spg_t) -> spg_t | None:
+        """Where a shard object may still live while a split settles:
+        the recorded parent (this OSD already split), or — when this
+        OSD's map predates the child entirely — the seed the LOCAL
+        pg_num folds it to."""
+        anc = self._split_ancestry.get(spg.pgid)
+        if anc is not None:
+            return spg_t(anc, spg.shard)
+        pool = self.osdmap.pools.get(spg.pgid.pool)
+        if pool is not None and pool.pg_num and \
+                spg.pgid.seed >= pool.pg_num:
+            return spg_t(pg_t(spg.pgid.pool,
+                              spg.pgid.seed % pool.pg_num), spg.shard)
+        return None
+
+    def _split_ancestors(self, pgid: pg_t) -> list[pg_t]:
+        """The ancestry chain of a child PG (oldest last), empty for
+        PGs that never split out."""
+        out: list[pg_t] = []
+        cur = self._split_ancestry.get(pgid)
+        while cur is not None and cur not in out and cur != pgid:
+            out.append(cur)
+            cur = self._split_ancestry.get(cur)
+        return out
+
     # -- shard-side ops (any OSD) ------------------------------------------
 
     def _cid(self, spg: spg_t) -> spg_t:
@@ -1097,7 +1659,9 @@ class OSDDaemon:
         return spg
 
     def apply_shard_txn(self, spg: spg_t, txn: Transaction) -> None:
-        self.store.queue_transactions(self._cid(spg), [txn])
+        with self._split_lock:
+            self.store.queue_transactions(self._cid(spg), [txn])
+            self._migrate_misplaced(spg, self._txn_hobjs(txn))
 
     def _shard_log(self, spg: spg_t):
         from .pg_log import ShardPGLog
@@ -1119,15 +1683,17 @@ class OSDDaemon:
             self.apply_shard_txn(spg, txn)
             return
         entries = [entry_from_wire(w) for w in wire_entries]
-        slog = self._shard_log(spg)
-        slog.append_to_txn(txn, entries, at_version)
-        self.store.queue_transactions(self._cid(spg), [txn])
-        slog.record(entries, at_version)
-        from .ec_util import refresh_chunk_crcs
-        refresh_chunk_crcs(self.store, self._cid(spg), spg.shard,
-                           entries)
-        if rollforward_to is not None:
-            slog.advance_rollforward(rollforward_to)
+        with self._split_lock:
+            slog = self._shard_log(spg)
+            slog.append_to_txn(txn, entries, at_version)
+            self.store.queue_transactions(self._cid(spg), [txn])
+            slog.record(entries, at_version)
+            from .ec_util import refresh_chunk_crcs
+            refresh_chunk_crcs(self.store, self._cid(spg), spg.shard,
+                               entries)
+            if rollforward_to is not None:
+                slog.advance_rollforward(rollforward_to)
+            self._migrate_misplaced(spg, {e.oid for e in entries})
 
     def _handle_activate(self, msg: M.MPGActivate) -> None:
         from .pg_log import entry_from_wire
@@ -1145,7 +1711,17 @@ class OSDDaemon:
             data = self.store.read(self._cid(spg), goid, off,
                                    None if length < 0 else length)
         except KeyError:
-            return None
+            # split settling: the object may still sit in the parent
+            # collection (local sweep pending, or this OSD's map is
+            # older than the requester's)
+            fb = self._fallback_spg(spg)
+            if fb is None:
+                return None
+            try:
+                data = self.store.read(self._cid(fb), goid, off,
+                                       None if length < 0 else length)
+            except KeyError:
+                return None
         if length > 0 and data.size < length:
             data = np.concatenate(
                 [data, np.zeros(length - data.size, dtype=np.uint8)])
@@ -1157,16 +1733,37 @@ class OSDDaemon:
             return M.MOSDECSubOpReadReply(spg, 0, spg.shard, -errno.ENOENT)
         return M.MOSDECSubOpReadReply(spg, 0, spg.shard, 0, data.tobytes())
 
-    def stat_shard(self, spg, oid, want_attrs) -> M.MOSDECSubOpReadReply:
+    def stat_shard(self, spg, oid, want_attrs,
+                   want_omap: bool = False) -> M.MOSDECSubOpReadReply:
         goid = ghobject_t(oid, shard=spg.shard)
         cid = self._cid(spg)
         try:
             size = self.store.stat(cid, goid)
         except KeyError:
-            return M.MOSDECSubOpReadReply(spg, 0, spg.shard, -errno.ENOENT)
+            fb = self._fallback_spg(spg)      # split settling
+            if fb is not None:
+                fcid = self._cid(fb)
+                try:
+                    size = self.store.stat(fcid, goid)
+                    cid = fcid
+                except KeyError:
+                    return M.MOSDECSubOpReadReply(
+                        spg, 0, spg.shard, -errno.ENOENT)
+            else:
+                return M.MOSDECSubOpReadReply(
+                    spg, 0, spg.shard, -errno.ENOENT)
         attrs = self.store.getattrs(cid, goid) if want_attrs else {}
+        omap: dict = {}
+        omap_hdr = b""
+        if want_omap:
+            try:
+                omap = self.store.omap_get(cid, goid)
+                omap_hdr = self.store.omap_get_header(cid, goid)
+            except KeyError:
+                pass
         return M.MOSDECSubOpReadReply(spg, 0, spg.shard, 0, b"",
-                                      attrs, size)
+                                      attrs, size, omap=omap,
+                                      omap_header=omap_hdr)
 
     def _route_write_reply(self, msg) -> None:
         waiter = self.raw_write_waiters.pop((msg.pgid, msg.tid), None)
@@ -1486,6 +2083,17 @@ class OSDDaemon:
             self._reply_op_error(conn, msg, e)
 
     def _do_client_op(self, conn, msg: M.MOSDOp, _t0: float) -> None:
+        # PG-split retarget (reference OSD::handle_op split requeue):
+        # under THIS osd's map the object may hash into a child of the
+        # PG the client computed.  If we lead the child, the op simply
+        # requeues against it; otherwise _get_pg raises EAGAIN and the
+        # client retargets off its refreshed map.
+        pool = self.osdmap.pools.get(msg.pgid.pgid.pool)
+        if pool is not None and pool.pg_num:
+            actual = self.osdmap.object_to_pg(pool.id, msg.oid.name,
+                                              msg.oid.key)
+            if actual != msg.pgid.pgid:
+                msg.pgid = spg_t(actual, msg.pgid.shard)
         state = self._get_pg(msg.pgid.pgid)
         be = state.backend
         if msg.oid.snap != 0:

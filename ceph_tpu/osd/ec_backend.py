@@ -707,37 +707,45 @@ class ECBackend:
         if chunk_len is None:
             raise ErasureCodeError(5, f"cannot recover {oid}: no survivor")
         got: dict[int, np.ndarray] = {}
+        glock = threading.Lock()
         done = {"n": 0}
         ready = threading.Event()
         targets = [s for s in range(self.n) if s not in missing]
 
         def on_done(sh, d):
-            if d is not None:
-                got[sh] = d
-            done["n"] += 1
-            if len(got) >= self.k or done["n"] >= len(targets):
+            with glock:       # replies race on reader threads
+                if d is not None:
+                    got[sh] = d
+                done["n"] += 1
+                fire = len(got) >= self.k or done["n"] >= len(targets)
+            if fire:
                 ready.set()
         on_done.loop_safe = True      # store + Event.set only
 
         self.shards.sub_read_batch(
             [(s, oid, 0, chunk_len) for s in targets], on_done)
         ready.wait(timeout=30)
-        if len(got) < self.k:
+        with glock:
+            # snapshot under a DIFFERENT name: `got` is the closure
+            # cell late on_done callbacks still write into — rebinding
+            # it would just point them at the copy
+            have = dict(got)
+        if len(have) < self.k:
             raise ErasureCodeError(5, f"cannot recover {oid}: "
-                                   f"{len(got)} < k={self.k}")
+                                   f"{len(have)} < k={self.k}")
         if self.mesh_codec is not None:
             # distributed repair: survivor rows shard over the mesh,
             # the rebuild is the sharded inverted-matrix contraction
-            survivors = tuple(sorted(got))[: self.k]
-            avail = np.stack([got[s] for s in survivors])
+            survivors = tuple(sorted(have))[: self.k]
+            avail = np.stack([have[s] for s in survivors])
             rebuilt_rows = self.mesh_codec.decode_flat(
                 avail, survivors, tuple(missing))
             rebuilt = {s: rebuilt_rows[i] for i, s in enumerate(missing)}
         else:
             dense = np.zeros((self.n, chunk_len), dtype=np.uint8)
-            for s, d in got.items():
+            for s, d in have.items():
                 dense[s] = d
-            erasures = [s for s in range(self.n) if s not in got]
+            erasures = [s for s in range(self.n) if s not in have]
             rebuilt = self.ec_impl.decode_chunks(dense, erasures)
         for s in missing:
             data = rebuilt[s]

@@ -39,6 +39,10 @@ class PGPool:
     # (reference pg_pool_t removed_snaps interval set)
     snap_seq: int = 0
     removed_snaps: list = field(default_factory=list)
+    # pg_autoscaler authority (reference pg_pool_t pg_autoscale_mode):
+    # "warn" = advisory only (health warning), "on" = the mgr module
+    # may issue real pg_num increases through the mon
+    pg_autoscale_mode: str = "warn"
 
     def is_erasure(self) -> bool:
         return self.type == PoolType.ERASURE
@@ -205,6 +209,25 @@ class OSDMap:
         self.pool_ids_by_name[name] = pid
         return pool
 
+    def set_pool_pg_num(self, pool_id: int, new_pg_num: int) -> None:
+        """Grow a pool's pg_num (PG split; reference OSDMonitor
+        prepare_command pg_num increase).  Validation (monotonic,
+        power-of-two) lives in the mon command path; this mutator also
+        keeps the override tables consistent: every pg_temp and
+        pg_upmap_items entry of the pool is pruned — the split is a new
+        interval for every PG of the pool (parents change content,
+        children are born), so acting-set and raw-mapping overrides
+        computed for the old interval no longer describe anything
+        (reference OSDMonitor clean_temps + maybe_remove_pg_upmaps
+        pruning on pg_num change)."""
+        self.pools[pool_id].pg_num = new_pg_num
+        self.pg_temp = {pg: v for pg, v in self.pg_temp.items()
+                        if pg.pool != pool_id}
+        self.pg_upmap_items = {pg: v for pg, v in
+                               self.pg_upmap_items.items()
+                               if pg.pool != pool_id}
+        self._pg_cache.clear()
+
     def bump_epoch(self) -> int:
         self.epoch += 1
         self._pg_cache.clear()
@@ -222,7 +245,7 @@ class OSDMap:
             "pools": [[p.id, p.name, int(p.type), p.size, p.min_size,
                        p.pg_num, p.crush_rule, p.erasure_code_profile,
                        p.stripe_width, p.snap_seq,
-                       list(p.removed_snaps)]
+                       list(p.removed_snaps), p.pg_autoscale_mode]
                       for p in self.pools.values()],
             "pg_temp": [[pg.pool, pg.seed, osds]
                         for pg, osds in self.pg_temp.items()],
@@ -257,10 +280,12 @@ class OSDMap:
             pid, name, t, size, msize, pgn, rule, prof, sw = rec[:9]
             snap_seq = rec[9] if len(rec) > 9 else 0
             removed = list(rec[10]) if len(rec) > 10 else []
+            autoscale = rec[11] if len(rec) > 11 else "warn"
             m.pools[pid] = PGPool(pid, name, PoolType(t), size, msize,
                                   pgn, rule, prof, sw,
                                   snap_seq=snap_seq,
-                                  removed_snaps=removed)
+                                  removed_snaps=removed,
+                                  pg_autoscale_mode=autoscale)
             m.pool_ids_by_name[name] = pid
         for pool, seed, osds in j.get("pg_temp", []):
             m.pg_temp[pg_t(pool, seed)] = osds

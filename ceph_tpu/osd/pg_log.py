@@ -252,6 +252,68 @@ class ShardPGLog:
                     json.dumps(self.info.to_json()).encode())
         self.store.queue_transactions(self.cid, [txn])
 
+    # -- PG split (reference PG::split_into / PGLog::split_out_child:
+    #    the parent's log partitions by which child each entry's object
+    #    rehashes into; the child inherits the parent's info bounds) ----
+
+    def merge_split(self, entries: list[LogEntry], last_update: eversion_t,
+                    les: int) -> None:
+        """Adopt split-inherited entries WITHOUT clobbering anything
+        this shard already logged (a child shard may have received
+        backfill or even new writes before the local parent's split
+        sweep ran — unlike `adopt`, which replaces).  The info bounds
+        only ratchet up: inheriting the parent's last_update /
+        last_epoch_started is what lets child peering fence out shards
+        that never saw the parent's history."""
+        existing = {_omap_key(e) for e in self.log.entries}
+        add = sorted((e for e in entries
+                      if _omap_key(e) not in existing),
+                     key=lambda e: e.version)
+        txn = _txn()
+        txn.touch(self.moid)
+        if add:
+            txn.omap_setkeys(self.moid, {
+                _omap_key(e): json.dumps(entry_to_wire(e)).encode()
+                for e in add})
+            merged = sorted(self.log.entries + add,
+                            key=lambda e: e.version)
+            newlog = PGLog()
+            for e in merged:
+                newlog.add(e)
+            newlog.tail = self.log.tail
+            newlog.can_rollback_to = self.log.can_rollback_to
+            newlog.rollforward_to = self.log.rollforward_to
+            self.log = newlog
+        self.info.last_update = max(self.info.last_update, last_update)
+        self.info.last_epoch_started = max(
+            self.info.last_epoch_started, les)
+        txn.setattr(self.moid, INFO_ATTR,
+                    json.dumps(self.info.to_json()).encode())
+        self.store.queue_transactions(self.cid, [txn])
+
+    def split_out(self, names: set[str]) -> list[LogEntry]:
+        """Drop (and return) the entries whose object moved to a child
+        PG.  The parent's last_update is NOT lowered: it still bounds
+        every entry the parent ever acked, and the peering min-rule
+        needs all parent shards to agree on it."""
+        moved = [e for e in self.log.entries if e.oid.name in names]
+        if not moved:
+            return []
+        kept = [e for e in self.log.entries if e.oid.name not in names]
+        txn = _txn()
+        txn.touch(self.moid)
+        txn.omap_rmkeys(self.moid, [_omap_key(e) for e in moved])
+        newlog = PGLog()
+        for e in kept:
+            newlog.add(e)
+        newlog.head = self.log.head
+        newlog.tail = self.log.tail
+        newlog.can_rollback_to = self.log.can_rollback_to
+        newlog.rollforward_to = self.log.rollforward_to
+        self.log = newlog
+        self.store.queue_transactions(self.cid, [txn])
+        return moved
+
     def rollback_to(self, v: eversion_t) -> list[hobject_t]:
         """Undo local entries newer than v.  Pure appends truncate back
         (and restore the prior hinfo xattr); overwrites/deletes restore

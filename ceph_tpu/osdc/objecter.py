@@ -46,6 +46,7 @@ class Objecter:
         self.mon_conn = self.messenger.connect(self.mon_addrs[0])
         self.osdmap = OSDMap()
         self.map_event = threading.Event()
+        self._map_nudge_pending = False
         self._tid = 0
         self._lock = threading.Lock()
         self._waiters: dict[int, dict] = {}
@@ -125,6 +126,7 @@ class Objecter:
             # mon's older epoch must not regress the map
             if newmap.epoch >= self.osdmap.epoch:
                 self.osdmap = newmap
+            self._map_nudge_pending = False
             self.map_event.set()
         elif isinstance(msg, M.MOSDOpReply):
             with self._lock:
@@ -226,6 +228,19 @@ class Objecter:
                                        self.osdmap.epoch, snapc=snapc))
             if w["event"].wait(timeout):
                 reply = w["reply"]
+                if reply.epoch > self.osdmap.epoch and \
+                        not self._map_nudge_pending:
+                    # the OSD is on a newer map (e.g. a pool's pg_num
+                    # grew and our target PG split): nudge a refresh so
+                    # subsequent ops retarget to the children without
+                    # having to eat an EAGAIN first.  One nudge per
+                    # staleness window — a burst of stale replies must
+                    # not multiply into a burst of mon requests.
+                    self._map_nudge_pending = True
+                    try:
+                        self.mon_conn.send_message(M.MMonGetMap())
+                    except Exception:  # noqa: BLE001 - mon electing
+                        pass
                 if reply.result == -errno.EAGAIN:
                     # primary moved or PG still peering: retarget
                     self.refresh_map()

@@ -29,6 +29,7 @@ from __future__ import annotations
 import errno
 import hashlib
 import json
+import threading
 import time
 
 from ..rados.client import RadosError
@@ -92,6 +93,14 @@ class RGWStore:
         # drops the first's field
         import threading as _threading
         self._bmeta_lock = _threading.Lock()
+        # quota admission is check-then-act over the user header;
+        # concurrent puts by one user must serialize the check AND
+        # count each other's admitted-but-not-yet-accounted growth, or
+        # N racing puts could each pass the gate and overshoot
+        # max_bytes/max_objects N-fold (mirrors _bmeta_lock)
+        self._quota_mu = _threading.Lock()
+        self._quota_locks: dict[str, _threading.Lock] = {}
+        self._quota_pending: dict[str, list[int]] = {}  # [objs, bytes]
 
     def _ensure_pools(self, ec_profile, pg_num) -> None:
         for name, kind in ((META_POOL, "replicated"),
@@ -182,21 +191,64 @@ class RGWStore:
 
     def _quota_gate(self, user: str | None, add_objects: int,
                     add_bytes: int) -> None:
-        """Admit or 403 a write against the owner's quota (reference
-        RGWQuotaHandler::check_quota before every put)."""
+        """Admit-or-403 a write against the owner's quota AND reserve
+        its growth (reference RGWQuotaHandler::check_quota before every
+        put).  The check and the reservation are one atomic step under
+        a per-user lock, and admitted-but-unaccounted growth (the
+        pending pot) counts toward the next admission — so concurrent
+        puts through THIS gateway cannot overshoot max_bytes /
+        max_objects.  Every successful gate must be paired with a
+        `_quota_release` once the op's accounting has landed (or the
+        op failed).
+
+        Residual approximate window, documented deviation: the pending
+        pot is process-local, so concurrent puts through DIFFERENT
+        gateway processes still race the shared totals (the reference
+        has the same eventual-consistency window — rgw quota caches
+        stats per gateway); and between `_user_stats` landing and the
+        release, the growth is briefly counted twice, which can only
+        falsely DENY at the boundary, never falsely admit."""
         if not user:
             return
-        hdr = self.get_user_header(user)
-        q = hdr.get("quota", {})
-        t = hdr.get("totals", {})
-        if q.get("max_objects", -1) >= 0 and \
-                t.get("objects", 0) + add_objects > q["max_objects"]:
-            raise RGWError(403, "QuotaExceeded",
-                           f"user {user} object quota")
-        if q.get("max_bytes", -1) >= 0 and \
-                t.get("bytes", 0) + add_bytes > q["max_bytes"]:
-            raise RGWError(403, "QuotaExceeded",
-                           f"user {user} byte quota")
+        with self._quota_mu:
+            lock = self._quota_locks.setdefault(user, threading.Lock())
+        with lock:
+            hdr = self.get_user_header(user)
+            q = hdr.get("quota", {})
+            t = hdr.get("totals", {})
+            with self._quota_mu:
+                pend = self._quota_pending.setdefault(user, [0, 0])
+                pend_obj, pend_bytes = pend
+            if q.get("max_objects", -1) >= 0 and \
+                    t.get("objects", 0) + pend_obj + add_objects > \
+                    q["max_objects"]:
+                raise RGWError(403, "QuotaExceeded",
+                               f"user {user} object quota")
+            if q.get("max_bytes", -1) >= 0 and \
+                    t.get("bytes", 0) + pend_bytes + add_bytes > \
+                    q["max_bytes"]:
+                raise RGWError(403, "QuotaExceeded",
+                               f"user {user} byte quota")
+            with self._quota_mu:
+                pend = self._quota_pending[user]
+                pend[0] += add_objects
+                pend[1] += add_bytes
+
+    def _quota_release(self, user: str | None, add_objects: int,
+                       add_bytes: int) -> None:
+        """Return a gate's reservation (accounting landed or op died)."""
+        if not user:
+            return
+        with self._quota_mu:
+            pend = self._quota_pending.get(user)
+            if pend is not None:
+                pend[0] -= add_objects
+                pend[1] -= add_bytes
+                if pend == [0, 0]:
+                    # drained: don't retain a pot per user ever seen
+                    # (the per-user Lock stays — pruning it could hand
+                    # two racing reservers different lock objects)
+                    del self._quota_pending[user]
 
     def _usage(self, user: str | None, op: str, bucket: str,
                key: str | None, nbytes: int) -> None:
@@ -583,48 +635,55 @@ class RGWStore:
         same = (cur is None or cur_owner == owner)
         # quota admits the NEW owner's growth; a same-owner overwrite
         # only pays the size delta
-        self._quota_gate(owner,
-                         (0 if cur else 1) if same else 1,
-                         (len(body) - (cur or {}).get("size", 0))
-                         if same else len(body))
-        etag = hashlib.md5(body).hexdigest()
-        self._modlog("sync", bucket, key)
-        if bmeta.get("versioning") == "Enabled":
-            self._archive_null_version(bucket, key)
-            vid = self._new_version_id()
+        q_obj = (0 if cur else 1) if same else 1
+        q_bytes = (len(body) - (cur or {}).get("size", 0)) \
+            if same else len(body)
+        self._quota_gate(owner, q_obj, q_bytes)
+        try:
+            etag = hashlib.md5(body).hexdigest()
+            self._modlog("sync", bucket, key)
+            if bmeta.get("versioning") == "Enabled":
+                self._archive_null_version(bucket, key)
+                vid = self._new_version_id()
+                meta = {"size": len(body), "etag": etag,
+                        "mtime": time.time(), **(extra or {})}
+                self.data.write_full(_version_oid(bucket, vid, key),
+                                     body)
+                self._archive_version(bucket, key, meta, vid)
+                self._cls(self.meta, f"index.{bucket}", "dir_add", {
+                    "key": key, "meta": {**meta, "version_id": vid}})
+                self._account_overwrite(bucket, key, cur, cur_owner,
+                                        owner, len(body))
+                self._publish(bucket, key, "s3:ObjectCreated:Put",
+                              len(body), bmeta=bmeta)
+                self._modlog("sync", bucket, key)   # post-success
+                return etag
+            suspended = bool(bmeta.get("versioning"))  # "" = never
+            reap = self._displaced_manifests(bucket, key, suspended,
+                                             cur=cur)
             meta = {"size": len(body), "etag": etag,
                     "mtime": time.time(), **(extra or {})}
-            self.data.write_full(_version_oid(bucket, vid, key), body)
-            self._archive_version(bucket, key, meta, vid)
+            self.data.write_full(_data_oid(bucket, key), body)
             self._cls(self.meta, f"index.{bucket}", "dir_add", {
-                "key": key, "meta": {**meta, "version_id": vid}})
-            self._account_overwrite(bucket, key, cur, cur_owner,
-                                    owner, len(body))
+                "key": key, "meta": meta})
+            if suspended:
+                # Suspended bucket: S3 says the PUT replaces the null
+                # version — (re)write the null row to match the bytes
+                self._archive_version(bucket, key,
+                                      {**meta, "null_data": True},
+                                      "null")
+            for m in reap:
+                self._reap_manifest(bucket, m)
+            self._account_overwrite(bucket, key, cur, cur_owner, owner,
+                                    len(body))
             self._publish(bucket, key, "s3:ObjectCreated:Put",
                           len(body), bmeta=bmeta)
-            self._modlog("sync", bucket, key)   # post-success
+            self._modlog("sync", bucket, key)       # post-success
             return etag
-        suspended = bool(bmeta.get("versioning"))   # "" = never versioned
-        reap = self._displaced_manifests(bucket, key, suspended,
-                                         cur=cur)
-        meta = {"size": len(body), "etag": etag, "mtime": time.time(),
-                **(extra or {})}
-        self.data.write_full(_data_oid(bucket, key), body)
-        self._cls(self.meta, f"index.{bucket}", "dir_add", {
-            "key": key, "meta": meta})
-        if suspended:
-            # Suspended bucket: S3 says the PUT replaces the null
-            # version — (re)write the null row to match the new bytes
-            self._archive_version(bucket, key,
-                                  {**meta, "null_data": True}, "null")
-        for m in reap:
-            self._reap_manifest(bucket, m)
-        self._account_overwrite(bucket, key, cur, cur_owner, owner,
-                                len(body))
-        self._publish(bucket, key, "s3:ObjectCreated:Put", len(body),
-                      bmeta=bmeta)
-        self._modlog("sync", bucket, key)       # post-success
-        return etag
+        finally:
+            # accounting has landed (or the op died): the reservation
+            # hands back to the shared totals
+            self._quota_release(owner, q_obj, q_bytes)
 
     def get_object_version(self, bucket: str, key: str,
                            version_id: str) -> tuple[bytes, dict]:
@@ -987,54 +1046,61 @@ class RGWStore:
         cur = self._current_meta(bucket, key)
         cur_owner = (cur or {}).get("owner") or bmeta.get("owner")
         same = (cur is None or cur_owner == owner)
-        self._quota_gate(owner,
-                         (0 if cur else 1) if same else 1,
-                         (total - (cur or {}).get("size", 0))
-                         if same else total)
-        self._modlog("sync", bucket, key)   # validated: will mutate
-        etag = f"{hashlib.md5(md5cat).hexdigest()}-{len(parts)}"
-        obj_meta = {"size": total, "etag": etag, "mtime": time.time(),
-                    "multipart": {"upload_id": upload_id,
-                                  "parts": manifest},
-                    **(extra or {})}
-        if bmeta.get("versioning") == "Enabled":
-            # S3: CompleteMultipartUpload on a versioned bucket mints
-            # a new object version like any PUT; the overwritten
-            # current survives as a version row (its manifest stays
-            # referenced by that row — never reaped here)
-            self._archive_null_version(bucket, key)
-            vid = self._new_version_id()
-            self._archive_version(bucket, key, obj_meta, vid)
-            self._cls(self.meta, f"index.{bucket}", "dir_add", {
-                "key": key, "meta": {**obj_meta, "version_id": vid}})
-        else:
-            suspended = bool(bmeta.get("versioning"))
-            reap = self._displaced_manifests(bucket, key, suspended)
-            self._cls(self.meta, f"index.{bucket}", "dir_add", {
-                "key": key, "meta": obj_meta})
-            if suspended:
-                # like put_object: the complete replaces the null
-                # version on a Suspended bucket
-                self._archive_version(
-                    bucket, key, {**obj_meta, "null_data": True}, "null")
-            for m in reap:
-                self._reap_manifest(bucket, m)
-        # unreferenced parts (uploaded but not listed in the complete)
-        listed = {num for num, _ in parts}
-        for num in have:
-            if num not in listed:
-                try:
-                    self.data.remove(_part_oid(bucket, upload_id, num))
-                except RadosError:
-                    pass
-        self._rm_upload_bookkeeping(bucket, key, upload_id)
-        self._account_overwrite(bucket, key, cur, cur_owner, owner,
-                                total)
-        self._publish(bucket, key,
-                      "s3:ObjectCreated:CompleteMultipartUpload",
-                      total, bmeta=bmeta)
-        self._modlog("sync", bucket, key)   # post-success (see _modlog)
-        return etag
+        q_obj = (0 if cur else 1) if same else 1
+        q_bytes = (total - (cur or {}).get("size", 0)) if same else total
+        self._quota_gate(owner, q_obj, q_bytes)
+        try:
+            self._modlog("sync", bucket, key)   # validated: will mutate
+            etag = f"{hashlib.md5(md5cat).hexdigest()}-{len(parts)}"
+            obj_meta = {"size": total, "etag": etag,
+                        "mtime": time.time(),
+                        "multipart": {"upload_id": upload_id,
+                                      "parts": manifest},
+                        **(extra or {})}
+            if bmeta.get("versioning") == "Enabled":
+                # S3: CompleteMultipartUpload on a versioned bucket
+                # mints a new object version like any PUT; the
+                # overwritten current survives as a version row (its
+                # manifest stays referenced by that row — never reaped
+                # here)
+                self._archive_null_version(bucket, key)
+                vid = self._new_version_id()
+                self._archive_version(bucket, key, obj_meta, vid)
+                self._cls(self.meta, f"index.{bucket}", "dir_add", {
+                    "key": key,
+                    "meta": {**obj_meta, "version_id": vid}})
+            else:
+                suspended = bool(bmeta.get("versioning"))
+                reap = self._displaced_manifests(bucket, key, suspended)
+                self._cls(self.meta, f"index.{bucket}", "dir_add", {
+                    "key": key, "meta": obj_meta})
+                if suspended:
+                    # like put_object: the complete replaces the null
+                    # version on a Suspended bucket
+                    self._archive_version(
+                        bucket, key, {**obj_meta, "null_data": True},
+                        "null")
+                for m in reap:
+                    self._reap_manifest(bucket, m)
+            # unreferenced parts (uploaded but not listed)
+            listed = {num for num, _ in parts}
+            for num in have:
+                if num not in listed:
+                    try:
+                        self.data.remove(
+                            _part_oid(bucket, upload_id, num))
+                    except RadosError:
+                        pass
+            self._rm_upload_bookkeeping(bucket, key, upload_id)
+            self._account_overwrite(bucket, key, cur, cur_owner, owner,
+                                    total)
+            self._publish(bucket, key,
+                          "s3:ObjectCreated:CompleteMultipartUpload",
+                          total, bmeta=bmeta)
+            self._modlog("sync", bucket, key)   # post-success
+            return etag
+        finally:
+            self._quota_release(owner, q_obj, q_bytes)
 
     def abort_multipart(self, bucket: str, key: str,
                         upload_id: str) -> None:

@@ -8,6 +8,9 @@ commands this build's mon implements:
   python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd pool ls
   python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd pool create NAME \
       [--type erasure --profile NAME --pg-num N --size N]
+  python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd pool set NAME \
+      {pg_num N | pg_autoscale_mode on|warn}     # pg_num grows = PG split
+  python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd pool get NAME [VAR]
   python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd erasure-code-profile \
       set NAME k=4 m=2 plugin=jax
   python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd erasure-code-profile \
@@ -58,6 +61,13 @@ def main(argv=None) -> int:
                    "type": args.type, "pg_num": args.pg_num,
                    "size": args.size,
                    "erasure_code_profile": args.profile}
+        elif words[:3] == ["osd", "pool", "set"] and len(words) == 6:
+            cmd = {"prefix": "osd pool set", "pool": words[3],
+                   "var": words[4], "val": words[5]}
+        elif words[:3] == ["osd", "pool", "get"] and len(words) in (4, 5):
+            cmd = {"prefix": "osd pool get", "pool": words[3]}
+            if len(words) == 5:
+                cmd["var"] = words[4]
         elif words[:3] == ["osd", "erasure-code-profile", "set"] \
                 and len(words) >= 4:
             name = words[3]

@@ -151,6 +151,82 @@ class Cluster:
             return self.client()
         return self._clients[0]
 
+    # -- quiescence (the "all PGs active+clean" gate; reference
+    #    qa/tasks/ceph_manager.wait_for_clean) -----------------------------
+
+    def _active_clean_once(self) -> tuple[bool, str]:
+        """One clean-state probe: every PG of every pool has a live
+        primary and a full acting set, every up OSD is on the current
+        map with peering settled, no recovery pending or running, and
+        no client ops in flight on any EC pipeline."""
+        from ..crush.map import CRUSH_ITEM_NONE
+        from ..osd.types import pg_t
+        m = self.mon.osdmap
+        epoch = m.epoch
+        live = []
+        for osd in self.osds:
+            if not m.is_up(osd.osd_id):
+                return False, f"osd.{osd.osd_id} down"
+            if osd.osdmap.epoch < epoch:
+                return False, (f"osd.{osd.osd_id} on epoch "
+                               f"{osd.osdmap.epoch} < {epoch}")
+            live.append(osd)
+        for osd in live:
+            if osd._pgs_needing_recovery:
+                return False, (f"osd.{osd.osd_id} recovery pending: "
+                               f"{sorted(map(str, osd._pgs_needing_recovery))[:4]}")
+            if osd._recovery_inflight:
+                return False, f"osd.{osd.osd_id} recovery running"
+            if osd._split_push_pending:
+                return False, (f"osd.{osd.osd_id} split pushes "
+                               f"pending: {len(osd._split_push_pending)}")
+            for pgid, state in list(osd.pgs.items()):
+                if state.kind == "ec":
+                    if state.needs_peer:
+                        return False, f"pg {pgid} unpeered on " \
+                                      f"osd.{osd.osd_id}"
+                    be = state.backend
+                    if be.waiting_state or be.waiting_reads or \
+                            be.waiting_commit:
+                        return False, f"pg {pgid} ops in flight"
+        for pool in m.pools.values():
+            for seed in range(pool.pg_num):
+                pgid = pg_t(pool.id, seed)
+                try:
+                    _, acting, _, primary = m.pg_to_up_acting_osds(pgid)
+                except Exception:  # noqa: BLE001
+                    return False, f"pg {pgid} unmapped"
+                alive = sum(1 for o in acting
+                            if o != CRUSH_ITEM_NONE and m.is_up(o))
+                if primary < 0 or alive < pool.size:
+                    return False, (f"pg {pgid} acting {alive}/"
+                                   f"{pool.size}")
+        return True, "active+clean"
+
+    def wait_active_clean(self, timeout: float = 180.0,
+                          stable_for: float = 1.0) -> None:
+        """Block until the cluster is quiescent — all PGs active+clean
+        with in-flight ops and recovery drained, and STAYS so for
+        `stable_for` seconds — or raise with the blocking condition.
+        Event-driven settling for thrash tests: a liveness regression
+        surfaces as the named stuck condition instead of hiding behind
+        a wall-clock grace."""
+        deadline = time.time() + timeout
+        stable_since = None
+        why = "never probed"
+        while time.time() < deadline:
+            ok, why = self._active_clean_once()
+            if ok:
+                if stable_since is None:
+                    stable_since = time.time()
+                elif time.time() - stable_since >= stable_for:
+                    return
+            else:
+                stable_since = None
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"cluster not active+clean within {timeout}s: {why}")
+
     def stop(self) -> None:
         for c in self._clients:
             c.shutdown()

@@ -11,9 +11,15 @@ import time
 import numpy as np
 import pytest
 
-from ceph_tpu.auth import AuthError, CephxAuth, Keyring
-from ceph_tpu.auth import cephx
-from ceph_tpu.tools.vstart import Cluster
+pytest.importorskip(
+    "cryptography",
+    reason="cephx sealing needs the optional 'cryptography' package; "
+           "auth modules import without it (AESGCM gated) but every "
+           "scenario here seals tickets or secures frames")
+
+from ceph_tpu.auth import AuthError, CephxAuth, Keyring  # noqa: E402
+from ceph_tpu.auth import cephx  # noqa: E402
+from ceph_tpu.tools.vstart import Cluster  # noqa: E402
 
 
 # -- tier 1: protocol units --------------------------------------------------

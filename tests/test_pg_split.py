@@ -1,0 +1,400 @@
+"""PG splitting: growable pg_num on live pools.
+
+The subsystem under test spans three layers: the mon validates and
+commits `osd pool set pg_num` through Paxos (power-of-two stepping,
+monotonic growth, pg_temp/upmap pruned for the pool); every OSD splits
+its local shard collections by the ps-bits rule on map receipt (data +
+xattrs + omap + rollback generations + PG log entries move; children
+inherit the parent's peering bounds); recovery pulls child objects off
+pre-split holders; clients retarget to children.  pg_autoscaler
+graduates from advisory to acting behind the per-pool
+pg_autoscale_mode=on flag.
+
+Reference analogs: src/mon/OSDMonitor.cc pg_num increase,
+src/osd/PG.cc split machinery, pybind/mgr/pg_autoscaler/module.py.
+"""
+
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.osd.types import pg_t
+from ceph_tpu.osdc.objecter import TimedOut
+from ceph_tpu.rados.client import RadosError
+from ceph_tpu.tools.vstart import Cluster
+
+
+def _write_corpus(io, prefix: str, n: int, base: int = 100) -> dict:
+    data = {}
+    for i in range(n):
+        name = f"{prefix}{i}"
+        data[name] = bytes([(i * 13 + 7) % 251]) * (base + i * 17)
+        io.write_full(name, data[name])
+    return data
+
+
+def _assert_corpus(io, data: dict) -> None:
+    for name, want in data.items():
+        got = bytes(io.read(name, len(want)))
+        assert got == want, f"{name}: {len(got)}B vs {len(want)}B"
+
+
+# -- mon-side validation and override consistency ----------------------------
+
+def test_pg_num_validation_and_override_pruning():
+    with Cluster(n_osds=3) as c:
+        client = c.client()
+        client.create_pool("vp", "replicated", pg_num=4, size=2)
+        # seed override tables the split must prune
+        r, _ = client.mon_command({"prefix": "osd pg-temp",
+                                   "pgid": [1, 1], "osds": [0, 1]})
+        assert r == 0
+        r, _ = client.mon_command({"prefix": "osd pg-upmap-items",
+                                   "pgid": [1, 2], "pairs": [[0, 2]]})
+        assert r == 0
+        assert c.mon.osdmap.pg_temp and c.mon.osdmap.pg_upmap_items
+
+        # merge and non-power-of-two stepping are rejected
+        r, _ = client.mon_command({"prefix": "osd pool set", "pool": "vp",
+                                   "var": "pg_num", "val": "2"})
+        assert r != 0
+        r, _ = client.mon_command({"prefix": "osd pool set", "pool": "vp",
+                                   "var": "pg_num", "val": "12"})
+        assert r != 0
+        r, _ = client.mon_command({"prefix": "osd pool set",
+                                   "pool": "nope", "var": "pg_num",
+                                   "val": "8"})
+        assert r != 0
+
+        epoch0 = c.mon.osdmap.epoch
+        r, out = client.mon_command({"prefix": "osd pool set",
+                                     "pool": "vp", "var": "pg_num",
+                                     "val": "8"})
+        assert r == 0 and out["pg_num"] == 8
+        assert c.mon.osdmap.epoch > epoch0
+        # overrides of the resized pool are gone — the split is a new
+        # interval for every PG of the pool, so stale acting-set /
+        # raw-mapping overrides must not leak onto parents or children
+        assert not any(pg.pool == 1 for pg in c.mon.osdmap.pg_temp)
+        assert not any(pg.pool == 1
+                       for pg in c.mon.osdmap.pg_upmap_items)
+        # idempotent set is a no-op success
+        r, _ = client.mon_command({"prefix": "osd pool set", "pool": "vp",
+                                   "var": "pg_num", "val": "8"})
+        assert r == 0
+        r, out = client.mon_command({"prefix": "osd pool get",
+                                     "pool": "vp", "var": "pg_num"})
+        assert r == 0 and out["pg_num"] == 8
+
+
+# -- end-to-end splits --------------------------------------------------------
+
+def test_replicated_split_objects_move_and_read():
+    with Cluster(n_osds=3) as c:
+        client = c.client()
+        client.create_pool("rp", "replicated", pg_num=4, size=2)
+        io = client.open_ioctx("rp")
+        data = _write_corpus(io, "r", 24)
+        r, _ = client.mon_command({"prefix": "osd pool set", "pool": "rp",
+                                   "var": "pg_num", "val": "16"})
+        assert r == 0
+        c.wait_active_clean(timeout=120)
+        _assert_corpus(io, data)
+        # the corpus really scattered into child PGs
+        m = c.mon.osdmap
+        seeds = {m.object_to_pg(io.pool_id, k).seed for k in data}
+        assert any(s >= 4 for s in seeds), sorted(seeds)
+        # children keep working for new writes
+        post = _write_corpus(io, "post", 8)
+        _assert_corpus(io, post)
+
+
+def test_ec_split_objects_read_and_scrub_clean():
+    with Cluster(n_osds=5) as c:
+        client = c.client()
+        client.set_ec_profile("split_p", {
+            "plugin": "jerasure", "k": "2", "m": "2",
+            "stripe_unit": "1024"})
+        client.create_pool("ep", "erasure",
+                           erasure_code_profile="split_p", pg_num=4)
+        io = client.open_ioctx("ep")
+        data = _write_corpus(io, "e", 20, base=700)
+        r, _ = client.mon_command({"prefix": "osd pool set", "pool": "ep",
+                                   "var": "pg_num", "val": "8"})
+        assert r == 0
+        c.wait_active_clean(timeout=120)
+        _assert_corpus(io, data)
+        # per-shard hinfo (EC shard identity) survived the move: a deep
+        # scrub recomputes every shard crc against it
+        errors = []
+        for osd in c.osds:
+            out = osd._asok_scrub({"deep": True, "repair": False})
+            for _pg, res in out.items():
+                errors.extend(res["errors"])
+        assert not errors, errors[:5]
+
+
+@pytest.mark.slow
+def test_split_with_missing_objects_mid_recovery():
+    """Split a PG while objects are in the missing set: one OSD is
+    down, writes land degraded, the pool splits, the OSD revives —
+    recovery must converge every child.  (slow: heartbeat-driven
+    revive + settle keeps it out of the tier-1 time budget.)"""
+    with Cluster(n_osds=5, heartbeat_interval=0.25) as c:
+        client = c.client()
+        client.set_ec_profile("deg_p", {
+            "plugin": "jerasure", "k": "2", "m": "2",
+            "stripe_unit": "1024"})
+        client.create_pool("dp", "erasure",
+                           erasure_code_profile="deg_p", pg_num=4)
+        io = client.open_ioctx("dp")
+        pre = _write_corpus(io, "pre", 8, base=600)
+        c.kill_osd(1)
+        c.mark_osd_down(1)
+        time.sleep(0.3)
+        degraded = _write_corpus(io, "deg", 8, base=900)
+        r, _ = client.mon_command({"prefix": "osd pool set", "pool": "dp",
+                                   "var": "pg_num", "val": "8"})
+        assert r == 0
+        time.sleep(0.5)   # let the split land while osd.1 is dead
+        c.revive_osd(1)
+        c.wait_active_clean(timeout=120)
+        _assert_corpus(io, pre)
+        _assert_corpus(io, degraded)
+
+
+@pytest.mark.slow
+def test_split_while_deep_scrub_running():
+    with Cluster(n_osds=3) as c:
+        client = c.client()
+        client.create_pool("sp", "replicated", pg_num=4, size=2)
+        io = client.open_ioctx("sp")
+        data = _write_corpus(io, "s", 16)
+        stop = threading.Event()
+        scrub_boom = []
+
+        def scrubber():
+            while not stop.is_set():
+                for osd in c.osds:
+                    try:
+                        osd._asok_scrub({"deep": True, "repair": False})
+                    except Exception as e:  # noqa: BLE001
+                        scrub_boom.append(e)
+                        return
+
+        t = threading.Thread(target=scrubber, daemon=True)
+        t.start()
+        time.sleep(0.2)   # scrub in flight when the split lands
+        r, _ = client.mon_command({"prefix": "osd pool set", "pool": "sp",
+                                   "var": "pg_num", "val": "8"})
+        assert r == 0
+        c.wait_active_clean(timeout=120)
+        stop.set()
+        t.join(10)
+        assert not scrub_boom, f"scrub crashed: {scrub_boom[0]!r}"
+        _assert_corpus(io, data)
+        # a clean scrub after settling: no split artifacts linger
+        errors = []
+        for osd in c.osds:
+            out = osd._asok_scrub({"deep": True, "repair": True})
+            for _pg, res in out.items():
+                errors.extend(res["errors"])
+        assert not errors, errors[:5]
+
+
+def test_inflight_client_op_retargets_to_child():
+    """A client still on the pre-split map sends ops for the parent
+    PG; the OSD either requeues against the child it now leads or
+    answers EAGAIN so the refreshed client retargets."""
+    with Cluster(n_osds=3) as c:
+        stale = c.client()
+        admin = c.client()
+        admin.create_pool("cp", "replicated", pg_num=4, size=2)
+        io = stale.open_ioctx("cp")
+        data = _write_corpus(io, "c", 12)
+        old_map = stale.objecter.osdmap
+        r, _ = admin.mon_command({"prefix": "osd pool set", "pool": "cp",
+                                  "var": "pg_num", "val": "16"})
+        assert r == 0
+        c.wait_active_clean(timeout=120)
+        # pin the client back onto the PRE-split map: its next ops
+        # compute parent pgids and land on the old primaries — exactly
+        # an op in flight across the split.  The OSD requeues against
+        # the child it now leads or answers EAGAIN; either way the op
+        # completes and the client ends up retargeted.
+        stale.objecter.osdmap = old_map
+        assert old_map.pools[io.pool_id].pg_num == 4
+        io.write_full("c3", b"retargeted!")
+        data["c3"] = b"retargeted!"
+        _assert_corpus(io, data)
+        # and a fresh client agrees on every object
+        io2 = admin.open_ioctx("cp")
+        _assert_corpus(io2, data)
+
+
+def test_autoscaler_acts_only_with_optin():
+    from ceph_tpu.mgr.daemon import MgrDaemon
+    from ceph_tpu.mgr.modules import PgAutoscalerModule
+    with Cluster(n_osds=4) as c:
+        client = c.client()
+        client.create_pool("auto", "replicated", pg_num=4, size=2)
+        client.create_pool("manual", "replicated", pg_num=4, size=2)
+        io = client.open_ioctx("auto")
+        data = _write_corpus(io, "a", 10)
+        r, _ = client.mon_command({"prefix": "osd pool set",
+                                   "pool": "auto",
+                                   "var": "pg_autoscale_mode",
+                                   "val": "on"})
+        assert r == 0
+        mgr = MgrDaemon(c.mon_addrs, modules=[PgAutoscalerModule]).start()
+        try:
+            # rec = 4 osds * 32 / 2 pools = 64, stepped <=4x per tick
+            deadline = time.time() + 45
+            while time.time() < deadline and \
+                    c.mon.osdmap.lookup_pool("auto").pg_num < 64:
+                time.sleep(0.5)
+            assert c.mon.osdmap.lookup_pool("auto").pg_num == 64
+            # without the flag the module stays advisory
+            assert c.mon.osdmap.lookup_pool("manual").pg_num == 4
+        finally:
+            mgr.shutdown()
+        c.wait_active_clean(timeout=120)
+        _assert_corpus(io, data)
+
+
+# -- the acceptance run: 16 -> 64 under the thrasher -------------------------
+
+@pytest.mark.slow
+def test_split_16_to_64_under_thrash_no_acked_loss():
+    """Grow a loaded replicated pool AND a loaded EC (k=8,m=3) pool
+    16 -> 64 PGs while the kill/revive thrasher runs: zero acked-data
+    loss, every object written before and during the split reads back
+    bit-identical after quiescence."""
+    rng = np.random.default_rng(11)
+    pyrng = random.Random(11)
+    # hb 1.0 (grace 4s): 12 in-process OSDs saturate a small host, and
+    # a 1s grace flap-storms revived daemons into permanent down
+    with Cluster(n_osds=12, heartbeat_interval=1.0) as c:
+        client = c.client()
+        client.create_pool("trp", "replicated", pg_num=16, size=2)
+        client.set_ec_profile("t83", {
+            "plugin": "jerasure", "k": "8", "m": "3",
+            "stripe_unit": "1024"})
+        client.create_pool("tep", "erasure",
+                           erasure_code_profile="t83", pg_num=16)
+        ios = {"trp": client.open_ioctx("trp"),
+               "tep": client.open_ioctx("tep")}
+
+        acked: dict[tuple, bytes] = {}
+        stop = threading.Event()
+        write_errors = []
+
+        def mon_retry(cmd: dict, tries: int = 4) -> None:
+            # the loaded 1-core host can starve a single mon round
+            # trip; the command itself is idempotent
+            for attempt in range(tries):
+                try:
+                    r, _ = client.mon_command(cmd)
+                    if r == 0:
+                        return
+                except (TimedOut, RadosError):
+                    pass
+                time.sleep(1.0)
+            raise AssertionError(f"mon command failed: {cmd}")
+
+        def writer(pool: str):
+            io = ios[pool]
+            i = 0
+            while not stop.is_set():
+                name = f"w{i}"
+                payload = rng.integers(
+                    0, 256, 800 + (i % 7) * 257,
+                    dtype=np.uint8).tobytes()
+                try:
+                    io.write_full(name, payload)
+                    acked[(pool, name)] = payload
+                except (TimedOut, RadosError):
+                    pass               # refused/unacked: no promise
+                except Exception as e:  # noqa: BLE001
+                    write_errors.append(e)
+                    return
+                i += 1
+                time.sleep(0.03)
+
+        threads = [threading.Thread(target=writer, args=(p,),
+                                    daemon=True) for p in ios]
+        for t in threads:
+            t.start()
+        # event-driven baseline: wait for real acked coverage on both
+        # pools before thrashing (first EC writes pay full peering)
+        deadline = time.time() + 120
+        while time.time() < deadline and not all(
+                sum(1 for (p, _n) in acked if p == pool) >= 8
+                for pool in ios):
+            time.sleep(0.5)
+
+        # thrash + grow interleaved: the splits land while OSDs die
+        dead: set[int] = set()
+        for cycle in range(3):
+            victim = pyrng.choice(
+                [o for o in range(12) if o not in dead])
+            c.kill_osd(victim)
+            dead.add(victim)
+            mon_retry({"prefix": "osd down", "id": victim})
+            if cycle == 0:
+                mon_retry({"prefix": "osd pool set", "pool": "trp",
+                           "var": "pg_num", "val": "64"})
+            if cycle == 1:
+                mon_retry({"prefix": "osd pool set", "pool": "tep",
+                           "var": "pg_num", "val": "64"})
+            time.sleep(3.0)
+            c.revive_osd(victim)
+            dead.discard(victim)
+            time.sleep(1.5)
+
+        # keep writing a moment AFTER both splits landed so "during
+        # the split" coverage includes post-split child targets too
+        post_deadline = time.time() + 30
+        post_mark = len(acked)
+        while time.time() < post_deadline and \
+                len(acked) < post_mark + 8:
+            time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join(10)
+        assert not write_errors, f"writer crashed: {write_errors[0]!r}"
+        assert len(acked) >= 30, f"workload too small: {len(acked)}"
+        assert c.mon.osdmap.lookup_pool("trp").pg_num == 64
+        assert c.mon.osdmap.lookup_pool("tep").pg_num == 64
+        # pg_temp/upmap state consistent: nothing refers to the pools'
+        # pre-split interval
+        pool_ids = {ios["trp"].pool_id, ios["tep"].pool_id}
+        assert not any(pg.pool in pool_ids
+                       for pg in c.mon.osdmap.pg_temp)
+        assert not any(pg.pool in pool_ids
+                       for pg in c.mon.osdmap.pg_upmap_items)
+
+        c.wait_active_clean(timeout=300)
+        missing = dict(acked)
+        last_err = None
+        for _ in range(3):
+            for (pool, name) in list(missing):
+                want = missing[(pool, name)]
+                try:
+                    got = ios[pool].read(name, len(want))
+                    assert got == want, \
+                        f"acked {pool}/{name} corrupted"
+                    del missing[(pool, name)]
+                except AssertionError:
+                    raise
+                except Exception as e:  # noqa: BLE001
+                    last_err = e
+            if not missing:
+                break
+            time.sleep(1.0)
+        assert not missing, \
+            f"{len(missing)} acked objects unreadable after split " \
+            f"settle (e.g. {sorted(missing)[:3]}, last {last_err!r})"
