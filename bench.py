@@ -221,6 +221,174 @@ def time_decode_jax(codec, erasures):
                        batch=batch)
 
 
+# -- end-to-end write pipeline + deep scrub (ISSUE 3) ------------------------
+#
+# The kernel slope numbers above measure the codec alone; these two
+# measure the PATH the paper is about: client writes through the
+# ECBackend 3-stage pipeline into a (mem)store, and deep scrub
+# re-verifying every shard.  The pipeline metric is an A/B —
+# dispatch-ahead (depth-2 window, drain N+1 assembles while drain N
+# computes on device, completion in submit order) vs sync (every drain
+# materialized before the next op) — on the same sizes, so the
+# published speedup isolates exactly the host-sync stalls the
+# dispatch-ahead work removes.
+
+PIPE_DEPTH = 2
+
+
+def _pipeline_backend(chunk: int):
+    from ceph_tpu.ec import ErasureCodePluginRegistry
+    from ceph_tpu.osd.ec_backend import ECBackend, LocalShardBackend
+    from ceph_tpu.osd.ec_util import StripeInfo
+    from ceph_tpu.osd.types import pg_t
+    from ceph_tpu.store import MemStore
+    reg = ErasureCodePluginRegistry.instance()
+    codec = reg.factory("jax", {"k": str(K), "m": str(M),
+                                "technique": "cauchy"})
+    sinfo = StripeInfo(stripe_width=K * chunk, chunk_size=chunk)
+    store = MemStore()
+    store.mount()
+    shards = LocalShardBackend(store, pg_t(1, 0), K + M)
+    return ECBackend(codec, sinfo, shards, dispatch_depth=PIPE_DEPTH)
+
+
+def _pipeline_payloads(nobj: int, objsize: int):
+    rng = np.random.default_rng(11)
+    return [rng.integers(0, 256, objsize, dtype=np.uint8)
+            for _ in range(nobj)]
+
+
+def time_write_pipeline(pipelined: bool, nobj: int, objsize: int,
+                        chunk: int, payloads=None) -> float:
+    """Wall-clock input bytes/sec of `nobj` object writes through the
+    full ECBackend path (plan -> assemble -> fused encode+crc launch ->
+    hinfo fold -> per-shard sub-writes on MemStore), every op its own
+    drain.  pipelined=True opens the dispatch-ahead window (flush at
+    exit included in the timing); False materializes each drain before
+    the next submit — the A/B contrast."""
+    import contextlib
+    from ceph_tpu.osd.ec_transaction import PGTransaction
+    from ceph_tpu.osd.types import eversion_t, hobject_t
+    backend = _pipeline_backend(chunk)
+    payloads = payloads or _pipeline_payloads(nobj, objsize)
+    acked = []
+    ctx = backend.pipeline() if pipelined else contextlib.nullcontext()
+    t0 = time.perf_counter()
+    with ctx:
+        for i, payload in enumerate(payloads):
+            txn = PGTransaction()
+            txn.write(hobject_t(pool=1, name=f"pipe{i}"), 0, payload)
+            backend.submit_transaction(txn, eversion_t(1, i + 1),
+                                       lambda: acked.append(1))
+    dt = time.perf_counter() - t0
+    if len(acked) != nobj:
+        raise RuntimeError(f"pipeline bench: {len(acked)}/{nobj} acked")
+    return nobj * objsize / dt
+
+
+def time_deep_scrub(nobj: int, objsize: int, chunk: int,
+                    use_device: bool) -> tuple[float, dict]:
+    """Shard bytes verified per second by a deep scrub of an EC
+    k=8,m=3 PG (all k+m shards of every object read via batched
+    fan-outs and crc32c'd — on device in one launch per chunk, or the
+    host fallback).  Returns (bytes/sec, meta)."""
+    from ceph_tpu.osd import scrub as scrub_mod
+    from ceph_tpu.osd.ec_transaction import PGTransaction
+    from ceph_tpu.osd.types import eversion_t, hobject_t
+    backend = _pipeline_backend(chunk)
+    payloads = _pipeline_payloads(nobj, objsize)
+    oids = []
+    with backend.pipeline():
+        for i, payload in enumerate(payloads):
+            oid = hobject_t(pool=1, name=f"scrub{i}")
+            oids.append(oid)
+            txn = PGTransaction()
+            txn.write(oid, 0, payload)
+            backend.submit_transaction(txn, eversion_t(1, i + 1),
+                                       lambda: None)
+    t0 = time.perf_counter()
+    res = scrub_mod.scrub_pg(backend, oids, deep=True,
+                             use_device=use_device)
+    dt = time.perf_counter() - t0
+    if not res.clean:
+        raise RuntimeError(f"deep scrub found {len(res.errors)} errors "
+                           f"on freshly written objects")
+    shard_bytes = res.device_bytes + res.host_bytes
+    if not shard_bytes:
+        raise RuntimeError("deep scrub verified zero bytes")
+    return shard_bytes / dt, {"device_bytes": res.device_bytes,
+                              "host_bytes": res.host_bytes}
+
+
+def bench_end_to_end(on_tpu: bool, passes: int, spacing: float) -> dict:
+    """The ISSUE-3 metrics: pipelined-vs-sync write A/B + deep scrub."""
+    if on_tpu:
+        nobj, objsize, chunk = 16, 8 << 20, 16384   # 1 MiB shard runs
+    else:
+        nobj, objsize, chunk = 6, 1 << 16, 1024     # CPU smoke sizes
+    payloads = _pipeline_payloads(nobj, objsize)
+    # warm the jit caches (kernel + combine shapes) outside timing
+    time_write_pipeline(True, 2, objsize, chunk, payloads[:2])
+    out = {}
+    pipe, sync = [], []
+    reps = min(passes, 3) if on_tpu else 1
+    for i in range(reps):
+        if i and spacing:
+            time.sleep(spacing)
+        pipe.append(time_write_pipeline(True, nobj, objsize, chunk,
+                                        payloads))
+        sync.append(time_write_pipeline(False, nobj, objsize, chunk,
+                                        payloads))
+        print(f"# write pipeline pass {i + 1}/{reps}: "
+              f"pipelined {pipe[-1] / 1e9:.2f} GB/s, "
+              f"sync {sync[-1] / 1e9:.2f} GB/s", file=sys.stderr)
+    pipe.sort()
+    sync.sort()
+    pipe_med = pipe[len(pipe) // 2]
+    sync_med = sync[len(sync) // 2]
+    out["ec_write_pipeline_k8_m3_GBps"] = round(pipe_med / 1e9, 3)
+    out["ec_write_pipeline_sync_GBps"] = round(sync_med / 1e9, 3)
+    out["ec_write_pipeline_speedup"] = round(pipe_med / sync_med, 3)
+    rate, meta = time_deep_scrub(nobj, objsize, chunk,
+                                 use_device=on_tpu)
+    out["ec_deep_scrub_GBps"] = round(rate / 1e9, 3)
+    out["ec_deep_scrub_device_bytes"] = meta["device_bytes"]
+    out["ec_deep_scrub_host_bytes"] = meta["host_bytes"]
+    return out
+
+
+SMOKE_KEYS = ("ec_write_pipeline_k8_m3_GBps",
+              "ec_write_pipeline_sync_GBps",
+              "ec_write_pipeline_speedup",
+              "ec_deep_scrub_GBps")
+
+
+def run_smoke() -> int:
+    """CPU-mode smoke for tier-1 (scripts/tier1.sh): tiny sizes, runs
+    the full end-to-end benches, and asserts the published JSON keys
+    exist with positive values — perf plumbing regressions fail here
+    before a TPU round ever sees them."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from ceph_tpu.utils.platform import ensure_usable_backend
+    ensure_usable_backend(prefer_cpu=True)
+    out = bench_end_to_end(on_tpu=False, passes=1, spacing=0.0)
+    out["metric"] = "ec_write_pipeline_smoke"
+    print(json.dumps(out))
+    missing = [k for k in SMOKE_KEYS
+               if not isinstance(out.get(k), (int, float))
+               or out[k] <= 0]
+    if missing:
+        print(f"# smoke FAILED: missing/invalid keys {missing}",
+              file=sys.stderr)
+        return 1
+    # the CPU smoke must exercise the HOST hash fallback of deep scrub
+    if out.get("ec_deep_scrub_host_bytes", 0) <= 0:
+        print("# smoke FAILED: host crc fallback not exercised",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def main():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from ceph_tpu.ec import ErasureCodePluginRegistry
@@ -326,6 +494,18 @@ def main():
             if error is None:
                 error = f"decode-{e_count}: {e}"
 
+    # end-to-end: client->ECBackend->memstore write pipeline (dispatch-
+    # ahead vs sync A/B) + deep scrub — the full path, not just the
+    # kernel (ISSUE 3; BENCH_r06+ tracks these alongside the headline)
+    try:
+        extras.update(bench_end_to_end(on_tpu, passes, spacing))
+    except Exception as e:  # noqa: BLE001
+        print(f"# end-to-end bench failed: {e}", file=sys.stderr)
+        for key in SMOKE_KEYS:
+            extras.setdefault(key, None)
+        if error is None:
+            error = f"end_to_end: {e}"
+
     out = {
         "metric": "ec_encode_k8_m3_1MiB",
         "value": round(value / 1e9, 3),
@@ -353,4 +533,6 @@ def main():
 
 
 if __name__ == "__main__":
+    if "--smoke" in sys.argv[1:]:
+        sys.exit(run_smoke())
     main()

@@ -47,6 +47,8 @@ class CephContext:
         self.asok.register_command(
             "perf dump", lambda cmd: self.perf.dump())
         self.asok.register_command(
+            "perf schema", lambda cmd: self.perf.schema())
+        self.asok.register_command(
             "config show", lambda cmd: self.conf.show())
 
         def config_set(cmd):

@@ -53,6 +53,26 @@ def crc32c(data, crc: int = 0xFFFFFFFF) -> int:
     return _crc32c_sw(crc & 0xFFFFFFFF, bytes(buf))
 
 
+def crc32c_rows(rows: np.ndarray, seeds) -> list[int]:
+    """Per-row crc32c of a (R, L) byte matrix, row r seeded seeds[r] —
+    the host fold of one encoded run's k+m shard rows in a single pass
+    (HashInfo.append and the ECBackend plain-path drain fold).  Native
+    path: one C call per row, no intermediate Python structures; table
+    fallback: ONE walk over the byte axis updating all R states per
+    column (R-wide vectorized, vs R separate byte loops)."""
+    rows = np.ascontiguousarray(rows, dtype=np.uint8)
+    lib = native.load()
+    if lib is not None:
+        return [lib.ceph_tpu_crc32c(int(s) & 0xFFFFFFFF,
+                                    rows[r].tobytes(), rows.shape[1])
+                for r, s in enumerate(seeds)]
+    t = _sw_table()
+    c = np.array([int(s) & 0xFFFFFFFF for s in seeds], dtype=np.uint32)
+    for col in rows.T:
+        c = t[(c ^ col) & np.uint32(0xFF)] ^ (c >> np.uint32(8))
+    return [int(v) for v in c]
+
+
 def crc32c_zeros(crc: int, length: int) -> int:
     """Advance `crc` over `length` zero bytes in O(log length)."""
     if length == 0:

@@ -121,6 +121,21 @@ register_options([
     Option("tpu_batch_window_ms", float, 0.0,
            "max time to hold EC ops for cross-transaction batching",
            Level.DEV, min=0.0),
+    Option("ec_dispatch_ahead_depth", int, 2,
+           "max encode drains kept in flight on the device before the "
+           "completion stage materializes the oldest (dispatch-ahead "
+           "pipeline, docs/PIPELINE.md)", Level.DEV, min=1),
+    Option("ec_dispatch_ahead", bool, False,
+           "hold an always-open dispatch-ahead window on EC backends "
+           "(drains materialize when pushed out by depth or by the "
+           "flush timer instead of synchronously)", Level.DEV),
+    Option("ec_dispatch_flush_ms", float, 2.0,
+           "idle flush timer for the always-open dispatch-ahead window",
+           Level.DEV, min=0.1),
+    Option("osd_deep_scrub_device", bool, True,
+           "verify deep-scrub crc32c with the device kernel when an "
+           "accelerator backend is active (host crc fallback otherwise)",
+           Level.DEV),
 ])
 
 

@@ -94,6 +94,12 @@ class PerfCounters:
                     out[key] = c.value
             return out
 
+    def schema(self) -> dict:
+        """key -> counter type name (reference `perf schema`): lets the
+        prometheus exporter emit correct # TYPE lines instead of
+        untyped."""
+        return {key: c.type.value for key, c in self._c.items()}
+
 
 class PerfCountersCollection:
     """All counter sets of one daemon (reference PerfCountersCollection),
@@ -111,3 +117,7 @@ class PerfCountersCollection:
     def dump(self) -> dict:
         with self._lock:
             return {name: pc.dump() for name, pc in self._sets.items()}
+
+    def schema(self) -> dict:
+        with self._lock:
+            return {name: pc.schema() for name, pc in self._sets.items()}

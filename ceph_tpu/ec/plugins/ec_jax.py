@@ -212,6 +212,55 @@ class ErasureCodeJax(ErasureCode):
             wb=point["wb"] if point else None,
             packed=point["packed"] if point else False)
 
+    def encode_extents_with_crc_submit(self, runs: list[np.ndarray]):
+        """Dispatch half of encode_extents_with_crc for the OSD's
+        dispatch-ahead pipeline: launches the drain's fused parity+crc
+        work and returns an opaque handle of device futures — the
+        caller does NOT block on the device.  Materialize with
+        encode_extents_with_crc_finalize (the pipeline's completion
+        stage), in submit order."""
+        from ...ops import bitsliced as bs
+        point = self.fused_point() if self._use_w32 else None
+        return bs.gf_encode_extents_with_crc_submit(
+            self._enc_bitmat, self._enc_bitmat32, runs, self.m,
+            use_w32=self._use_w32,
+            tile=point["tile"] if point else None,
+            wb=point["wb"] if point else None,
+            packed=point["packed"] if point else False)
+
+    def encode_extents_with_crc_finalize(self, handle):
+        """Completion half: blocks on one submit handle's device work
+        and returns the per-run (parity, l, tail, body_bytes) tuples."""
+        from ...ops import bitsliced as bs
+        return bs.gf_encode_extents_with_crc_finalize(handle)
+
+    def encode_chunks_submit(self, chunks: np.ndarray):
+        """Plain-parity dispatch half (no crc): launch the encode of
+        (k, N) uint8 chunks and return a handle without syncing — the
+        pipeline's path for non-append (overwrite) extents whose
+        incremental crc is dead anyway."""
+        import jax.numpy as jnp
+        bs = _ops()
+        chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
+        k, n = chunks.shape
+        if not self._use_w32:
+            return ("bytes", n,
+                    bs.gf_bitmatmul(self._enc_bitmat,
+                                    jnp.asarray(chunks), self.m))
+        pad = -n % 4
+        if pad:
+            chunks = np.pad(chunks, ((0, 0), (0, pad)))
+        words = jnp.asarray(chunks.view("<u4").view(np.int32))
+        return ("w32", n,
+                bs.gf_bitmatmul_w32(self._enc_bitmat32, words, self.m))
+
+    def encode_chunks_finalize(self, handle) -> np.ndarray:
+        kind, n, dev = handle
+        out = np.asarray(dev)
+        if kind == "w32":
+            out = out.view("<u4").view(np.uint8).reshape(self.m, -1)
+        return out[:, :n] if out.shape[1] != n else out
+
     def fold_extent_crcs(self, l, tail_bytes, seeds: list[int],
                          body_bytes: int) -> list[int]:
         """Host fold of one run's device-combined L-vectors into

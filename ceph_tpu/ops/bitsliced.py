@@ -664,6 +664,14 @@ def _hier_lsub_core(bitmat32, cmat_sub, words, m: int, tile: int,
 _fused_hier_lsub = functools.partial(jax.jit, static_argnames=(
     "m", "tile", "wb", "interpret", "packed"))(_hier_lsub_core)
 
+# donated twin for the dispatch-ahead pipeline: the staged device input
+# words are single-use (one drain's concatenated runs), so XLA may
+# reuse their HBM for the parity output instead of allocating fresh —
+# only selected on real accelerators (CPU ignores donation and warns)
+_fused_hier_lsub_donate = functools.partial(jax.jit, static_argnames=(
+    "m", "tile", "wb", "interpret", "packed"),
+    donate_argnums=(2,))(_hier_lsub_core)
+
 
 def gf_encode_extents_with_crc(bitmat, bitmat32, runs, m: int,
                                use_w32: bool | None = None,
@@ -696,11 +704,35 @@ def gf_encode_extents_with_crc(bitmat, bitmat32, runs, m: int,
     fold with crc32c_linear.fold_run_crc seeded per shard: O(1) host
     combines per extent, no per-tile Python loop.
     """
+    return gf_encode_extents_with_crc_finalize(
+        gf_encode_extents_with_crc_submit(
+            bitmat, bitmat32, runs, m, use_w32=use_w32,
+            force_xla=force_xla, interpret=interpret, tile=tile,
+            wb=wb, packed=packed))
+
+
+def gf_encode_extents_with_crc_submit(bitmat, bitmat32, runs, m: int,
+                                      use_w32: bool | None = None,
+                                      force_xla: bool | None = None,
+                                      interpret: bool = False,
+                                      tile: int | None = None,
+                                      wb: int | None = None,
+                                      packed: bool = False,
+                                      donate: bool | None = None):
+    """Dispatch half of gf_encode_extents_with_crc: stages the drain's
+    runs, launches parity + per-block L + the per-run device combines,
+    and returns an opaque handle holding ONLY device arrays (futures)
+    plus host metadata — no np.asarray anywhere, so the caller never
+    blocks on the device.  `donate=True` (resolved to the backend: real
+    accelerators only) hands the staged input words' HBM to XLA for
+    reuse.  Pair with gf_encode_extents_with_crc_finalize."""
     from . import crc32c_linear as cl
     if force_xla is None:
         force_xla = jax.default_backend() == "cpu"
     if use_w32 is None:
         use_w32 = not force_xla
+    if donate is None:
+        donate = jax.default_backend() != "cpu"
     runs = [np.ascontiguousarray(r, dtype=np.uint8) for r in runs]
     k = runs[0].shape[0]
     r_tot = k + m
@@ -725,9 +757,10 @@ def gf_encode_extents_with_crc(bitmat, bitmat32, runs, m: int,
     big = np.concatenate(padded, axis=1)               # (k, ntiles*tile)
     ntiles_total = big.shape[1] // tile
     rows = _crc_rows(r_tot)
+    w32_out = False
     if force_xla:
         cmat = jnp.asarray(cl.crc_tile_matrix(tile))
-        parity_big, crc_bits = gf_encode_with_crc_xla(
+        parity_dev, crc_bits = gf_encode_with_crc_xla(
             bitmat, cmat, jnp.asarray(big), m)
         lb_all = jnp.transpose(crc_bits, (1, 0, 2))    # (r, ntiles, 32)
         block_bytes = tile
@@ -735,9 +768,8 @@ def gf_encode_extents_with_crc(bitmat, bitmat32, runs, m: int,
         # byte-path Pallas kernel (TPU without the w32 layout): per-tile
         # L rows, device-combined per run below like the flat w32 path
         cmat = jnp.asarray(cl.crc_tile_matrix(tile))
-        parity_big, crc_flat = gf_encode_with_crc_pallas(
+        parity_dev, crc_flat = gf_encode_with_crc_pallas(
             bitmat, cmat, jnp.asarray(big), m)
-        parity_big = np.asarray(parity_big)
         lb_all = jnp.transpose(
             crc_flat.reshape(ntiles_total, rows, 32)[:, :r_tot],
             (1, 0, 2))                                 # (r, ntiles, 32)
@@ -745,32 +777,29 @@ def gf_encode_extents_with_crc(bitmat, bitmat32, runs, m: int,
     elif hier:
         cmat_sub = jnp.asarray(cl.crc_tile_matrix_w32(wb))
         words = big.view("<u4").view(np.int32)
-        par_words, lb_all = _fused_hier_lsub(
+        hier_fn = _fused_hier_lsub_donate if donate else _fused_hier_lsub
+        parity_dev, lb_all = hier_fn(
             bitmat32, cmat_sub, jnp.asarray(words), m, tile, wb,
             interpret, packed)                         # (r, nsub, 32)
-        parity_big = np.asarray(par_words).view("<u4").view(np.uint8) \
-            .reshape(m, big.shape[1])
         block_bytes = 4 * wb
+        w32_out = True
     else:
         wt = tile // 4
         cmat32 = jnp.asarray(cl.crc_tile_matrix_w32(wt))
         words = big.view("<u4").view(np.int32)
-        par_words, crc_flat = gf_encode_with_crc_pallas_w32(
+        parity_dev, crc_flat = gf_encode_with_crc_pallas_w32(
             bitmat32, cmat32, jnp.asarray(words), m, interpret=interpret)
-        parity_big = np.asarray(par_words).view("<u4").view(np.uint8) \
-            .reshape(m, big.shape[1])
         lb_all = jnp.transpose(
             crc_flat.reshape(ntiles_total, rows, 32)[:, :r_tot],
             (1, 0, 2))                                 # (r, ntiles, 32)
         block_bytes = tile
-    if force_xla:
-        parity_big = np.asarray(parity_big)
-    out = []
+        w32_out = True
+    # per-run device combines dispatched NOW (still no host sync): each
+    # run's full blocks fold to one L per shard on device
+    lbits_devs = []
     coff = 0
     for w, pr in zip(meta, padded):
-        par = parity_big[:, coff:coff + w]
-        nb = w // block_bytes                 # full blocks = run body
-        body = nb * block_bytes
+        nb = w // block_bytes
         if nb:
             boff = coff // block_bytes
             lb_run = lb_all[:, boff:boff + nb]
@@ -783,7 +812,36 @@ def gf_encode_extents_with_crc(bitmat, bitmat32, runs, m: int,
             if nb2 != nb:
                 lb_run = jnp.pad(lb_run, ((0, 0), (nb2 - nb, 0),
                                           (0, 0)))
-            lbits = _combine_run(lb_run, block_bytes)
+            lbits_devs.append(_combine_run(lb_run, block_bytes))
+        else:
+            lbits_devs.append(None)
+        coff += pr.shape[1]
+    return {"meta": meta, "padded": padded, "parity_dev": parity_dev,
+            "lbits_devs": lbits_devs, "block_bytes": block_bytes,
+            "r_tot": r_tot, "m": m, "w32_out": w32_out,
+            "big_width": big.shape[1]}
+
+
+def gf_encode_extents_with_crc_finalize(handle):
+    """Completion half: blocks on the device results of one submit
+    handle and materializes the per-run
+    (parity, l, tail_bytes, body_bytes) tuples (the contract of
+    gf_encode_extents_with_crc)."""
+    from . import crc32c_linear as cl
+    meta, padded = handle["meta"], handle["padded"]
+    r_tot = handle["r_tot"]
+    block_bytes = handle["block_bytes"]
+    parity_big = np.asarray(handle["parity_dev"])
+    if handle["w32_out"]:
+        parity_big = parity_big.view("<u4").view(np.uint8) \
+            .reshape(handle["m"], handle["big_width"])
+    out = []
+    coff = 0
+    for w, pr, lbits in zip(meta, padded, handle["lbits_devs"]):
+        par = parity_big[:, coff:coff + w]
+        nb = w // block_bytes                 # full blocks = run body
+        body = nb * block_bytes
+        if lbits is not None:
             l = cl.bits_to_u32(np.asarray(lbits))      # (k+m,) u32
         else:
             l = np.zeros(r_tot, dtype=np.uint32)

@@ -165,6 +165,71 @@ def fold_run_crc(lbody: int, body_bytes: int, seed: int,
     return _crc.crc32c_zeros(seed & 0xFFFFFFFF, n) ^ acc
 
 
+SCRUB_BLOCK = 2048       # bytes per L-block of the scrub rows path
+SCRUB_WB = SCRUB_BLOCK // 4
+
+
+def _rows_l(words, cmat_sub, wb: int):
+    """(R, Wt) i32 word rows -> (R, 32) 0/1 L-bits per row: per-sub-
+    block L matmuls + the log-depth device combine.  Pure jnp (no
+    Pallas), so it runs on CPU XLA too — the deep-scrub verify core."""
+    r, wt = words.shape
+    s = wt // wb
+    lsub = subblock_crc_bits_w32(words, cmat_sub, wb)     # (R*S, 32)
+    return combine_crcs_pow2(lsub.reshape(r, s, 32), 4 * wb)
+
+
+_rows_l_jit = None          # lazily-built jit (jax imported on demand)
+
+
+def crc32c_rows_device(row_list, seeds,
+                       block_bytes: int = SCRUB_BLOCK) -> list[int]:
+    """crc32c of many independent byte rows in ONE device launch — the
+    deep-scrub verify path (every shard of a scrub chunk hashed by one
+    kernel dispatch instead of per-object host crc32c).
+
+    Rows may have different lengths.  Each row splits into body (full
+    `block_bytes` blocks) + tail; bodies are FRONT-padded with zeros to
+    their power-of-two size bucket (L(0^n || B) = L(B), so prefix
+    zeros are free AND the pow2 rounding bounds the jit-cache key
+    space), one launch per bucket emits one L per row, and the host
+    pays one seed-advance + tail fold per row (fold_run_crc)."""
+    import jax
+    import jax.numpy as jnp
+    global _rows_l_jit
+    import functools as _ft
+    if _rows_l_jit is None:
+        _rows_l_jit = _ft.partial(jax.jit,
+                                  static_argnames=("wb",))(_rows_l)
+    wb = block_bytes // 4
+    rows = [np.ascontiguousarray(r, dtype=np.uint8).ravel()
+            for r in row_list]
+    bodies = [r.size - r.size % block_bytes for r in rows]
+    ls = np.zeros(len(rows), dtype=np.uint64)
+    # bucket rows by their pow2-padded width: padding every row to the
+    # GLOBAL max would cost rows x max_width memory (one large object
+    # in a chunk of small ones multiplies the footprint thousands of
+    # times); per-bucket matrices keep the pad overhead < 2x per row
+    # while still batching each size class into one launch
+    buckets: dict[int, list[int]] = {}
+    for i, b in enumerate(bodies):
+        if b:
+            nb = b // block_bytes
+            buckets.setdefault(1 << (nb - 1).bit_length(), []).append(i)
+    for nb2, idxs in sorted(buckets.items()):
+        w = block_bytes * nb2
+        mat = np.zeros((len(idxs), w), dtype=np.uint8)
+        for j, i in enumerate(idxs):
+            mat[j, w - bodies[i]:] = rows[i][:bodies[i]]
+        words = mat.view("<u4").view(np.int32)
+        cmat_sub = jnp.asarray(crc_tile_matrix_w32(wb))
+        lbits = _rows_l_jit(jnp.asarray(words), cmat_sub, wb)
+        ls[idxs] = bits_to_u32(np.asarray(lbits))
+    return [fold_run_crc(int(ls[i]), bodies[i], int(seeds[i]),
+                         rows[i][bodies[i]:].tobytes())
+            for i in range(len(rows))]
+
+
 def subblock_crc_bits_w32(words, cmat_sub, wb: int):
     """Level 1 of the hierarchical tile crc, MXU-friendly.
 

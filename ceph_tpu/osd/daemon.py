@@ -412,6 +412,7 @@ class OSDDaemon:
                         if st.kind == "ec"
                         for o in (st.backend.waiting_state +
                                   st.backend.waiting_reads +
+                                  st.backend.inflight_ops() +
                                   st.backend.waiting_commit)]})
         self.store = store or MemStore()
         self.store.mount()
@@ -1810,7 +1811,17 @@ class OSDDaemon:
                     sinfo = StripeInfo(pool.stripe_width,
                                        pool.stripe_width // k)
                     shards = MessengerShardBackend(self, pgid, acting)
-                    backend = ECBackend(codec, sinfo, shards)
+                    backend = ECBackend(
+                        codec, sinfo, shards,
+                        dispatch_depth=int(self.cct.conf.get(
+                            "ec_dispatch_ahead_depth") or 2),
+                        perf_name=f"ec.{pgid}")
+                    # surface the backend's pipeline counters in this
+                    # daemon's `perf dump` / prometheus scrape
+                    self.cct.perf.add(backend.perf)
+                    if bool(self.cct.conf.get("ec_dispatch_ahead")):
+                        backend.set_pipelined(float(self.cct.conf.get(
+                            "ec_dispatch_flush_ms") or 2.0))
                     state = PGState(backend, "ec")
                 else:
                     replicas = MessengerReplicaBackend(self, pgid, acting)
@@ -2445,6 +2456,11 @@ class OSDDaemon:
                     be.submit_transaction(txn, version, done.set)
             if not done.wait(30):
                 result = -errno.ETIMEDOUT
+            elif staged is not None and staged.error is not None:
+                # pipeline failure containment acks with the error
+                # attached instead of raising (docs/PIPELINE.md) — the
+                # client must NOT see a failed write as durable
+                result = -errno.EIO
         elif result == 0:
             self.perf.inc("op_r")
         self.perf.tinc("op_latency", time.perf_counter() - _t0)
@@ -2714,8 +2730,12 @@ class OSDDaemon:
                 names = sorted(self._pg_object_names(
                     pgid, acting, range(state.backend.n)),
                     key=lambda o: o.name)
+                use_device = None  # platform default
+                if not bool(self.cct.conf.get("osd_deep_scrub_device")):
+                    use_device = False
                 res = scrub_mod.scrub_pg(state.backend, names, deep=deep,
-                                         repair=repair)
+                                         repair=repair,
+                                         use_device=use_device)
                 trimmed = self._trim_snaps(state, pgid, names)
                 out[str(pgid)] = {
                     "objects": res.objects,
@@ -2723,6 +2743,8 @@ class OSDDaemon:
                                for e in res.errors],
                     "repaired": len(res.repaired),
                     "snaps_trimmed": trimmed,
+                    "device_bytes": res.device_bytes,
+                    "host_bytes": res.host_bytes,
                 }
         return out
 

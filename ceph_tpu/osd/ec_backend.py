@@ -19,6 +19,17 @@ transactions (launch-latency amortization; reference analog is the
 waiting_reads->waiting_commit queue, which only pipelines, never
 batches).
 
+Dispatch-ahead (docs/PIPELINE.md): the drain itself is split into a
+submit half (assemble extents, LAUNCH parity+crc, no host sync) and a
+completion half (materialize device results, fold crc seeds, issue
+sub-writes).  Up to `dispatch_depth` drains stay in flight while more
+work is queued or a `pipeline()` window is open, so assembly of drain
+N+1 overlaps device compute of drain N; completion always runs in
+submit order, and a lone op with nothing behind it still completes
+synchronously (the flush-on-idle rule — existing callers see no
+change).  The staged device inputs are donated to XLA on real
+accelerators (ops/bitsliced submit path).
+
 Shard I/O goes through the ShardBackend seam: LocalShardBackend applies
 to a local ObjectStore (the single-process / test topology, like
 standalone clusters on MemStore); the messenger-backed implementation
@@ -181,12 +192,59 @@ class ECOp:
         default_factory=dict)
     pending_commits: int = 0
     state: str = "queued"
+    error: Exception | None = None
+    # extents this op actually pinned in the ExtentCache (populated
+    # incrementally during assembly): release must mirror EXACTLY the
+    # present() calls — releasing the full plan after a mid-assembly
+    # failure would decrement another in-flight op's pin on the same
+    # range and let stale store bytes satisfy a later overlay
+    pinned: list[tuple[hobject_t, int, int]] = field(default_factory=list)
+
+
+@dataclass
+class _Drain:
+    """One submitted (launched, not yet materialized) pipeline drain."""
+    ops: list[ECOp]
+    # (op, oid, extent, run (k, W)) per stripe-aligned extent, op order
+    work: list[tuple]
+    kinds: list[str]                  # per work item: "fused" | "plain"
+    fused_handle: object | None       # plugin submit handle
+    fused_pos: dict[int, int]         # work index -> position in handle
+    plain_handle: tuple | None        # ("mesh"|"plugin"|"np", handle)
+    plain_cols: dict[int, int]        # work index -> column offset
+    t_assemble: float = 0.0
+
+
+def _build_ec_perf(name: str):
+    """The backend's own counter set (registered into the daemon's
+    PerfCountersCollection so `perf dump` and the prometheus exporter
+    surface it)."""
+    from ..common.perf_counters import PerfCountersBuilder
+    return (PerfCountersBuilder(name)
+            .add_u64_counter("ec_drain_submits", "pipeline drains launched")
+            .add_u64_counter("ec_drain_extents", "extents encoded")
+            .add_u64_counter("ec_drain_errors",
+                             "sub-write/encode failures absorbed")
+            .add_gauge("ec_inflight_depth",
+                       "drains in flight after last submit")
+            .add_time_avg("ec_drain_assemble",
+                          "host assemble+launch time per drain")
+            .add_time_avg("ec_drain_device",
+                          "device materialize (block) time per drain")
+            .add_time_avg("ec_drain_commit",
+                          "sub-write issue time per drain")
+            .add_u64_counter("ec_scrub_device_bytes",
+                             "deep-scrub bytes crc'd on device")
+            .add_u64_counter("ec_scrub_host_bytes",
+                             "deep-scrub bytes crc'd on host")
+            .create_perf_counters())
 
 
 class ECBackend:
     def __init__(self, ec_impl: ErasureCodeInterface, sinfo: StripeInfo,
                  shards: ShardBackend, log: PGLog | None = None,
-                 mesh_codec=None):
+                 mesh_codec=None, dispatch_depth: int = 2,
+                 perf=None, perf_name: str = "ec"):
         self.ec_impl = ec_impl
         self.sinfo = sinfo
         self.shards = shards
@@ -216,6 +274,22 @@ class ECBackend:
         self.batched_launches: int = 0
         self.batched_extents: int = 0
         self._hold = 0
+        # dispatch-ahead pipeline (docs/PIPELINE.md): submitted drains
+        # whose device work is in flight, completion in submit order
+        self.dispatch_depth = max(1, int(dispatch_depth))
+        self.perf = perf if perf is not None else _build_ec_perf(perf_name)
+        from collections import deque
+        self._inflight: "deque[_Drain]" = deque()
+        self._pipeline_win = 0        # pipeline() windows currently open
+        self._completing = False      # re-entrancy guard for completion
+        self._auto_flush_ms: float | None = None
+        self._flush_timer = None
+        # projected end-of-chunk per object across IN-FLIGHT drains:
+        # the submit-time append/fused decision for drain N+1 must see
+        # the sizes drain N will produce, which the (shared) projected
+        # hinfo only reflects after N's completion stage runs
+        self._sim_chunk: dict[hobject_t, int] = {}
+        self._sim_refs: dict[hobject_t, int] = {}
         from .extent_cache import ExtentCache
         self.extent_cache = ExtentCache()
         # projected per-object state for queued-but-uncommitted ops
@@ -248,6 +322,70 @@ class ECBackend:
                     if self._hold == 0:
                         self.check_ops()
         return _win()
+
+    def pipeline(self):
+        """Dispatch-ahead window: while open, up to `dispatch_depth`
+        drains stay in flight on the device (submit of drain N+1
+        overlaps compute of drain N); everything flushes — completing
+        in submit order — when the window closes.  Unlike batch()
+        (which HOLDS ops to coalesce them into one launch), ops drain
+        immediately here; only materialization is deferred."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _win():
+            with self.lock:
+                self._pipeline_win += 1
+            try:
+                yield
+            finally:
+                with self.lock:
+                    self._pipeline_win -= 1
+                    if self._pipeline_win == 0:
+                        self.flush_pipeline()
+        return _win()
+
+    def set_pipelined(self, flush_ms: float = 2.0) -> None:
+        """Persistent dispatch-ahead (daemon mode): the window never
+        closes, so a flush timer bounds the commit latency of the last
+        drains when the op stream goes idle."""
+        with self.lock:
+            self._pipeline_win += 1
+            self._auto_flush_ms = max(0.1, float(flush_ms))
+
+    def flush_pipeline(self) -> None:
+        """Complete every in-flight drain, in submit order."""
+        with self.lock:
+            if self._completing:
+                return
+            self._completing = True
+            try:
+                while self._inflight:
+                    self._complete_drain(self._inflight.popleft())
+            finally:
+                self._completing = False
+            if self.perf:
+                self.perf.set("ec_inflight_depth", 0)
+
+    def _arm_auto_flush(self) -> None:
+        if self._auto_flush_ms is None or self._flush_timer is not None:
+            return
+
+        def _fire():
+            with self.lock:
+                self._flush_timer = None
+            self.flush_pipeline()
+
+        t = threading.Timer(self._auto_flush_ms / 1000.0, _fire)
+        t.daemon = True
+        self._flush_timer = t
+        t.start()
+
+    def inflight_ops(self) -> list[ECOp]:
+        """Ops submitted to the device pipeline, not yet committing
+        (for dump_ops_in_flight)."""
+        with self.lock:
+            return [op for d in self._inflight for op in d.ops]
 
     # -- object metadata helpers -------------------------------------------
 
@@ -464,101 +602,311 @@ class ECBackend:
         ready: list[ECOp] = []
         while self.waiting_reads and self.waiting_reads[0].pending_reads == 0:
             ready.append(self.waiting_reads.pop(0))
-        if not ready:
-            return
+        if ready:
+            try:
+                drain = self._submit_drain(ready)
+            except Exception as e:  # noqa: BLE001 — encode staging died
+                # complete earlier in-flight drains FIRST so their acks
+                # (lower versions) precede these ops' error acks —
+                # completion stays in submit order even on failure
+                self.flush_pipeline()
+                for op in ready:
+                    self._abort_op(op, e)
+            else:
+                self._inflight.append(drain)
+                if self.perf:
+                    self.perf.inc("ec_drain_submits")
+                    self.perf.set("ec_inflight_depth", len(self._inflight))
+                self._arm_auto_flush()
+        self._drain_pipeline()
 
-        # ---- THE BATCHED LAUNCH ----
-        # Gather every extent of every ready op; encode all of them in one
-        # codec call along the byte axis.
-        work: list[tuple[ECOp, hobject_t, Extent, np.ndarray]] = []
+    # -- submit half: assemble + launch, NO host sync -----------------------
+
+    def _submit_drain(self, ready: list[ECOp]) -> _Drain:
+        """Gather every extent of every ready op, encode the whole
+        drain with launches that return device futures (one fused
+        launch for appends + one plain launch for overwrites), and
+        record the in-flight drain.  Nothing here blocks on the
+        device; materialization happens in _complete_drain."""
+        import time as _time
+        t0 = _time.perf_counter()
+        k = self.k
+        work: list[tuple] = []
+        runs: list[np.ndarray] = []
         for op in ready:
+            op.state = "encoding"
             for oid, extents in op.plan.will_write.items():
                 for e in extents:
                     buf = self._assemble_extent(op, oid, e)
                     # pin so later ops in this (or the next) drain see
                     # these bytes instead of stale store reads
                     self.extent_cache.present(oid, e.off, buf)
+                    op.pinned.append((oid, e.off, e.length))
+                    nstripes = e.length // self.sinfo.stripe_width
                     work.append((op, oid, e, buf))
-        encoded_by_op: dict[int, dict] = {id(op): {} for op in ready}
-        crcs_by_op: dict[int, dict] = {id(op): {} for op in ready}
-        if work:
-            k = self.k
-            runs = []
-            for _, _, e, logical in work:
-                nstripes = e.length // self.sinfo.stripe_width
-                runs.append(logical.reshape(
-                    nstripes, k, self.sinfo.chunk_size)
-                    .transpose(1, 0, 2).reshape(k, -1))
-            # North-star fused path: every chunk-aligned appending extent
-            # of the WHOLE drain gets parity + cumulative shard crcs from
-            # one kernel launch, seeds chained per object across in-drain
-            # ops (round-1 restricted this to single-op drains — exactly
-            # not the batched case the pipeline exists for).  Non-append
-            # extents (overwrites) take the plain parity path: their
-            # incremental crc is invalidated anyway (generations work).
-            fused_idx: list[int] = []
-            plain_idx: list[int] = []
-            if self.mesh_codec is not None:
-                # multi-chip drain: the whole batch goes through the
-                # sharded collective program; crc folds on host (the
-                # fused in-kernel crc is a single-chip formulation)
-                plain_idx = list(range(len(work)))
-            elif hasattr(self.ec_impl, "encode_extents_with_crc"):
-                sim_size: dict[hobject_t, int] = {}
-                for i, ((op, oid, e, _), run) in enumerate(zip(work, runs)):
-                    hinfo = op.plan.hash_infos[oid]
-                    cur = sim_size.get(oid, hinfo.total_chunk_size)
-                    chunk_off = (self.sinfo
-                                 .aligned_logical_offset_to_chunk_offset(
-                                     e.off))
-                    if chunk_off == cur:
-                        fused_idx.append(i)
-                        sim_size[oid] = cur + run.shape[1]
-                    else:
-                        plain_idx.append(i)
+                    runs.append(buf.reshape(
+                        nstripes, k, self.sinfo.chunk_size)
+                        .transpose(1, 0, 2).reshape(k, -1))
+        drain = _Drain(ops=ready, work=work, kinds=[],
+                       fused_handle=None, fused_pos={},
+                       plain_handle=None, plain_cols={})
+        if not work:
+            return drain
+        # North-star fused path: every chunk-aligned appending extent
+        # of the WHOLE drain gets parity + cumulative shard crcs from
+        # one kernel launch.  The append decision uses _sim_chunk, the
+        # projected end-of-chunk across ALL in-flight drains (the
+        # shared hinfo instances only advance at completion).  Non-
+        # append extents (overwrites) take the plain parity path: their
+        # incremental crc is invalidated anyway (generations work).
+        fused_idx: list[int] = []
+        plain_idx: list[int] = []
+        can_fuse = self.mesh_codec is None and \
+            hasattr(self.ec_impl, "encode_extents_with_crc_submit")
+        deleted: set[tuple[int, hobject_t]] = set()
+        for i, ((op, oid, e, _), run) in enumerate(zip(work, runs)):
+            hinfo = op.plan.hash_infos[oid]
+            if op.txn.ops[oid].delete and (id(op), oid) not in deleted:
+                # delete-then-recreate: the fresh plan hinfo starts at 0
+                deleted.add((id(op), oid))
+                self._sim_chunk[oid] = 0
+            cur = self._sim_chunk.get(oid, hinfo.total_chunk_size)
+            chunk_off = self.sinfo.aligned_logical_offset_to_chunk_offset(
+                e.off)
+            if can_fuse and chunk_off == cur:
+                fused_idx.append(i)
+                self._sim_chunk[oid] = cur + run.shape[1]
             else:
-                plain_idx = list(range(len(work)))
-            parities: dict[int, np.ndarray] = {}
+                plain_idx.append(i)
+                self._sim_chunk[oid] = max(cur, chunk_off + run.shape[1])
+            self._sim_refs[oid] = self._sim_refs.get(oid, 0) + 1
+        # txn-level size effects that land after the writes (mirrors
+        # generate_transactions order): truncate clamps the projection.
+        # Only for objects this drain TRACKS (has a _sim_refs entry
+        # from a work item) — an untracked entry would never be
+        # released by _drop_sim_refs and the stale projection would
+        # push all later appends off the fused path; pure truncates
+        # stay safe via generate's own append re-check
+        for op in ready:
+            for oid, objop in op.txn.ops.items():
+                if objop.truncate_to is not None and \
+                        oid in self._sim_refs:
+                    self._sim_chunk[oid] = \
+                        self.sinfo.logical_to_next_chunk_offset(
+                            objop.truncate_to)
+        fused_set = set(fused_idx)
+        drain.kinds = ["fused" if i in fused_set else "plain"
+                       for i in range(len(work))]
+        try:
             if fused_idx:
-                results = self.ec_impl.encode_extents_with_crc(
-                    [runs[i] for i in fused_idx])
-                sim_hash: dict[hobject_t, list[int]] = {}
-                # per-run fold is O(1) combines per shard: the launch
-                # already device-combined each run's body into one L
-                for i, (par, l, tail, body_bytes) in zip(fused_idx,
-                                                         results):
-                    op, oid, e, _ = work[i]
-                    hinfo = op.plan.hash_infos[oid]
-                    seeds = sim_hash.get(
-                        oid, list(hinfo.cumulative_shard_hashes))
-                    crcs = self.ec_impl.fold_extent_crcs(
-                        l, tail, seeds, body_bytes)
-                    sim_hash[oid] = crcs
-                    crcs_by_op[id(op)][(oid, e.off)] = crcs
-                    parities[i] = np.asarray(par)
+                drain.fused_pos = {wi: p
+                                   for p, wi in enumerate(fused_idx)}
+                drain.fused_handle = \
+                    self.ec_impl.encode_extents_with_crc_submit(
+                        [runs[i] for i in fused_idx])
             if plain_idx:
+                col = 0
+                for i in plain_idx:
+                    drain.plain_cols[i] = col
+                    col += runs[i].shape[1]
                 plain_runs = [runs[i] for i in plain_idx]
                 big = np.concatenate(plain_runs, axis=1) \
                     if len(plain_runs) > 1 else plain_runs[0]
                 if self.mesh_codec is not None:
-                    parity = self.mesh_codec.encode_flat(big)
+                    drain.plain_handle = (
+                        "mesh", self.mesh_codec.encode_flat_submit(big))
+                elif hasattr(self.ec_impl, "encode_chunks_submit"):
+                    drain.plain_handle = (
+                        "plugin", self.ec_impl.encode_chunks_submit(big))
                 else:
-                    parity = np.asarray(self.ec_impl.encode_chunks(big))
-                col = 0
-                for i in plain_idx:
-                    width = runs[i].shape[1]
-                    parities[i] = parity[:, col:col + width]
-                    col += width
-            self.batched_launches += 1 + (1 if fused_idx and plain_idx
-                                          else 0)
-            self.batched_extents += len(work)
-            for i, ((op, oid, e, _), run) in enumerate(zip(work, runs)):
-                encoded_by_op[id(op)][(oid, e.off)] = \
-                    np.concatenate([run, parities[i]], axis=0)
+                    # host-synchronous CPU plugins: nothing to defer
+                    drain.plain_handle = (
+                        "np", np.asarray(self.ec_impl.encode_chunks(big)))
+        except Exception:
+            # undo this drain's projection refs before the caller
+            # aborts the ops (a stale projection would quietly push
+            # every later append of these objects off the fused path)
+            for _, oid, _, _ in work:
+                self._sim_refs[oid] -= 1
+                if self._sim_refs[oid] <= 0:
+                    del self._sim_refs[oid]
+                    self._sim_chunk.pop(oid, None)
+            raise
+        drain.work = [(op, oid, e, run)
+                      for (op, oid, e, _), run in zip(work, runs)]
+        self.batched_launches += 1 + (1 if fused_idx and plain_idx
+                                      else 0)
+        self.batched_extents += len(work)
+        drain.t_assemble = _time.perf_counter() - t0
+        if self.perf:
+            self.perf.inc("ec_drain_extents", len(work))
+            self.perf.tinc("ec_drain_assemble", drain.t_assemble)
+        return drain
 
-        for op in ready:
-            self._commit_op(op, encoded_by_op[id(op)],
-                            crcs_by_op[id(op)])
+    def _drain_pipeline(self) -> None:
+        """Completion policy: keep up to dispatch_depth drains in
+        flight while more work is imminent (a pipeline window is open,
+        or ops are queued behind us); otherwise flush — a lone op with
+        nothing behind it completes synchronously, preserving the
+        pre-pipeline contract."""
+        if self._completing:
+            return
+        self._completing = True
+        try:
+            while self._inflight:
+                more = (self._pipeline_win > 0
+                        or bool(self.waiting_state)
+                        or bool(self.waiting_reads
+                                and self.waiting_reads[0]
+                                .pending_reads == 0))
+                allowed = self.dispatch_depth if more else 0
+                if len(self._inflight) <= allowed:
+                    break
+                self._complete_drain(self._inflight.popleft())
+        finally:
+            self._completing = False
+        if self.perf:
+            self.perf.set("ec_inflight_depth", len(self._inflight))
+
+    # -- completion half: materialize + fold + sub-writes -------------------
+
+    def _drop_sim_refs(self, drain: _Drain) -> None:
+        """Drop this drain's projection refs; the LAST in-flight drain
+        touching an object releases its _sim_chunk entry so the next
+        submit re-seeds from the (now current) hinfo.  Must run on
+        EVERY completion outcome — a leaked ref would strand a stale
+        projection and silently push all later appends of the object
+        off the fused path."""
+        for _, oid, _, _ in drain.work:
+            self._sim_refs[oid] -= 1
+            if self._sim_refs[oid] <= 0:
+                del self._sim_refs[oid]
+                self._sim_chunk.pop(oid, None)
+
+    def _complete_drain(self, drain: _Drain) -> None:
+        import time as _time
+        t0 = _time.perf_counter()
+        try:
+            try:
+                fused_res = self.ec_impl.encode_extents_with_crc_finalize(
+                    drain.fused_handle) if drain.fused_handle is not None \
+                    else []
+                plain_par = None
+                if drain.plain_handle is not None:
+                    kind, h = drain.plain_handle
+                    if kind == "mesh":
+                        plain_par = self.mesh_codec.encode_flat_finalize(h)
+                    elif kind == "plugin":
+                        plain_par = self.ec_impl.encode_chunks_finalize(h)
+                    else:
+                        plain_par = h
+            except Exception as e:  # noqa: BLE001 — device/encode failure
+                if self.perf:
+                    self.perf.inc("ec_drain_errors")
+                for op in drain.ops:
+                    self._abort_op(op, e)
+                return
+            device_dt = _time.perf_counter() - t0
+            encoded_by_op: dict[int, dict] = {id(op): {}
+                                              for op in drain.ops}
+            crcs_by_op: dict[int, dict] = {id(op): {} for op in drain.ops}
+            fused_ls: dict[int, tuple] = {}
+            for i, (op, oid, e, run) in enumerate(drain.work):
+                if drain.kinds[i] == "fused":
+                    par, l, tail, body = fused_res[drain.fused_pos[i]]
+                    par = np.asarray(par)
+                    fused_ls[i] = (l, tail, body)
+                else:
+                    col = drain.plain_cols[i]
+                    par = plain_par[:, col:col + run.shape[1]]
+                encoded_by_op[id(op)][(oid, e.off)] = \
+                    np.concatenate([run, par], axis=0)
+            self._fold_drain_crcs(drain, encoded_by_op, fused_ls,
+                                  crcs_by_op)
+            t1 = _time.perf_counter()
+            for op in drain.ops:
+                try:
+                    self._commit_op(op, encoded_by_op[id(op)],
+                                    crcs_by_op[id(op)])
+                except Exception as e:  # noqa: BLE001
+                    if self.perf:
+                        self.perf.inc("ec_drain_errors")
+                    self._abort_op(op, e)
+            if self.perf:
+                self.perf.tinc("ec_drain_device", device_dt)
+                self.perf.tinc("ec_drain_commit",
+                               _time.perf_counter() - t1)
+        finally:
+            self._drop_sim_refs(drain)
+
+    def _fold_drain_crcs(self, drain: _Drain, encoded_by_op: dict,
+                         fused_ls: dict, crcs_by_op: dict) -> None:
+        """ONE ordered host pass over the drain computing cumulative
+        shard crcs for every appending extent: fused extents fold the
+        device-combined L (O(1) combines per shard), plain extents
+        (mesh drains, CPU plugins) fold all k+m shard rows per run in
+        a single vectorized crc32c_rows call.  Seeds chain per object
+        through the walk exactly as generate_transactions will apply
+        them; a mismatch (projection raced a truncate/delete) simply
+        yields no precomputed crc and generate falls back to its own
+        host append — correctness never depends on the projection."""
+        from ..common import crc32c as _crc
+        sim_size: dict[hobject_t, int] = {}
+        sim_hash: dict[hobject_t, list[int]] = {}
+        items_by_op: dict[int, list[int]] = {}
+        for i, (op, _, _, _) in enumerate(drain.work):
+            items_by_op.setdefault(id(op), []).append(i)
+        for op in drain.ops:
+            for oid, objop in op.txn.ops.items():
+                if objop.delete:
+                    # recreate seeds from the op's FRESH plan hinfo
+                    sim_size[oid] = 0
+                    sim_hash.pop(oid, None)
+            for i in items_by_op.get(id(op), []):
+                _, oid, e, run = drain.work[i]
+                hinfo = op.plan.hash_infos[oid]
+                chunk_off = (self.sinfo
+                             .aligned_logical_offset_to_chunk_offset(
+                                 e.off))
+                cur = sim_size.get(oid, hinfo.total_chunk_size)
+                width = run.shape[1]
+                if chunk_off != cur:
+                    sim_size[oid] = max(cur, chunk_off + width)
+                    sim_hash.pop(oid, None)
+                    continue
+                seeds = sim_hash.get(
+                    oid, list(hinfo.cumulative_shard_hashes))
+                if i in fused_ls:
+                    l, tail, body = fused_ls[i]
+                    crcs = self.ec_impl.fold_extent_crcs(
+                        l, tail, seeds, body)
+                else:
+                    crcs = _crc.crc32c_rows(
+                        encoded_by_op[id(op)][(oid, e.off)], seeds)
+                sim_hash[oid] = crcs
+                sim_size[oid] = cur + width
+                crcs_by_op[id(op)][(oid, e.off)] = crcs
+            for oid, objop in op.txn.ops.items():
+                if objop.truncate_to is not None:
+                    sim_size[oid] = \
+                        self.sinfo.logical_to_next_chunk_offset(
+                            objop.truncate_to)
+                    sim_hash.pop(oid, None)
+
+    def _abort_op(self, op: ECOp, err: Exception) -> None:
+        """Failure path (satellite of the pipeline work): an op that
+        dies before/at commit is routed through the in-order finish
+        queue with its error attached — _try_finish_rmw releases its
+        pinned extents (stale assembled bytes must never satisfy a
+        later drain's overlay), drops its projection refs, and acks it
+        AFTER every earlier op, so the pipeline never wedges and acks
+        never reorder."""
+        op.error = err
+        op.state = "failed"
+        op.pending_commits = 0
+        if op not in self.waiting_commit:
+            self.waiting_commit.append(op)
+        self._try_finish_rmw()
 
     def _commit_op(self, op: ECOp, encoded: dict,
                    crcs: dict | None = None) -> None:
@@ -615,10 +963,20 @@ class ECBackend:
 
         rf = self.log.rollforward_to
         for s in range(self.n):
-            self.shards.sub_write(s, txns[s], on_commit,
-                                  log_entries=entries,
-                                  at_version=op.version,
-                                  rollforward_to=rf)
+            try:
+                self.shards.sub_write(s, txns[s], on_commit,
+                                      log_entries=entries,
+                                      at_version=op.version,
+                                      rollforward_to=rf)
+            except Exception as e:  # noqa: BLE001 — a failed sub-write
+                # must not wedge the in-order commit queue: count the
+                # shard as resolved (failed) so the op drains, carrying
+                # the error to the ack (reference marks the PG
+                # inconsistent and lets scrub/peering repair the shard)
+                op.error = op.error or e
+                if self.perf:
+                    self.perf.inc("ec_drain_errors")
+                on_commit(s)
 
     def _try_finish_rmw(self) -> None:
         """reference try_finish_rmw :2103: in-order completion, advance
@@ -626,12 +984,14 @@ class ECBackend:
         while self.waiting_commit and \
                 self.waiting_commit[0].pending_commits == 0:
             op = self.waiting_commit.pop(0)
-            op.state = "done"
+            op.state = "failed" if op.error is not None else "done"
             self.log.roll_forward_to(op.version)
-            # unpin cached extents + drop projected refs
-            for oid, extents in (op.plan.will_write if op.plan else {}).items():
-                for e in extents:
-                    self.extent_cache.release(oid, e.off, e.length)
+            # unpin EXACTLY what this op presented + drop projected
+            # refs (op.pinned, not the plan: a mid-assembly abort may
+            # have pinned only a prefix of the plan's extents)
+            for oid, off, length in op.pinned:
+                self.extent_cache.release(oid, off, length)
+            op.pinned.clear()
             for oid in op.txn.ops:
                 proj = self._projected.get(oid)
                 if proj is not None:

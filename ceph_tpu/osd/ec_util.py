@@ -161,9 +161,8 @@ class HashInfo:
             f"append at {old_size} != current {self.total_chunk_size}"
         n, added = shard_chunks.shape
         assert n == len(self.cumulative_shard_hashes)
-        for s in range(n):
-            self.cumulative_shard_hashes[s] = _crc.crc32c(
-                shard_chunks[s].tobytes(), self.cumulative_shard_hashes[s])
+        self.cumulative_shard_hashes = _crc.crc32c_rows(
+            shard_chunks, self.cumulative_shard_hashes)
         self.total_chunk_size += added
 
     def append_precomputed(self, old_size: int, added: int,

@@ -46,6 +46,17 @@ from ..ops import bitsliced
 
 LANE = bitsliced.LANE
 
+# jax.shard_map (with check_vma) landed after 0.4.x; older runtimes
+# expose it as jax.experimental.shard_map with the check_rep kwarg.
+# Same semantics for this module's use (the replication checker can't
+# statically infer the XOR-of-all_gather fold either way).
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+    _SM_NOCHECK = {"check_vma": False}
+else:  # pragma: no cover - exercised on older jax runtimes
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _SM_NOCHECK = {"check_rep": False}
+
 
 def make_mesh(n_shard: int, n_data: int, devices=None) -> Mesh:
     """Build a ('shard', 'data') mesh from the first n_shard*n_data devices."""
@@ -133,13 +144,13 @@ class DistributedStripeCodec:
             return functools.reduce(
                 jnp.bitwise_xor, [gath[i] for i in range(n_shard)])
 
-        # check_vma=False: the checker can't statically infer that the
+        # no-check: the checker can't statically infer that the
         # XOR fold of an all_gather over 'shard' is 'shard'-replicated
         # (it is: every member folds the same gathered operands)
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(_shard_map(
             local, mesh=self.mesh,
             in_specs=(P("shard", None, None), P("shard", "data")),
-            out_specs=P(None, "data"), check_vma=False))
+            out_specs=P(None, "data"), **_SM_NOCHECK))
         self._apply_cache[r] = fn
         return fn
 
@@ -149,9 +160,12 @@ class DistributedStripeCodec:
         per_dev = LANE * 4 if self.use_w32 else LANE
         return self.n_data * per_dev
 
-    def _apply_flat(self, bitmats, rows: np.ndarray, r: int) -> np.ndarray:
-        """rows (j, W) uint8 (j = k data rows or k survivor rows) ->
-        (r, W) uint8 via the sharded contraction."""
+    def _apply_flat_submit(self, bitmats, rows: np.ndarray, r: int):
+        """Dispatch half of _apply_flat: stages rows onto the mesh and
+        launches the sharded contraction, returning a handle of the
+        device future + layout metadata — no host sync (the OSD's
+        dispatch-ahead drains materialize in a later completion
+        stage)."""
         rows = np.ascontiguousarray(rows, dtype=np.uint8)
         j, w = rows.shape
         pad = -w % self._quantum()
@@ -163,10 +177,21 @@ class DistributedStripeCodec:
             x = rows
         x = jax.device_put(
             jnp.asarray(x), NamedSharding(self.mesh, P("shard", "data")))
-        out = np.asarray(self._sharded_apply(r)(bitmats, x))
+        return {"dev": self._sharded_apply(r)(bitmats, x),
+                "r": r, "w": w, "pad": pad}
+
+    def _apply_flat_finalize(self, handle) -> np.ndarray:
+        out = np.asarray(handle["dev"])
+        r, w, pad = handle["r"], handle["w"], handle["pad"]
         if self.use_w32:
             out = out.view("<u4").view(np.uint8).reshape(r, w + pad)
         return out[:, :w] if pad else out
+
+    def _apply_flat(self, bitmats, rows: np.ndarray, r: int) -> np.ndarray:
+        """rows (j, W) uint8 (j = k data rows or k survivor rows) ->
+        (r, W) uint8 via the sharded contraction."""
+        return self._apply_flat_finalize(
+            self._apply_flat_submit(bitmats, rows, r))
 
     # -- device-resident entry (no host round-trip) -------------------------
 
@@ -195,6 +220,16 @@ class DistributedStripeCodec:
         fan-out, ECBackend.cc:2074, as one collective program)."""
         assert chunks.shape[0] == self.k
         return self._apply_flat(self.enc_bitmats, chunks, self.m)
+
+    def encode_flat_submit(self, chunks: np.ndarray):
+        """Dispatch half of encode_flat (no host sync); materialize
+        with encode_flat_finalize.  The ECBackend dispatch-ahead drain
+        entry for mesh-configured pools."""
+        assert chunks.shape[0] == self.k
+        return self._apply_flat_submit(self.enc_bitmats, chunks, self.m)
+
+    def encode_flat_finalize(self, handle) -> np.ndarray:
+        return self._apply_flat_finalize(handle)
 
     def encode(self, stripes):
         """stripes (B, k, C) uint8 -> parity (B, m, C): batch and byte

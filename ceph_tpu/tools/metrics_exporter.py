@@ -17,31 +17,57 @@ import os
 import sys
 
 
+# perf-counter type -> prometheus metric type (u64 counters are
+# monotonic; gauges settable; time/avg expand to _sum/_count pairs,
+# which prometheus models as counters)
+_PROM_TYPE = {"u64": "counter", "gauge": "gauge",
+              "time": "counter", "avg": "counter"}
+
+
 def collect(asok_dir: str) -> str:
     from ..common.admin_socket import admin_command
     lines = [
         "# HELP ceph_tpu_perf daemon perf counters",
-        "# TYPE ceph_tpu_perf untyped",
     ]
+    typed: set[str] = set()
+
+    def emit_type(name: str, ctype: str | None) -> None:
+        if name in typed:
+            return
+        typed.add(name)
+        lines.append(f"# TYPE {name} "
+                     f"{_PROM_TYPE.get(ctype, 'untyped')}")
+
     for path in sorted(glob.glob(os.path.join(asok_dir, "*.asok"))):
         daemon = os.path.basename(path).rsplit(".asok", 1)[0]
         try:
             dump = admin_command(path, {"prefix": "perf dump"}, timeout=2)
         except Exception:  # noqa: BLE001 - daemon may be down
             continue
+        try:
+            schema = admin_command(path, {"prefix": "perf schema"},
+                                   timeout=2)
+        except Exception:  # noqa: BLE001 - older daemon: untyped
+            schema = {}
         for group, counters in dump.items():
             if not isinstance(counters, dict):
                 continue
+            gschema = schema.get(group, {}) if isinstance(schema, dict) \
+                else {}
             for key, val in counters.items():
                 name = f"ceph_tpu_{key}"
+                ctype = gschema.get(key)
                 labels = f'{{daemon="{daemon}",group="{group}"}}'
                 if isinstance(val, dict):   # time-avg
+                    emit_type(f"{name}_sum", ctype)
+                    emit_type(f"{name}_count", ctype)
                     lines.append(
                         f'ceph_tpu_{key}_sum{labels} {val.get("sum", 0)}')
                     lines.append(
                         f'ceph_tpu_{key}_count{labels} '
                         f'{val.get("avgcount", 0)}')
                 else:
+                    emit_type(name, ctype)
                     lines.append(f"{name}{labels} {val}")
     return "\n".join(lines) + "\n"
 

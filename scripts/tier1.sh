@@ -1,5 +1,14 @@
 #!/usr/bin/env bash
 # Tier-1 verify gate — the single source of truth for builder and CI.
-# This is the ROADMAP.md "Tier-1 verify" command VERBATIM; change it
-# there and here together or not at all.
-set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
+# The pytest line is the ROADMAP.md "Tier-1 verify" command VERBATIM
+# (minus the trailing exit, moved to the end so the bench smoke can
+# run); change it there and here together or not at all.
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+# CPU-mode smoke of the end-to-end bench metrics (ISSUE 3): tiny sizes,
+# asserts the ec_write_pipeline_* / ec_deep_scrub_* JSON keys are
+# present and positive, so perf-plumbing regressions fail tier-1 before
+# a TPU round ever sees them.
+if [ "$rc" -eq 0 ]; then
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python bench.py --smoke || rc=$?
+fi
+exit $rc

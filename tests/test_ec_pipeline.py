@@ -382,6 +382,234 @@ def test_batched_overlapping_writes_same_object():
     assert not backend._projected
 
 
+# -- dispatch-ahead pipeline (docs/PIPELINE.md) ------------------------------
+
+def test_pipeline_window_acks_in_submit_order():
+    """depth=2 window: drains pile up on the device (observed in-flight
+    hits the cap), completion stays in submit order, and the window
+    exit flushes everything — extent cache and projections drain to
+    zero."""
+    backend, _ = make_backend()
+    assert backend.dispatch_depth == 2
+    acks = []
+    seen_depth = 0
+    rng = np.random.default_rng(30)
+    payloads = [rng.integers(0, 256, 512, dtype=np.uint8)
+                for _ in range(5)]
+    with backend.pipeline():
+        for i, p in enumerate(payloads):
+            txn = PGTransaction()
+            txn.write(oid(f"pw{i}"), 0, p)
+            backend.submit_transaction(txn, eversion_t(1, i + 1),
+                                       lambda i=i: acks.append(i))
+            seen_depth = max(seen_depth, len(backend._inflight))
+        assert backend._inflight          # still in flight mid-window
+    assert seen_depth == 2                # the cap was reached and held
+    assert acks == [0, 1, 2, 3, 4]        # submit order
+    assert not backend._inflight
+    for i, p in enumerate(payloads):
+        np.testing.assert_array_equal(backend.read(oid(f"pw{i}"), 0, 512), p)
+    assert len(backend.extent_cache) == 0
+    assert not backend._projected
+    assert not backend._sim_chunk and not backend._sim_refs
+
+
+def test_pipeline_overlapping_writes_same_object():
+    """Overlapping writes to ONE object across in-flight drains: the
+    second op's assembly must see the first's pinned (uncommitted)
+    bytes, acks stay in submit order, and everything releases."""
+    backend, _ = make_backend()
+    o = oid("pover")
+    rng = np.random.default_rng(31)
+    base = rng.integers(0, 256, 512, dtype=np.uint8)
+    patch = rng.integers(0, 256, 40, dtype=np.uint8)
+    acks = []
+    with backend.pipeline():
+        t1 = PGTransaction()
+        t1.write(o, 0, base)
+        backend.submit_transaction(t1, eversion_t(1, 1),
+                                   lambda: acks.append(1))
+        # drain 1 is STILL in flight when this assembles
+        assert backend._inflight
+        t2 = PGTransaction()
+        t2.write(o, 100, patch)
+        backend.submit_transaction(t2, eversion_t(1, 2),
+                                   lambda: acks.append(2))
+    assert acks == [1, 2]
+    expect = base.copy()
+    expect[100:140] = patch
+    np.testing.assert_array_equal(backend.read(o, 0, 512), expect)
+    assert len(backend.extent_cache) == 0
+    assert not backend._projected
+
+
+def test_pipeline_appends_chain_hinfo_across_inflight_drains():
+    """Chained appends in separate in-flight drains (fused jax path):
+    the cumulative crc chain must match the host convention even
+    though drain N+1 launches before drain N materializes."""
+    from ceph_tpu.common import crc32c as C
+    backend, _ = make_backend(plugin="jax")
+    o = oid("pchain")
+    rng = np.random.default_rng(32)
+    parts = [rng.integers(0, 256, 256, dtype=np.uint8)
+             for _ in range(3)]
+    with backend.pipeline():
+        for i, p in enumerate(parts):
+            txn = PGTransaction()
+            txn.write(o, 256 * i, p)
+            backend.submit_transaction(txn, eversion_t(1, i + 1),
+                                       lambda: None)
+    whole = np.concatenate(parts)
+    np.testing.assert_array_equal(backend.read(o, 0, 768), whole)
+    hinfo = backend.shards.get_hinfo(0, o)
+    shards = ec_util.encode(backend.sinfo, backend.ec_impl, whole)
+    for s in range(6):
+        assert hinfo.get_chunk_hash(s) == C.crc32c(
+            shards[s].tobytes(), 0xFFFFFFFF), f"shard {s}"
+    assert len(backend.extent_cache) == 0
+    assert not backend._sim_chunk
+
+
+class _FailingShards(LocalShardBackend):
+    """Raises on the sub-write of one (object, shard) once."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.fail_on = None     # (oid_name, shard)
+
+    def sub_write(self, shard, txn, on_commit, **kw):
+        if self.fail_on is not None and shard == self.fail_on[1] and \
+                any(self.fail_on[0] in str(g) for g in txn.ops):
+            self.fail_on = None
+            raise IOError("injected sub-write failure")
+        return super().sub_write(shard, txn, on_commit, **kw)
+
+
+def test_pipeline_subwrite_failure_drains_cleanly():
+    """A mid-pipeline sub-write failure must not wedge the queues: the
+    failed op acks with its error attached, later ops commit, and the
+    extent cache / projections return to zero (failed ops release
+    their pins — stale assembled bytes must never satisfy a later
+    drain)."""
+    codec = REG.factory("jerasure", {"k": "4", "m": "2"})
+    sinfo = ec_util.StripeInfo(4 * 64, 64)
+    store = MemStore()
+    store.mount()
+    shards = _FailingShards(store, pg_t(1, 0), 6)
+    backend = ECBackend(codec, sinfo, shards)
+    shards.fail_on = ("pf1", 5)           # parity shard of the 2nd op
+    rng = np.random.default_rng(33)
+    payloads = [rng.integers(0, 256, 512, dtype=np.uint8)
+                for _ in range(3)]
+    acks = []
+    ops = []
+    with backend.pipeline():
+        for i, p in enumerate(payloads):
+            txn = PGTransaction()
+            txn.write(oid(f"pf{i}"), 0, p)
+            ops.append(backend.submit_transaction(
+                txn, eversion_t(1, i + 1), lambda i=i: acks.append(i)))
+    assert acks == [0, 1, 2]              # nothing wedged, order kept
+    assert ops[1].state == "failed" and ops[1].error is not None
+    assert ops[0].state == "done" and ops[2].state == "done"
+    assert not backend.waiting_reads and not backend.waiting_commit
+    assert len(backend.extent_cache) == 0
+    assert not backend._projected
+    # the pipeline still works after the failure
+    t = PGTransaction()
+    t.write(oid("pf3"), 0, payloads[0])
+    done = []
+    backend.submit_transaction(t, eversion_t(1, 4), lambda: done.append(1))
+    assert done == [1]
+    np.testing.assert_array_equal(backend.read(oid("pf3"), 0, 512),
+                                  payloads[0])
+
+
+def test_pipeline_encode_failure_aborts_cleanly():
+    """A device finalize failure aborts the drain's ops through the
+    in-order finish queue: error attached, pins and projections (incl.
+    the cross-drain _sim_chunk refs) fully released, later drains
+    unaffected."""
+    backend, _ = make_backend(plugin="jax")
+    orig = backend.ec_impl.encode_extents_with_crc_finalize
+    boom = {"armed": True}
+
+    def failing(handle):
+        if boom["armed"]:
+            boom["armed"] = False
+            raise RuntimeError("injected finalize failure")
+        return orig(handle)
+
+    backend.ec_impl.encode_extents_with_crc_finalize = failing
+    rng = np.random.default_rng(35)
+    payloads = [rng.integers(0, 256, 512, dtype=np.uint8)
+                for _ in range(2)]
+    acks = []
+    ops = []
+    with backend.pipeline():
+        for i, p in enumerate(payloads):
+            txn = PGTransaction()
+            txn.write(oid(f"ef{i}"), 0, p)
+            ops.append(backend.submit_transaction(
+                txn, eversion_t(1, i + 1), lambda i=i: acks.append(i)))
+    assert acks == [0, 1]
+    assert ops[0].state == "failed" and ops[0].error is not None
+    assert ops[1].state == "done" and ops[1].error is None
+    np.testing.assert_array_equal(backend.read(oid("ef1"), 0, 512),
+                                  payloads[1])
+    assert len(backend.extent_cache) == 0
+    assert not backend._projected
+    assert not backend._sim_chunk and not backend._sim_refs
+
+
+def test_mesh_drain_matches_single_chip_fused_hashes():
+    """Satellite: a multi-chip (CPU-mesh) drain must produce the same
+    cumulative shard hashes as the single-chip fused path — the mesh
+    rides the plain parity path whose host crc fold is now the
+    vectorized single-pass-per-drain (crc32c_rows)."""
+    from ceph_tpu.common import crc32c as C
+    from ceph_tpu.parallel.mesh import DistributedStripeCodec, make_mesh
+    mesh = make_mesh(4, 2)
+    mc = DistributedStripeCodec(4, 2, mesh)
+    codec = REG.factory("jax", {"k": "4", "m": "2"})
+    sinfo = ec_util.StripeInfo(4 * 64, 64)
+    store = MemStore()
+    store.mount()
+    shards = LocalShardBackend(store, pg_t(1, 0), 6)
+    bmesh = ECBackend(codec, sinfo, shards, mesh_codec=mc)
+    bfused, _ = make_backend(plugin="jax")
+    rng = np.random.default_rng(34)
+    pa = rng.integers(0, 256, 512, dtype=np.uint8)
+    pb = rng.integers(0, 256, 256, dtype=np.uint8)
+    pc = rng.integers(0, 256, 384, dtype=np.uint8)
+    o1, o2 = oid("mesh1"), oid("mesh2")
+    for b in (bmesh, bfused):
+        with b.batch():                   # ONE multi-run drain
+            t1 = PGTransaction()
+            t1.write(o1, 0, pa)
+            b.submit_transaction(t1, eversion_t(1, 1), lambda: None)
+            t2 = PGTransaction()          # chained append on o1
+            t2.write(o1, 512, pb)
+            b.submit_transaction(t2, eversion_t(1, 2), lambda: None)
+            t3 = PGTransaction()          # second object
+            t3.write(o2, 0, pc)
+            b.submit_transaction(t3, eversion_t(1, 3), lambda: None)
+    for o, ln in ((o1, 768), (o2, 384)):
+        hm = bmesh.shards.get_hinfo(0, o)
+        hf = bfused.shards.get_hinfo(0, o)
+        assert hm.cumulative_shard_hashes == hf.cumulative_shard_hashes, o
+        assert hm.total_chunk_size == hf.total_chunk_size
+        np.testing.assert_array_equal(bmesh.read(o, 0, ln),
+                                      bfused.read(o, 0, ln))
+    # and both equal the host convention
+    whole = np.concatenate([pa, pb])
+    enc = ec_util.encode(bmesh.sinfo, bmesh.ec_impl, whole)
+    hm = bmesh.shards.get_hinfo(0, o1)
+    for s in range(6):
+        assert hm.get_chunk_hash(s) == C.crc32c(
+            enc[s].tobytes(), 0xFFFFFFFF), f"shard {s}"
+
+
 def test_batched_appends_same_object_chain_hinfo():
     """Consecutive appends in one window chain the cumulative crc."""
     from ceph_tpu.common import crc32c as C
