@@ -259,13 +259,17 @@ def _pipeline_payloads(nobj: int, objsize: int):
 
 
 def time_write_pipeline(pipelined: bool, nobj: int, objsize: int,
-                        chunk: int, payloads=None) -> float:
+                        chunk: int, payloads=None,
+                        tracker=None) -> float:
     """Wall-clock input bytes/sec of `nobj` object writes through the
     full ECBackend path (plan -> assemble -> fused encode+crc launch ->
     hinfo fold -> per-shard sub-writes on MemStore), every op its own
     drain.  pipelined=True opens the dispatch-ahead window (flush at
     exit included in the timing); False materializes each drain before
-    the next submit — the A/B contrast."""
+    the next submit — the A/B contrast.  tracker: an OpTracker makes
+    every op a TrackedOp with the full stage timeline (the always-on
+    daemon configuration; the tracked-vs-untracked delta is the
+    tracking overhead guard, docs/TRACING.md)."""
     import contextlib
     from ceph_tpu.osd.ec_transaction import PGTransaction
     from ceph_tpu.osd.types import eversion_t, hobject_t
@@ -278,12 +282,43 @@ def time_write_pipeline(pipelined: bool, nobj: int, objsize: int,
         for i, payload in enumerate(payloads):
             txn = PGTransaction()
             txn.write(hobject_t(pool=1, name=f"pipe{i}"), 0, payload)
-            backend.submit_transaction(txn, eversion_t(1, i + 1),
-                                       lambda: acked.append(1))
+            top = tracker.create("osd_op", f"pipe{i}") \
+                if tracker is not None else None
+            if top is not None:
+                backend.submit_transaction(
+                    txn, eversion_t(1, i + 1),
+                    lambda t=top: (acked.append(1),
+                                   tracker.unregister(t, 0)),
+                    top=top)
+            else:
+                backend.submit_transaction(txn, eversion_t(1, i + 1),
+                                           lambda: acked.append(1))
     dt = time.perf_counter() - t0
     if len(acked) != nobj:
         raise RuntimeError(f"pipeline bench: {len(acked)}/{nobj} acked")
     return nobj * objsize / dt
+
+
+def time_tracking_overhead(nobj: int, objsize: int, chunk: int,
+                           payloads, reps: int = 3
+                           ) -> tuple[float, float, float]:
+    """Tracked-vs-untracked A/B on the pipelined write path: `reps`
+    interleaved runs each, best-of rates compared (best-of damps
+    scheduler noise far better than medians at these run lengths).
+    Returns (tracked_best, untracked_best, noise_pct) where noise_pct
+    is the untracked spread — the measurement's own noise floor, which
+    the smoke guard adds to its threshold so the assertion tests the
+    tracker, not the scheduler."""
+    from ceph_tpu.common.tracked_op import OpTracker
+    untracked, tracked = [], []
+    for _ in range(reps):
+        untracked.append(time_write_pipeline(True, nobj, objsize,
+                                             chunk, payloads))
+        tracked.append(time_write_pipeline(
+            True, nobj, objsize, chunk, payloads,
+            tracker=OpTracker(complaint_time=30.0)))
+    noise = (max(untracked) - min(untracked)) / max(untracked) * 100.0
+    return max(tracked), max(untracked), noise
 
 
 def time_deep_scrub(nobj: int, objsize: int, chunk: int,
@@ -354,12 +389,22 @@ def bench_end_to_end(on_tpu: bool, passes: int, spacing: float) -> dict:
     out["ec_deep_scrub_GBps"] = round(rate / 1e9, 3)
     out["ec_deep_scrub_device_bytes"] = meta["device_bytes"]
     out["ec_deep_scrub_host_bytes"] = meta["host_bytes"]
+    # always-on op tracking overhead (ISSUE 4 guard: must stay under
+    # TRACK_OVERHEAD_MAX_PCT + the measured noise floor; asserted in
+    # --smoke so a hot-path regression fails tier-1)
+    t_best, u_best, noise = time_tracking_overhead(
+        nobj, objsize, chunk, payloads, reps=3)
+    out["ec_write_pipeline_tracked_GBps"] = round(t_best / 1e9, 3)
+    out["ec_write_tracking_overhead_pct"] = round(
+        (1.0 - t_best / u_best) * 100.0, 2)
+    out["ec_write_tracking_noise_pct"] = round(noise, 2)
     return out
 
 
 SMOKE_KEYS = ("ec_write_pipeline_k8_m3_GBps",
               "ec_write_pipeline_sync_GBps",
               "ec_write_pipeline_speedup",
+              "ec_write_pipeline_tracked_GBps",
               "ec_deep_scrub_GBps")
 
 
@@ -385,6 +430,20 @@ def run_smoke() -> int:
     if out.get("ec_deep_scrub_host_bytes", 0) <= 0:
         print("# smoke FAILED: host crc fallback not exercised",
               file=sys.stderr)
+        return 1
+    # tracking-overhead guard (docs/TRACING.md): always-on tracking
+    # must cost < TRACK_OVERHEAD_MAX_PCT (default 2%) beyond the
+    # run-to-run noise the untracked config itself shows at smoke
+    # sizes — a real regression (per-event allocation, a sync, O(n)
+    # dump work on the hot path) blows well past this; noise does not
+    thresh = float(os.environ.get("TRACK_OVERHEAD_MAX_PCT", "2.0"))
+    noise = max(float(out.get("ec_write_tracking_noise_pct") or 0.0),
+                0.0)
+    ovh = out.get("ec_write_tracking_overhead_pct")
+    if ovh is None or ovh > thresh + noise:
+        print(f"# smoke FAILED: tracking overhead {ovh}% > "
+              f"{thresh + noise:.2f}% ({thresh}% threshold + "
+              f"{noise:.2f}% measured noise)", file=sys.stderr)
         return 1
     return 0
 

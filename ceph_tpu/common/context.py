@@ -55,8 +55,16 @@ class CephContext:
             self.conf.set(cmd["key"], cmd["value"])
             return {"success": True, cmd["key"]: self.conf.get(cmd["key"])}
         self.asok.register_command("config set", config_set)
-        self.asok.register_command(
-            "log dump", lambda cmd: (self.log.dump_recent(), {"ok": 1})[1])
+
+        def log_dump(cmd):
+            """Structured dump of the in-memory recent-events ring
+            (reference `log dump`: the higher-verbosity ring kept for
+            post-hoc debugging); optional `count` bounds the tail."""
+            count = cmd.get("count")
+            return {"ok": 1, "count": len(self.log.ring),
+                    "entries": self.log.recent(
+                        int(count) if count is not None else None)}
+        self.asok.register_command("log dump", log_dump)
 
     def shutdown(self) -> None:
         if self.asok is not None:

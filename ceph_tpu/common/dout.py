@@ -40,6 +40,19 @@ class LogRing:
         with self.lock:
             self.ring.append(entry)
 
+    def __len__(self) -> int:
+        with self.lock:
+            return len(self.ring)
+
+    def recent(self, count: int | None = None) -> list[tuple]:
+        """Tail of the ring, newest last (allocation-light: entries
+        stay tuples; formatting happens only at dump time)."""
+        with self.lock:
+            entries = list(self.ring)
+        if count is not None:
+            entries = entries[-count:] if count > 0 else []
+        return entries
+
     def dump(self, out=sys.stderr) -> None:
         with self.lock:
             entries = list(self.ring)
@@ -80,6 +93,12 @@ class DoutStream:
 
     def dump_recent(self, out=sys.stderr) -> None:
         self.ring.dump(out)
+
+    def recent(self, count: int | None = None) -> list[dict]:
+        """Structured view of the recent-events ring (the `log dump`
+        asok command payload)."""
+        return [{"ts": ts, "subsys": subsys, "level": level, "msg": msg}
+                for ts, subsys, level, msg in self.ring.recent(count)]
 
 
 _default = DoutStream()

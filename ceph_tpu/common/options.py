@@ -113,6 +113,19 @@ register_options([
     Option("osd_scrub_auto_repair", bool, False,
            "repair inconsistencies found by background scrub "
            "(reference osd_scrub_auto_repair)"),
+    # op tracking (reference TrackedOp/OpTracker options)
+    Option("osd_enable_op_tracker", bool, True,
+           "track per-op event timelines (reference "
+           "osd_enable_op_tracker; off = zero-cost null path)"),
+    Option("osd_op_complaint_time", float, 30.0,
+           "seconds before an op latches as slow and is reported to "
+           "the mon (reference osd_op_complaint_time)", min=0.0),
+    Option("osd_op_history_size", int, 20,
+           "completed ops kept for dump_historic_ops (reference "
+           "osd_op_history_size)", min=0),
+    Option("osd_op_history_slow_size", int, 20,
+           "slow ops kept for dump_historic_slow_ops (reference "
+           "osd_op_history_slow_op_size)", min=0),
     # tpu data plane
     Option("tpu_encode_tile", int, 8192,
            "byte-axis tile of the GF matmul kernel", Level.DEV, min=128),

@@ -9,9 +9,10 @@ the admin socket (`perf dump`) and shipped to the mgr role.
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from enum import Enum
 
 
@@ -20,6 +21,14 @@ class CounterType(Enum):
     GAUGE = "gauge"          # settable level
     TIME = "time"            # accumulated seconds
     AVG = "avg"              # (sum, count) long-running average
+    HISTOGRAM = "hist"       # bucketed samples (prometheus histogram)
+
+
+# Log-spaced latency bounds in seconds (reference PerfHistogram axis
+# config; prometheus-style, the implicit +Inf bucket holds the rest).
+DEFAULT_LAT_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
 
 @dataclass
@@ -30,6 +39,8 @@ class _Counter:
     value: float = 0
     sum: float = 0
     count: int = 0
+    buckets: tuple = ()           # histogram upper bounds
+    hist: list = field(default_factory=list)  # per-bucket counts (+Inf last)
 
 
 class PerfCountersBuilder:
@@ -47,6 +58,14 @@ class PerfCountersBuilder:
 
     def add_time_avg(self, key: str, desc: str = ""):
         self._counters[key] = _Counter(key, CounterType.AVG, desc)
+        return self
+
+    def add_histogram(self, key: str, desc: str = "",
+                      buckets: tuple = DEFAULT_LAT_BUCKETS):
+        c = _Counter(key, CounterType.HISTOGRAM, desc,
+                     buckets=tuple(buckets))
+        c.hist = [0] * (len(c.buckets) + 1)
+        self._counters[key] = c
         return self
 
     def create_perf_counters(self) -> "PerfCounters":
@@ -70,6 +89,23 @@ class PerfCounters:
         c.sum += seconds
         c.count += 1
 
+    def hinc(self, key: str, value: float) -> None:
+        """Observe one sample into a histogram counter.  Creates the
+        histogram on first use — consumers with dynamic key sets (the
+        OpTracker's per-stage latency series) need not predeclare."""
+        c = self._c.get(key)
+        if c is None:
+            with self._lock:
+                c = self._c.get(key)
+                if c is None:
+                    c = _Counter(key, CounterType.HISTOGRAM,
+                                 buckets=DEFAULT_LAT_BUCKETS)
+                    c.hist = [0] * (len(c.buckets) + 1)
+                    self._c[key] = c
+        c.hist[bisect.bisect_left(c.buckets, value)] += 1
+        c.sum += value
+        c.count += 1
+
     def time(self, key: str):
         """Context manager timing a block into a time-avg counter."""
         pc = self
@@ -90,6 +126,15 @@ class PerfCounters:
                 if c.type == CounterType.AVG:
                     out[key] = {"avgcount": c.count, "sum": c.sum,
                                 "avgtime": c.sum / c.count if c.count else 0}
+                elif c.type == CounterType.HISTOGRAM:
+                    # cumulative prometheus-style buckets, +Inf last
+                    cum, buckets = 0, []
+                    for le, n in zip(c.buckets, c.hist):
+                        cum += n
+                        buckets.append([le, cum])
+                    buckets.append(["+Inf", cum + c.hist[-1]])
+                    out[key] = {"sum": c.sum, "count": c.count,
+                                "buckets": buckets}
                 else:
                     out[key] = c.value
             return out

@@ -54,9 +54,15 @@ DEFAULT_EC_PROFILE = {"plugin": "jax", "k": "2", "m": "1",
 READONLY_COMMANDS = {
     "osd erasure-code-profile get", "osd erasure-code-profile ls",
     "osd pool ls", "osd pool get", "status", "osd tree", "mon stat",
-    "config get", "config dump",
+    "config get", "config dump", "health",
     "fs ls", "fs dump", "mgr dump",
 }
+
+# read-only for caps purposes but answerable only by the leader: the
+# payload is leader-local transient state (slow_op_reports is not
+# paxos-committed), so a peon serving it locally would report
+# HEALTH_OK while the cluster has blocked ops
+LEADER_ONLY_READS = {"health"}
 
 FWD_TID_BASE = 1 << 40
 
@@ -71,6 +77,11 @@ class Monitor:
         self.lock = threading.RLock()
         self.failure_quorum = failure_quorum
         self._failure_reports: dict[int, set[int]] = {}
+        # per-OSD slow-op reports (MOSDSlowOpReport) feeding the
+        # `health` SLOW_OPS check.  Transient leader-side state, not
+        # paxos-committed: OSDs re-report while the condition holds
+        # and the check expires when reports stop (see _cmd_health).
+        self.slow_op_reports: dict[int, dict] = {}
         self._subscribers: list = []
         self.auth = auth       # auth.CephxAuth with keyring (AuthMonitor)
         # PaxosService state beyond the OSDMap (reference AuthMonitor /
@@ -298,7 +309,8 @@ class Monitor:
         # cluster-internal — only service-keyed peers may speak it
         # (reference MonCap service caps on mon/osd messages)
         if kind is not None and kind != "service" and isinstance(
-                msg, (M.MMonPaxos, M.MOSDBoot, M.MOSDFailure)):
+                msg, (M.MMonPaxos, M.MOSDBoot, M.MOSDFailure,
+                      M.MOSDSlowOpReport)):
             return
         if isinstance(msg, M.MMonPaxos):
             # paxos peers must be monitors, not arbitrary daemons
@@ -336,6 +348,11 @@ class Monitor:
                 self._handle_failure(msg)
             else:
                 self._forward(msg)
+        elif isinstance(msg, M.MOSDSlowOpReport):
+            if self.is_leader:
+                self._handle_slow_op_report(msg)
+            else:
+                self._forward(msg)
         elif isinstance(msg, M.MAuth):
             self._handle_auth(conn, msg)
         elif isinstance(msg, M.MMonCommand):
@@ -344,6 +361,7 @@ class Monitor:
                 conn.send_message(M.MMonCommandAck(
                     msg.tid, -errno.EACCES, {"error": "caps deny"}))
             elif self.is_leader or (prefix in READONLY_COMMANDS and
+                                    prefix not in LEADER_ONLY_READS and
                                     self._lease_ok()):
                 result, out = self.handle_command(msg.cmd)
                 conn.send_message(M.MMonCommandAck(msg.tid, result, out))
@@ -448,6 +466,16 @@ class Monitor:
                 self._failure_reports.pop(msg.failed, None)
                 self.osdmap.bump_epoch()
                 self._propose_current()
+
+    def _handle_slow_op_report(self, msg: M.MOSDSlowOpReport) -> None:
+        """An OSD's tracker latched (or cleared) slow ops (reference:
+        the osd->mgr->mon health path behind the SLOW_OPS warning)."""
+        with self.lock:
+            if msg.report.get("count"):
+                self.slow_op_reports[msg.osd_id] = {
+                    **msg.report, "ts": time.time()}
+            else:
+                self.slow_op_reports.pop(msg.osd_id, None)
 
     # -- admin commands (reference OSDMonitor command surface) --------------
 
@@ -599,6 +627,8 @@ class Monitor:
                 return 0, {"removed": snapid}
             if prefix == "status":
                 return self._cmd_status()
+            if prefix == "health":
+                return self._cmd_health()
             if prefix == "osd tree":
                 return self._cmd_tree()
             if prefix == "mon stat":
@@ -946,6 +976,43 @@ class Monitor:
                 "pools": len(self.osdmap.pools),
                 "quorum": self.quorum_status(),
             }
+
+    def _cmd_health(self) -> tuple[int, dict]:
+        """`ceph health` (reference HealthMonitor checks, reduced to
+        the checks this build produces): SLOW_OPS from per-OSD tracker
+        reports, cleared by a count-0 report or staleness (a dead OSD
+        stops reporting; its stale entry must not warn forever —
+        OSD-down visibility is the failure-report path's job)."""
+        now = time.time()
+        with self.lock:
+            for osd in [o for o, r in self.slow_op_reports.items()
+                        if now - r["ts"] > 120.0]:
+                del self.slow_op_reports[osd]
+            reports = {o: dict(r)
+                       for o, r in self.slow_op_reports.items()}
+        checks: dict = {}
+        total = sum(r.get("count", 0) for r in reports.values())
+        if total:
+            oldest = max((r.get("oldest_age", 0.0)
+                          for r in reports.values()), default=0.0)
+            daemons = ", ".join(f"osd.{o}" for o in sorted(reports))
+            checks["SLOW_OPS"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{total} slow ops, oldest one blocked "
+                           f"for {oldest:.1f} sec, daemons "
+                           f"[{daemons}] have slow ops",
+                "detail": [
+                    f"osd.{o}: {r.get('count')} slow ops (lifetime "
+                    f"{r.get('total_slow')}): " + "; ".join(
+                        f"{op.get('type')} {op.get('desc')} age "
+                        f"{op.get('age')}s blamed stage "
+                        f"{op.get('blamed_stage')} trace "
+                        f"{op.get('trace_id')}"
+                        for op in r.get("ops", []))
+                    for o, r in sorted(reports.items())],
+            }
+        status = "HEALTH_WARN" if checks else "HEALTH_OK"
+        return 0, {"status": status, "checks": checks}
 
     def _cmd_tree(self) -> tuple[int, dict]:
         with self.lock:

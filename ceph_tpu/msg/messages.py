@@ -155,19 +155,27 @@ class MOSDOp(Message):
 
     def __init__(self, pgid: spg_t, oid: hobject_t, ops: list,
                  data: bytes = b"", tid: int = 0, epoch: int = 0,
-                 snapc: list | None = None):
+                 snapc: list | None = None,
+                 trace: dict | None = None):
         super().__init__()
         self.pgid, self.oid, self.ops = pgid, oid, ops
         self.data, self.tid, self.epoch = data, tid, epoch
         # SnapContext [seq, [snap ids]] for self-managed snapshots
         # (reference MOSDOp snap_seq + snaps)
         self.snapc = snapc
+        # Dapper-style trace context (common/tracked_op.py
+        # TraceContext.to_wire): stitches the client's objecter span
+        # to the primary's op span across the wire
+        self.trace = trace
 
     def to_meta(self):
-        return {"pgid": spg_to_json(self.pgid),
-                "oid": hobj_to_json(self.oid),
-                "ops": self.ops, "tid": self.tid, "epoch": self.epoch,
-                "snapc": self.snapc}
+        m = {"pgid": spg_to_json(self.pgid),
+             "oid": hobj_to_json(self.oid),
+             "ops": self.ops, "tid": self.tid, "epoch": self.epoch,
+             "snapc": self.snapc}
+        if self.trace is not None:
+            m["trace"] = self.trace
+        return m
 
     def data_segment(self):
         return self.data
@@ -178,6 +186,7 @@ class MOSDOp(Message):
         self.ops, self.tid = meta["ops"], meta["tid"]
         self.epoch = meta["epoch"]
         self.snapc = meta.get("snapc")
+        self.trace = meta.get("trace")
         self.data = data
 
 
@@ -218,21 +227,28 @@ class MOSDECSubOpWrite(Message):
 
     def __init__(self, pgid: spg_t, tid: int, at_version: eversion_t,
                  txn: Transaction, log_entries: list | None = None,
-                 rollforward_to: eversion_t | None = None):
+                 rollforward_to: eversion_t | None = None,
+                 trace: dict | None = None):
         super().__init__()
         self.pgid, self.tid, self.at_version, self.txn = \
             pgid, tid, at_version, txn
         self.log_entries = log_entries or []    # wire lists (entry_to_wire)
         self.rollforward_to = rollforward_to
+        # child trace context of the primary's op span (the shard
+        # holder registers its sub-op span under the same trace id)
+        self.trace = trace
 
     def to_meta(self):
         ops, blob = txn_to_wire(self.txn)
         self._blob = blob
         rf = self.rollforward_to
-        return {"pgid": spg_to_json(self.pgid), "tid": self.tid,
-                "v": [self.at_version.epoch, self.at_version.version],
-                "ops": ops, "log": self.log_entries,
-                "rf": [rf.epoch, rf.version] if rf is not None else None}
+        m = {"pgid": spg_to_json(self.pgid), "tid": self.tid,
+             "v": [self.at_version.epoch, self.at_version.version],
+             "ops": ops, "log": self.log_entries,
+             "rf": [rf.epoch, rf.version] if rf is not None else None}
+        if self.trace is not None:
+            m["trace"] = self.trace
+        return m
 
     def data_segment(self):
         return self._blob
@@ -245,6 +261,7 @@ class MOSDECSubOpWrite(Message):
         self.log_entries = meta.get("log", [])
         rf = meta.get("rf")
         self.rollforward_to = eversion_t(*rf) if rf else None
+        self.trace = meta.get("trace")
 
 
 @register_message
@@ -450,6 +467,28 @@ class MOSDFailure(Message):
     def decode_wire(self, meta, data):
         self.reporter, self.failed = meta["reporter"], meta["failed"]
         self.epoch = meta["epoch"]
+
+
+@register_message
+class MOSDSlowOpReport(Message):
+    """OSD -> mon slow-op health report (the role of the reference's
+    osd beacon / MMonHealthChecks feeding the SLOW_OPS warning): the
+    tracker's slow_op_summary, re-sent while the condition holds and
+    once more — with count 0 — to clear it."""
+
+    type_id = 73
+
+    def __init__(self, osd_id: int = -1, report: dict | None = None):
+        super().__init__()
+        self.osd_id = osd_id
+        self.report = report or {}
+
+    def to_meta(self):
+        return {"osd": self.osd_id, "report": self.report}
+
+    def decode_wire(self, meta, data):
+        self.osd_id = meta["osd"]
+        self.report = meta.get("report", {})
 
 
 @register_message

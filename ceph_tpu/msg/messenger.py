@@ -42,6 +42,7 @@ import json
 import random
 import struct
 import threading
+import time
 import uuid
 from typing import Callable
 
@@ -851,6 +852,10 @@ class Messenger:
                     await conn._send_ack()
                     continue
                 msg = Message.decode(tid, seq, meta_raw, data, pcrc)
+                # ingest stamp for op tracking (reference
+                # Message::recv_stamp set by the messenger): dispatch
+                # latency is attributable even when the executor queues
+                msg.recv_stamp = time.time()
                 sess.in_seq = seq
                 if self.recv_filter is not None and \
                         self.recv_filter(msg):

@@ -28,6 +28,7 @@ import time
 
 import numpy as np
 
+from ..common.tracked_op import NULL_TRACKED, OpTracker, TraceContext
 from ..crush.hash import crush_hash32
 from ..ec import ErasureCodeError, ErasureCodePluginRegistry, Profile
 from ..msg import Messenger
@@ -75,7 +76,7 @@ class MessengerShardBackend(ShardBackend):
     # -- writes -------------------------------------------------------------
 
     def sub_write(self, shard, txn, on_commit, log_entries=None,
-                  at_version=None, rollforward_to=None):
+                  at_version=None, rollforward_to=None, trace=None):
         from .pg_log import entry_to_wire
         osd = self._osd_for(shard)
         spg = spg_t(self.pgid, shard)
@@ -101,7 +102,8 @@ class MessengerShardBackend(ShardBackend):
         conn = self.daemon.conn_to_osd(osd)
         conn.send_message(M.MOSDECSubOpWrite(
             spg, tid, at_version or eversion_t(), txn,
-            log_entries=wire_entries, rollforward_to=rollforward_to))
+            log_entries=wire_entries, rollforward_to=rollforward_to,
+            trace=trace))
 
     def handle_write_reply(self, msg: M.MOSDECSubOpWriteReply) -> None:
         with self.lock:
@@ -396,6 +398,28 @@ class OSDDaemon:
             .add_u64_counter("subop_r", "shard sub-reads served")
             .add_time_avg("op_latency", "client op latency")
             .create_perf_counters())
+        # request tracing (reference TrackedOp/OpTracker, docs/
+        # TRACING.md): always-on per-op event timelines + per-stage
+        # latency histograms; conf observers keep the master switch
+        # and complaint time live-tunable (injectargs / pre-boot conf)
+        _tconf = self.cct.conf
+        self.op_tracker = OpTracker(
+            enabled=bool(_tconf.get("osd_enable_op_tracker")),
+            complaint_time=float(_tconf.get("osd_op_complaint_time")),
+            history_size=int(_tconf.get("osd_op_history_size")),
+            history_slow_size=int(
+                _tconf.get("osd_op_history_slow_size")),
+            perf=self.cct.perf.add(
+                PerfCountersBuilder(f"optracker.osd.{osd_id}")
+                .create_perf_counters()))
+
+        def _apply_track(_k=None, _v=None):
+            self.op_tracker.enabled = bool(
+                _tconf.get("osd_enable_op_tracker"))
+            self.op_tracker.complaint_time = float(
+                _tconf.get("osd_op_complaint_time"))
+        for _opt in ("osd_enable_op_tracker", "osd_op_complaint_time"):
+            _tconf.add_observer(_opt, _apply_track)
         if self.cct.asok is not None:
             self.cct.asok.register_command(
                 "status", lambda cmd: {
@@ -404,16 +428,13 @@ class OSDDaemon:
                     "num_pgs": len(self.pgs)})
             self.cct.asok.register_command("scrub", self._asok_scrub)
             self.cct.asok.register_command(
-                "dump_ops_in_flight", lambda cmd: {
-                    "ops": [
-                        {"pg": str(pgid), "state": o.state,
-                         "version": str(o.version)}
-                        for pgid, st in self.pgs.items()
-                        if st.kind == "ec"
-                        for o in (st.backend.waiting_state +
-                                  st.backend.waiting_reads +
-                                  st.backend.inflight_ops() +
-                                  st.backend.waiting_commit)]})
+                "dump_ops_in_flight", self._asok_dump_ops_in_flight)
+            self.cct.asok.register_command(
+                "dump_historic_ops",
+                lambda cmd: self.op_tracker.dump_historic_ops())
+            self.cct.asok.register_command(
+                "dump_historic_slow_ops",
+                lambda cmd: self.op_tracker.dump_historic_slow_ops())
         self.store = store or MemStore()
         self.store.mount()
         self._raw_tid = 1 << 32   # raw-RPC tids, disjoint from backends'
@@ -556,6 +577,11 @@ class OSDDaemon:
             threading.Thread(
                 target=self._scrub_loop, daemon=True,
                 name=f"osd.{self.osd_id}.scrub").start()
+        # always started: osd_enable_op_tracker is live-tunable, so the
+        # surveillance loop must exist even when tracking is off at boot
+        threading.Thread(
+            target=self._optrack_loop, daemon=True,
+            name=f"osd.{self.osd_id}.optrack").start()
 
     def shutdown(self) -> None:
         self._hb_stop.set()
@@ -587,6 +613,22 @@ class OSDDaemon:
             if isinstance(msg, M.MMonMap):
                 self._handle_map(msg)
             elif isinstance(msg, M.MOSDOp):
+                # op tracking starts at messenger dispatch: adopt the
+                # client's trace context — same span, the op continues
+                # across the wire (docs/TRACING.md).  The enabled gate
+                # is out here so the off path skips the description
+                # f-string and trace decode entirely (zero per-op cost)
+                if self.op_tracker.enabled:
+                    top = self.op_tracker.create(
+                        "osd_op",
+                        f"{msg.oid.name} {[op[0] for op in msg.ops]}",
+                        TraceContext.from_wire(msg.trace))
+                    top.mark_event("msgr_dispatch",
+                                   getattr(msg, "recv_stamp", None))
+                    top.set_info("pg", str(msg.pgid.pgid))
+                else:
+                    top = NULL_TRACKED
+                msg.top = top
                 # client ops run on the sharded op pool (reference
                 # ShardedOpWQ): the messenger awaits each dispatch per
                 # connection, so handling inline would serialize every
@@ -594,13 +636,32 @@ class OSDDaemon:
                 # no pipelining, and the batch window could never see
                 # two ops.  Per-object ordering still comes from the
                 # stripe locks in _handle_client_op.
+                top.mark_event("queued")
                 self._op_pool.submit(self._handle_client_op_safe, conn, msg)
             elif isinstance(msg, M.MOSDECSubOpWrite):
                 self.perf.inc("subop_w")
-                self.apply_sub_write(msg.pgid, msg.txn, msg.log_entries,
-                                     msg.at_version, msg.rollforward_to)
+                # sub-op span: child of the primary's op span, same
+                # trace id — the cross-hop stitch point
+                if self.op_tracker.enabled:
+                    stop = self.op_tracker.create(
+                        "ec_sub_write", f"{msg.pgid} tid={msg.tid}",
+                        TraceContext.from_wire(msg.trace))
+                    stop.set_info("pg", str(msg.pgid.pgid))
+                else:
+                    stop = NULL_TRACKED
+                try:
+                    self.apply_sub_write(msg.pgid, msg.txn,
+                                         msg.log_entries,
+                                         msg.at_version,
+                                         msg.rollforward_to)
+                except Exception:
+                    stop.mark_event("failed")
+                    self.op_tracker.unregister(stop, -errno.EIO)
+                    raise
+                stop.mark_event("sub_op_applied")
                 conn.send_message(M.MOSDECSubOpWriteReply(
                     msg.pgid, msg.tid, msg.pgid.shard))
+                self.op_tracker.unregister(stop, 0)
             elif isinstance(msg, M.MPGLogQuery):
                 slog = self._shard_log(msg.pgid)
                 from .pg_log import entry_to_wire
@@ -764,9 +825,12 @@ class OSDDaemon:
         primaries reconstruct the lost shards onto them."""
         with self.pg_lock:
             self._recovery_inflight += 1
+        top = self.op_tracker.create("recovery", f"epoch={epoch}")
         try:
             self._recover_epoch_inner(epoch, prevmap)
+            top.mark_event("recovery_done")
         finally:
+            self.op_tracker.unregister(top)
             with self.pg_lock:
                 self._recovery_inflight -= 1
         # Convergence timer: a failed/partial recovery (split sources
@@ -2014,6 +2078,10 @@ class OSDDaemon:
         if eno != errno.EAGAIN and not isinstance(e, ValueError):
             import traceback
             traceback.print_exc()
+        top = getattr(msg, "top", None)
+        if top is not None:
+            top.mark_event("failed")
+            self.op_tracker.unregister(top, -eno)
         try:
             conn.send_message(M.MOSDOpReply(msg.tid, -eno))
         except Exception:   # connection already gone
@@ -2026,10 +2094,17 @@ class OSDDaemon:
         the Future and the client stalls a full attempt timeout
         instead of fast-retrying (reference: do_op replies -errno on
         every failure path)."""
+        top = getattr(msg, "top", NULL_TRACKED)
+        top.mark_event("dequeued")
         try:
             self._handle_client_op(conn, msg)
         except Exception as e:  # noqa: BLE001 - must reply, not die
             self._reply_op_error(conn, msg, e)
+        finally:
+            # idempotent: the write/read paths unregister with their
+            # result; this net catches early-return paths (snap
+            # reads, watch control ops, caps/blacklist rejections)
+            self.op_tracker.unregister(top)
 
     def _handle_client_op(self, conn, msg: M.MOSDOp) -> None:
         """reference PrimaryLogPG::do_op/do_osd_ops: decode the op
@@ -2099,12 +2174,14 @@ class OSDDaemon:
         # PG the client computed.  If we lead the child, the op simply
         # requeues against it; otherwise _get_pg raises EAGAIN and the
         # client retargets off its refreshed map.
+        top = getattr(msg, "top", NULL_TRACKED)
         pool = self.osdmap.pools.get(msg.pgid.pgid.pool)
         if pool is not None and pool.pg_num:
             actual = self.osdmap.object_to_pg(pool.id, msg.oid.name,
                                               msg.oid.key)
             if actual != msg.pgid.pgid:
                 msg.pgid = spg_t(actual, msg.pgid.shard)
+                top.set_info("pg", str(msg.pgid.pgid))
         state = self._get_pg(msg.pgid.pgid)
         be = state.backend
         if msg.oid.snap != 0:
@@ -2438,8 +2515,8 @@ class OSDDaemon:
             # version entering the FIFO pipeline first would commit out
             # of order and violate the PG log's monotonicity.  The
             # blocking metadata prefetch runs BEFORE the lock.
-            staged = be.make_op(txn, done.set) if state.kind == "ec" \
-                else None
+            staged = be.make_op(txn, done.set, top=top) \
+                if state.kind == "ec" else None
             if window > 0 and state.kind == "ec":
                 # dynamic batch window (SURVEY section 7 "hard parts",
                 # BlueStore-deferred style): hold the pipeline drain
@@ -2450,22 +2527,30 @@ class OSDDaemon:
                 self._arm_batch_drain(be, window)
             with state.lock:
                 version = state.next_version(self.osdmap.epoch)
+                top.set_info("version", str(version))
                 if staged is not None:
                     be.enqueue(staged, version)
                 else:
                     be.submit_transaction(txn, version, done.set)
             if not done.wait(30):
                 result = -errno.ETIMEDOUT
+                top.mark_event("timeout")
             elif staged is not None and staged.error is not None:
                 # pipeline failure containment acks with the error
                 # attached instead of raising (docs/PIPELINE.md) — the
                 # client must NOT see a failed write as durable
                 result = -errno.EIO
+            elif staged is None:
+                # EC ops mark commit/failed inside the pipeline's
+                # in-order finisher; replicated ops commit here
+                top.mark_event("commit")
         elif result == 0:
             self.perf.inc("op_r")
         self.perf.tinc("op_latency", time.perf_counter() - _t0)
+        top.mark_event("reply_sent")
         conn.send_message(M.MOSDOpReply(msg.tid, result, read_payload,
                                         self.osdmap.epoch))
+        self.op_tracker.unregister(top, result)
 
     def _arm_batch_drain(self, be, window_ms: float) -> None:
         """One timer per backend per window: the first op entering an
@@ -2749,8 +2834,22 @@ class OSDDaemon:
         return out
 
     def _asok_scrub(self, cmd: dict) -> dict:
-        return self._scrub_led_pgs(deep=bool(cmd.get("deep", True)),
-                                   repair=bool(cmd.get("repair", False)))
+        # scrub runs are tracked ops too (reference: scrubs surface in
+        # dump_ops_in_flight / slow-op checks like client ops)
+        top = self.op_tracker.create(
+            "scrub", f"deep={bool(cmd.get('deep', True))}")
+        top.mark_event("scrub_start")
+        try:
+            out = self._scrub_led_pgs(
+                deep=bool(cmd.get("deep", True)),
+                repair=bool(cmd.get("repair", False)))
+        except Exception:
+            top.mark_event("failed")
+            self.op_tracker.unregister(top, -errno.EIO)
+            raise
+        top.mark_event("scrub_done")
+        self.op_tracker.unregister(top, 0)
+        return out
 
     # -- snap trim (reference PrimaryLogPG SnapTrimmer / snap trim queue;
     #    runs with scrub here: both walk the same object listing) ----------
@@ -2832,6 +2931,55 @@ class OSDDaemon:
                                   f"across {len(out)} pgs")
             except Exception as e:  # noqa: BLE001 - scheduler survives
                 self.cct.dout("osd", 1, f"background scrub failed: {e!r}")
+
+    # -- op tracking surveillance (reference OSD::check_ops_in_flight
+    #    tick + the SLOW_OPS health path) -----------------------------------
+
+    def _asok_dump_ops_in_flight(self, cmd: dict) -> dict:
+        """Tracker-backed dump_ops_in_flight.  Keeps the pre-tracker
+        output keys (pg / state / version) for compatibility and adds
+        the tracker surface (age, current stage, trace id, events)."""
+        if not self.op_tracker.enabled:
+            # the reference returns an explicit error here; an empty
+            # dump would affirmatively claim nothing is in flight
+            return {"num_ops": 0, "ops": [],
+                    "error": "op tracking disabled "
+                             "(osd_enable_op_tracker=false)"}
+        d = self.op_tracker.dump_ops_in_flight()
+        for op in d["ops"]:
+            op.setdefault("pg", "")
+            op.setdefault("version", "0'0")
+            op["state"] = op.get("current_stage", "")
+        return d
+
+    def _optrack_interval(self) -> float:
+        ct = self.op_tracker.complaint_time
+        return min(1.0, max(0.05, ct / 4.0)) if ct > 0 else 1.0
+
+    def _optrack_loop(self) -> None:
+        """Slow-op surveillance: latch over-complaint ops, report them
+        to the mon (MOSDSlowOpReport -> `health` SLOW_OPS warning),
+        and send one clearing report when the last slow op ages out so
+        the warning retires."""
+        last = 0
+        while not self._hb_stop.wait(self._optrack_interval()):
+            try:
+                if not self.op_tracker.enabled:
+                    if last:
+                        # tracking turned off mid-warning: clear it at
+                        # the mon instead of leaving it to go stale
+                        self.mon_conn.send_message(M.MOSDSlowOpReport(
+                            self.osd_id, {"count": 0, "oldest_age": 0.0,
+                                          "ops": []}))
+                        last = 0
+                    continue
+                rep = self.op_tracker.slow_op_summary()
+                if rep["count"] or last:
+                    self.mon_conn.send_message(
+                        M.MOSDSlowOpReport(self.osd_id, rep))
+                last = rep["count"]
+            except Exception:  # noqa: BLE001 - mon electing/shutdown
+                pass
 
     # -- heartbeats (reference OSD::handle_osd_ping / failure_queue) --------
 

@@ -45,6 +45,7 @@ from typing import Callable
 
 import numpy as np
 
+from ..common.tracked_op import NULL_TRACKED
 from ..ec.interface import ErasureCodeError, ErasureCodeInterface
 from ..store.object_store import ObjectStore, Transaction
 from . import ec_transaction as ect
@@ -63,9 +64,13 @@ class ShardBackend:
     def sub_write(self, shard: int, txn: Transaction,
                   on_commit: Callable[[int], None],
                   log_entries: list | None = None,
-                  at_version=None, rollforward_to=None) -> None:
+                  at_version=None, rollforward_to=None,
+                  trace: dict | None = None) -> None:
         """Apply txn on `shard`; log_entries (pg_log.LogEntry) persist
-        atomically with it (reference ECSubWrite.log_entries)."""
+        atomically with it (reference ECSubWrite.log_entries).  trace
+        is an optional child TraceContext wire dict — remote
+        transports forward it so the shard holder's sub-op span
+        stitches under the primary's op span."""
         raise NotImplementedError
 
     def sub_read(self, shard: int, oid: hobject_t, off: int, length: int,
@@ -126,7 +131,7 @@ class LocalShardBackend(ShardBackend):
                            for s in range(n_shards)}
 
     def sub_write(self, shard, txn, on_commit, log_entries=None,
-                  at_version=None, rollforward_to=None):
+                  at_version=None, rollforward_to=None, trace=None):
         slog = self.shard_logs[shard]
         if log_entries and at_version is not None:
             slog.append_to_txn(txn, log_entries, at_version)
@@ -199,6 +204,9 @@ class ECOp:
     # failure would decrement another in-flight op's pin on the same
     # range and let stale store bytes satisfy a later overlay
     pinned: list[tuple[hobject_t, int, int]] = field(default_factory=list)
+    # per-op trace/timeline (common/tracked_op.py); NULL_TRACKED when
+    # tracking is off — every mark_event below is then a no-op
+    top: object = NULL_TRACKED
 
 
 @dataclass
@@ -415,12 +423,13 @@ class ECBackend:
     # -- entry (reference submit_transaction :1483 / start_rmw :1839) ------
 
     def make_op(self, txn: PGTransaction,
-                on_commit: Callable[[], None]) -> ECOp:
+                on_commit: Callable[[], None], top=None) -> ECOp:
         """Stage an op WITHOUT entering the pipeline: prefetches object
         metadata (a blocking RPC fan-out) so no lock is held during it.
         The racy peek at _projected is benign: the plan re-checks it
         under the lock and falls back to a locked probe on a miss."""
-        op = ECOp(txn, eversion_t(), on_commit)
+        op = ECOp(txn, eversion_t(), on_commit,
+                  top=top if top is not None else NULL_TRACKED)
         for oid in txn.ops:
             if oid not in self._projected:
                 op.meta[oid] = self.shards.probe(oid, self.n)
@@ -436,8 +445,10 @@ class ECBackend:
         return op
 
     def submit_transaction(self, txn: PGTransaction, version: eversion_t,
-                           on_commit: Callable[[], None]) -> ECOp:
-        return self.enqueue(self.make_op(txn, on_commit), version)
+                           on_commit: Callable[[], None],
+                           top=None) -> ECOp:
+        return self.enqueue(self.make_op(txn, on_commit, top=top),
+                            version)
 
     # -- pipeline (reference check_ops :2151) -------------------------------
 
@@ -651,6 +662,9 @@ class ECBackend:
                        fused_handle=None, fused_pos={},
                        plain_handle=None, plain_cols={})
         if not work:
+            # no encode work: no launch/materialize events — a
+            # fabricated launch would poison per-stage blame and the
+            # lat_ec_encode_launch histogram
             return drain
         # North-star fused path: every chunk-aligned appending extent
         # of the WHOLE drain gets parity + cumulative shard crcs from
@@ -732,6 +746,14 @@ class ECBackend:
                     del self._sim_refs[oid]
                     self._sim_chunk.pop(oid, None)
             raise
+        # submit half done: the device work is in flight, no host sync
+        # has happened (the launch/materialize split makes host-vs-
+        # device wait attributable per op).  Only ops that contributed
+        # encode extents get the event
+        worked = {id(op) for op, _, _, _ in work}
+        for op in ready:
+            if id(op) in worked:
+                op.top.mark_event("ec_encode_launch")
         drain.work = [(op, oid, e, run)
                       for (op, oid, e, _), run in zip(work, runs)]
         self.batched_launches += 1 + (1 if fused_idx and plain_idx
@@ -807,6 +829,10 @@ class ECBackend:
                     self._abort_op(op, e)
                 return
             device_dt = _time.perf_counter() - t0
+            worked = {id(op) for op, _, _, _ in drain.work}
+            for op in drain.ops:
+                if id(op) in worked:
+                    op.top.mark_event("ec_encode_materialize")
             encoded_by_op: dict[int, dict] = {id(op): {}
                                               for op in drain.ops}
             crcs_by_op: dict[int, dict] = {id(op): {} for op in drain.ops}
@@ -954,8 +980,18 @@ class ECBackend:
         op.state = "committing"
         op.pending_commits = self.n
         self.waiting_commit.append(op)
+        top = op.top
+        tracked = top.is_tracked
+        # one child span for the whole shard fan-out (the holder's
+        # sub-op description carries the shard); per-shard spans would
+        # cost n uuid draws per op on the hot path
+        wire_trace = top.trace.child().to_wire() if tracked else None
+        if tracked:
+            top.mark_event("sub_write_sent")
 
         def on_commit(shard: int) -> None:
+            if tracked:
+                top.mark_event(f"sub_write_ack({shard})")
             with self.lock:
                 op.pending_commits -= 1
                 if op.pending_commits == 0:
@@ -967,7 +1003,8 @@ class ECBackend:
                 self.shards.sub_write(s, txns[s], on_commit,
                                       log_entries=entries,
                                       at_version=op.version,
-                                      rollforward_to=rf)
+                                      rollforward_to=rf,
+                                      trace=wire_trace)
             except Exception as e:  # noqa: BLE001 — a failed sub-write
                 # must not wedge the in-order commit queue: count the
                 # shard as resolved (failed) so the op drains, carrying
@@ -985,6 +1022,8 @@ class ECBackend:
                 self.waiting_commit[0].pending_commits == 0:
             op = self.waiting_commit.pop(0)
             op.state = "failed" if op.error is not None else "done"
+            op.top.mark_event("failed" if op.error is not None
+                              else "commit")
             self.log.roll_forward_to(op.version)
             # unpin EXACTLY what this op presented + drop projected
             # refs (op.pinned, not the plan: a mid-assembly abort may

@@ -173,7 +173,18 @@ class ShardedOpWQ:
             t.start()
 
     def queue(self, fn: Callable[[], None], op_class: str = "client",
-              priority: int = 63) -> None:
+              priority: int = 63, top=None) -> None:
+        """top: optional TrackedOp (common/tracked_op.py) — the
+        scheduler marks `queued` / `dequeued` on its timeline so queue
+        wait is attributable per op (reference OpTracker events around
+        the OSD op queue)."""
+        if top is not None and getattr(top, "is_tracked", False):
+            top.mark_event("queued")
+            inner = fn
+
+            def fn():
+                top.mark_event("dequeued")
+                inner()
         with self._cv:
             if isinstance(self.scheduler, MClockScheduler):
                 self.scheduler.enqueue(fn, op_class=op_class)

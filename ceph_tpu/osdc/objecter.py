@@ -15,6 +15,7 @@ import errno
 import threading
 import time
 
+from ..common.tracked_op import OpTracker, TraceContext
 from ..msg import Messenger
 from ..msg import messages as M
 from ..osd.osd_map import OSDMap
@@ -49,6 +50,11 @@ class Objecter:
         self._map_nudge_pending = False
         self._tid = 0
         self._lock = threading.Lock()
+        # client-side op tracking: every op gets the ROOT trace span
+        # here (Dapper-style; the OSD continues the same span, shard
+        # sub-ops branch children) — `dump_historic_ops` on this
+        # tracker shows client-observed latency per op
+        self.op_tracker = OpTracker(complaint_time=30.0)
         self._waiters: dict[int, dict] = {}
         self._mon_waiters: dict[int, dict] = {}
         self._auth_waiters: dict[int, dict] = {}
@@ -192,6 +198,26 @@ class Objecter:
             except Exception:  # noqa: BLE001 - mon may be electing
                 pass
         oid = hobject_t(pool=pool_id, name=name, snap=snap)
+        # root trace span: origin_ts stamps "objecter submit" on every
+        # downstream timeline of this request
+        trace = TraceContext.new()
+        top = self.op_tracker.create(
+            "osd_op", f"{pool_id}/{name} {[op[0] for op in ops]}",
+            trace)
+        try:
+            return self._op_submit_attempts(
+                pool_id, name, ops, data, timeout, attempts, snapc,
+                oid, trace, top)
+        finally:
+            # idempotent (reply/timeout paths unregister with their
+            # result); catches exceptions escaping the retry loop —
+            # e.g. connect() to a dead primary — that would otherwise
+            # leak the op in the tracker forever
+            self.op_tracker.unregister(top, -errno.EIO)
+
+    def _op_submit_attempts(self, pool_id, name, ops, data, timeout,
+                            attempts, snapc, oid, trace, top
+                            ) -> M.MOSDOpReply:
         last_err = None
         # EAGAIN (not-primary / peering-incomplete) replies arrive in
         # milliseconds now that the OSD fences every op path; they ride
@@ -225,7 +251,8 @@ class Objecter:
                 self._waiters[tid] = w
             conn = self.messenger.connect(tuple(info.addr))
             conn.send_message(M.MOSDOp(spg, oid, ops, data, tid,
-                                       self.osdmap.epoch, snapc=snapc))
+                                       self.osdmap.epoch, snapc=snapc,
+                                       trace=trace.to_wire()))
             if w["event"].wait(timeout):
                 reply = w["reply"]
                 if reply.epoch > self.osdmap.epoch and \
@@ -243,6 +270,7 @@ class Objecter:
                         pass
                 if reply.result == -errno.EAGAIN:
                     # primary moved or PG still peering: retarget
+                    top.mark_event("retry")
                     self.refresh_map()
                     last_err = reply.result
                     if deadline is None:
@@ -252,12 +280,17 @@ class Objecter:
                     else:
                         attempt += 1    # budget exhausted
                     continue
+                top.mark_event("reply")
+                self.op_tracker.unregister(top, reply.result)
                 return reply
             with self._lock:
                 self._waiters.pop(tid, None)
+            top.mark_event("attempt_timeout")
             self.refresh_map()
             last_err = -errno.ETIMEDOUT
             attempt += 1
+        top.mark_event("timeout")
+        self.op_tracker.unregister(top, last_err)
         raise TimedOut(f"op {name} failed after {attempts} attempts "
                        f"(last {last_err})")
 

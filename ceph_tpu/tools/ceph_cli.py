@@ -4,6 +4,7 @@ Re-expresses the reference's src/ceph.in command surface for the
 commands this build's mon implements:
 
   python -m ceph_tpu.tools.ceph_cli -m HOST:PORT status
+  python -m ceph_tpu.tools.ceph_cli -m HOST:PORT health     # SLOW_OPS etc.
   python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd tree
   python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd pool ls
   python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd pool create NAME \
@@ -52,6 +53,8 @@ def main(argv=None) -> int:
         cmd = None
         if words == ["status"]:
             cmd = {"prefix": "status"}
+        elif words == ["health"]:
+            cmd = {"prefix": "health"}
         elif words == ["osd", "tree"]:
             cmd = {"prefix": "osd tree"}
         elif words == ["osd", "pool", "ls"]:

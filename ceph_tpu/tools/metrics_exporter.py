@@ -19,9 +19,16 @@ import sys
 
 # perf-counter type -> prometheus metric type (u64 counters are
 # monotonic; gauges settable; time/avg expand to _sum/_count pairs,
-# which prometheus models as counters)
+# which prometheus models as counters; hist is a native histogram)
 _PROM_TYPE = {"u64": "counter", "gauge": "gauge",
-              "time": "counter", "avg": "counter"}
+              "time": "counter", "avg": "counter",
+              "hist": "histogram"}
+
+# Cumulative scrape failures per daemon, for the whole exporter
+# process lifetime: a daemon whose asok stops answering must be
+# VISIBLE (daemon_up 0 + a rising error counter), not silently absent
+# from the exposition.
+_SCRAPE_ERRORS: dict[str, int] = {}
 
 
 def collect(asok_dir: str) -> str:
@@ -40,10 +47,23 @@ def collect(asok_dir: str) -> str:
 
     for path in sorted(glob.glob(os.path.join(asok_dir, "*.asok"))):
         daemon = os.path.basename(path).rsplit(".asok", 1)[0]
+        dlabel = f'{{daemon="{daemon}"}}'
         try:
             dump = admin_command(path, {"prefix": "perf dump"}, timeout=2)
-        except Exception:  # noqa: BLE001 - daemon may be down
+        except Exception:  # noqa: BLE001 - daemon down: say so
+            _SCRAPE_ERRORS[daemon] = _SCRAPE_ERRORS.get(daemon, 0) + 1
+            emit_type("ceph_tpu_daemon_up", "gauge")
+            lines.append(f"ceph_tpu_daemon_up{dlabel} 0")
+            emit_type("ceph_tpu_scrape_errors_total", "u64")
+            lines.append(f"ceph_tpu_scrape_errors_total{dlabel} "
+                         f"{_SCRAPE_ERRORS[daemon]}")
             continue
+        emit_type("ceph_tpu_daemon_up", "gauge")
+        lines.append(f"ceph_tpu_daemon_up{dlabel} 1")
+        if daemon in _SCRAPE_ERRORS:
+            emit_type("ceph_tpu_scrape_errors_total", "u64")
+            lines.append(f"ceph_tpu_scrape_errors_total{dlabel} "
+                         f"{_SCRAPE_ERRORS[daemon]}")
         try:
             schema = admin_command(path, {"prefix": "perf schema"},
                                    timeout=2)
@@ -58,7 +78,18 @@ def collect(asok_dir: str) -> str:
                 name = f"ceph_tpu_{key}"
                 ctype = gschema.get(key)
                 labels = f'{{daemon="{daemon}",group="{group}"}}'
-                if isinstance(val, dict):   # time-avg
+                if isinstance(val, dict) and "buckets" in val:
+                    # histogram: cumulative le buckets + sum/count
+                    emit_type(name, "hist")
+                    for le, cum in val["buckets"]:
+                        lines.append(
+                            f'{name}_bucket{{daemon="{daemon}",'
+                            f'group="{group}",le="{le}"}} {cum}')
+                    lines.append(
+                        f'{name}_sum{labels} {val.get("sum", 0)}')
+                    lines.append(
+                        f'{name}_count{labels} {val.get("count", 0)}')
+                elif isinstance(val, dict):   # time-avg
                     emit_type(f"{name}_sum", ctype)
                     emit_type(f"{name}_count", ctype)
                     lines.append(

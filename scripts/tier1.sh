@@ -7,7 +7,10 @@ set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu pyt
 # CPU-mode smoke of the end-to-end bench metrics (ISSUE 3): tiny sizes,
 # asserts the ec_write_pipeline_* / ec_deep_scrub_* JSON keys are
 # present and positive, so perf-plumbing regressions fail tier-1 before
-# a TPU round ever sees them.
+# a TPU round ever sees them.  Also runs the tracked-vs-untracked
+# overhead guard (ISSUE 4, docs/TRACING.md): always-on op tracking must
+# cost < TRACK_OVERHEAD_MAX_PCT (default 2%) + measured noise on the
+# pipelined write bench, so tracking-overhead regressions fail fast.
 if [ "$rc" -eq 0 ]; then
   timeout -k 10 300 env JAX_PLATFORMS=cpu python bench.py --smoke || rc=$?
 fi

@@ -1,0 +1,516 @@
+"""OpTracker / request-tracing tests (ISSUE 4).
+
+Reference analogs: src/test/common/test_mclock_priority_queue.cc has no
+tracker twin — the reference tests TrackedOp through qa teuthology
+dump_ops_in_flight checks; here the tracker is unit-tested directly
+plus an end-to-end cluster stitch (client objecter span -> primary op
+span -> shard sub-op spans under one trace id) and the slow-op ->
+mon-health round trip.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.common.perf_counters import PerfCountersBuilder
+from ceph_tpu.common.tracked_op import (NULL_TRACKED, OpTracker,
+                                        TraceContext, canonical_stage)
+
+
+# -- TraceContext ------------------------------------------------------------
+
+def test_trace_context_child_and_wire():
+    root = TraceContext.new()
+    child = root.child()
+    assert child.trace_id == root.trace_id
+    assert child.span_id != root.span_id
+    assert child.parent_span == root.span_id
+    assert child.origin_ts == root.origin_ts
+    back = TraceContext.from_wire(child.to_wire())
+    assert (back.trace_id, back.span_id, back.parent_span) == \
+        (child.trace_id, child.span_id, child.parent_span)
+    assert TraceContext.from_wire(None) is None
+    assert TraceContext.from_wire({}) is None
+
+
+def test_canonical_stage_strips_shard_suffix():
+    assert canonical_stage("sub_write_ack(7)") == "sub_write_ack"
+    assert canonical_stage("commit") == "commit"
+
+
+# -- tracker core ------------------------------------------------------------
+
+def test_historic_ring_eviction_bounds():
+    tr = OpTracker(history_size=5, history_slow_size=3,
+                   complaint_time=30.0)
+    for i in range(12):
+        top = tr.create("osd_op", f"op{i}")
+        top.mark_event("commit")
+        tr.unregister(top, 0)
+    hist = tr.dump_historic_ops()
+    assert hist["num_ops"] == 5
+    assert [o["description"] for o in hist["ops"]] == \
+        [f"op{i}" for i in range(7, 12)]
+    assert tr.dump_ops_in_flight()["num_ops"] == 0
+    assert tr.num_tracked == 12
+
+
+def test_tracing_off_fast_path_zero_events():
+    tr = OpTracker(enabled=False)
+    tops = [tr.create("osd_op", f"op{i}") for i in range(4)]
+    # the singleton comes back every time: zero allocations per op
+    assert all(t is NULL_TRACKED for t in tops)
+    for t in tops:
+        t.mark_event("whatever")
+        t.set_info("pg", "1.0")
+        tr.unregister(t, 0)
+    assert NULL_TRACKED.events == ()
+    assert tr.dump_ops_in_flight()["num_ops"] == 0
+    assert tr.dump_historic_ops()["num_ops"] == 0
+    assert tr.check_ops_in_flight() == []
+    assert NULL_TRACKED.to_dict() == {}
+
+
+def test_slow_op_latch_and_blame_in_flight():
+    tr = OpTracker(complaint_time=0.05)
+    top = tr.create("osd_op", "stuck")
+    top.mark_event("sub_write_sent")
+    time.sleep(0.12)
+    slow = tr.check_ops_in_flight()
+    assert slow == [top]
+    assert top.slow
+    assert "sub_write_sent" in top.blamed_stage
+    # latching is edge-triggered into the ring, but stays visible
+    assert tr.check_ops_in_flight() == [top]
+    assert tr.dump_historic_slow_ops()["num_ops"] == 1
+    rep = tr.slow_op_summary()
+    assert rep["count"] == 1 and rep["ops"][0]["blamed_stage"]
+    tr.unregister(top, 0)
+    # a just-completed slow op stays in the report for a recency
+    # window (the mon warning must not flicker off the instant the
+    # op finally commits), then ages out
+    assert tr.slow_op_summary()["count"] == 1
+    assert tr.slow_op_summary(window=0.0)["count"] == 0
+    # still in the slow ring after completion
+    assert tr.dump_historic_slow_ops()["num_ops"] == 1
+
+
+def test_slow_op_blames_largest_gap_after_completion():
+    tr = OpTracker(complaint_time=0.05)
+    top = tr.create("osd_op", "laggy")
+    top.initiated_at = time.time() - 0.3   # back-date: 0.3s of life
+    t0 = top.initiated_at
+    top.mark_event("queued", t0 + 0.001)
+    top.mark_event("dequeued", t0 + 0.002)
+    top.mark_event("sub_write_ack(2)", t0 + 0.2)   # the big gap
+    top.mark_event("commit", t0 + 0.201)
+    tr.unregister(top, 0)
+    assert top.slow
+    assert top.blamed_stage == "sub_write_ack(2)"
+
+
+def test_stage_latency_histograms():
+    perf = (PerfCountersBuilder("optracker.test")
+            .create_perf_counters())
+    tr = OpTracker(perf=perf, complaint_time=30.0)
+    top = tr.create("osd_op", "h")
+    t0 = top.initiated_at
+    top.mark_event("queued", t0 + 0.001)
+    top.mark_event("sub_write_ack(0)", t0 + 0.003)
+    top.mark_event("sub_write_ack(1)", t0 + 0.004)
+    top.mark_event("commit", t0 + 0.005)
+    tr.unregister(top, 0)
+    dump = perf.dump()
+    # per-shard events share one canonical histogram
+    assert dump["lat_sub_write_ack"]["count"] == 2
+    assert dump["lat_queued"]["count"] == 1
+    assert dump["lat_commit"]["count"] == 1
+    # cumulative prometheus-style buckets, +Inf last
+    buckets = dump["lat_commit"]["buckets"]
+    assert buckets[-1][0] == "+Inf"
+    assert buckets[-1][1] == 1
+    counts = [c for _le, c in buckets]
+    assert counts == sorted(counts)       # cumulative
+    assert perf.schema()["lat_commit"] == "hist"
+
+
+# -- scheduler hooks ---------------------------------------------------------
+
+def test_sharded_wq_marks_queue_and_dequeue():
+    from ceph_tpu.osd.scheduler import ShardedOpWQ
+    tr = OpTracker()
+    wq = ShardedOpWQ(n_threads=1)
+    try:
+        top = tr.create("osd_op", "wq")
+        done = threading.Event()
+        wq.queue(done.set, op_class="client", top=top)
+        assert done.wait(5)
+        deadline = time.time() + 2
+        while len(top.events) < 2 and time.time() < deadline:
+            time.sleep(0.005)
+        names = [e for _ts, e in top.events]
+        assert names == ["queued", "dequeued"]
+        ts = [t for t, _e in top.events]
+        assert ts[0] <= ts[1]
+    finally:
+        wq.drain_and_stop()
+
+
+# -- EC pipeline stage timeline (depth-2 dispatch-ahead) ---------------------
+
+def _make_backend(k=4, m=2, chunk=64):
+    from ceph_tpu.ec import ErasureCodePluginRegistry
+    from ceph_tpu.osd.ec_backend import ECBackend, LocalShardBackend
+    from ceph_tpu.osd.ec_util import StripeInfo
+    from ceph_tpu.osd.types import pg_t
+    from ceph_tpu.store import MemStore
+    codec = ErasureCodePluginRegistry.instance().factory(
+        "jerasure", {"k": str(k), "m": str(m)})
+    sinfo = StripeInfo(stripe_width=k * chunk, chunk_size=chunk)
+    store = MemStore()
+    store.mount()
+    shards = LocalShardBackend(store, pg_t(1, 0), k + m)
+    return ECBackend(codec, sinfo, shards, dispatch_depth=2)
+
+
+def _event_ts(top, name):
+    for ts, ev in top.events:
+        if ev == name or ev.startswith(name + "("):
+            return ts
+    raise AssertionError(f"{name} not in {[e for _t, e in top.events]}")
+
+
+def test_pipeline_stage_timeline_depth2_overlap():
+    from ceph_tpu.osd.ec_transaction import PGTransaction
+    from ceph_tpu.osd.types import eversion_t, hobject_t
+    backend = _make_backend()
+    tr = OpTracker()
+    tops, acked = [], []
+    rng = np.random.default_rng(3)
+    with backend.pipeline():
+        for i in range(2):
+            txn = PGTransaction()
+            txn.write(hobject_t(pool=1, name=f"t{i}"), 0,
+                      rng.integers(0, 256, 512, dtype=np.uint8))
+            top = tr.create("osd_op", f"t{i}")
+            tops.append(top)
+            backend.submit_transaction(txn, eversion_t(1, i + 1),
+                                       lambda: acked.append(1), top=top)
+        # both drains submitted (launched), neither materialized yet:
+        # the dispatch-ahead window holds them on the "device"
+        assert len(backend._inflight) == 2
+        for top in tops:
+            names = [e for _t, e in top.events]
+            assert "ec_encode_launch" in names
+            assert "ec_encode_materialize" not in names
+    assert len(acked) == 2
+    for top in tops:
+        tr.unregister(top, 0)
+        launch = _event_ts(top, "ec_encode_launch")
+        mat = _event_ts(top, "ec_encode_materialize")
+        sent = _event_ts(top, "sub_write_sent")
+        ack = _event_ts(top, "sub_write_ack")
+        commit = _event_ts(top, "commit")
+        assert launch <= mat <= sent <= ack <= commit
+        n_acks = sum(1 for _t, e in top.events
+                     if e.startswith("sub_write_ack("))
+        assert n_acks == backend.n
+    # dispatch-ahead: op 2 launched BEFORE op 1 materialized
+    assert _event_ts(tops[1], "ec_encode_launch") <= \
+        _event_ts(tops[0], "ec_encode_materialize")
+    # completion stays in submit order
+    assert _event_ts(tops[0], "commit") <= _event_ts(tops[1], "commit")
+
+
+def test_pipeline_failure_marks_failed_stage():
+    from ceph_tpu.osd.ec_transaction import PGTransaction
+    from ceph_tpu.osd.types import eversion_t, hobject_t
+    backend = _make_backend()
+    orig = backend.ec_impl.encode_chunks
+
+    def boom(_chunks):
+        raise RuntimeError("injected encode failure")
+    backend.ec_impl.encode_chunks = boom
+    try:
+        tr = OpTracker()
+        top = tr.create("osd_op", "fail")
+        txn = PGTransaction()
+        txn.write(hobject_t(pool=1, name="f"), 0,
+                  np.zeros(512, dtype=np.uint8))
+        done = []
+        op = backend.submit_transaction(txn, eversion_t(1, 1),
+                                        lambda: done.append(1), top=top)
+        assert done and op.error is not None
+        assert "failed" in [e for _t, e in top.events]
+    finally:
+        backend.ec_impl.encode_chunks = orig
+
+
+# -- wire propagation --------------------------------------------------------
+
+def test_mosdop_trace_wire_roundtrip():
+    from ceph_tpu.msg import messages as M
+    from ceph_tpu.msg.message import Message
+    from ceph_tpu.osd.types import hobject_t, pg_t, spg_t
+    ctx = TraceContext.new()
+    msg = M.MOSDOp(spg_t(pg_t(1, 0), 0), hobject_t(pool=1, name="o"),
+                   [["write", 0, 4]], b"abcd", tid=7, epoch=3,
+                   trace=ctx.to_wire())
+    raw = msg.encode(seq=1)
+    tid, seq, meta_len, data_len = Message.parse_header(
+        raw[:Message.HEADER_SIZE])
+    meta_raw = raw[Message.HEADER_SIZE:Message.HEADER_SIZE + meta_len]
+    data = raw[Message.HEADER_SIZE + meta_len:
+               Message.HEADER_SIZE + meta_len + data_len]
+    import struct
+    (pcrc,) = struct.unpack("<I", raw[-4:])
+    back = Message.decode(tid, seq, meta_raw, data, pcrc)
+    got = TraceContext.from_wire(back.trace)
+    assert got.trace_id == ctx.trace_id
+    assert got.span_id == ctx.span_id
+    # messages that never carried a trace still decode (back-compat)
+    msg2 = M.MOSDOp(spg_t(pg_t(1, 0), 0), hobject_t(pool=1, name="o"),
+                    [["stat"]])
+    assert "trace" not in msg2.to_meta()
+
+
+# -- cluster integration -----------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cluster():
+    from ceph_tpu.tools.vstart import Cluster
+    with Cluster(n_osds=4,
+                 conf={"ec_dispatch_ahead": "true"}) as c:
+        yield c
+
+
+@pytest.fixture(scope="module")
+def client(cluster):
+    return cluster.client()
+
+
+@pytest.fixture(scope="module")
+def ecpool(cluster, client):
+    client.set_ec_profile("traceprof", {
+        "plugin": "jerasure", "k": "2", "m": "1",
+        "stripe_unit": "1024"})
+    client.create_pool("tracepool", "erasure",
+                       erasure_code_profile="traceprof", pg_num=4)
+    return client.open_ioctx("tracepool")
+
+
+def _primary_osd(cluster, pool_name, oid_name):
+    osd0 = cluster.osds[0]
+    pool = osd0.osdmap.lookup_pool(pool_name)
+    pgid = osd0.osdmap.object_to_pg(pool.id, oid_name)
+    _up, acting, _, primary = osd0.osdmap.pg_to_up_acting_osds(pgid)
+    return primary, acting
+
+
+def test_trace_stitches_client_primary_and_shards(cluster, client,
+                                                  ecpool):
+    rng = np.random.default_rng(0)
+    payload = rng.integers(0, 256, 8192, dtype=np.uint8).tobytes()
+    ecpool.write_full("traced", payload)
+    # client span: the objecter tracked the op end to end
+    hist = client.objecter.op_tracker.dump_historic_ops()["ops"]
+    writes = [o for o in hist if "traced" in o["description"]
+              and "writefull" in o["description"]]
+    assert writes, f"no write op in client history: {hist}"
+    cop = writes[-1]
+    trace_id = cop["trace_id"]
+    names = [e["event"] for e in cop["events"]]
+    assert names[0] == "objecter_submit"
+    assert "reply" in names
+
+    primary, acting = _primary_osd(cluster, "tracepool", "traced")
+    posd = cluster.osds[primary]
+    deadline = time.time() + 10
+    ops = []
+    while time.time() < deadline:
+        ops = [t for t in posd.op_tracker.get_historic(trace_id)
+               if t.op_type == "osd_op"]
+        if ops:
+            break
+        time.sleep(0.05)
+    assert ops, f"primary osd.{primary} has no historic op for trace"
+    top = ops[-1]
+    # the same trace id + span continued across the wire
+    assert top.trace.trace_id == trace_id
+    assert top.trace.span_id == cop["span_id"]
+    names = [e for _t, e in top.events]
+    for want in ("objecter_submit", "msgr_dispatch", "queued",
+                 "dequeued", "ec_encode_launch",
+                 "ec_encode_materialize", "sub_write_sent", "commit",
+                 "reply_sent"):
+        assert any(n == want or n.startswith(want + "(")
+                   for n in names), f"missing {want} in {names}"
+    idx = {n: i for i, n in enumerate(names)}
+    assert idx["objecter_submit"] < idx["msgr_dispatch"] < \
+        idx["queued"] < idx["dequeued"] < idx["ec_encode_launch"] < \
+        idx["ec_encode_materialize"] < idx["sub_write_sent"] < \
+        idx["commit"] < idx["reply_sent"]
+    acks = [n for n in names if n.startswith("sub_write_ack(")]
+    assert len(acks) == 3                 # every shard acked (k+m)
+
+    # shard-holder sub-op spans: same trace, parented on the op span
+    remote = [o for o in set(acting) if o != primary]
+    stitched = 0
+    for osd_id in remote:
+        for sub in cluster.osds[osd_id].op_tracker.get_historic(
+                trace_id):
+            assert sub.op_type == "ec_sub_write"
+            assert sub.trace.parent_span == top.trace.span_id
+            assert "sub_op_applied" in [e for _t, e in sub.events]
+            stitched += 1
+    assert stitched >= 1, "no shard-holder sub-op spans stitched"
+
+
+def test_dump_ops_in_flight_keeps_legacy_keys(cluster):
+    osd = cluster.osds[0]
+    top = osd.op_tracker.create("osd_op", "compat probe")
+    top.set_info("pg", "1.0")
+    top.set_info("version", "3'7")
+    try:
+        dump = osd._asok_dump_ops_in_flight({})
+        assert dump["num_ops"] >= 1
+        mine = [o for o in dump["ops"]
+                if o["description"] == "compat probe"][0]
+        # the pre-tracker output keys survive
+        assert mine["pg"] == "1.0"
+        assert mine["version"] == "3'7"
+        assert isinstance(mine["state"], str)
+        # plus the tracker's new surface
+        assert mine["trace_id"]
+        assert mine["age"] >= 0
+    finally:
+        osd.op_tracker.unregister(top, 0)
+
+
+def test_slow_op_latch_and_mon_health_roundtrip(cluster, client,
+                                                ecpool):
+    rng = np.random.default_rng(1)
+    name = "slowop"
+    primary, acting = _primary_osd(cluster, "tracepool", name)
+    laggard = next(o for o in acting if o != primary)
+    losd = cluster.osds[laggard]
+    for osd in cluster.osds:
+        osd.cct.conf.set("osd_op_complaint_time", "0.15")
+    orig = losd.apply_sub_write
+
+    def delayed(*a, **kw):
+        time.sleep(0.8)
+        return orig(*a, **kw)
+    losd.apply_sub_write = delayed
+    try:
+        payload = rng.integers(0, 256, 4096, dtype=np.uint8).tobytes()
+        ecpool.write_full(name, payload)
+    finally:
+        losd.apply_sub_write = orig
+
+    posd = cluster.osds[primary]
+    slow = posd.op_tracker.dump_historic_slow_ops()
+    assert slow["num_ops"] >= 1
+    blamed = [o["blamed_stage"] for o in slow["ops"]
+              if name in o["description"]]
+    assert blamed and any("sub_write" in b for b in blamed), blamed
+
+    # the mon surfaced (or shortly surfaces) a SLOW_OPS health warning
+    def health():
+        r, out = client.mon_command({"prefix": "health"})
+        assert r == 0
+        return out
+    deadline = time.time() + 8
+    warned = None
+    while time.time() < deadline:
+        out = health()
+        if out["status"] == "HEALTH_WARN" and \
+                "SLOW_OPS" in out["checks"]:
+            warned = out
+            break
+        time.sleep(0.1)
+    assert warned is not None, f"no SLOW_OPS warning: {health()}"
+    chk = warned["checks"]["SLOW_OPS"]
+    assert f"osd.{primary}" in chk["summary"]
+    assert any("sub_write" in str(d) for d in chk["detail"])
+
+    # and clears once the OSD reports zero slow ops again
+    deadline = time.time() + 10
+    cleared = False
+    while time.time() < deadline:
+        if health()["status"] == "HEALTH_OK":
+            cleared = True
+            break
+        time.sleep(0.2)
+    assert cleared, f"SLOW_OPS never cleared: {health()}"
+    for osd in cluster.osds:
+        osd.cct.conf.set("osd_op_complaint_time", "30.0")
+
+
+# -- asok / log ring / exporter ---------------------------------------------
+
+def test_historic_asok_commands(cluster, tmp_path):
+    from ceph_tpu.common.admin_socket import admin_command
+    osd = cluster.osds[0]
+    assert osd.cct.asok is None      # cluster fixture runs without asok
+    # drive the handlers directly (the registration path is covered by
+    # test_log_dump_ring's real socket below)
+    hist = osd.op_tracker.dump_historic_ops()
+    assert "ops" in hist and "num_ops" in hist
+    slow = osd.op_tracker.dump_historic_slow_ops()
+    assert "complaint_time" in slow
+
+
+def test_log_dump_ring(tmp_path):
+    from ceph_tpu.common.admin_socket import admin_command
+    from ceph_tpu.common.context import CephContext
+    cct = CephContext("osd.77", asok_path=str(tmp_path / "t.asok"))
+    try:
+        for i in range(5):
+            cct.dout("osd", 1, f"ring entry {i}")
+        out = admin_command(str(tmp_path / "t.asok"),
+                            {"prefix": "log dump"})
+        assert out["count"] >= 5
+        msgs = [e["msg"] for e in out["entries"]]
+        assert "ring entry 4" in msgs
+        # bounded fetch
+        out2 = admin_command(str(tmp_path / "t.asok"),
+                             {"prefix": "log dump", "count": 2})
+        assert len(out2["entries"]) == 2
+        assert out2["entries"][-1]["msg"] == "ring entry 4"
+    finally:
+        cct.shutdown()
+
+
+def test_exporter_daemon_up_and_scrape_errors(tmp_path):
+    from ceph_tpu.common.context import CephContext
+    from ceph_tpu.common.perf_counters import PerfCountersBuilder
+    from ceph_tpu.tools import metrics_exporter
+    cct = CephContext("osd.88", asok_path=str(tmp_path / "osd.88.asok"))
+    pc = cct.perf.add(PerfCountersBuilder("osd.88")
+                      .add_u64_counter("op", "ops")
+                      .create_perf_counters())
+    pc.inc("op")
+    pc.hinc("lat_commit", 0.002)
+    (tmp_path / "osd.99.asok").write_text("")   # dead daemon
+    try:
+        body = metrics_exporter.collect(str(tmp_path))
+        assert 'ceph_tpu_daemon_up{daemon="osd.88"} 1' in body
+        assert 'ceph_tpu_daemon_up{daemon="osd.99"} 0' in body
+        assert 'ceph_tpu_scrape_errors_total{daemon="osd.99"}' in body
+        # histogram exposition: cumulative buckets + sum/count
+        assert "ceph_tpu_lat_commit_bucket" in body
+        assert 'le="+Inf"' in body
+        assert "ceph_tpu_lat_commit_count" in body
+        body2 = metrics_exporter.collect(str(tmp_path))
+        # the scrape-error counter is cumulative across scrapes
+        import re
+        m1 = re.search(
+            r'scrape_errors_total\{daemon="osd\.99"\} (\d+)', body)
+        m2 = re.search(
+            r'scrape_errors_total\{daemon="osd\.99"\} (\d+)', body2)
+        assert int(m2.group(1)) > int(m1.group(1))
+    finally:
+        cct.shutdown()
