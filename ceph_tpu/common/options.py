@@ -113,6 +113,14 @@ register_options([
     Option("osd_scrub_auto_repair", bool, False,
            "repair inconsistencies found by background scrub "
            "(reference osd_scrub_auto_repair)"),
+    Option("osd_pg_stat_interval", float, 0.5,
+           "seconds between MPGStats reports to the mon (degraded/"
+           "misplaced/unfound counts + pending split/merge pushes; "
+           "reference mgr stats period).  Capped well below the "
+           "mon's 10s report-freshness window (PG_STAT_FRESH) — a "
+           "report that expires before its renewal would make the "
+           "ok-to-stop/safe-to-destroy/merge gates flap EAGAIN",
+           min=0.05, max=5.0),
     # op tracking (reference TrackedOp/OpTracker options)
     Option("osd_enable_op_tracker", bool, True,
            "track per-op event timelines (reference "

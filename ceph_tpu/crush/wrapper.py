@@ -43,6 +43,35 @@ class CrushWrapper:
         # parent weight = sum of children
         rb.weights[rb.items.index(hb.id)] = hb.weight
 
+    def remove_osd(self, osd_id: int) -> None:
+        """Remove a device and its bucket membership (reference
+        CrushWrapper::remove_item): the device row goes away and every
+        bucket drops it, with parent weights re-summed so straw2 draws
+        stop landing on the hole."""
+        self.map.devices.pop(osd_id, None)
+        for b in self.map.buckets.values():
+            if osd_id in b.items:
+                i = b.items.index(osd_id)
+                del b.items[i]
+                del b.weights[i]
+        # re-sum interior bucket weights to a fixpoint: dict order is
+        # insertion order (parents usually precede children), so one
+        # pass could copy a stale child weight in a >=3-level
+        # hierarchy — iterate until no entry changes (bounded by the
+        # hierarchy depth)
+        for _ in range(len(self.map.buckets) + 1):
+            changed = False
+            for b in self.map.buckets.values():
+                for i, item in enumerate(b.items):
+                    if item < 0:
+                        child = self.map.buckets.get(item)
+                        if child is not None and \
+                                b.weights[i] != child.weight:
+                            b.weights[i] = child.weight
+                            changed = True
+            if not changed:
+                break
+
     # -- rules --------------------------------------------------------------
 
     def add_simple_rule(self, name: str, root: str, failure_domain: str,

@@ -143,16 +143,17 @@ class PgAutoscalerModule(MgrModule):
 
     Pools default to advisory mode (a health warning when far off).
     A pool with pg_autoscale_mode=on (`ceph osd pool set <pool>
-    pg_autoscale_mode on`) gets real `osd pool set pg_num` commands:
-    the mon commits the increase through Paxos and the OSDs split the
-    PGs live.  Growth only (PG merge is unsupported), stepped at most
-    `max_step`x per tick so one tick never floods the cluster with
-    every split at once."""
+    pg_autoscale_mode on`) gets real `osd pool set pg_num` commands
+    in BOTH directions: the mon commits the change through Paxos and
+    the OSDs split or merge the PGs live.  Stepped at most `max_step`x
+    per tick so one tick never floods the cluster with every split or
+    merge at once; a decrease the mon refuses (split still settling —
+    the interleave guard) simply retries on a later tick."""
 
     name = "pg_autoscaler"
     run_interval = 2.0
     target_pgs_per_osd = 32
-    max_step = 4           # per-tick growth factor cap (power of two)
+    max_step = 4           # per-tick resize factor cap (power of two)
 
     def recommendations(self) -> dict[str, int]:
         m = self.get_osdmap()
@@ -171,14 +172,27 @@ class PgAutoscalerModule(MgrModule):
         for p in m.pools.values():
             want = recs.get(p.name, p.pg_num)
             mode = getattr(p, "pg_autoscale_mode", "warn")
-            if mode == "on" and want > p.pg_num and p.pg_num and \
+            if mode == "on" and want != p.pg_num and p.pg_num and \
                     p.pg_num & (p.pg_num - 1) == 0:
-                target = min(want, p.pg_num * self.max_step)
-                r, _out = self.mon_command({
-                    "prefix": "osd pool set", "pool": p.name,
-                    "var": "pg_num", "val": str(target)})
-                if r == 0:
-                    continue   # acted; re-evaluate next tick
+                if want > p.pg_num:
+                    target = min(want, p.pg_num * self.max_step)
+                elif want * 4 <= p.pg_num:
+                    # scale DOWN too (PG merge): capped step, and only
+                    # past a 4x hysteresis band — a transiently-down
+                    # OSD shrinking the recommendation must not
+                    # trigger merge/split thrash.  The mon rejects
+                    # with EBUSY while a split is settling (interleave
+                    # guard) — retry next tick.
+                    target = max(want, max(1,
+                                           p.pg_num // self.max_step))
+                else:
+                    target = p.pg_num   # inside the band: leave it
+                if target != p.pg_num:
+                    r, _out = self.mon_command({
+                        "prefix": "osd pool set", "pool": p.name,
+                        "var": "pg_num", "val": str(target)})
+                    if r == 0:
+                        continue   # acted; re-evaluate next tick
             if want >= 4 * p.pg_num or p.pg_num >= 4 * want:
                 warns.append(
                     f"pool {p.name!r} pg_num {p.pg_num} far from "

@@ -54,15 +54,23 @@ DEFAULT_EC_PROFILE = {"plugin": "jax", "k": "2", "m": "1",
 READONLY_COMMANDS = {
     "osd erasure-code-profile get", "osd erasure-code-profile ls",
     "osd pool ls", "osd pool get", "status", "osd tree", "mon stat",
-    "config get", "config dump", "health",
+    "config get", "config dump", "health", "pg stat",
+    "osd ok-to-stop", "osd safe-to-destroy",
     "fs ls", "fs dump", "mgr dump",
 }
 
 # read-only for caps purposes but answerable only by the leader: the
-# payload is leader-local transient state (slow_op_reports is not
-# paxos-committed), so a peon serving it locally would report
-# HEALTH_OK while the cluster has blocked ops
-LEADER_ONLY_READS = {"health"}
+# payload is leader-local transient state (slow_op_reports and
+# pg_stat_reports are not paxos-committed), so a peon serving them
+# locally would report HEALTH_OK / safe while the cluster has blocked
+# ops or degraded data
+LEADER_ONLY_READS = {"health", "pg stat",
+                     "osd ok-to-stop", "osd safe-to-destroy"}
+
+# how long an OSD's MPGStats report stays authoritative; the OSD
+# re-sends every osd_pg_stat_interval (default 0.5s), so 10s of
+# silence means the daemon is gone, not healthy
+PG_STAT_FRESH = 10.0
 
 FWD_TID_BASE = 1 << 40
 
@@ -82,6 +90,18 @@ class Monitor:
         # paxos-committed: OSDs re-report while the condition holds
         # and the check expires when reports stop (see _cmd_health).
         self.slow_op_reports: dict[int, dict] = {}
+        # per-OSD PG-state reports (MPGStats): degraded/misplaced/
+        # unfound counts + pending split/merge push targets.  Feeds
+        # `pg stat`, the PG_DEGRADED health check, the pg_num-decrease
+        # interleave guard, and `osd safe-to-destroy`.  Same transient
+        # leader-side lifecycle as slow_op_reports.
+        self.pg_stat_reports: dict[int, dict] = {}
+        # OSDs being drained (osd drain): weight walks down by `step`
+        # per maintenance tick until 0, each step a committed epoch so
+        # CRUSH gradually backfills the OSD out instead of one storm.
+        # Leader-local: a failover pauses an unfinished walk until the
+        # operator re-issues `osd drain` (documented).
+        self._draining: dict[int, float] = {}
         self._subscribers: list = []
         self.auth = auth       # auth.CephxAuth with keyring (AuthMonitor)
         # PaxosService state beyond the OSDMap (reference AuthMonitor /
@@ -225,6 +245,7 @@ class Monitor:
                         self._on_quorum_loss()
                     else:
                         self.paxos.grant_lease()
+                        self._drain_tick()
                 elif not self.election.electing and \
                         not self.election.recently_deferred() and \
                         len(self.mon_addrs) > 1 and \
@@ -310,7 +331,7 @@ class Monitor:
         # (reference MonCap service caps on mon/osd messages)
         if kind is not None and kind != "service" and isinstance(
                 msg, (M.MMonPaxos, M.MOSDBoot, M.MOSDFailure,
-                      M.MOSDSlowOpReport)):
+                      M.MOSDSlowOpReport, M.MPGStats)):
             return
         if isinstance(msg, M.MMonPaxos):
             # paxos peers must be monitors, not arbitrary daemons
@@ -351,6 +372,11 @@ class Monitor:
         elif isinstance(msg, M.MOSDSlowOpReport):
             if self.is_leader:
                 self._handle_slow_op_report(msg)
+            else:
+                self._forward(msg)
+        elif isinstance(msg, M.MPGStats):
+            if self.is_leader:
+                self._handle_pg_stats(msg)
             else:
                 self._forward(msg)
         elif isinstance(msg, M.MAuth):
@@ -477,6 +503,59 @@ class Monitor:
             else:
                 self.slow_op_reports.pop(msg.osd_id, None)
 
+    def _handle_pg_stats(self, msg: M.MPGStats) -> None:
+        """An OSD's periodic PG-state summary (reference MPGStats via
+        the mgr, reduced to the mon directly)."""
+        with self.lock:
+            self.pg_stat_reports[msg.osd_id] = {
+                **msg.report, "ts": time.time()}
+
+    def _fresh_pg_stats(self) -> dict[int, dict]:
+        """Reports younger than PG_STAT_FRESH; stale ones are pruned
+        (a dead OSD must not pin degraded counts — its PGs' state is
+        re-reported by the primaries that take over)."""
+        now = time.time()
+        with self.lock:
+            for osd in [o for o, r in self.pg_stat_reports.items()
+                        if now - r["ts"] > PG_STAT_FRESH]:
+                del self.pg_stat_reports[osd]
+            return {o: dict(r) for o, r in self.pg_stat_reports.items()}
+
+    def _complete_pg_stats(self) -> tuple[dict[int, dict], list[int]]:
+        """(fresh stats, up OSDs with NO fresh report).  Safety gates
+        (ok-to-stop, safe-to-destroy, the interleave guard) need a
+        COMPLETE cluster view: right after a leader failover the new
+        leader's report table starts empty, and judging from a partial
+        view would read silence as health."""
+        stats = self._fresh_pg_stats()
+        with self.lock:
+            missing = sorted(o.id for o in self.osdmap.osds.values()
+                             if o.up and o.id not in stats)
+        return stats, missing
+
+    def _drain_tick(self) -> None:
+        """Leader maintenance: walk each draining OSD's weight toward
+        0, one step per tick, each a committed map epoch — CRUSH
+        remaps a slice of PGs per step and the existing recovery
+        machinery backfills them out (reference: gradual `osd
+        reweight` walks in ceph-volume/drain tooling)."""
+        with self.lock:
+            todo = [(o, s) for o, s in self._draining.items()]
+            if not todo:
+                return
+            changed = False
+            for osd_id, step in todo:
+                info = self.osdmap.osds.get(osd_id)
+                if info is None or info.weight <= 0.0:
+                    del self._draining[osd_id]
+                    continue
+                self.osdmap.set_osd_weight(
+                    osd_id, max(0.0, round(info.weight - step, 6)))
+                changed = True
+            if changed:
+                self.osdmap.bump_epoch()
+                self._propose_current()
+
     # -- admin commands (reference OSDMonitor command surface) --------------
 
     def handle_command(self, cmd: dict) -> tuple[int, dict]:
@@ -515,6 +594,29 @@ class Monitor:
                     self.osdmap.bump_epoch()
                     self._propose_current()
                 return 0, {"in": osd_id}
+            if prefix == "osd reweight":
+                osd_id = int(cmd["id"])
+                weight = float(cmd["weight"])
+                with self.lock:
+                    if osd_id not in self.osdmap.osds:
+                        return -errno.ENOENT, {"error": f"no osd.{osd_id}"}
+                    try:
+                        self.osdmap.set_osd_weight(osd_id, weight)
+                    except ValueError as e:
+                        return -errno.EINVAL, {"error": str(e)}
+                    self.osdmap.bump_epoch()
+                    self._propose_current()
+                return 0, {"osd": osd_id, "weight": weight}
+            if prefix == "osd drain":
+                return self._cmd_osd_drain(cmd)
+            if prefix == "osd ok-to-stop":
+                return self._cmd_ok_to_stop(cmd)
+            if prefix == "osd safe-to-destroy":
+                return self._cmd_safe_to_destroy(cmd)
+            if prefix == "osd rm":
+                return self._cmd_osd_rm(cmd)
+            if prefix == "pg stat":
+                return self._cmd_pg_stat()
             if prefix == "osd blacklist add":
                 entity = str(cmd["entity"])
                 ttl = float(cmd.get("expire", 3600.0))
@@ -829,6 +931,216 @@ class Monitor:
                 "role": "active" if self.mgrmap["active"] == name
                 else "standby"}
 
+    # -- drain / decommission (reference OSDMonitor `osd ok-to-stop`
+    #    :3870, `osd safe-to-destroy` :3760, `osd rm`) ----------------------
+
+    def _cmd_osd_drain(self, cmd: dict) -> tuple[int, dict]:
+        """Begin a graceful drain: walk the OSD's reweight down to 0
+        in `step` increments, one committed epoch per maintenance
+        tick, so backfill-out proceeds in slices instead of one
+        recovery storm.  `osd safe-to-destroy` turning safe is the
+        completion signal; `osd rm` finishes the decommission."""
+        osd_id = int(cmd["id"])
+        step = float(cmd.get("step", 0.25))
+        if not 0.0 < step <= 1.0:
+            return -errno.EINVAL, {
+                "error": f"drain step {step} not in (0, 1]"}
+        with self.lock:
+            info = self.osdmap.osds.get(osd_id)
+            if info is None:
+                return -errno.ENOENT, {"error": f"no osd.{osd_id}"}
+            self._draining[osd_id] = step
+        return 0, {"draining": osd_id, "step": step,
+                   "weight": info.weight}
+
+    def _stop_would_break(self, osd_ids: set[int]) -> list[str]:
+        """PGs that would drop below min_size if osd_ids all stopped
+        (reference OSDMonitor::check_pg_num / ok-to-stop logic)."""
+        from ..crush.map import CRUSH_ITEM_NONE
+        blocked: list[str] = []
+        for pool in self.osdmap.pools.values():
+            for seed in range(pool.pg_num):
+                pgid = pg_t(pool.id, seed)
+                try:
+                    _, acting, _, _ = \
+                        self.osdmap.pg_to_up_acting_osds(pgid)
+                except Exception:  # noqa: BLE001 - unmapped pg
+                    continue
+                live = [o for o in acting if o != CRUSH_ITEM_NONE and
+                        self.osdmap.is_up(o)]
+                if not any(o in osd_ids for o in live):
+                    continue
+                remain = sum(1 for o in live if o not in osd_ids)
+                if remain < pool.min_size:
+                    blocked.append(str(pgid))
+        return blocked
+
+    def _cmd_ok_to_stop(self, cmd: dict) -> tuple[int, dict]:
+        """Would stopping these OSDs leave every PG at or above
+        min_size, with no unfound-adjacent data at risk?  Refusal
+        names the blocking PGs (reference `osd ok-to-stop`)."""
+        ids = {int(i) for i in
+               (cmd["ids"] if "ids" in cmd else [cmd["id"]])}
+        with self.lock:
+            unknown = [i for i in ids if i not in self.osdmap.osds]
+            if unknown:
+                return -errno.ENOENT, {"error": f"no osd {unknown}"}
+            blocked = self._stop_would_break(ids)
+        if blocked:
+            return -errno.EBUSY, {
+                "ok_to_stop": False,
+                "blocked_by": blocked[:16],
+                "error": f"{len(blocked)} pgs would drop below "
+                         f"min_size"}
+        # unfound-adjacent guard: while ANY object is unfound, a
+        # not-yet-consulted holder may be the last copy — refuse to
+        # shrink the holder set further (conservative superset of the
+        # reference's per-pg missing_loc check).  Incomplete stats =
+        # we CANNOT rule unfound out (fresh leader, first interval
+        # after boot) — refuse rather than treat silence as health.
+        stats, unreported = self._complete_pg_stats()
+        if unreported:
+            return -errno.EAGAIN, {
+                "ok_to_stop": False,
+                "error": f"no fresh pg stats from up osds "
+                         f"{unreported}; cannot verify no unfound "
+                         f"objects"}
+        unfound = sum(r.get("unfound", 0) for r in stats.values())
+        if unfound:
+            return -errno.EBUSY, {
+                "ok_to_stop": False,
+                "error": f"{unfound} objects unfound; stopping more "
+                         f"osds could destroy the last copy"}
+        return 0, {"ok_to_stop": True}
+
+    def _cmd_safe_to_destroy(self, cmd: dict) -> tuple[int, dict]:
+        """May this OSD's data be destroyed without risk?  Safe iff no
+        PG maps to it under the current map AND fresh pg stats show
+        the cluster fully recovered (no degraded/misplaced/unfound
+        objects anywhere — so nothing could still need this OSD as a
+        backfill source).  Reference `osd safe-to-destroy`."""
+        from ..crush.map import CRUSH_ITEM_NONE
+        osd_id = int(cmd["id"])
+        with self.lock:
+            if osd_id not in self.osdmap.osds:
+                return -errno.ENOENT, {"error": f"no osd.{osd_id}"}
+            mapped = []
+            for pool in self.osdmap.pools.values():
+                for seed in range(pool.pg_num):
+                    pgid = pg_t(pool.id, seed)
+                    try:
+                        up, acting, _, _ = \
+                            self.osdmap.pg_to_up_acting_osds(pgid)
+                    except Exception:  # noqa: BLE001
+                        continue
+                    if osd_id in up or osd_id in acting:
+                        mapped.append(str(pgid))
+        if mapped:
+            return -errno.EBUSY, {
+                "safe": False, "pgs": mapped[:16],
+                "error": f"osd.{osd_id} still maps {len(mapped)} pgs "
+                         f"(drain not finished)"}
+        stats, unreported = self._complete_pg_stats()
+        if unreported:
+            return -errno.EAGAIN, {
+                "safe": False,
+                "error": f"no fresh pg stats from up osds "
+                         f"{unreported}; cannot verify recovery"}
+        if not stats:
+            return -errno.EAGAIN, {
+                "safe": False,
+                "error": "no fresh pg stats; cannot verify recovery"}
+        deg = sum(r.get("degraded_pgs", 0) for r in stats.values())
+        mis = sum(r.get("misplaced", 0) for r in stats.values())
+        unf = sum(r.get("unfound", 0) for r in stats.values())
+        rec = sum(r.get("recovering", 0) for r in stats.values())
+        if deg or mis or unf or rec:
+            # `rec` closes a window: a recovery pass mid-pull hasn't
+            # failed yet (so nothing is marked degraded), but this OSD
+            # may be the very source it is pulling from
+            return -errno.EBUSY, {
+                "safe": False,
+                "error": f"cluster not fully recovered "
+                         f"({deg} degraded pgs, {mis} misplaced, "
+                         f"{unf} unfound objects, {rec} recovery "
+                         f"passes running)"}
+        return 0, {"safe": True}
+
+    def _cmd_osd_rm(self, cmd: dict) -> tuple[int, dict]:
+        """Remove an OSD from the map.  Guarded: the daemon must be
+        stopped (an up OSD would simply re-register on its next boot
+        message) and `safe-to-destroy` must pass, unless force=true
+        (the operator accepting data loss, reference --force)."""
+        osd_id = int(cmd["id"])
+        with self.lock:
+            info = self.osdmap.osds.get(osd_id)
+            if info is None:
+                return -errno.ENOENT, {"error": f"no osd.{osd_id}"}
+            if info.up:
+                return -errno.EBUSY, {
+                    "error": f"osd.{osd_id} is up; stop it first "
+                             f"(osd ok-to-stop, then kill)"}
+        if not cmd.get("force"):
+            r, out = self._cmd_safe_to_destroy({"id": osd_id})
+            if r != 0:
+                return r, {**out,
+                           "error": f"not safe to destroy: "
+                                    f"{out.get('error')}"}
+        with self.lock:
+            # re-check under the lock: the OSD may have booted (a
+            # concurrent MOSDBoot dispatch) since the guard above —
+            # removing a live daemon from the map would leave it
+            # serving while unmapped
+            info = self.osdmap.osds.get(osd_id)
+            if info is None:
+                return -errno.ENOENT, {"error": f"no osd.{osd_id}"}
+            if info.up:
+                return -errno.EBUSY, {
+                    "error": f"osd.{osd_id} came up mid-removal; "
+                             f"stop it first"}
+            self._draining.pop(osd_id, None)
+            self.pg_stat_reports.pop(osd_id, None)
+            self.slow_op_reports.pop(osd_id, None)
+            self._failure_reports.pop(osd_id, None)
+            self.osdmap.remove_osd(osd_id)
+            self.osdmap.bump_epoch()
+            self._propose_current()
+        return 0, {"removed": osd_id, "epoch": self.osdmap.epoch}
+
+    def _cmd_pg_stat(self) -> tuple[int, dict]:
+        """Aggregate the OSDs' MPGStats reports (reference `ceph pg
+        stat`): drain/merge/recovery progress as counts instead of
+        quiescence polling."""
+        stats = self._fresh_pg_stats()
+        pools: dict[str, dict] = {}
+        for rep in stats.values():
+            for pid, p in rep.get("pools", {}).items():
+                agg = pools.setdefault(pid, {
+                    "degraded_pgs": 0, "misplaced": 0, "unfound": 0,
+                    "push_seeds": []})
+                agg["degraded_pgs"] += p.get("degraded_pgs", 0)
+                agg["misplaced"] += p.get("misplaced", 0)
+                agg["unfound"] += p.get("unfound", 0)
+                agg["push_seeds"] = sorted(
+                    set(agg["push_seeds"]) |
+                    set(p.get("push_seeds", [])))
+        with self.lock:
+            num_pgs = sum(p.pg_num for p in self.osdmap.pools.values())
+        return 0, {
+            "num_pgs": num_pgs,
+            "osds_reporting": len(stats),
+            "degraded_pgs": sum(r.get("degraded_pgs", 0)
+                                for r in stats.values()),
+            "misplaced_objects": sum(r.get("misplaced", 0)
+                                     for r in stats.values()),
+            "unfound_objects": sum(r.get("unfound", 0)
+                                   for r in stats.values()),
+            "recovering_osds": sorted(
+                o for o, r in stats.items()
+                if r.get("degraded_pgs") or r.get("misplaced")),
+            "pools": pools,
+        }
+
     def _cmd_profile_set(self, cmd: dict) -> tuple[int, dict]:
         """Validate + normalize via the plugin itself (reference
         normalize_profile, OSDMonitor.cc:7190)."""
@@ -900,11 +1212,14 @@ class Monitor:
     #    prepare_command "osd pool set ... pg_num") ------------------------
 
     def _cmd_pool_set(self, cmd: dict) -> tuple[int, dict]:
-        """`osd pool set <pool> <var> <val>`.  pg_num is the PG-split
-        trigger: validated here (growth only, power-of-two stepping),
-        committed through Paxos as a map epoch every subscriber applies
-        — OSDs split their local collections on receipt, clients
-        retarget by the new pg_num."""
+        """`osd pool set <pool> <var> <val>`.  pg_num is the PG
+        split/merge trigger: validated here (power-of-two stepping in
+        both directions, >= 1; a decrease is additionally gated on no
+        target child still mid-split), committed through Paxos as a
+        map epoch every subscriber applies — OSDs split or fold their
+        local collections on receipt, clients retarget by the new
+        pg_num (reference OSDMonitor pg_num change; decrease landed
+        in Nautilus)."""
         name = cmd["pool"]
         var = cmd["var"]
         val = cmd["val"]
@@ -931,22 +1246,56 @@ class Monitor:
             if n == pool.pg_num:
                 return 0, {"pool": name, "pg_num": n,
                            "epoch": self.osdmap.epoch}
+            # structural validation FIRST (shared with the mutator —
+            # one source of truth for the error strings): an invalid
+            # value must answer EINVAL, never bounce off the
+            # cluster-state guard below with EAGAIN/EBUSY
+            from ..osd.osd_map import validate_pg_num_step
+            try:
+                validate_pg_num_step(pool.pg_num, n)
+            except ValueError as e:
+                return -errno.EINVAL, {"error": str(e)}
             if n < pool.pg_num:
-                return -errno.EINVAL, {
-                    "error": f"pg_num {n} < {pool.pg_num}: PGs grow "
-                             f"monotonically (merge unsupported)"}
-            if n & (n - 1) or pool.pg_num & (pool.pg_num - 1):
-                # the ps-bits rehash rule (child = hash mod new_pg_num)
-                # assigns each parent's objects exactly to {parent +
-                # i*old_pg_num} only when both counts are powers of two
-                return -errno.EINVAL, {
-                    "error": f"pg_num must step between powers of two "
-                             f"({pool.pg_num} -> {n})"}
-            self.osdmap.set_pool_pg_num(pool.id, n)
+                # split/merge interleave guard: while any PG of the
+                # pool still has split pushes in flight (objects
+                # mid-move between collections), folding children
+                # away could strand data on a holder whose sweep
+                # lags.  Retry once the split settles.  An INCOMPLETE
+                # stats view (fresh leader, report gap) cannot rule
+                # pending pushes out — refuse rather than read
+                # silence as settled, like ok-to-stop/safe-to-destroy.
+                stats, unreported = self._complete_pg_stats()
+                if unreported:
+                    return -errno.EAGAIN, {
+                        "error": f"no fresh pg stats from up osds "
+                                 f"{unreported}; cannot verify the "
+                                 f"pool is not mid-split — retry"}
+                busy = self._pool_push_pending(pool.id, stats)
+                if busy:
+                    return -errno.EBUSY, {
+                        "error": f"pool {name} still splitting: pgs "
+                                 f"{busy[:8]} have pushes pending; "
+                                 f"retry after the split settles"}
+            try:
+                self.osdmap.set_pool_pg_num(pool.id, n)
+            except ValueError as e:
+                return -errno.EINVAL, {"error": str(e)}
             self.osdmap.bump_epoch()
             self._propose_current()
             return 0, {"pool": name, "pg_num": n,
                        "epoch": self.osdmap.epoch}
+
+    def _pool_push_pending(self, pool_id: int,
+                           stats: dict[int, dict]) -> list[int]:
+        """Seeds of this pool's PGs that fresh OSD stats show with
+        split/merge pushes still pending (the interleave-guard
+        signal)."""
+        seeds: set[int] = set()
+        for rep in stats.values():
+            p = rep.get("pools", {}).get(str(pool_id))
+            if p:
+                seeds |= set(p.get("push_seeds", []))
+        return sorted(seeds)
 
     def _cmd_pool_get(self, cmd: dict) -> tuple[int, dict]:
         name = cmd["pool"]
@@ -1010,6 +1359,31 @@ class Monitor:
                         f"{op.get('trace_id')}"
                         for op in r.get("ops", []))
                     for o, r in sorted(reports.items())],
+            }
+        # PG_DEGRADED: redundancy below target somewhere (reference
+        # PG_DEGRADED/PG_DEGRADED_FULL health checks) — drain/merge/
+        # recovery progress is observable here instead of inferred
+        # from quiescence polling
+        pg_stats = self._fresh_pg_stats()
+        deg = sum(r.get("degraded_pgs", 0) for r in pg_stats.values())
+        mis = sum(r.get("misplaced", 0) for r in pg_stats.values())
+        unf = sum(r.get("unfound", 0) for r in pg_stats.values())
+        if deg or mis or unf:
+            affected = [
+                (o, r) for o, r in sorted(pg_stats.items())
+                if r.get("degraded_pgs") or r.get("misplaced") or
+                r.get("unfound")]
+            checks["PG_DEGRADED"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{deg} pgs degraded, {mis} objects "
+                           f"misplaced, {unf} objects unfound "
+                           f"(reported by "
+                           f"[{', '.join(f'osd.{o}' for o, _r in affected)}])",
+                "detail": [
+                    f"osd.{o}: {r.get('degraded_pgs', 0)} degraded "
+                    f"pgs, {r.get('misplaced', 0)} misplaced, "
+                    f"{r.get('unfound', 0)} unfound"
+                    for o, r in affected],
             }
         status = "HEALTH_WARN" if checks else "HEALTH_OK"
         return 0, {"status": status, "checks": checks}

@@ -492,6 +492,31 @@ class MOSDSlowOpReport(Message):
 
 
 @register_message
+class MPGStats(Message):
+    """OSD -> mon PG-state summary (reference MPGStats via the mgr):
+    per-pool degraded/misplaced/unfound object and PG counts plus the
+    seeds of PGs with split/merge pushes still pending.  Feeds the
+    mon's `pg stat` command, the PG_DEGRADED health check, and the
+    split/merge interleave guard on pg_num decreases.  Transient
+    leader-side state like slow-op reports: re-sent every stats tick,
+    expired by staleness."""
+
+    type_id = 74
+
+    def __init__(self, osd_id: int = -1, report: dict | None = None):
+        super().__init__()
+        self.osd_id = osd_id
+        self.report = report or {}
+
+    def to_meta(self):
+        return {"osd": self.osd_id, "report": self.report}
+
+    def decode_wire(self, meta, data):
+        self.osd_id = meta["osd"]
+        self.report = meta.get("report", {})
+
+
+@register_message
 class MMonCommand(Message):
     """Admin command (reference MMonCommand.h; `ceph` CLI JSON dispatch)."""
 

@@ -397,6 +397,10 @@ class OSDDaemon:
             .add_u64_counter("subop_w", "shard sub-writes applied")
             .add_u64_counter("subop_r", "shard sub-reads served")
             .add_time_avg("op_latency", "client op latency")
+            .add_gauge("pg_degraded", "led PGs with recovery pending")
+            .add_gauge("pg_misplaced",
+                       "objects with split/merge pushes pending")
+            .add_gauge("pg_unfound", "objects latched unfound")
             .create_perf_counters())
         # request tracing (reference TrackedOp/OpTracker, docs/
         # TRACING.md): always-on per-op event timelines + per-stage
@@ -493,6 +497,11 @@ class OSDDaemon:
         # pushes and retries until each lands.
         self._split_push_pending: set[tuple[spg_t, hobject_t]] = set()
         self._split_pusher_armed = False
+        # PG merge state is deliberately NOT in-memory: dying merge
+        # children are derived from the committed map itself
+        # (pool.pg_num <= seed < pool.pg_num_max — see _is_dying_pg /
+        # _merge_source_pgs), so an OSD that was down across the
+        # shrink routes, folds, and recovers identically after revive.
         self.raw_read_waiters: dict = {}
         # shard-resident replicated PG logs (reference: pglog omap keys
         # in the pg meta collection) + peering RPC plumbing
@@ -582,6 +591,11 @@ class OSDDaemon:
         threading.Thread(
             target=self._optrack_loop, daemon=True,
             name=f"osd.{self.osd_id}.optrack").start()
+        # pg stats: the mon-side `pg stat` / PG_DEGRADED / interleave
+        # guard all read these periodic reports
+        threading.Thread(
+            target=self._pgstats_loop, daemon=True,
+            name=f"osd.{self.osd_id}.pgstats").start()
 
     def shutdown(self) -> None:
         self._hb_stop.set()
@@ -731,40 +745,62 @@ class OSDDaemon:
                              self.prev_osdmap.is_up(oid_)):
                 self._hb_last_seen.pop(oid_, None)
                 self._hb_first_ping.pop(oid_, None)
-        # PG split detection: pools whose pg_num grew.  Record the
-        # ps-bits ancestry BEFORE adopting the map so concurrent
-        # reads/stats that miss in a child collection can already fall
-        # back to the parent while the sweep runs.
+        # PG split/merge detection: pools whose pg_num changed.
+        # Record the ps-bits ancestry BEFORE adopting the map so
+        # concurrent reads/stats that miss in a child (split) or
+        # parent (merge) collection can already fall back while the
+        # sweep runs.
         grown: list[tuple[int, int, int]] = []
+        shrunk: list[tuple[int, int, int]] = []
         if self.prev_osdmap is not None:
             for pid, pool in newmap.pools.items():
                 old = self.prev_osdmap.pools.get(pid)
-                if old is not None and pool.pg_num > old.pg_num:
+                if old is None:
+                    continue
+                if pool.pg_num > old.pg_num:
                     grown.append((pid, old.pg_num, pool.pg_num))
                     for c in range(old.pg_num, pool.pg_num):
                         self._split_ancestry[pg_t(pid, c)] = \
                             pg_t(pid, c % old.pg_num)
+                elif pool.pg_num < old.pg_num:
+                    shrunk.append((pid, old.pg_num, pool.pg_num))
         else:
-            # first map after (re)boot: a split may have committed
-            # while this OSD was down — its collections would still
-            # hold pre-split placement.  Rehash every pool's local
-            # collections (no-op when nothing is misplaced; one
-            # boot-time hash per local object.  A persisted per-pool
-            # pg_num marker could skip this entirely — future work if
-            # boot time on large persistent stores ever matters).
+            # first map after (re)boot: a split OR merge may have
+            # committed while this OSD was down — its collections
+            # would still hold pre-resize placement.  Rehash every
+            # pool's local collections and fold any stale
+            # beyond-pg_num child collections (no-op when nothing is
+            # misplaced; one boot-time hash per local object.  A
+            # persisted per-pool pg_num marker could skip this
+            # entirely — future work if boot time on large persistent
+            # stores ever matters).
             grown = [(pid, pool.pg_num, pool.pg_num)
                      for pid, pool in newmap.pools.items()]
+            shrunk = [(pid, pool.pg_num, pool.pg_num)
+                      for pid, pool in newmap.pools.items()]
         self.osdmap = newmap
         # refresh acting sets of cached backends; an interval change
         # (acting set differs) forces re-peering before the next op
         # (reference PeeringState start_peering_interval)
-        grown_pools = {pid for pid, _o, _n in grown}
+        resized_pools = {pid for pid, _o, _n in grown} | \
+            {pid for pid, old_n, new_n in shrunk if old_n != new_n}
         with self.pg_lock:
+            # dying merge children stop existing: their recovery /
+            # unfound bookkeeping must not wedge quiescence
+            for pid, old_n, new_n in shrunk:
+                if old_n == new_n:
+                    continue
+                self._pgs_needing_recovery = {
+                    p for p in self._pgs_needing_recovery
+                    if not (p.pool == pid and p.seed >= new_n)}
+                for p in [p for p in self._unfound
+                          if p.pool == pid and p.seed >= new_n]:
+                    self._unfound.pop(p, None)
             for pgid, state in list(self.pgs.items()):
-                if pgid.pool in grown_pools:
-                    # the split is a new interval for every PG of the
-                    # pool: parents shed objects, children are born —
-                    # rebuild (and re-peer) on next use
+                if pgid.pool in resized_pools:
+                    # a resize is a new interval for every PG of the
+                    # pool: parents change content, children are born
+                    # or die — rebuild (and re-peer) on next use
                     self.pgs.pop(pgid, None)
                     continue
                 up, acting, _, primary = newmap.pg_to_up_acting_osds(pgid)
@@ -802,6 +838,39 @@ class OSDDaemon:
                 # recovery retries converge the leftovers
                 import traceback
                 traceback.print_exc()
+        # fold dying merge children into their parents, likewise
+        # before recovery (the parent primary's pass must see folded
+        # objects locally; remote stragglers come via child scans)
+        for pid, old_n, new_n in shrunk:
+            # boot-time rehash folds silently; a live shrink is a
+            # tracked op (docs/TRACING.md `merge` stages)
+            top = self.op_tracker.create(
+                "merge", f"pool={pid} {old_n}->{new_n}") \
+                if old_n != new_n else NULL_TRACKED
+            try:
+                self._merge_pool_collections(pid, new_n)
+                top.mark_event("merge_done")
+            except Exception:  # noqa: BLE001 - same containment as
+                top.mark_event("failed")        # the split sweep
+                import traceback
+                traceback.print_exc()
+            finally:
+                self.op_tracker.unregister(top)
+            if old_n == new_n:
+                continue      # boot-time rehash, not a live shrink
+            # every surviving parent this OSD leads re-runs the wide
+            # recovery scan: lagging holders' child collections may
+            # still hold acked data the fold hasn't delivered
+            with self.pg_lock:
+                for seed in range(new_n):
+                    pgid = pg_t(pid, seed)
+                    try:
+                        _, _, _, primary = \
+                            newmap.pg_to_up_acting_osds(pgid)
+                    except Exception:  # noqa: BLE001
+                        continue
+                    if primary == self.osd_id:
+                        self._pgs_needing_recovery.add(pgid)
         self.map_event.set()
         if self.recovery_enabled and newmap.pools and \
                 newmap.epoch not in self._recovered_epochs:
@@ -843,7 +912,12 @@ class OSDDaemon:
         # recovers normally, and full-scan retry passes against dead
         # peers starve live traffic mid-thrash.  One pending retry at
         # a time, 5s apart.
-        if not self._hb_stop.is_set() and epoch == self.osdmap.epoch \
+        # Armed on CURRENT state, not `epoch == self.osdmap.epoch`: a
+        # pass for a stale epoch can be the LAST one to touch the
+        # needing set (a newer epoch's pass may already have finished
+        # while this one was mid-scan), and skipping the arm then
+        # strands the set until an unrelated map change.
+        if not self._hb_stop.is_set() and self._pgs_needing_recovery \
                 and self._retry_could_help():
             with self.pg_lock:
                 if self._split_retry_pending:
@@ -882,6 +956,27 @@ class OSDDaemon:
     def _recover_epoch_inner(self, epoch: int, prevmap=None) -> None:
         import numpy as np
         from ..store.object_store import Transaction
+        # prune needing-recovery/unfound entries for PGs the map no
+        # longer has (pool deleted, or a merge folded the child away)
+        # or that another OSD now leads (recovery passes only process
+        # led PGs, so a non-led entry can never clear) — a stale
+        # entry would wedge quiescence forever
+        def still_ours(p: pg_t) -> bool:
+            pool = self.osdmap.pools.get(p.pool)
+            if pool is None or p.seed >= pool.pg_num:
+                return False
+            try:
+                _, _, _, primary = self.osdmap.pg_to_up_acting_osds(p)
+            except Exception:  # noqa: BLE001 - unmappable: keep
+                return True
+            return primary == self.osd_id or primary < 0
+        with self.pg_lock:
+            self._pgs_needing_recovery = {
+                p for p in self._pgs_needing_recovery if still_ours(p)}
+            for p in [p for p in self._unfound
+                      if p.pool not in self.osdmap.pools or
+                      p.seed >= self.osdmap.pools[p.pool].pg_num]:
+                self._unfound.pop(p, None)
         # peers that time out once in this pass are not probed again:
         # a dead-but-still-up OSD must not cost 3s per object/shard
         unreachable: set[int] = set()
@@ -907,12 +1002,24 @@ class OSDDaemon:
                     else:
                         with self._recovery_sem:
                             self._recover_replicated_pg(pgid, acting,
-                                                        prevmap)
+                                                        prevmap,
+                                                        unreachable)
                 except ErasureCodeError as e:
                     # peering-incomplete (EAGAIN) or similar on ONE PG
                     # must not kill the recovery pass for the rest —
-                    # but a later steady-state epoch must retry it
-                    self._pgs_needing_recovery.add(pgid)
+                    # but a later steady-state epoch must retry it.
+                    # Re-check leadership on the LIVE map first: if the
+                    # primary moved mid-pass ("not primary" EAGAIN),
+                    # adding the pg here would re-wedge the needing set
+                    # a newer epoch's pass already pruned — and with no
+                    # further epochs coming, quiescence never clears.
+                    try:
+                        _, _, _, cur_primary = \
+                            self.osdmap.pg_to_up_acting_osds(pgid)
+                    except Exception:  # noqa: BLE001
+                        cur_primary = self.osd_id
+                    if cur_primary == self.osd_id or cur_primary < 0:
+                        self._pgs_needing_recovery.add(pgid)
                     self.cct.dout("osd", 2,
                                   f"recovery of {pgid} deferred: {e}")
 
@@ -1160,11 +1267,13 @@ class OSDDaemon:
                     continue
                 for oj in self._remote_list(osd, spg, timeout=3.0):
                     names.add(M.hobj_from_json(oj))
-        # split child: objects may still sit in ANCESTOR collections on
-        # holders whose local sweep lags — list those too, keeping only
-        # names the ps-bits rule assigns to this child
-        ancestors = self._split_ancestors(pgid) if prev_acting is None \
-            else []
+        # split child / merge parent: objects may still sit in
+        # ANCESTOR collections (split) or dying-CHILD collections
+        # (merge) on holders whose local sweep lags — list those too,
+        # keeping only names the ps-bits rule assigns to this PG
+        ancestors = (self._split_ancestors(pgid) +
+                     self._merge_source_pgs(pgid)) \
+            if prev_acting is None else []
         names |= self._names_from_ancestors(pgid, ancestors,
                                             range(be.n), pool.pg_num,
                                             up_osds, unreachable)
@@ -1332,11 +1441,14 @@ class OSDDaemon:
 
     def _recover_replicated_pg(self, pgid: pg_t,
                                acting: list[int],
-                               prevmap=None) -> None:
+                               prevmap=None,
+                               unreachable: set | None = None) -> None:
         from ..store.object_store import Transaction
         pool = self.osdmap.pools.get(pgid.pool)
         prevmap = prevmap if prevmap is not None else self.prev_osdmap
+        unreachable = unreachable if unreachable is not None else set()
         fresh_child = False
+        prev_acting = None
         if prevmap is not None and pgid.pool in prevmap.pools:
             fresh_child = pgid.seed >= prevmap.pools[pgid.pool].pg_num
             try:
@@ -1348,27 +1460,47 @@ class OSDDaemon:
                         all(self.osdmap.is_up(o) for o in acting):
                     return   # steady state: nothing moved
             except Exception:  # noqa: BLE001
-                pass
+                prev_acting = None
         spg = spg_t(pgid, NO_SHARD)
-        names = self._pg_object_names(pgid, acting, [0])
+        names = self._pg_object_names(pgid, acting, [0],
+                                      unreachable=unreachable)
         # union over all replicas so a primary that lost data also heals
         for r, osd in enumerate(acting):
-            if osd != self.osd_id and self.osdmap.is_up(osd):
-                for oj in self._remote_list(osd, spg):
+            if osd != self.osd_id and self.osdmap.is_up(osd) and \
+                    osd not in unreachable:
+                for oj in self._remote_list(osd, spg,
+                                            unreachable=unreachable):
                     names.add(M.hobj_from_json(oj))
-        # split child: scan every up OSD's copy of this child plus the
-        # ancestor collections of not-yet-swept holders
+        # the PG moved: objects may live ONLY on old holders — a full
+        # remap (both replicas changed at once, e.g. a drain step)
+        # would otherwise strand them, since the new acting set lists
+        # nothing.  Ordinary interval changes list just the DEPARTED
+        # holders (the replicated analog of the EC shard_moved scan);
+        # a retry/fresh-child pass widens to every up OSD.  Listings
+        # share the pass's unreachable cache so a dead-but-marked-up
+        # peer costs one timeout, not one per PG.
         ancestors = []
-        up_osds = [o.id for o in self.osdmap.osds.values() if o.up]
-        if fresh_child or pgid in self._pgs_needing_recovery:
-            ancestors = self._split_ancestors(pgid)
-            for osd in up_osds:
-                if osd not in acting:
-                    for oj in self._remote_list(osd, spg, timeout=3.0):
-                        names.add(M.hobj_from_json(oj))
+        up_osds = [o.id for o in self.osdmap.osds.values()
+                   if o.up and o.id not in unreachable]
+        wide = fresh_child or pgid in self._pgs_needing_recovery or \
+            prev_acting is None
+        scan = [o for o in up_osds if o not in acting] if wide else \
+            [o for o in prev_acting
+             if o not in acting and self.osdmap.is_up(o) and
+             o not in unreachable]
+        for osd in scan:
+            for oj in self._remote_list(osd, spg, timeout=3.0,
+                                        unreachable=unreachable):
+                names.add(M.hobj_from_json(oj))
+        if wide:
+            # split child / merge parent: ancestor and dying-child
+            # collections of not-yet-swept holders too
+            ancestors = self._split_ancestors(pgid) + \
+                self._merge_source_pgs(pgid)
             if pool is not None:
                 names |= self._names_from_ancestors(
-                    pgid, ancestors, [0], pool.pg_num, up_osds, None)
+                    pgid, ancestors, [0], pool.pg_num, up_osds,
+                    unreachable)
         if pool is not None and pool.pg_num:
             names = {h for h in names
                      if crush_hash32(h.key or h.name) % pool.pg_num ==
@@ -1542,6 +1674,88 @@ class OSDDaemon:
         if hdr:
             txn.omap_setheader(g, hdr)
 
+    # -- PG merge (the inverse of the split sweep; reference
+    #    PG::merge_from / OSDMonitor pg_num decrease, Nautilus) ------------
+
+    def _merge_pool_collections(self, pool_id: int, new_n: int) -> None:
+        """Fold every local shard collection whose seed the shrunk
+        pg_num no longer covers into its parent (seed mod new_n):
+        data, xattrs, omap, rollback generations and snap clones move,
+        the child's shard log unions into the parent's WITHOUT moving
+        its peering bounds (`ShardPGLog.fold_in` explains why), and
+        the folded objects queue for holder-driven delivery to the
+        parent's acting home.  Runs under the split lock — a concurrent
+        sub-write must not land in a child collection behind the
+        fold."""
+        with self._split_lock:
+            for cid in list(self.store.list_collections()):
+                if cid.pgid.pool != pool_id or cid.pgid.seed < new_n:
+                    continue
+                try:
+                    self._merge_shard_collection(cid, new_n)
+                except KeyError:
+                    continue   # collection raced away
+
+    def _merge_shard_collection(self, cid: spg_t, new_n: int) -> None:
+        from .pg_log import PG_META_NAME
+        parent = spg_t(pg_t(cid.pgid.pool, cid.pgid.seed % new_n),
+                       cid.shard)
+        gobjs = [g for g in self.store.list_objects(cid)
+                 if g.hobj.name != PG_META_NAME]
+        slog = self._shard_log(cid)
+        if gobjs:
+            pcid = self._cid(parent)
+            ctxn = Transaction()
+            by_name: dict[str, hobject_t] = {}
+            for g in gobjs:
+                self._stage_object_copy(cid, ctxn, g)
+                by_name.setdefault(g.hobj.name, g.hobj)
+            self.store.queue_transactions(pcid, [ctxn])
+            # this OSD now owes the folded objects to the parent's
+            # acting home under the new map — same holder-driven
+            # delivery as a split (_queue_split_push pushes from
+            # whatever collection the target pgid names)
+            self._queue_split_push(parent, set(by_name.values()))
+            self.cct.dout("osd", 3,
+                          f"merge {cid}: {len(gobjs)} shard objects "
+                          f"-> {parent}")
+        # log union, bounds-preserving (see ShardPGLog.fold_in for why
+        # the parent's peering bounds must not ratchet): child entries
+        # above the bound travel as unlogged backfill data instead
+        # (push + wide recovery scan), the proven split-push path
+        self._shard_log(parent).fold_in(list(slog.log.entries))
+        # the child is dead: drop its collection and log state so a
+        # later re-grow starts from a clean slate
+        with self.pg_lock:
+            self.shard_logs.pop(cid, None)
+        try:
+            self.store.remove_collection(cid)
+        except KeyError:
+            pass
+        self._created_cids.discard(cid)
+
+    def _is_dying_pg(self, pgid: pg_t) -> bool:
+        """A merge child the current map has folded away: its seed is
+        beyond the pool's pg_num but within the committed historical
+        maximum (OSDMap pg_num_max) — derivable on ANY osd, including
+        one that slept through the shrink."""
+        pool = self.osdmap.pools.get(pgid.pool)
+        return pool is not None and \
+            pool.pg_num <= pgid.seed < pool.pg_num_ever()
+
+    def _merge_source_pgs(self, pgid: pg_t) -> list[pg_t]:
+        """Dying children (across stacked shrinks too: every retired
+        seed congruent to pgid mod pg_num) that fold into pgid — the
+        collections recovery/reads consult while a merge settles.
+        Map-derived, so it survives reboots."""
+        pool = self.osdmap.pools.get(pgid.pool)
+        if pool is None or not pool.pg_num or \
+                pgid.seed >= pool.pg_num:
+            return []
+        return [pg_t(pgid.pool, s)
+                for s in range(pgid.seed + pool.pg_num,
+                               pool.pg_num_ever(), pool.pg_num)]
+
     @staticmethod
     def _txn_hobjs(txn: Transaction) -> set[hobject_t]:
         out: set[hobject_t] = set()
@@ -1567,11 +1781,16 @@ class OSDDaemon:
             if hobj.name == PG_META_NAME:
                 continue
             seed = crush_hash32(hobj.key or hobj.name) % pool.pg_num
-            if seed == spg.pgid.seed or spg.pgid.seed >= pool.pg_num:
-                # seed matches, or WE are behind the writer's map (a
-                # child sub-write arriving before our split sweep):
-                # leave it — our own sweep re-homes everything when the
-                # new map lands
+            if seed == spg.pgid.seed:
+                continue
+            if spg.pgid.seed >= pool.pg_num and \
+                    not self._is_dying_pg(spg.pgid):
+                # WE are behind the writer's map (a child sub-write
+                # arriving before our split sweep): leave it — our own
+                # sweep re-homes everything when the new map lands.
+                # (A recorded merge ancestor means the opposite: the
+                # WRITER is behind and this child is dying — fall
+                # through and fold the write into the parent now.)
                 continue
             cid = self._cid(spg)
             child = spg_t(pg_t(spg.pgid.pool, seed), spg.shard)
@@ -1586,9 +1805,18 @@ class OSDDaemon:
             self.store.queue_transactions(ccid, [ctxn])
             slog = self._shard_log(spg)
             moved = slog.split_out({hobj.name})
-            self._shard_log(child).merge_split(
-                moved, slog.info.last_update,
-                slog.info.last_epoch_started)
+            if self._is_dying_pg(spg.pgid):
+                # merge direction (dying child -> parent): bounds-
+                # preserving fold — the write's data still travels
+                # via the push below
+                self._shard_log(child).fold_in(moved)
+            else:
+                # split direction: the child inherits the parent's
+                # bounds (uniform across holders — every parent
+                # shard's log carries the same lineage)
+                self._shard_log(child).merge_split(
+                    moved, slog.info.last_update,
+                    slog.info.last_epoch_started)
             ptxn = Transaction()
             for g in goids:
                 ptxn.remove(g)
@@ -1655,6 +1883,11 @@ class OSDDaemon:
         from ..crush.map import CRUSH_ITEM_NONE
         pool = self.osdmap.pools.get(child.pgid.pool)
         if pool is None or child.pgid.seed >= pool.pg_num:
+            if pool is not None and self._is_dying_pg(child.pgid):
+                # the target child died in a merge: the fold sweep
+                # moved its objects to the parent and queued parent
+                # pushes — this entry is superseded, not stuck
+                return True
             return pool is None   # pool gone: drop; map lag: retry
         cid = self._cid(child)
         goids = [g for g in self.store.list_objects(cid)
@@ -1690,20 +1923,38 @@ class OSDDaemon:
                 ok_all = False
         return ok_all
 
-    def _fallback_spg(self, spg: spg_t) -> spg_t | None:
-        """Where a shard object may still live while a split settles:
-        the recorded parent (this OSD already split), or — when this
-        OSD's map predates the child entirely — the seed the LOCAL
-        pg_num folds it to."""
+    def _fallback_spgs(self, spg: spg_t,
+                       oid: hobject_t | None = None) -> list[spg_t]:
+        """Where a shard object may still live while a split or merge
+        settles, in probe order: the recorded split parent (this OSD
+        already split), the seed the LOCAL pg_num folds the request
+        to (this OSD's map predates the child entirely), the seed the
+        OBJECT hashes to under the local pg_num (this OSD's map
+        predates a merge — the object still sits in the old child
+        collection), and any recorded dying merge children of the
+        requested PG (local fold pending or mid-flight)."""
+        out: list[spg_t] = []
+
+        def add(pg: pg_t) -> None:
+            cand = spg_t(pg, spg.shard)
+            if cand != spg and cand not in out:
+                out.append(cand)
+
         anc = self._split_ancestry.get(spg.pgid)
         if anc is not None:
-            return spg_t(anc, spg.shard)
+            add(anc)
         pool = self.osdmap.pools.get(spg.pgid.pool)
-        if pool is not None and pool.pg_num and \
-                spg.pgid.seed >= pool.pg_num:
-            return spg_t(pg_t(spg.pgid.pool,
-                              spg.pgid.seed % pool.pg_num), spg.shard)
-        return None
+        if pool is not None and pool.pg_num:
+            if spg.pgid.seed >= pool.pg_num:
+                add(pg_t(spg.pgid.pool,
+                         spg.pgid.seed % pool.pg_num))
+            if oid is not None:
+                add(pg_t(spg.pgid.pool,
+                         crush_hash32(oid.key or oid.name) %
+                         pool.pg_num))
+        for child in self._merge_source_pgs(spg.pgid):
+            add(child)
+        return out
 
     def _split_ancestors(self, pgid: pg_t) -> list[pg_t]:
         """The ancestry chain of a child PG (oldest last), empty for
@@ -1776,16 +2027,21 @@ class OSDDaemon:
             data = self.store.read(self._cid(spg), goid, off,
                                    None if length < 0 else length)
         except KeyError:
-            # split settling: the object may still sit in the parent
-            # collection (local sweep pending, or this OSD's map is
-            # older than the requester's)
-            fb = self._fallback_spg(spg)
-            if fb is None:
-                return None
-            try:
-                data = self.store.read(self._cid(fb), goid, off,
-                                       None if length < 0 else length)
-            except KeyError:
+            # split/merge settling: the object may still sit in a
+            # parent or dying-child collection (local sweep pending,
+            # or this OSD's map is older than the requester's)
+            data = None
+            for fb in self._fallback_spgs(spg, oid):
+                if not self.store.collection_exists(fb):
+                    continue
+                try:
+                    data = self.store.read(
+                        fb, goid, off,
+                        None if length < 0 else length)
+                    break
+                except KeyError:
+                    continue
+            if data is None:
                 return None
         if length > 0 and data.size < length:
             data = np.concatenate(
@@ -1805,16 +2061,17 @@ class OSDDaemon:
         try:
             size = self.store.stat(cid, goid)
         except KeyError:
-            fb = self._fallback_spg(spg)      # split settling
-            if fb is not None:
-                fcid = self._cid(fb)
+            size = None
+            for fb in self._fallback_spgs(spg, oid):  # resize settling
+                if not self.store.collection_exists(fb):
+                    continue
                 try:
-                    size = self.store.stat(fcid, goid)
-                    cid = fcid
+                    size = self.store.stat(fb, goid)
+                    cid = fb
+                    break
                 except KeyError:
-                    return M.MOSDECSubOpReadReply(
-                        spg, 0, spg.shard, -errno.ENOENT)
-            else:
+                    continue
+            if size is None:
                 return M.MOSDECSubOpReadReply(
                     spg, 0, spg.shard, -errno.ENOENT)
         attrs = self.store.getattrs(cid, goid) if want_attrs else {}
@@ -1974,6 +2231,10 @@ class OSDDaemon:
                                   [entry_from_wire(w) for w in m.entries])
         complete = set(replies) == set(live)
         if not complete:
+            self.cct.dout("osd", 2,
+                          f"peering {pgid} incomplete: shards "
+                          f"{sorted(set(live) - set(replies))} did "
+                          f"not answer")
             # A live shard didn't answer.  Its log may hold acked writes
             # newer than anything we heard; rolling back / activating on
             # the partial view could elect a stale shard as authority and
@@ -2978,6 +3239,63 @@ class OSDDaemon:
                     self.mon_conn.send_message(
                         M.MOSDSlowOpReport(self.osd_id, rep))
                 last = rep["count"]
+            except Exception:  # noqa: BLE001 - mon electing/shutdown
+                pass
+
+    # -- PG stats reporting (reference MPGStats via the mgr: the
+    #    degraded/misplaced/unfound counts behind `ceph pg stat`,
+    #    PG_DEGRADED health, and the split/merge interleave guard) ---------
+
+    def _compile_pg_stats(self) -> dict:
+        """Summarize this OSD's recovery/split/merge state: led PGs
+        with recovery pending (degraded), objects with split/merge
+        pushes in flight (misplaced), and latched-unfound objects,
+        per pool and in total."""
+        with self.pg_lock:
+            needing = list(self._pgs_needing_recovery)
+            pushes = list(self._split_push_pending)
+            unfound = {pg: len(objs)
+                       for pg, objs in self._unfound.items()}
+            recovering = self._recovery_inflight
+        pools: dict[str, dict] = {}
+
+        def pool_rec(pool_id: int) -> dict:
+            return pools.setdefault(str(pool_id), {
+                "degraded_pgs": 0, "misplaced": 0, "unfound": 0,
+                "push_seeds": []})
+
+        for pgid in needing:
+            pool_rec(pgid.pool)["degraded_pgs"] += 1
+        seen_seeds: dict[str, set] = {}
+        for child, _h in pushes:
+            rec = pool_rec(child.pgid.pool)
+            rec["misplaced"] += 1
+            seen_seeds.setdefault(str(child.pgid.pool),
+                                  set()).add(child.pgid.seed)
+        for pid, seeds in seen_seeds.items():
+            pools[pid]["push_seeds"] = sorted(seeds)[:128]
+        for pg, n in unfound.items():
+            pool_rec(pg.pool)["unfound"] += n
+        return {
+            "degraded_pgs": len(needing),
+            "misplaced": len(pushes),
+            "unfound": sum(unfound.values()),
+            "recovering": recovering,
+            "epoch": self.osdmap.epoch,
+            "pools": pools,
+        }
+
+    def _pgstats_loop(self) -> None:
+        conf = self.cct.conf
+        while not self._hb_stop.wait(
+                float(conf.get("osd_pg_stat_interval") or 0.5)):
+            try:
+                rep = self._compile_pg_stats()
+                self.perf.set("pg_degraded", rep["degraded_pgs"])
+                self.perf.set("pg_misplaced", rep["misplaced"])
+                self.perf.set("pg_unfound", rep["unfound"])
+                self.mon_conn.send_message(
+                    M.MPGStats(self.osd_id, rep))
             except Exception:  # noqa: BLE001 - mon electing/shutdown
                 pass
 

@@ -41,11 +41,38 @@ class PGPool:
     removed_snaps: list = field(default_factory=list)
     # pg_autoscaler authority (reference pg_pool_t pg_autoscale_mode):
     # "warn" = advisory only (health warning), "on" = the mgr module
-    # may issue real pg_num increases through the mon
+    # may issue real pg_num changes (both directions) through the mon
     pg_autoscale_mode: str = "warn"
+    # highest pg_num this pool ever had (reference: the role of
+    # pg_num_pending/past_intervals history for merges).  Committed in
+    # the map so ANY osd — including one that was down across the
+    # shrink — can derive which seeds are dying merge children
+    # (pg_num <= seed < pg_num_max) and where their data may still
+    # sit.  0 means "never resized" (treat as pg_num).
+    pg_num_max: int = 0
+
+    def pg_num_ever(self) -> int:
+        return max(self.pg_num, self.pg_num_max)
 
     def is_erasure(self) -> bool:
         return self.type == PoolType.ERASURE
+
+
+def validate_pg_num_step(cur: int, new: int) -> None:
+    """Structural validation for a pg_num change, shared by the mon
+    command path and the map mutator (one source of truth for the
+    error strings): >= 1, and powers of two on both sides — the
+    ps-bits rule (child = hash mod pg_num) only folds exactly when
+    both counts are powers of two, in either direction."""
+    if new < 1:
+        raise ValueError(
+            f"pg_num {new} below 1: a pool needs at least one PG")
+    if new & (new - 1) or cur & (cur - 1):
+        raise ValueError(
+            f"pg_num must step between powers of two "
+            f"({cur} -> {new}): the ps-bits rule "
+            f"(child = hash mod pg_num) only folds exactly when "
+            f"both counts are powers of two")
 
 
 @dataclass
@@ -195,6 +222,28 @@ class OSDMap:
             self.osds[osd_id].in_ = False
         self._pg_cache.clear()
 
+    def set_osd_weight(self, osd_id: int, weight: float) -> None:
+        """Reweight in [0,1] (reference `osd reweight`): CRUSH draws
+        scale by it, so walking it to 0 backfills every PG off the OSD
+        while the daemon stays up to serve as a recovery source."""
+        if not 0.0 <= weight <= 1.0:
+            raise ValueError(f"weight {weight} not in [0, 1]")
+        self.osds[osd_id].weight = weight
+        self._pg_cache.clear()
+
+    def remove_osd(self, osd_id: int) -> None:
+        """Drop an OSD from the map entirely (reference `osd rm` +
+        `osd crush remove`): device, crush bucket membership, and any
+        override-table entries naming it."""
+        self.osds.pop(osd_id, None)
+        self.crush.remove_osd(osd_id)
+        self.pg_temp = {pg: v for pg, v in self.pg_temp.items()
+                        if osd_id not in v}
+        self.pg_upmap_items = {
+            pg: pairs for pg, pairs in self.pg_upmap_items.items()
+            if all(osd_id not in p for p in pairs)}
+        self._pg_cache.clear()
+
     def create_pool(self, name: str, type_: PoolType, size: int,
                     pg_num: int, crush_rule: int,
                     erasure_code_profile: str = "",
@@ -210,17 +259,22 @@ class OSDMap:
         return pool
 
     def set_pool_pg_num(self, pool_id: int, new_pg_num: int) -> None:
-        """Grow a pool's pg_num (PG split; reference OSDMonitor
-        prepare_command pg_num increase).  Validation (monotonic,
-        power-of-two) lives in the mon command path; this mutator also
-        keeps the override tables consistent: every pg_temp and
-        pg_upmap_items entry of the pool is pruned — the split is a new
-        interval for every PG of the pool (parents change content,
-        children are born), so acting-set and raw-mapping overrides
-        computed for the old interval no longer describe anything
-        (reference OSDMonitor clean_temps + maybe_remove_pg_upmaps
-        pruning on pg_num change)."""
-        self.pools[pool_id].pg_num = new_pg_num
+        """Resize a pool's pg_num in EITHER direction (PG split or
+        merge; reference OSDMonitor prepare_command pg_num change —
+        decrease landed in Nautilus).  Structural validation lives
+        here (the mon command path adds cluster-state gating such as
+        the split/merge interleave guard); the mutator also keeps the
+        override tables consistent: every pg_temp and pg_upmap_items
+        entry of the pool is pruned — a resize is a new interval for
+        every PG of the pool (parents change content, children are
+        born or die), so acting-set and raw-mapping overrides computed
+        for the old interval no longer describe anything (reference
+        OSDMonitor clean_temps + maybe_remove_pg_upmaps pruning on
+        pg_num change)."""
+        pool = self.pools[pool_id]
+        validate_pg_num_step(pool.pg_num, new_pg_num)
+        pool.pg_num_max = max(pool.pg_num_ever(), new_pg_num)
+        pool.pg_num = new_pg_num
         self.pg_temp = {pg: v for pg, v in self.pg_temp.items()
                         if pg.pool != pool_id}
         self.pg_upmap_items = {pg: v for pg, v in
@@ -245,7 +299,8 @@ class OSDMap:
             "pools": [[p.id, p.name, int(p.type), p.size, p.min_size,
                        p.pg_num, p.crush_rule, p.erasure_code_profile,
                        p.stripe_width, p.snap_seq,
-                       list(p.removed_snaps), p.pg_autoscale_mode]
+                       list(p.removed_snaps), p.pg_autoscale_mode,
+                       p.pg_num_max]
                       for p in self.pools.values()],
             "pg_temp": [[pg.pool, pg.seed, osds]
                         for pg, osds in self.pg_temp.items()],
@@ -281,11 +336,13 @@ class OSDMap:
             snap_seq = rec[9] if len(rec) > 9 else 0
             removed = list(rec[10]) if len(rec) > 10 else []
             autoscale = rec[11] if len(rec) > 11 else "warn"
+            pg_num_max = rec[12] if len(rec) > 12 else 0
             m.pools[pid] = PGPool(pid, name, PoolType(t), size, msize,
                                   pgn, rule, prof, sw,
                                   snap_seq=snap_seq,
                                   removed_snaps=removed,
-                                  pg_autoscale_mode=autoscale)
+                                  pg_autoscale_mode=autoscale,
+                                  pg_num_max=pg_num_max)
             m.pool_ids_by_name[name] = pid
         for pool, seed, osds in j.get("pg_temp", []):
             m.pg_temp[pg_t(pool, seed)] = osds

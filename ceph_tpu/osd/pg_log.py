@@ -291,6 +291,24 @@ class ShardPGLog:
                     json.dumps(self.info.to_json()).encode())
         self.store.queue_transactions(self.cid, [txn])
 
+    def fold_in(self, entries: list[LogEntry]) -> int:
+        """PG-merge log union (the inverse of split_out): adopt a
+        dying child's entries WITHOUT moving this shard's peering
+        bounds.  Only entries at or below our own last_update union in
+        (as recovery history); newer child entries are dropped here —
+        their data travels as unlogged backfill instead — because a
+        bound ratchet would be non-uniform across the parent's acting
+        shards (each folds whichever children IT held) and the peering
+        min-last_update rule would roll the ratcheted shards back,
+        undoing folded writes as if they were divergent.  Returns the
+        number of entries adopted."""
+        fold = [e for e in entries
+                if e.version <= self.info.last_update]
+        if fold:
+            self.merge_split(fold, self.info.last_update,
+                             self.info.last_epoch_started)
+        return len(fold)
+
     def split_out(self, names: set[str]) -> list[LogEntry]:
         """Drop (and return) the entries whose object moved to a child
         PG.  The parent's last_update is NOT lowered: it still bounds

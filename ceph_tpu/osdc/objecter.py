@@ -275,10 +275,18 @@ class Objecter:
                     last_err = reply.result
                     if deadline is None:
                         deadline = time.time() + min(timeout, 5.0)
-                    if time.time() < deadline:
-                        time.sleep(0.25)
+                    if time.time() >= deadline:
+                        attempt += 1    # budget exhausted — the
+                        # retarget fast-path below must not bypass it
+                        # (sustained map churn would spin forever)
+                    elif self._calc_target(pool_id, name) != tgt:
+                        # the refreshed map moved the op — a pg_num
+                        # change (split/merge) or primary remap, not a
+                        # peering blip: go straight at the new target
+                        # instead of eating the flat backoff
+                        pass
                     else:
-                        attempt += 1    # budget exhausted
+                        time.sleep(0.25)
                     continue
                 top.mark_event("reply")
                 self.op_tracker.unregister(top, reply.result)

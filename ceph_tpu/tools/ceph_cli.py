@@ -10,7 +10,13 @@ commands this build's mon implements:
   python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd pool create NAME \
       [--type erasure --profile NAME --pg-num N --size N]
   python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd pool set NAME \
-      {pg_num N | pg_autoscale_mode on|warn}     # pg_num grows = PG split
+      {pg_num N | pg_autoscale_mode on|warn}  # pg_num up = split, down = merge
+  python -m ceph_tpu.tools.ceph_cli -m HOST:PORT pg stat      # recovery counts
+  python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd reweight ID W
+  python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd drain ID  # weight -> 0
+  python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd ok-to-stop ID
+  python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd safe-to-destroy ID
+  python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd rm ID    # guarded remove
   python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd pool get NAME [VAR]
   python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd erasure-code-profile \
       set NAME k=4 m=2 plugin=jax
@@ -85,8 +91,16 @@ def main(argv=None) -> int:
             cmd = {"prefix": "osd erasure-code-profile ls"}
         elif words == ["mon", "stat"]:
             cmd = {"prefix": "mon stat"}
+        elif words == ["pg", "stat"]:
+            cmd = {"prefix": "pg stat"}
+        elif words[:2] == ["osd", "reweight"] and len(words) == 4:
+            cmd = {"prefix": "osd reweight", "id": int(words[2]),
+                   "weight": float(words[3])}
         elif words[:2] in (["osd", "out"], ["osd", "in"],
-                           ["osd", "down"]) and len(words) == 3:
+                           ["osd", "down"], ["osd", "drain"],
+                           ["osd", "ok-to-stop"],
+                           ["osd", "safe-to-destroy"],
+                           ["osd", "rm"]) and len(words) == 3:
             cmd = {"prefix": f"osd {words[1]}", "id": int(words[2])}
         elif words[:2] == ["auth", "get-or-create"] and len(words) >= 3:
             cmd = {"prefix": "auth get-or-create", "entity": words[2],

@@ -92,6 +92,10 @@ class ProcCluster:
         self.heartbeat_interval = heartbeat_interval
         self.failure_quorum = failure_quorum
         self.conf = dict(conf or {})
+        # per-OSD conf overrides carried across revive (chaos knobs
+        # must survive restarts): merged over self.conf at every
+        # (re)spawn of that daemon
+        self.osd_conf: dict[int, dict] = {}
         self.boot_timeout = boot_timeout
         self.mon_ports = _free_ports(n_mons)
         self.mon_addrs = [("127.0.0.1", p) for p in self.mon_ports]
@@ -150,9 +154,18 @@ class ProcCluster:
                 "--objectstore", self.objectstore,
                 "--data-dir", str(self.data_dir / f"osd.{osd_id}"),
                 "--heartbeat", str(self.heartbeat_interval)]
-        for k, v in self.conf.items():
+        merged = {**self.conf, **self.osd_conf.get(osd_id, {})}
+        for k, v in merged.items():
             argv += ["--conf", f"{k}={v}"]
         return self._spawn(argv)
+
+    def set_osd_conf(self, osd_id: int, key: str, value) -> None:
+        """Record a per-OSD conf override applied at every (re)spawn —
+        the process analog of Cluster.set_osd_conf.  A running daemon
+        picks it up on its next revive (live injection would need the
+        asok injectargs path; spawn-time conf is what the thrasher
+        needs to survive kill/revive)."""
+        self.osd_conf.setdefault(osd_id, {})[key] = value
 
     def spawn_rgw(self) -> tuple[str, int]:
         p = self._spawn([

@@ -33,6 +33,11 @@ class Cluster:
                  auth: str = "none", secure: bool = False,
                  conf: dict | None = None):
         self.conf = dict(conf or {})   # applied to every OSD pre-boot
+        # per-OSD conf overrides that SURVIVE revive: a revived daemon
+        # gets a fresh CephContext, so anything set only via
+        # cct.conf.set (chaos knobs like ms_inject_socket_failures)
+        # would silently reset — set through set_osd_conf instead
+        self.osd_conf: dict[int, dict] = {}
         # cephx deployment: one cluster service key shared by daemons,
         # a keyring of client entities on the mon (reference
         # vstart.sh's keyring bootstrap + ceph auth get-or-create)
@@ -92,10 +97,29 @@ class Cluster:
                             secure=self.secure)
             self.osds.append(osd)
         for osd in self.osds:
-            for k, v in self.conf.items():
-                osd.cct.conf.set(k, v)
+            self._apply_conf(osd)
             osd.boot()
         return self
+
+    def _apply_conf(self, osd: OSDDaemon) -> None:
+        """Cluster-wide conf, then this OSD's recorded overrides."""
+        for k, v in self.conf.items():
+            osd.cct.conf.set(k, v)
+        for k, v in self.osd_conf.get(osd.osd_id, {}).items():
+            osd.cct.conf.set(k, v)
+
+    def set_osd_conf(self, osd_id: int, key: str, value) -> None:
+        """Set a conf override that sticks across kill/revive (the
+        thrasher's chaos knobs must survive restarts; reference
+        ceph.conf [osd.N] sections persist the same way).  Applied
+        live when the daemon is running."""
+        self.osd_conf.setdefault(osd_id, {})[key] = value
+        osd = self.osds[osd_id] if osd_id < len(self.osds) else None
+        if osd is not None:
+            try:
+                osd.cct.conf.set(key, value)
+            except Exception:  # noqa: BLE001 - daemon mid-shutdown
+                pass
 
     def _daemon_auth(self, osd_id: int):
         if self.auth_mode != "cephx":
@@ -125,7 +149,9 @@ class Cluster:
     def revive_osd(self, osd_id: int) -> None:
         """Restart a killed OSD on its surviving store (reference
         qa/tasks/ceph_manager.py revive_osd): FileStore replays its
-        WAL on mount; MemStore data survives in-process."""
+        WAL on mount; MemStore data survives in-process.  Cluster and
+        per-OSD conf overrides re-apply to the fresh CephContext —
+        chaos settings (fault injection) survive the restart."""
         old = self.osds[osd_id]
         asok = (f"{self.asok_dir}/osd.{osd_id}.asok"
                 if self.asok_dir else None)
@@ -134,7 +160,19 @@ class Cluster:
                         asok_path=asok, auth=self._daemon_auth(osd_id),
                         secure=self.secure)
         self.osds[osd_id] = osd
+        self._apply_conf(osd)
         osd.boot()
+
+    def remove_osd(self, osd_id: int) -> None:
+        """Decommission an OSD for good: shut the daemon down and drop
+        it from the roster so quiescence checks stop expecting it (the
+        map-side removal is `osd rm` — run drain/safe-to-destroy
+        first)."""
+        osd = self.osds[osd_id]
+        if osd is not None:
+            osd.shutdown()
+        self.osds[osd_id] = None
+        self.osd_conf.pop(osd_id, None)
 
     def kill_mon(self, rank: int) -> None:
         """Hard-kill a monitor (quorum must re-elect)."""
@@ -165,6 +203,8 @@ class Cluster:
         epoch = m.epoch
         live = []
         for osd in self.osds:
+            if osd is None:
+                continue          # decommissioned (remove_osd)
             if not m.is_up(osd.osd_id):
                 return False, f"osd.{osd.osd_id} down"
             if osd.osdmap.epoch < epoch:
@@ -231,7 +271,8 @@ class Cluster:
         for c in self._clients:
             c.shutdown()
         for osd in self.osds:
-            osd.shutdown()
+            if osd is not None:
+                osd.shutdown()
         for m in self.mons:
             m.shutdown()
 

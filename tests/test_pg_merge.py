@@ -1,0 +1,527 @@
+"""Elastic shrink: PG merge, graceful OSD drain/decommission, and
+safe-to-stop gating.
+
+The inverse of tests/test_pg_split.py across the same three layers:
+the mon validates and commits `osd pool set pg_num` DECREASES through
+Paxos (power-of-two stepping, >= 1, a split/merge interleave guard fed
+by MPGStats reports); every OSD folds dying child collections into
+their parents by the inverse ps-bits rule on map receipt (data +
+xattrs + omap + generations move; bounds-preserving log union —
+ShardPGLog.fold_in); clients and late sub-writes retarget from dying
+children to the parent; recovery pulls parent objects off lagging
+child holders.  Plus the contraction control surface: `osd reweight` /
+`osd drain` (gradual weight walk), `osd ok-to-stop` / `osd
+safe-to-destroy` gates, and guarded `osd rm`.
+
+Reference analogs: src/mon/OSDMonitor.cc pg_num decrease (Nautilus),
+PG::merge_from, `osd ok-to-stop` / `osd safe-to-destroy`.
+"""
+
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.osdc.objecter import TimedOut
+from ceph_tpu.rados.client import RadosError
+from ceph_tpu.tools.vstart import Cluster
+
+
+def _write_corpus(io, prefix: str, n: int, base: int = 100) -> dict:
+    data = {}
+    for i in range(n):
+        name = f"{prefix}{i}"
+        data[name] = bytes([(i * 13 + 7) % 251]) * (base + i * 17)
+        io.write_full(name, data[name])
+    return data
+
+
+def _assert_corpus(io, data: dict) -> None:
+    for name, want in data.items():
+        got = bytes(io.read(name, len(want)))
+        assert got == want, f"{name}: {len(got)}B vs {len(want)}B"
+
+
+# -- mon-side validation, interleave guard, pg stat / health -----------------
+
+def test_pg_num_decrease_validation_guard_and_pg_stat():
+    with Cluster(n_osds=3) as c:
+        client = c.client()
+        client.create_pool("vp", "replicated", pg_num=8, size=2)
+        pool_id = c.mon.osdmap.lookup_pool("vp").id
+
+        # explicit error strings: non-power-of-two and below-1
+        r, out = client.mon_command({"prefix": "osd pool set",
+                                     "pool": "vp", "var": "pg_num",
+                                     "val": "6"})
+        assert r != 0 and "powers of two" in out["error"]
+        r, out = client.mon_command({"prefix": "osd pool set",
+                                     "pool": "vp", "var": "pg_num",
+                                     "val": "0"})
+        assert r != 0 and "below 1" in out["error"]
+
+        # split/merge interleave guard: a fresh report showing pushes
+        # still pending for the pool refuses the decrease
+        c.mon.pg_stat_reports[99] = {
+            "ts": time.time(), "degraded_pgs": 1, "misplaced": 1,
+            "unfound": 0,
+            "pools": {str(pool_id): {"degraded_pgs": 1, "misplaced": 1,
+                                     "unfound": 0, "push_seeds": [5]}}}
+        r, out = client.mon_command({"prefix": "osd pool set",
+                                     "pool": "vp", "var": "pg_num",
+                                     "val": "4"})
+        assert r != 0 and "still splitting" in out["error"]
+        # the same state surfaces in `pg stat` and `health`
+        r, out = client.mon_command({"prefix": "pg stat"})
+        assert r == 0 and out["degraded_pgs"] >= 1
+        assert out["pools"][str(pool_id)]["push_seeds"] == [5]
+        r, out = client.mon_command({"prefix": "health"})
+        assert r == 0 and "PG_DEGRADED" in out["checks"]
+        del c.mon.pg_stat_reports[99]
+
+        # guard cleared: the decrease commits, override tables pruned
+        r, _ = client.mon_command({"prefix": "osd pg-temp",
+                                   "pgid": [pool_id, 1],
+                                   "osds": [0, 1]})
+        assert r == 0
+        r, out = client.mon_command({"prefix": "osd pool set",
+                                     "pool": "vp", "var": "pg_num",
+                                     "val": "4"})
+        assert r == 0 and out["pg_num"] == 4
+        assert not any(pg.pool == pool_id
+                       for pg in c.mon.osdmap.pg_temp)
+        r, out = client.mon_command({"prefix": "osd pool get",
+                                     "pool": "vp", "var": "pg_num"})
+        assert r == 0 and out["pg_num"] == 4
+
+
+# -- fast merge smoke (tier-1): 16 -> 8, no thrash ---------------------------
+
+def test_replicated_merge_smoke_16_to_8():
+    with Cluster(n_osds=3) as c:
+        client = c.client()
+        client.create_pool("mp", "replicated", pg_num=16, size=2)
+        io = client.open_ioctx("mp")
+        data = _write_corpus(io, "m", 24)
+        # the corpus really uses seeds the merge will retire
+        m = c.mon.osdmap
+        assert any(m.object_to_pg(io.pool_id, k).seed >= 8
+                   for k in data)
+        r, _ = client.mon_command({"prefix": "osd pool set",
+                                   "pool": "mp", "var": "pg_num",
+                                   "val": "8"})
+        assert r == 0
+        c.wait_active_clean(timeout=120)
+        _assert_corpus(io, data)
+        # parents keep working for new writes
+        post = _write_corpus(io, "post", 8)
+        _assert_corpus(io, post)
+        # observability settled: no degraded/misplaced left anywhere
+        r, out = client.mon_command({"prefix": "pg stat"})
+        assert r == 0 and out["degraded_pgs"] == 0 \
+            and out["misplaced_objects"] == 0
+        # and the per-daemon gauges the prometheus exporter scrapes
+        dump = c.osds[0].cct.perf.dump()["osd.0"]
+        assert dump["pg_degraded"] == 0 and dump["pg_misplaced"] == 0
+
+
+@pytest.mark.slow
+def test_ec_merge_objects_read_and_scrub_clean():
+    """(slow: the replicated 16→8 smoke is the tier-1 merge gate; EC
+    fold correctness also rides the slow 64→16 thrash acceptance.)"""
+    with Cluster(n_osds=5) as c:
+        client = c.client()
+        client.set_ec_profile("merge_p", {
+            "plugin": "jerasure", "k": "2", "m": "2",
+            "stripe_unit": "1024"})
+        client.create_pool("ep", "erasure",
+                           erasure_code_profile="merge_p", pg_num=8)
+        io = client.open_ioctx("ep")
+        data = _write_corpus(io, "e", 16, base=700)
+        r, _ = client.mon_command({"prefix": "osd pool set",
+                                   "pool": "ep", "var": "pg_num",
+                                   "val": "2"})
+        assert r == 0
+        c.wait_active_clean(timeout=120)
+        _assert_corpus(io, data)
+        # per-shard hinfo survived the fold: deep scrub recomputes
+        # every shard crc against it
+        errors = []
+        for osd in c.osds:
+            out = osd._asok_scrub({"deep": True, "repair": False})
+            for _pg, res in out.items():
+                errors.extend(res["errors"])
+        assert not errors, errors[:5]
+
+
+# -- merge edge cases --------------------------------------------------------
+
+@pytest.mark.slow
+def test_merge_mid_recovery():
+    """Shrink a pool while objects are in the missing set: one OSD is
+    down, writes land degraded, the pool merges, the OSD revives —
+    recovery must converge every parent (the revived holder's child
+    collections fold on its first map and the data re-homes)."""
+    with Cluster(n_osds=5, heartbeat_interval=0.25) as c:
+        client = c.client()
+        client.set_ec_profile("degm_p", {
+            "plugin": "jerasure", "k": "2", "m": "2",
+            "stripe_unit": "1024"})
+        client.create_pool("dp", "erasure",
+                           erasure_code_profile="degm_p", pg_num=8)
+        io = client.open_ioctx("dp")
+        pre = _write_corpus(io, "pre", 8, base=600)
+        c.kill_osd(1)
+        c.mark_osd_down(1)
+        time.sleep(0.3)
+        degraded = _write_corpus(io, "deg", 8, base=900)
+        r, _ = client.mon_command({"prefix": "osd pool set",
+                                   "pool": "dp", "var": "pg_num",
+                                   "val": "2"})
+        assert r == 0
+        time.sleep(0.5)   # let the fold land while osd.1 is dead
+        c.revive_osd(1)
+        c.wait_active_clean(timeout=120)
+        _assert_corpus(io, pre)
+        _assert_corpus(io, degraded)
+
+
+@pytest.mark.slow
+def test_merge_while_deep_scrub_running():
+    """A deep scrub in flight over a child while the merge folds it
+    must complete or re-home without wedging, and a post-settle scrub
+    is clean."""
+    with Cluster(n_osds=3) as c:
+        client = c.client()
+        client.create_pool("sp", "replicated", pg_num=16, size=2)
+        io = client.open_ioctx("sp")
+        data = _write_corpus(io, "s", 16)
+        stop = threading.Event()
+        scrub_boom = []
+
+        def scrubber():
+            while not stop.is_set():
+                for osd in c.osds:
+                    try:
+                        osd._asok_scrub({"deep": True, "repair": False})
+                    except Exception as e:  # noqa: BLE001
+                        scrub_boom.append(e)
+                        return
+
+        t = threading.Thread(target=scrubber, daemon=True)
+        t.start()
+        time.sleep(0.2)   # scrub in flight when the merge lands
+        r, _ = client.mon_command({"prefix": "osd pool set",
+                                   "pool": "sp", "var": "pg_num",
+                                   "val": "4"})
+        assert r == 0
+        c.wait_active_clean(timeout=120)
+        stop.set()
+        t.join(10)
+        assert not scrub_boom, f"scrub crashed: {scrub_boom[0]!r}"
+        _assert_corpus(io, data)
+        errors = []
+        for osd in c.osds:
+            out = osd._asok_scrub({"deep": True, "repair": True})
+            for _pg, res in out.items():
+                errors.extend(res["errors"])
+        assert not errors, errors[:5]
+
+
+def test_stale_client_retargets_dying_child_to_parent():
+    """A client still on the pre-merge map sends ops for a dying
+    child PG; the OSD either requeues against the parent it now leads
+    or answers EAGAIN so the refreshed client retargets."""
+    with Cluster(n_osds=3) as c:
+        stale = c.client()
+        admin = c.client()
+        admin.create_pool("cp", "replicated", pg_num=16, size=2)
+        io = stale.open_ioctx("cp")
+        data = _write_corpus(io, "c", 12)
+        old_map = stale.objecter.osdmap
+        r, _ = admin.mon_command({"prefix": "osd pool set",
+                                  "pool": "cp", "var": "pg_num",
+                                  "val": "4"})
+        assert r == 0
+        c.wait_active_clean(timeout=120)
+        # pin the client onto the PRE-merge map and pick a name whose
+        # old seed the merge retired — its next op computes a dying
+        # child pgid and lands on that child's old primary
+        stale.objecter.osdmap = old_map
+        assert old_map.pools[io.pool_id].pg_num == 16
+        name = next(n for n in (f"x{i}" for i in range(64))
+                    if old_map.object_to_pg(io.pool_id, n).seed >= 4)
+        io.write_full(name, b"retargeted to parent!")
+        data[name] = b"retargeted to parent!"
+        _assert_corpus(io, data)
+        # and a fresh client agrees on every object
+        io2 = admin.open_ioctx("cp")
+        _assert_corpus(io2, data)
+
+
+# -- drain / ok-to-stop / safe-to-destroy / rm -------------------------------
+
+def test_ok_to_stop_refuses_below_min_size():
+    with Cluster(n_osds=3, heartbeat_interval=0.25) as c:
+        client = c.client()
+        client.create_pool("gp", "replicated", pg_num=8, size=3)
+        io = client.open_ioctx("gp")
+        _write_corpus(io, "g", 6)
+        # all 3 up: stopping any one leaves 2 >= min_size=2
+        r, out = client.mon_command({"prefix": "osd ok-to-stop",
+                                     "id": 0})
+        assert r == 0 and out["ok_to_stop"] is True
+        # one already down: stopping another would leave 1 < 2
+        c.kill_osd(1)
+        c.mark_osd_down(1)
+        r, out = client.mon_command({"prefix": "osd ok-to-stop",
+                                     "id": 0})
+        assert r != 0 and out["ok_to_stop"] is False
+        assert out.get("blocked_by"), out
+        # unknown osd is ENOENT, not a silent yes
+        r, out = client.mon_command({"prefix": "osd ok-to-stop",
+                                     "id": 42})
+        assert r != 0 and "no osd" in out["error"]
+
+
+def test_drain_safe_to_destroy_rm_no_window_below_min_size():
+    with Cluster(n_osds=4) as c:
+        client = c.client()
+        client.create_pool("drp", "replicated", pg_num=16, size=2)
+        io = client.open_ioctx("drp")
+        data = _write_corpus(io, "d", 20)
+        c.wait_active_clean(timeout=120)
+        victim = 3
+        # an un-drained data-bearing OSD is NOT safe to destroy
+        r, out = client.mon_command({"prefix": "osd safe-to-destroy",
+                                     "id": victim})
+        assert r != 0 and out["safe"] is False
+        r, _ = client.mon_command({"prefix": "osd drain",
+                                   "id": victim})
+        assert r == 0
+        # poll to completion, asserting NO window where any PG sits
+        # below min_size (the whole point of graceful drain)
+        from ceph_tpu.crush.map import CRUSH_ITEM_NONE
+        from ceph_tpu.osd.types import pg_t
+
+        def pgs_below_min_size() -> list[str]:
+            m = c.mon.osdmap
+            out = []
+            for pool in m.pools.values():
+                for seed in range(pool.pg_num):
+                    pgid = pg_t(pool.id, seed)
+                    _, acting, _, _ = m.pg_to_up_acting_osds(pgid)
+                    live = sum(1 for o in acting
+                               if o != CRUSH_ITEM_NONE and m.is_up(o))
+                    if live < pool.min_size:
+                        out.append(str(pgid))
+            return out
+
+        deadline = time.time() + 90
+        safe = False
+        while time.time() < deadline:
+            blocked = pgs_below_min_size()
+            assert not blocked, \
+                f"pgs below min_size mid-drain: {blocked[:4]}"
+            r, out = client.mon_command(
+                {"prefix": "osd safe-to-destroy", "id": victim})
+            if r == 0 and out["safe"]:
+                safe = True
+                break
+            time.sleep(0.5)
+        assert safe, f"drain never finished: {out}"
+        assert c.mon.osdmap.osds[victim].weight == 0.0
+        # rm refuses while the daemon is still up
+        r, out = client.mon_command({"prefix": "osd rm", "id": victim})
+        assert r != 0 and "is up" in out["error"]
+        r, out = client.mon_command({"prefix": "osd ok-to-stop",
+                                     "id": victim})
+        assert r == 0 and out["ok_to_stop"] is True
+        c.remove_osd(victim)
+        c.mark_osd_down(victim)
+        r, out = client.mon_command({"prefix": "osd rm", "id": victim})
+        assert r == 0, out
+        assert victim not in c.mon.osdmap.osds
+        assert victim not in c.mon.osdmap.crush.map.devices
+        c.wait_active_clean(timeout=120)
+        _assert_corpus(io, data)
+
+
+# -- autoscaler scales down too ----------------------------------------------
+
+def test_autoscaler_scales_down_with_optin():
+    from ceph_tpu.mgr.daemon import MgrDaemon
+    from ceph_tpu.mgr.modules import PgAutoscalerModule
+
+    class SmallTarget(PgAutoscalerModule):
+        target_pgs_per_osd = 4
+
+    with Cluster(n_osds=2) as c:
+        client = c.client()
+        # rec = 2 osds * 4 / 1 pool = 8; 32 is 4x over -> merge to 8
+        client.create_pool("auto", "replicated", pg_num=32, size=2)
+        io = client.open_ioctx("auto")
+        data = _write_corpus(io, "a", 10)
+        r, _ = client.mon_command({"prefix": "osd pool set",
+                                   "pool": "auto",
+                                   "var": "pg_autoscale_mode",
+                                   "val": "on"})
+        assert r == 0
+        mgr = MgrDaemon(c.mon_addrs, modules=[SmallTarget]).start()
+        try:
+            deadline = time.time() + 45
+            while time.time() < deadline and \
+                    c.mon.osdmap.lookup_pool("auto").pg_num > 8:
+                time.sleep(0.5)
+            assert c.mon.osdmap.lookup_pool("auto").pg_num == 8
+        finally:
+            mgr.shutdown()
+        c.wait_active_clean(timeout=120)
+        _assert_corpus(io, data)
+
+
+# -- the acceptance run: 64 -> 16 under the thrasher -------------------------
+
+@pytest.mark.slow
+def test_shrink_64_to_16_under_thrash_no_acked_loss():
+    """Shrink a loaded replicated pool AND a loaded EC (k=8,m=3) pool
+    64 -> 16 PGs while the kill/revive thrasher runs with messenger
+    fault injection armed: zero acked-data loss, every object written
+    before and during the merges reads back bit-identical after
+    quiescence."""
+    rng = np.random.default_rng(13)
+    pyrng = random.Random(13)
+    # hb 1.0 (grace 4s): 12 in-process OSDs saturate a small host, and
+    # a 1s grace flap-storms revived daemons into permanent down
+    with Cluster(n_osds=12, heartbeat_interval=1.0) as c:
+        client = c.client()
+        client.create_pool("trp", "replicated", pg_num=64, size=2)
+        client.set_ec_profile("m83", {
+            "plugin": "jerasure", "k": "8", "m": "3",
+            "stripe_unit": "1024"})
+        client.create_pool("tep", "erasure",
+                           erasure_code_profile="m83", pg_num=64)
+        ios = {"trp": client.open_ioctx("trp"),
+               "tep": client.open_ioctx("tep")}
+        # light wire chaos everywhere, carried across revives by the
+        # cluster's per-OSD conf overrides
+        for osd in c.osds:
+            c.set_osd_conf(osd.osd_id,
+                           "ms_inject_socket_failures", 120)
+
+        acked: dict[tuple, bytes] = {}
+        stop = threading.Event()
+        write_errors = []
+
+        def mon_retry(cmd: dict, tries: int = 6) -> None:
+            # idempotent commands; a merge may also bounce off the
+            # interleave guard (EBUSY) while pushes settle
+            for attempt in range(tries):
+                try:
+                    r, _ = client.mon_command(cmd)
+                    if r == 0:
+                        return
+                except (TimedOut, RadosError):
+                    pass
+                time.sleep(1.0)
+            raise AssertionError(f"mon command failed: {cmd}")
+
+        def writer(pool: str):
+            io = ios[pool]
+            i = 0
+            while not stop.is_set():
+                name = f"w{i}"
+                payload = rng.integers(
+                    0, 256, 800 + (i % 7) * 257,
+                    dtype=np.uint8).tobytes()
+                try:
+                    io.write_full(name, payload)
+                    acked[(pool, name)] = payload
+                except (TimedOut, RadosError):
+                    pass               # refused/unacked: no promise
+                except Exception as e:  # noqa: BLE001
+                    write_errors.append(e)
+                    return
+                i += 1
+                time.sleep(0.03)
+
+        threads = [threading.Thread(target=writer, args=(p,),
+                                    daemon=True) for p in ios]
+        for t in threads:
+            t.start()
+        # event-driven baseline: real acked coverage on both pools
+        # before thrashing (first EC writes pay full peering)
+        deadline = time.time() + 150
+        while time.time() < deadline and not all(
+                sum(1 for (p, _n) in acked if p == pool) >= 8
+                for pool in ios):
+            time.sleep(0.5)
+
+        # thrash + shrink interleaved: the merges land while OSDs die
+        dead: set[int] = set()
+        for cycle in range(3):
+            victim = pyrng.choice(
+                [o for o in range(12) if o not in dead])
+            c.kill_osd(victim)
+            dead.add(victim)
+            mon_retry({"prefix": "osd down", "id": victim})
+            if cycle == 0:
+                mon_retry({"prefix": "osd pool set", "pool": "trp",
+                           "var": "pg_num", "val": "16"})
+            if cycle == 1:
+                mon_retry({"prefix": "osd pool set", "pool": "tep",
+                           "var": "pg_num", "val": "16"})
+            time.sleep(3.0)
+            c.revive_osd(victim)
+            dead.discard(victim)
+            time.sleep(1.5)
+
+        # keep writing a moment AFTER both merges landed so "during
+        # the merge" coverage includes post-merge parent targets too
+        post_deadline = time.time() + 30
+        post_mark = len(acked)
+        while time.time() < post_deadline and \
+                len(acked) < post_mark + 8:
+            time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join(10)
+        assert not write_errors, f"writer crashed: {write_errors[0]!r}"
+        assert len(acked) >= 30, f"workload too small: {len(acked)}"
+        assert c.mon.osdmap.lookup_pool("trp").pg_num == 16
+        assert c.mon.osdmap.lookup_pool("tep").pg_num == 16
+        # override tables consistent: nothing refers to the pools'
+        # pre-merge interval
+        pool_ids = {ios["trp"].pool_id, ios["tep"].pool_id}
+        assert not any(pg.pool in pool_ids
+                       for pg in c.mon.osdmap.pg_temp)
+        assert not any(pg.pool in pool_ids
+                       for pg in c.mon.osdmap.pg_upmap_items)
+
+        # injection off before the settle (the quiescence gate must
+        # not fight deliberate socket resets)
+        for osd in c.osds:
+            c.set_osd_conf(osd.osd_id, "ms_inject_socket_failures", 0)
+        c.wait_active_clean(timeout=300)
+        missing = dict(acked)
+        last_err = None
+        for _ in range(3):
+            for (pool, name) in list(missing):
+                want = missing[(pool, name)]
+                try:
+                    got = ios[pool].read(name, len(want))
+                    assert got == want, \
+                        f"acked {pool}/{name} corrupted"
+                    del missing[(pool, name)]
+                except AssertionError:
+                    raise
+                except Exception as e:  # noqa: BLE001
+                    last_err = e
+            if not missing:
+                break
+            time.sleep(1.0)
+        assert not missing, \
+            f"{len(missing)} acked objects unreadable after merge " \
+            f"settle (e.g. {sorted(missing)[:3]}, last {last_err!r})"

@@ -57,9 +57,11 @@ def test_pg_num_validation_and_override_pruning():
         assert r == 0
         assert c.mon.osdmap.pg_temp and c.mon.osdmap.pg_upmap_items
 
-        # merge and non-power-of-two stepping are rejected
+        # non-power-of-two stepping is rejected in both directions
+        # (merge itself is supported since the elastic-shrink PR —
+        # tests/test_pg_merge.py covers the decrease path)
         r, _ = client.mon_command({"prefix": "osd pool set", "pool": "vp",
-                                   "var": "pg_num", "val": "2"})
+                                   "var": "pg_num", "val": "3"})
         assert r != 0
         r, _ = client.mon_command({"prefix": "osd pool set", "pool": "vp",
                                    "var": "pg_num", "val": "12"})

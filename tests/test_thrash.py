@@ -32,9 +32,12 @@ def test_thrash_osds_no_acked_data_loss():
         client.create_pool("thrashpool", "erasure",
                            erasure_code_profile="thrash_p", pg_num=8)
         io = client.open_ioctx("thrashpool")
-        # light wire chaos everywhere: ~1/80 frames resets its socket
+        # light wire chaos everywhere: ~1/80 frames resets its socket.
+        # set_osd_conf records the override on the CLUSTER, so a
+        # revived daemon's fresh CephContext re-arms automatically —
+        # no manual re-arm after revive.
         for osd in c.osds:
-            osd.cct.conf.set("ms_inject_socket_failures", 80)
+            c.set_osd_conf(osd.osd_id, "ms_inject_socket_failures", 80)
 
         acked: dict[str, bytes] = {}
         stop = threading.Event()
@@ -75,8 +78,9 @@ def test_thrash_osds_no_acked_data_loss():
                 assert r == 0
             time.sleep(2.0)   # let peering/recovery churn under load
             c.revive_osd(victim)
-            # the revived daemon has a fresh CephContext: re-arm chaos
-            c.osds[victim].cct.conf.set("ms_inject_socket_failures", 80)
+            # chaos conf survives the revive (Cluster.set_osd_conf)
+            assert int(c.osds[victim].cct.conf.get(
+                "ms_inject_socket_failures")) == 80
             dead.discard(victim)
             if cycle == 1:
                 r, _ = client.mon_command(
@@ -97,7 +101,7 @@ def test_thrash_osds_no_acked_data_loss():
         # silently-consumed 300s window.  Injection off first so the
         # settle isn't fighting deliberate socket resets.
         for osd in c.osds:
-            osd.cct.conf.set("ms_inject_socket_failures", 0)
+            c.set_osd_conf(osd.osd_id, "ms_inject_socket_failures", 0)
         c.wait_active_clean(timeout=180)
 
         # every acked write must be readable and bit-identical NOW;
