@@ -321,6 +321,37 @@ def time_tracking_overhead(nobj: int, objsize: int, chunk: int,
     return max(tracked), max(untracked), noise
 
 
+def time_tail_latency(nobj: int, objsize: int, chunk: int,
+                      payloads) -> dict:
+    """Per-stage p99 tail latency of the pipelined EC write path
+    (ISSUE 9): every op tracked, stage intervals land in latency
+    histograms (common/perf_counters.py), and the percentile pipeline
+    turns them into per-stage p99s — so a tail regression names the
+    stage (queue wait, encode launch vs materialize, sub-write ack,
+    commit), not just "writes got slower".  Returns
+    {"ec_write_p99_ms": end-to-end op p99,
+     "ec_write_stage_p99_ms": {stage: p99_ms}}."""
+    from ceph_tpu.common.perf_counters import PerfCountersBuilder
+    from ceph_tpu.common.tracked_op import OpTracker
+    perf = PerfCountersBuilder("optracker.bench").create_perf_counters()
+    tracker = OpTracker(complaint_time=30.0, perf=perf)
+    time_write_pipeline(True, nobj, objsize, chunk, payloads,
+                        tracker=tracker)
+    lat = perf.dump_latencies()
+    stages = {}
+    total_p99 = None
+    for key, row in lat.items():
+        p99 = row.get("p99")
+        if p99 is None:
+            continue
+        if key == "lat_total_osd_op":
+            total_p99 = round(p99 * 1e3, 4)
+        elif key.startswith("lat_"):
+            stages[key[len("lat_"):]] = round(p99 * 1e3, 4)
+    return {"ec_write_p99_ms": total_p99,
+            "ec_write_stage_p99_ms": stages}
+
+
 def time_deep_scrub(nobj: int, objsize: int, chunk: int,
                     use_device: bool) -> tuple[float, dict]:
     """Shard bytes verified per second by a deep scrub of an EC
@@ -398,6 +429,19 @@ def bench_end_to_end(on_tpu: bool, passes: int, spacing: float) -> dict:
     out["ec_write_tracking_overhead_pct"] = round(
         (1.0 - t_best / u_best) * 100.0, 2)
     out["ec_write_tracking_noise_pct"] = round(noise, 2)
+    # tail latency: per-stage p99 on the pipelined write path
+    # (ISSUE 9 — throughput medians hide exactly what this shows)
+    out.update(time_tail_latency(nobj, objsize, chunk, payloads))
+    # QoS isolation: the deterministic virtual-time mClock experiment
+    # (tools/load_harness.py) — greedy tenant vs reserved victim;
+    # qos_isolation_ratio is gated in --smoke, no_qos_ratio is the
+    # single-FIFO contrast that proves the scheduler is doing it
+    from ceph_tpu.tools.load_harness import run_qos_isolation_sim
+    qos = run_qos_isolation_sim("tenant")
+    out["qos_isolation_ratio"] = qos["qos_isolation_ratio"]
+    out["qos_no_qos_ratio"] = qos["no_qos_ratio"]
+    out["qos_victim_p99_ms"] = qos["victim_qos_p99_ms"]
+    out["qos_victim_alone_p99_ms"] = qos["victim_alone_p99_ms"]
     return out
 
 
@@ -444,6 +488,50 @@ def run_smoke() -> int:
         print(f"# smoke FAILED: tracking overhead {ovh}% > "
               f"{thresh + noise:.2f}% ({thresh}% threshold + "
               f"{noise:.2f}% measured noise)", file=sys.stderr)
+        return 1
+    # tail-latency guard (ISSUE 9): the per-stage percentile pipeline
+    # must produce a positive end-to-end p99 AND per-stage p99s for
+    # the stages the pipelined write path always crosses — a tracing
+    # or percentile regression (events dropped, histograms empty,
+    # quantile() broken) fails here, not in a TPU round
+    stages = out.get("ec_write_stage_p99_ms") or {}
+    p99 = out.get("ec_write_p99_ms")
+    if not isinstance(p99, (int, float)) or p99 <= 0:
+        print(f"# smoke FAILED: ec_write_p99_ms={p99!r}",
+              file=sys.stderr)
+        return 1
+    # generous absolute ceiling (env-tunable): catches a pathological
+    # tail regression (an accidental sync/sleep on the op path) while
+    # absorbing slow-box noise at CPU smoke sizes
+    p99_max = float(os.environ.get("TAIL_P99_MAX_MS", "500.0"))
+    if p99 > p99_max:
+        print(f"# smoke FAILED: ec_write_p99_ms={p99} > "
+              f"TAIL_P99_MAX_MS={p99_max}", file=sys.stderr)
+        return 1
+    missing_stages = [s for s in ("ec_encode_launch", "commit")
+                      if not stages.get(s, 0) or stages[s] <= 0]
+    if missing_stages:
+        print(f"# smoke FAILED: no per-stage p99 for {missing_stages} "
+              f"(have {sorted(stages)})", file=sys.stderr)
+        return 1
+    # QoS isolation guard: a greedy tenant must not move the reserved
+    # victim's p99 past QOS_ISOLATION_MAX (deterministic virtual-time
+    # experiment — a scheduler regression, not load noise, fails it);
+    # the FIFO contrast must stay ABOVE the bound or the experiment
+    # itself lost its teeth
+    from ceph_tpu.tools.load_harness import QOS_ISOLATION_MAX
+    bound = float(os.environ.get("QOS_ISOLATION_MAX",
+                                 str(QOS_ISOLATION_MAX)))
+    ratio = out.get("qos_isolation_ratio")
+    if not isinstance(ratio, (int, float)) or ratio > bound:
+        print(f"# smoke FAILED: qos_isolation_ratio={ratio!r} > "
+              f"{bound}", file=sys.stderr)
+        return 1
+    if out.get("qos_no_qos_ratio", 0) <= bound:
+        print(f"# smoke FAILED: FIFO contrast ratio "
+              f"{out.get('qos_no_qos_ratio')!r} <= {bound} — the "
+              f"isolation experiment no longer stresses the victim",
+              file=sys.stderr)
         return 1
     return 0
 

@@ -48,6 +48,11 @@ class CephContext:
             "perf dump", lambda cmd: self.perf.dump())
         self.asok.register_command(
             "perf schema", lambda cmd: self.perf.schema())
+        # precomputed p50/p95/p99/p999 (+ error bounds) of every
+        # latency histogram — the tail-latency answer to `perf dump`'s
+        # raw buckets (docs/QOS.md, docs/TRACING.md)
+        self.asok.register_command(
+            "dump_latencies", lambda cmd: self.perf.dump_latencies())
         self.asok.register_command(
             "config show", lambda cmd: self.conf.show())
 

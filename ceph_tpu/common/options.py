@@ -101,6 +101,16 @@ register_options([
     Option("osd_pool_default_pg_num", int, 8, "default pg count", min=1),
     Option("osd_op_queue", str, "wpq", "op scheduler",
            enum_values=("wpq", "mclock")),
+    # mClock QoS (reference osd_mclock_profile + the dmclock
+    # reservation/weight/limit triples it expands to; docs/QOS.md)
+    Option("osd_mclock_profile", str, "balanced",
+           "named (reservation, weight, limit) preset per op class",
+           enum_values=("balanced", "high_client_ops",
+                        "high_recovery_ops", "custom")),
+    Option("osd_mclock_custom_profile", str, "",
+           "per-class overrides applied on top of the named profile: "
+           "'class:res,wgt,lim;...' (res/lim in ops/sec, 0 = none); "
+           "also how tenant classes get their QoS triples"),
     Option("osd_max_backfills", int, 1,
            "concurrent recovery ops per OSD", min=1),
     Option("osd_scrub_auto", bool, False, "run background scrub"),
@@ -197,6 +207,40 @@ class Config:
         if value != old:
             for cb in observers:
                 cb(name, value)
+
+    def apply_mon_layer(self, values: dict[str, Any]) -> None:
+        """Replace the 'mon' layer wholesale with the central-config
+        sections relevant to this daemon (reference ConfigMonitor ->
+        MConfig push).  Keys the schema doesn't know are skipped (a
+        newer mon may carry options this build lacks); observers fire
+        for every effectively-changed option — including ones whose
+        mon override was REMOVED (they fall back to a lower layer)."""
+        validated: dict[str, Any] = {}
+        for name, raw in values.items():
+            opt = SCHEMA.get(name)
+            if opt is None:
+                continue
+            try:
+                validated[name] = opt.validate(raw)
+            except (ValueError, TypeError):
+                continue
+        with self._lock:
+            touched = set(self._layers["mon"]) | set(validated)
+            old = {name: self.get(name) for name in touched}
+            self._layers["mon"] = validated
+            changed = [(name, self.get(name)) for name in touched
+                       if self.get(name) != old[name]]
+            observers = [(cb, name, val) for name, val in changed
+                         for cb in self._observers.get(name, [])]
+        for cb, name, val in observers:
+            # isolate observer failures: the layer is already swapped,
+            # so a skipped notification would never be retried — one
+            # bad consumer must not eat its siblings' callbacks
+            try:
+                cb(name, val)
+            except Exception:  # noqa: BLE001
+                import traceback
+                traceback.print_exc()
 
     def add_observer(self, name: str,
                      cb: Callable[[str, Any], None]) -> None:

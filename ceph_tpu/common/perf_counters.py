@@ -24,6 +24,68 @@ class CounterType(Enum):
     HISTOGRAM = "hist"       # bucketed samples (prometheus histogram)
 
 
+# The percentile set every latency surface publishes (dump_latencies
+# asok, the exporter's precomputed gauges, the load harness rows):
+# production tails are ruled by p99/p999, p50/p95 anchor the body.
+LATENCY_QUANTILES = ((0.5, "p50"), (0.95, "p95"),
+                     (0.99, "p99"), (0.999, "p999"))
+
+
+def quantile_from_cumulative(buckets: list, q: float
+                             ) -> tuple[float, float, float] | None:
+    """Quantile estimate from prometheus-style cumulative buckets
+    [[le, cum], ..., ["+Inf", total]] — the exact shape PerfCounters
+    histograms dump and the exporter scrapes.
+
+    Returns (estimate, err_lo, err_hi) or None for an empty histogram.
+    The estimate linearly interpolates inside the bucket holding the
+    q-th sample (the classic histogram_quantile estimator); err_lo/
+    err_hi are the bucket bounds — the true quantile provably lies in
+    [err_lo, err_hi], so the publication carries its own error bar.
+    A quantile landing in the +Inf bucket reports the last finite
+    bound as the estimate with err_hi = inf (the honest answer: the
+    axis ran out, widen the buckets)."""
+    if not buckets:
+        return None
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile {q} outside [0, 1]")
+    rank = q * total
+    prev_le, prev_cum = 0.0, 0
+    for le, cum in buckets:
+        if le == "+Inf":
+            if cum > prev_cum and rank > prev_cum:
+                return (prev_le, prev_le, float("inf"))
+            # rank landed exactly on the finite edge
+            return (prev_le, prev_le, prev_le)
+        if cum >= rank:
+            lo = prev_le
+            frac = ((rank - prev_cum) / (cum - prev_cum)) \
+                if cum > prev_cum else 1.0
+            return (lo + frac * (le - lo), lo, le)
+        prev_le, prev_cum = le, cum
+    return (prev_le, prev_le, float("inf"))
+
+
+def percentiles_from_samples(samples: list, quantiles=None) -> dict:
+    """Exact percentiles from raw latency samples (the harness's
+    per-op recordings; nearest-rank on the sorted list).  Returns
+    {label: seconds} for LATENCY_QUANTILES (or the given
+    [(q, label), ...]); empty dict when there are no samples."""
+    if not samples:
+        return {}
+    import math
+    s = sorted(samples)
+    out = {}
+    for q, label in (quantiles or LATENCY_QUANTILES):
+        # nearest-rank: the ceil(q*n)-th order statistic (1-indexed)
+        idx = min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))
+        out[label] = s[idx]
+    return out
+
+
 # Log-spaced latency bounds in seconds (reference PerfHistogram axis
 # config; prometheus-style, the implicit +Inf bucket holds the rest).
 DEFAULT_LAT_BUCKETS = (
@@ -81,6 +143,19 @@ class PerfCounters:
     def inc(self, key: str, by: float = 1) -> None:
         self._c[key].value += by
 
+    def dinc(self, key: str, by: float = 1) -> None:
+        """inc() for dynamic key sets (the mClock per-class counters:
+        op classes appear at runtime as tenants do): creates the U64
+        counter on first use, like hinc does for histograms."""
+        c = self._c.get(key)
+        if c is None:
+            with self._lock:
+                c = self._c.get(key)
+                if c is None:
+                    c = _Counter(key, CounterType.U64)
+                    self._c[key] = c
+        c.value += by
+
     def set(self, key: str, value: float) -> None:
         self._c[key].value = value
 
@@ -128,13 +203,8 @@ class PerfCounters:
                                 "avgtime": c.sum / c.count if c.count else 0}
                 elif c.type == CounterType.HISTOGRAM:
                     # cumulative prometheus-style buckets, +Inf last
-                    cum, buckets = 0, []
-                    for le, n in zip(c.buckets, c.hist):
-                        cum += n
-                        buckets.append([le, cum])
-                    buckets.append(["+Inf", cum + c.hist[-1]])
                     out[key] = {"sum": c.sum, "count": c.count,
-                                "buckets": buckets}
+                                "buckets": self._cumulative(c)}
                 else:
                     out[key] = c.value
             return out
@@ -144,6 +214,51 @@ class PerfCounters:
         prometheus exporter emit correct # TYPE lines instead of
         untyped."""
         return {key: c.type.value for key, c in self._c.items()}
+
+    # -- percentile pipeline (tail-latency observability) --------------------
+
+    def _cumulative(self, c: _Counter) -> list:
+        cum, buckets = 0, []
+        for le, n in zip(c.buckets, c.hist):
+            cum += n
+            buckets.append([le, cum])
+        buckets.append(["+Inf", cum + c.hist[-1]])
+        return buckets
+
+    def quantile(self, key: str, q: float
+                 ) -> tuple[float, float, float] | None:
+        """(estimate, err_lo, err_hi) of a histogram counter's q-th
+        quantile, or None when the key is absent/empty/not a
+        histogram (see quantile_from_cumulative)."""
+        c = self._c.get(key)
+        if c is None or c.type != CounterType.HISTOGRAM:
+            return None
+        with self._lock:
+            buckets = self._cumulative(c)
+        return quantile_from_cumulative(buckets, q)
+
+    def dump_latencies(self) -> dict:
+        """Precomputed percentile summary of every histogram counter:
+        {key: {count, sum, p50, p95, p99, p999, p99_err: [lo, hi]}} —
+        the `dump_latencies` asok payload and the exporter's gauge
+        source.  Estimates are bucket-interpolated; p99_err carries
+        the p99's bucket bounds so consumers see the resolution."""
+        with self._lock:
+            snap = [(key, c.count, c.sum, self._cumulative(c))
+                    for key, c in self._c.items()
+                    if c.type == CounterType.HISTOGRAM]
+        out = {}
+        for key, count, total, buckets in snap:
+            row = {"count": count, "sum": round(total, 9)}
+            for q, label in LATENCY_QUANTILES:
+                est = quantile_from_cumulative(buckets, q)
+                row[label] = round(est[0], 9) if est else None
+                if est and label == "p99":
+                    row["p99_err"] = [round(est[1], 9),
+                                      est[2] if est[2] == float("inf")
+                                      else round(est[2], 9)]
+            out[key] = row
+        return out
 
 
 class PerfCountersCollection:
@@ -166,3 +281,16 @@ class PerfCountersCollection:
     def schema(self) -> dict:
         with self._lock:
             return {name: pc.schema() for name, pc in self._sets.items()}
+
+    def dump_latencies(self) -> dict:
+        """Percentile summaries of every set's histogram counters
+        (the daemon-wide `dump_latencies` asok command); sets without
+        histograms are omitted."""
+        with self._lock:
+            sets = list(self._sets.items())
+        out = {}
+        for name, pc in sets:
+            lat = pc.dump_latencies()
+            if lat:
+                out[name] = lat
+        return out

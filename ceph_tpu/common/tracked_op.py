@@ -324,6 +324,10 @@ class OpTracker:
         if self.perf is not None:
             for name, dt in top.stage_durations():
                 self.perf.hinc(f"lat_{canonical_stage(name)}", dt)
+            # end-to-end per-op-type series: the p99 every per-stage
+            # series decomposes (dump_latencies / the exporter's
+            # precomputed tail gauges read it like any stage)
+            self.perf.hinc(f"lat_total_{top.op_type}", top.duration())
 
     # -- slow-op surveillance ------------------------------------------------
 

@@ -55,6 +55,7 @@ READONLY_COMMANDS = {
     "osd erasure-code-profile get", "osd erasure-code-profile ls",
     "osd pool ls", "osd pool get", "status", "osd tree", "mon stat",
     "config get", "config dump", "health", "pg stat",
+    "osd mclock profile get",
     "osd ok-to-stop", "osd safe-to-destroy",
     "fs ls", "fs dump", "mgr dump",
 }
@@ -301,10 +302,19 @@ class Monitor:
         ok = self.paxos.propose(value)
         return ok
 
+    def _map_payload(self) -> dict:
+        """The MMonMap body: the committed osdmap plus the central
+        config sections (reference ConfigMonitor: config rides map
+        publishes so daemons apply `config set` / `osd mclock profile
+        set` at runtime; OSDMap.from_json ignores the extra key)."""
+        j = dict(self._committed_json.get("osdmap", {}))
+        j["config"] = self._committed_json.get("config", {})
+        return j
+
     def _publish(self) -> None:
         """Push the committed map to every subscriber (reference OSDMap
         epoch share; subscribers are daemons and clients)."""
-        j = self._committed_json.get("osdmap", {})
+        j = self._map_payload()
         for conn in list(self._subscribers):
             try:
                 conn.send_message(M.MMonMap(j))
@@ -357,8 +367,7 @@ class Monitor:
             # makes daemons/clients hunt to a live mon (reference
             # Paxos::is_lease_valid gating on reads)
             if self._lease_ok():
-                conn.send_message(M.MMonMap(
-                    self._committed_json.get("osdmap", {})))
+                conn.send_message(M.MMonMap(self._map_payload()))
         elif isinstance(msg, M.MOSDBoot):
             if self.is_leader:
                 self._handle_boot(msg)
@@ -617,6 +626,9 @@ class Monitor:
                 return self._cmd_osd_rm(cmd)
             if prefix == "pg stat":
                 return self._cmd_pg_stat()
+            if prefix in ("osd mclock profile set",
+                          "osd mclock profile get"):
+                return self._cmd_mclock_profile(prefix, cmd)
             if prefix == "osd blacklist add":
                 entity = str(cmd["entity"])
                 ttl = float(cmd.get("expire", 3600.0))
@@ -748,6 +760,58 @@ class Monitor:
             return -e.errno, {"error": str(e)}
         except KeyError as e:
             return -errno.EINVAL, {"error": f"missing arg {e}"}
+
+    def _cmd_mclock_profile(self, prefix: str, cmd: dict
+                            ) -> tuple[int, dict]:
+        """mClock QoS profile get/set (reference `ceph config set osd
+        osd_mclock_profile ...` sugar): the set lands in the central
+        config 'osd' section and rides the next map publish to every
+        running OSD (docs/QOS.md); get reports the stored knobs AND
+        the per-class (reservation, weight, limit) triples they
+        resolve to."""
+        from ..osd.scheduler import (MCLOCK_PROFILES,
+                                     parse_custom_profile,
+                                     profiles_from_conf)
+        if prefix == "osd mclock profile set":
+            name = str(cmd.get("profile", ""))
+            if name not in (*MCLOCK_PROFILES, "custom"):
+                return -errno.EINVAL, {
+                    "error": f"unknown profile {name!r}",
+                    "known": sorted((*MCLOCK_PROFILES, "custom"))}
+            custom = cmd.get("custom")
+            if custom:
+                try:
+                    parse_custom_profile(str(custom))
+                except ValueError as e:
+                    return -errno.EINVAL, {"error": str(e)}
+            with self.lock:
+                osd_sec = self.config_db.setdefault("osd", {})
+                osd_sec["osd_mclock_profile"] = name
+                if custom is not None:
+                    if custom:
+                        osd_sec["osd_mclock_custom_profile"] = \
+                            str(custom)
+                    else:
+                        osd_sec.pop("osd_mclock_custom_profile", None)
+                self._propose_current()
+            return 0, {"profile": name,
+                       "custom": osd_sec.get(
+                           "osd_mclock_custom_profile", "")}
+        # get: the effective resolution a fresh OSD would compute
+        osd_sec = self.config_db.get("osd", {})
+        name = osd_sec.get("osd_mclock_profile", "balanced")
+        custom = osd_sec.get("osd_mclock_custom_profile", "")
+
+        class _ConfView:
+            def get(self, key):
+                return {"osd_mclock_profile": name,
+                        "osd_mclock_custom_profile": custom}[key]
+        resolved = profiles_from_conf(_ConfView())
+        return 0, {"profile": name, "custom": custom,
+                   "classes": {c: {"reservation": p.reservation,
+                                   "weight": p.weight,
+                                   "limit": p.limit}
+                               for c, p in resolved.items()}}
 
     # -- PaxosService command surfaces (auth/config/fs/mgr) -----------------
 

@@ -156,7 +156,8 @@ class MOSDOp(Message):
     def __init__(self, pgid: spg_t, oid: hobject_t, ops: list,
                  data: bytes = b"", tid: int = 0, epoch: int = 0,
                  snapc: list | None = None,
-                 trace: dict | None = None):
+                 trace: dict | None = None,
+                 qos: str | None = None):
         super().__init__()
         self.pgid, self.oid, self.ops = pgid, oid, ops
         self.data, self.tid, self.epoch = data, tid, epoch
@@ -167,6 +168,10 @@ class MOSDOp(Message):
         # TraceContext.to_wire): stitches the client's objecter span
         # to the primary's op span across the wire
         self.trace = trace
+        # client-declared QoS class (dmclock rides client info on the
+        # op the same way): the mClock scheduler's per-tenant key;
+        # None schedules as plain "client"
+        self.qos = qos
 
     def to_meta(self):
         m = {"pgid": spg_to_json(self.pgid),
@@ -175,6 +180,8 @@ class MOSDOp(Message):
              "snapc": self.snapc}
         if self.trace is not None:
             m["trace"] = self.trace
+        if self.qos is not None:
+            m["qos"] = self.qos
         return m
 
     def data_segment(self):
@@ -187,6 +194,7 @@ class MOSDOp(Message):
         self.epoch = meta["epoch"]
         self.snapc = meta.get("snapc")
         self.trace = meta.get("trace")
+        self.qos = meta.get("qos")
         self.data = data
 
 

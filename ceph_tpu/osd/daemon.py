@@ -383,11 +383,17 @@ class OSDDaemon:
                  addr: tuple[str, int] = ("127.0.0.1", 0),
                  heartbeat_interval: float = 0.0,
                  asok_path: str | None = None,
-                 auth=None, secure: bool = False):
+                 auth=None, secure: bool = False,
+                 conf: dict | None = None):
         from ..common.context import CephContext
         from ..common.perf_counters import PerfCountersBuilder
         self.osd_id = osd_id
         self.cct = CephContext(f"osd.{osd_id}", asok_path)
+        # startup conf overrides must land BEFORE anything reads them:
+        # options like osd_op_queue choose construction-time shape
+        # (the scheduler kind), so post-construction .set() is too late
+        for _k, _v in (conf or {}).items():
+            self.cct.conf.set(_k, _v)
         self.cct.preload_erasure_code()
         self.perf = self.cct.perf.add(
             PerfCountersBuilder(f"osd.{osd_id}")
@@ -460,6 +466,29 @@ class OSDDaemon:
         from concurrent.futures import ThreadPoolExecutor
         self._op_pool = ThreadPoolExecutor(
             max_workers=16, thread_name_prefix=f"osd.{osd_id}.op")
+        # op scheduler (reference OpScheduler.cc make_scheduler):
+        # osd_op_queue=mclock routes client ops through a ShardedOpWQ
+        # draining an MClockScheduler — per-class reservation/weight/
+        # limit QoS with observable phase + queue-wait counters
+        # (mclock.osd.N perf set, docs/QOS.md).  The wpq default keeps
+        # the plain executor: same 16-wide worker pool either way.
+        self.op_wq = None
+        if str(self.cct.conf.get("osd_op_queue")) == "mclock":
+            from .scheduler import ShardedOpWQ
+            self.op_wq = ShardedOpWQ(
+                n_threads=16, kind="mclock", conf=self.cct.conf,
+                perf=self.cct.perf.add(
+                    PerfCountersBuilder(f"mclock.osd.{osd_id}")
+                    .create_perf_counters()))
+
+            def _apply_mclock(_k=None, _v=None):
+                self.op_wq.apply_conf(self.cct.conf)
+            for _opt in ("osd_mclock_profile",
+                         "osd_mclock_custom_profile"):
+                self.cct.conf.add_observer(_opt, _apply_mclock)
+            if self.cct.asok is not None:
+                self.cct.asok.register_command(
+                    "dump_mclock", lambda cmd: self.op_wq.dump())
         # PGs whose last recovery pass failed: the steady-state skip
         # must not strand them until an unrelated acting change
         self._pgs_needing_recovery: set = set()
@@ -600,6 +629,8 @@ class OSDDaemon:
     def shutdown(self) -> None:
         self._hb_stop.set()
         self._op_pool.shutdown(wait=False)
+        if self.op_wq is not None:
+            self.op_wq.drain_and_stop()
         self.messenger.shutdown()
         self.store.umount()
         self.cct.shutdown()
@@ -651,7 +682,24 @@ class OSDDaemon:
                 # two ops.  Per-object ordering still comes from the
                 # stripe locks in _handle_client_op.
                 top.mark_event("queued")
-                self._op_pool.submit(self._handle_client_op_safe, conn, msg)
+                if self.op_wq is not None:
+                    # mclock path: the op class is the client-declared
+                    # QoS class riding the wire (dmclock carries client
+                    # info the same way) — but only operator-
+                    # provisioned, non-internal classes are honored;
+                    # everything else collapses into "client"
+                    # (ShardedOpWQ.wire_class_ok).
+                    # _handle_client_op_safe marks `dequeued`.
+                    qc = getattr(msg, "qos", None)
+                    if not qc or not self.op_wq.wire_class_ok(qc):
+                        qc = "client"
+                    self.op_wq.queue(
+                        lambda c=conn, m=msg:
+                            self._handle_client_op_safe(c, m),
+                        op_class=qc)
+                else:
+                    self._op_pool.submit(self._handle_client_op_safe,
+                                         conn, msg)
             elif isinstance(msg, M.MOSDECSubOpWrite):
                 self.perf.inc("subop_w")
                 # sub-op span: child of the primary's op span, same
@@ -732,8 +780,28 @@ class OSDDaemon:
                 import traceback
                 traceback.print_exc()
 
+    def _apply_mon_config(self, config: dict) -> None:
+        """Central config (reference ConfigMonitor/MConfig): the mon
+        piggybacks its config_db sections on every map publish; the
+        'global' < 'osd' < 'osd.N' sections become this daemon's 'mon'
+        config layer, so `ceph config set` / `osd mclock profile set`
+        reach running daemons without a restart."""
+        merged: dict = {}
+        for section in ("global", "osd", f"osd.{self.osd_id}"):
+            merged.update(config.get(section, {}))
+        try:
+            self.cct.conf.apply_mon_layer(merged)
+        except Exception:  # noqa: BLE001 - a bad central value must
+            # never take the map-handling path down with it
+            import traceback
+            traceback.print_exc()
+
     def _handle_map(self, msg: M.MMonMap) -> None:
         self._last_map_time = time.time()
+        # config rides every publish, even ones whose osdmap epoch we
+        # already have (a pure `config set` doesn't bump the osdmap)
+        if "config" in msg.map_json:
+            self._apply_mon_config(msg.map_json["config"] or {})
         newmap = OSDMap.from_json(msg.map_json)
         if newmap.epoch <= self.osdmap.epoch and self.osdmap.epoch:
             self.map_event.set()

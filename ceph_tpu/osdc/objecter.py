@@ -187,7 +187,8 @@ class Objecter:
     def op_submit(self, pool_id: int, name: str, ops: list,
                   data: bytes = b"", timeout: float = 30.0,
                   attempts: int = 3, snap: int = 0,
-                  snapc: list | None = None) -> M.MOSDOpReply:
+                  snapc: list | None = None,
+                  qos_class: str | None = None) -> M.MOSDOpReply:
         # an expired ticket would make every OSD reconnect fail
         # permanently; refresh before it lapses (reference
         # CephxTicketManager renewal)
@@ -207,7 +208,7 @@ class Objecter:
         try:
             return self._op_submit_attempts(
                 pool_id, name, ops, data, timeout, attempts, snapc,
-                oid, trace, top)
+                oid, trace, top, qos_class)
         finally:
             # idempotent (reply/timeout paths unregister with their
             # result); catches exceptions escaping the retry loop —
@@ -216,8 +217,8 @@ class Objecter:
             self.op_tracker.unregister(top, -errno.EIO)
 
     def _op_submit_attempts(self, pool_id, name, ops, data, timeout,
-                            attempts, snapc, oid, trace, top
-                            ) -> M.MOSDOpReply:
+                            attempts, snapc, oid, trace, top,
+                            qos_class=None) -> M.MOSDOpReply:
         last_err = None
         # EAGAIN (not-primary / peering-incomplete) replies arrive in
         # milliseconds now that the OSD fences every op path; they ride
@@ -252,7 +253,8 @@ class Objecter:
             conn = self.messenger.connect(tuple(info.addr))
             conn.send_message(M.MOSDOp(spg, oid, ops, data, tid,
                                        self.osdmap.epoch, snapc=snapc,
-                                       trace=trace.to_wire()))
+                                       trace=trace.to_wire(),
+                                       qos=qos_class))
             if w["event"].wait(timeout):
                 reply = w["reply"]
                 if reply.epoch > self.osdmap.epoch and \

@@ -86,6 +86,15 @@ class IoCtx:
         # snapc rides every write; read_snap redirects reads to a clone
         self.snapc: list | None = None     # [seq, [snap ids desc]]
         self.read_snap: int = 0
+        # QoS class every op of this ioctx declares on the wire (the
+        # mClock scheduler's per-tenant key; None = plain "client")
+        self.qos_class: str | None = None
+
+    def set_qos_class(self, qos_class: str | None) -> None:
+        """Tag this ioctx's ops with an mClock QoS class (tenant name);
+        the OSD schedules them under that class's (reservation,
+        weight, limit) triple — see docs/QOS.md."""
+        self.qos_class = qos_class
 
     def set_snap_context(self, seq: int, snaps: list[int]) -> None:
         self.snapc = [int(seq), [int(s) for s in snaps]]
@@ -117,7 +126,7 @@ class IoCtx:
                 snap: int = 0) -> bytes:
         reply = self.client.objecter.op_submit(
             self.pool_id, name, ops, data, snap=snap,
-            snapc=self.snapc)
+            snapc=self.snapc, qos_class=self.qos_class)
         if reply.result != 0:
             raise RadosError(-reply.result, f"op on {name}")
         return reply.data
@@ -137,10 +146,7 @@ class IoCtx:
                             else snap)
 
     def stat(self, name: str) -> int:
-        reply = self.client.objecter.op_submit(
-            self.pool_id, name, [["stat"]])
-        if reply.result != 0:
-            raise RadosError(-reply.result, f"stat {name}")
+        self._submit(name, [["stat"]])
         return 0  # size via read for now; meta channel reserved
 
     def remove(self, name: str) -> None:

@@ -22,6 +22,12 @@ commands this build's mon implements:
       set NAME k=4 m=2 plugin=jax
   python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd erasure-code-profile \
       {get NAME | ls}
+  python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd mclock profile get
+  python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd mclock profile \
+      set PROFILE [CLASS:RES,WGT,LIM;...]   # rides central config to OSDs
+  python -m ceph_tpu.tools.ceph_cli daemon /path/to/osd.N.asok \
+      {dump_latencies | dump_mclock | perf dump | ...}   # local asok,
+      # no mon needed (reference `ceph daemon`)
 """
 
 from __future__ import annotations
@@ -36,7 +42,35 @@ def parse_addr(s: str) -> tuple[str, int]:
     return host, int(port)
 
 
+def daemon_command(argv: list[str]) -> int:
+    """`ceph daemon PATH CMD [KEY VALUE ...]` — straight to a local
+    admin socket, mon not required (reference src/ceph.in daemon
+    mode).  The tail-latency commands live here: `dump_latencies`
+    (percentile summary of every latency histogram) and `dump_mclock`
+    (per-class QoS state)."""
+    if len(argv) < 2:
+        print("ceph daemon: usage: daemon ASOK_PATH COMMAND "
+              "[KEY VALUE ...]", file=sys.stderr)
+        return 22
+    from ..common.admin_socket import admin_command
+    path, prefix = argv[0], argv[1]
+    cmd = {"prefix": prefix}
+    extra = argv[2:]
+    if len(extra) % 2:
+        print("ceph daemon: trailing KEY without VALUE",
+              file=sys.stderr)
+        return 22
+    for k, v in zip(extra[::2], extra[1::2]):
+        cmd[k] = v
+    out = admin_command(path, cmd)
+    print(json.dumps(out, indent=2, sort_keys=True))
+    return 1 if isinstance(out, dict) and "error" in out else 0
+
+
 def main(argv=None) -> int:
+    raw = list(sys.argv[1:] if argv is None else argv)
+    if raw and raw[0] == "daemon":
+        return daemon_command(raw[1:])
     ap = argparse.ArgumentParser(prog="ceph")
     ap.add_argument("-m", "--mon", required=True)
     ap.add_argument("--type", default="replicated")
@@ -46,7 +80,7 @@ def main(argv=None) -> int:
     ap.add_argument("words", nargs="+")
     from .rados_cli import add_auth_args, cli_auth
     add_auth_args(ap)
-    args = ap.parse_args(argv)
+    args = ap.parse_args(raw)
     words = args.words
 
     from ..osdc import Objecter
@@ -93,6 +127,14 @@ def main(argv=None) -> int:
             cmd = {"prefix": "mon stat"}
         elif words == ["pg", "stat"]:
             cmd = {"prefix": "pg stat"}
+        elif words[:4] == ["osd", "mclock", "profile", "get"]:
+            cmd = {"prefix": "osd mclock profile get"}
+        elif words[:4] == ["osd", "mclock", "profile", "set"] \
+                and len(words) in (5, 6):
+            cmd = {"prefix": "osd mclock profile set",
+                   "profile": words[4]}
+            if len(words) == 6:
+                cmd["custom"] = words[5]
         elif words[:2] == ["osd", "reweight"] and len(words) == 4:
             cmd = {"prefix": "osd reweight", "id": int(words[2]),
                    "weight": float(words[3])}

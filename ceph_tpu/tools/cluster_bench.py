@@ -28,22 +28,29 @@ import numpy as np
 
 def bench_pool(cluster, client, pool: str, seconds: float,
                threads: int, size: int) -> dict:
+    from .latency import LatencyRecorder
     io = client.open_ioctx(pool)
     payload = np.random.default_rng(7).integers(
         0, 256, size, dtype=np.uint8).tobytes()
     stop = time.time() + seconds
     counts = [0] * threads
-    errors = [0] * threads
+    # per-op latency samples + errors bucketed by exception type (a
+    # bare error count hid WHAT failed; reference `rados bench` keeps
+    # per-op latencies the same way)
+    wlat = LatencyRecorder("write")
+    rlat = LatencyRecorder("read")
 
     def writer(t: int) -> None:
         i = 0
         myio = client.open_ioctx(pool)
         while time.time() < stop:
+            t0 = time.perf_counter()
             try:
                 myio.write_full(f"b_{t}_{i}", payload)
+                wlat.record(time.perf_counter() - t0)
                 counts[t] += 1
-            except Exception:  # noqa: BLE001
-                errors[t] += 1
+            except Exception as e:  # noqa: BLE001
+                wlat.error(e)
             i += 1
 
     ts = [threading.Thread(target=writer, args=(t,)) for t in
@@ -65,15 +72,30 @@ def bench_pool(cluster, client, pool: str, seconds: float,
     r0 = time.time()
     rn = 0
     for i in range(min(counts[0], 64)):
-        got = io.read(f"b_0_{i}", size)
+        rt0 = time.perf_counter()
+        try:
+            got = io.read(f"b_0_{i}", size)
+        except Exception as e:  # noqa: BLE001
+            rlat.error(e)
+            continue
+        rlat.record(time.perf_counter() - rt0)
         assert got == payload, "read-back mismatch"
         rn += 1
     relapsed = time.time() - r0
+    wsum, rsum = wlat.summary(), rlat.summary()
+    by_type = dict(wsum["errors_by_type"])
+    for k, v in rsum["errors_by_type"].items():
+        by_type[k] = by_type.get(k, 0) + v
     return {
         "write_mb_s": round(wrote * size / elapsed / 1e6, 2),
         "write_iops": round(wrote / elapsed, 1),
         "ops": wrote,
-        "errors": sum(errors),
+        "errors": wsum["errors"] + rsum["errors"],
+        "errors_by_type": by_type,
+        "write_lat": {k: v for k, v in wsum.items()
+                      if k not in ("errors", "errors_by_type")},
+        "read_lat": {k: v for k, v in rsum.items()
+                     if k not in ("errors", "errors_by_type")},
         "read_mb_s": round(rn * size / relapsed / 1e6, 2)
         if relapsed > 0 and rn else None,
     }

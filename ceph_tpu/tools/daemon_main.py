@@ -59,11 +59,14 @@ def run_osd(args) -> int:
     from ..store import create_store
     store = create_store(args.objectstore, args.data_dir)
     mons = [_parse_addr(a) for a in args.mon.split(",")]
-    osd = OSDDaemon(args.id, mons, store=store,
-                    heartbeat_interval=args.heartbeat)
+    conf = {}
     for kv in args.conf or []:
         k, _, v = kv.partition("=")
-        osd.cct.conf.set(k, v)
+        conf[k] = v
+    # conf rides the constructor: startup options (osd_op_queue) pick
+    # construction-time shape and must precede anything reading them
+    osd = OSDDaemon(args.id, mons, store=store,
+                    heartbeat_interval=args.heartbeat, conf=conf)
     osd.boot()
     print(f"READY {osd.addr[0]}:{osd.addr[1]}", flush=True)
     _serve_forever(osd.shutdown)

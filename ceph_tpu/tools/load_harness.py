@@ -1,0 +1,608 @@
+"""High-concurrency load harness: tail latency and QoS as first-class,
+gated metrics (ROADMAP open item 4; reference `rados bench` +
+qa/tasks/radosbench.py crossed with the dmclock QoS test matrix).
+
+Throughput benches (cluster_bench.py) answer "how fast"; production
+serving is ruled by p99.  This harness drives million-client-SHAPED
+load — many concurrent client sessions, mixed read/write, Zipf-skewed
+hot objects, burst arrival schedules — over raw rados, RBD and RGW S3,
+records every op's end-to-end latency, and pulls per-stage latency
+from the PR 4 tracing histograms so a p99/p999 regression lands on a
+STAGE (queue wait, encode launch vs materialize, sub-write ack,
+commit), not a blob.  The QoS scenarios make the mClock scheduler's
+isolation claim falsifiable: a greedy tenant must not move a
+well-behaved tenant's p99 by more than a bounded factor.
+
+One JSON line per scenario (BENCH-artifact compatible, so BENCH_r0N
+rounds can carry p99 trajectories):
+
+  python -m ceph_tpu.tools.load_harness --scenario rados --clients 64
+  python -m ceph_tpu.tools.load_harness --scenario qos-sim
+  python -m ceph_tpu.tools.load_harness --scenario all --seconds 5
+
+Scenarios: rados | rbd | s3 | qos-sim | qos-sim-recovery |
+qos-cluster | all.  The qos-sim rows run the mClock dequeuer in
+VIRTUAL time (deterministic, no cluster, milliseconds of wall clock)
+— they are the tier-1-gated isolation proof; the cluster scenarios
+exercise the same claim end to end and run under the `slow` marker.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+from ..common.perf_counters import (LATENCY_QUANTILES,
+                                    percentiles_from_samples,
+                                    quantile_from_cumulative)
+from ..osd.scheduler import (MCLOCK_PROFILES, ClientProfile,
+                             MClockScheduler)
+from .latency import LatencyRecorder, ZipfSampler, burst_gaps
+
+# QoS isolation bound: the harness (and bench.py --smoke) assert a
+# greedy tenant moves a well-behaved tenant's p99 queue wait by no
+# more than this factor.  The sim is deterministic; 2x leaves room
+# for the one-service-time quantization a reservation can't remove.
+QOS_ISOLATION_MAX = 2.0
+
+
+# -- per-stage percentile extraction (the tracing histograms) ---------------
+
+def merge_stage_histograms(perf_dumps) -> dict[str, list]:
+    """Merge `lat_<stage>` histogram buckets across daemons' `perf
+    dump` payloads (all histograms share the same le axis, so the
+    cumulative columns add): {stage: [[le, cum], ..., ['+Inf', n]]}.
+    Accepts the exact dict `perf dump` returns — works in-process
+    (osd.cct.perf.dump()) and over the asok alike."""
+    merged: dict[str, list] = {}
+    for dump in perf_dumps:
+        for counters in dump.values():
+            if not isinstance(counters, dict):
+                continue
+            for key, val in counters.items():
+                if not key.startswith("lat_") or \
+                        not isinstance(val, dict) or \
+                        "buckets" not in val:
+                    continue
+                stage = key[len("lat_"):]
+                if stage not in merged:
+                    merged[stage] = [[le, cum] for le, cum
+                                     in val["buckets"]]
+                else:
+                    have = merged[stage]
+                    for i, (_le, cum) in enumerate(val["buckets"]):
+                        have[i][1] += cum
+    return merged
+
+
+def stage_quantiles(perf_dumps, unit_ms: bool = True) -> dict:
+    """{stage: {count, p50/p95/p99/p999}} from merged tracing
+    histograms — the "blame lands on a stage" payload."""
+    scale = 1e3 if unit_ms else 1.0
+    suffix = "_ms" if unit_ms else "_s"
+    out = {}
+    for stage, buckets in merge_stage_histograms(perf_dumps).items():
+        total = buckets[-1][1]
+        if not total:
+            continue
+        row = {"count": total}
+        for q, label in LATENCY_QUANTILES:
+            est = quantile_from_cumulative(buckets, q)
+            row[f"{label}{suffix}"] = round(est[0] * scale, 4) \
+                if est else None
+        out[stage] = row
+    return out
+
+
+def cluster_stage_quantiles(cluster) -> dict:
+    """Per-stage percentiles aggregated over every live OSD of an
+    in-process Cluster (tools/vstart.py)."""
+    return stage_quantiles(
+        osd.cct.perf.dump() for osd in cluster.osds if osd is not None)
+
+
+# -- mixed-workload drivers -------------------------------------------------
+
+class WorkloadSpec:
+    """One scenario's knobs (shared by the rados/rbd/s3 drivers)."""
+
+    def __init__(self, clients: int = 32, seconds: float = 3.0,
+                 size: int = 64 << 10, read_frac: float = 0.5,
+                 n_objects: int = 512, zipf_alpha: float = 1.1,
+                 rate: float = 0.0, burst_factor: float = 4.0,
+                 burst_every: int = 0, burst_len: int = 0,
+                 sessions_per_client: int = 1, seed: int = 1):
+        self.clients = clients
+        self.seconds = seconds
+        self.size = size
+        self.read_frac = read_frac
+        self.n_objects = n_objects
+        self.zipf_alpha = zipf_alpha
+        self.rate = rate                  # per-session ops/sec (0 = closed loop)
+        self.burst_factor = burst_factor
+        self.burst_every = burst_every
+        self.burst_len = burst_len
+        # open-loop only: each worker thread multiplexes this many
+        # logical client sessions, each with its own arrival schedule
+        # — thousands of client sessions without thousands of Python
+        # threads (the million-client SHAPE at harness scale)
+        self.sessions_per_client = max(1, sessions_per_client)
+        self.seed = seed
+
+    def meta(self) -> dict:
+        return {"clients": self.clients, "seconds": self.seconds,
+                "sessions": self.clients * self.sessions_per_client,
+                "obj_size": self.size, "read_frac": self.read_frac,
+                "n_objects": self.n_objects,
+                "zipf_alpha": self.zipf_alpha,
+                "rate_per_session": self.rate,
+                "burst": [self.burst_factor, self.burst_every,
+                          self.burst_len]}
+
+
+def _run_workers(spec: WorkloadSpec, make_op) -> LatencyRecorder:
+    """Drive `spec.clients` concurrent sessions for `spec.seconds`.
+    make_op(worker_idx) -> op(is_read, obj_idx) callable; every call
+    is timed into the shared recorder, exceptions bucket by type.
+    Arrival pacing: closed loop by default; with spec.rate, each
+    session follows an open-loop Poisson/burst schedule (ops whose
+    slot already passed fire immediately — the backlogged-queue shape
+    a real burst produces)."""
+    lat = LatencyRecorder()
+    zipf = ZipfSampler(spec.n_objects, spec.zipf_alpha, spec.seed)
+    stop_at = [0.0]
+
+    def worker(widx: int) -> None:
+        import heapq
+        rng = np.random.default_rng(spec.seed + 1000 + widx)
+        sampler = zipf.spawn(spec.seed + 2000 + widx)
+        op = make_op(widx)
+        # one arrival schedule per logical session; the worker fires
+        # whichever session is due next (earliest-deadline heap)
+        nsess = spec.sessions_per_client if spec.rate > 0 else 1
+        gaps = [burst_gaps(spec.rate, 1 << 30, spec.burst_factor,
+                           spec.burst_every, spec.burst_len,
+                           seed=spec.seed + 3000 + widx * 10007 + s)
+                for s in range(nsess)]
+        t_start = time.perf_counter()
+        due = [(t_start + next(gaps[s]), s) for s in range(nsess)] \
+            if spec.rate > 0 else None
+        if due:
+            heapq.heapify(due)
+        while True:
+            now = time.perf_counter()
+            if now >= stop_at[0]:
+                return
+            if due is not None:
+                next_due, sess = heapq.heappop(due)
+                if next_due > now:
+                    time.sleep(min(next_due - now,
+                                   max(0.0, stop_at[0] - now)))
+                    if time.perf_counter() >= stop_at[0]:
+                        return
+                heapq.heappush(due, (next_due + next(gaps[sess]),
+                                     sess))
+            is_read = rng.random() < spec.read_frac
+            obj = sampler.draw()
+            t0 = time.perf_counter()
+            try:
+                op(is_read, obj)
+                lat.record(time.perf_counter() - t0)
+            except Exception as e:  # noqa: BLE001 - bucketed, reported
+                lat.error(e)
+
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(spec.clients)]
+    stop_at[0] = time.perf_counter() + spec.seconds
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return lat
+
+
+def run_rados_mixed(cluster, client, pool: str,
+                    spec: WorkloadSpec, qos_class: str | None = None
+                    ) -> dict:
+    """Mixed read/write over raw rados.  Objects are pre-seeded so
+    reads never miss; writes overwrite (the hot-object overwrite
+    pattern the extent cache and batch window exist for)."""
+    payload = np.random.default_rng(5).integers(
+        0, 256, spec.size, dtype=np.uint8).tobytes()
+    seed_io = client.open_ioctx(pool)
+    for i in range(spec.n_objects):
+        seed_io.write_full(f"h_{i}", payload)
+
+    def make_op(widx: int):
+        io = client.open_ioctx(pool)
+        if qos_class:
+            io.set_qos_class(qos_class)
+
+        def op(is_read: bool, obj: int) -> None:
+            if is_read:
+                io.read(f"h_{obj}", spec.size)
+            else:
+                io.write_full(f"h_{obj}", payload)
+        return op
+
+    lat = _run_workers(spec, make_op)
+    row = {"metric": "harness_rados_mixed", "pool": pool,
+           **spec.meta(), **lat.summary(),
+           "stages": cluster_stage_quantiles(cluster)}
+    return row
+
+
+def run_rbd_mixed(cluster, client, pool: str, spec: WorkloadSpec
+                  ) -> dict:
+    """Mixed block I/O over RBD: one image per client session (the
+    many-VMs shape), Zipf-hot blocks inside each image."""
+    from ..rbd import RBD, Image
+    io = client.open_ioctx(pool)
+    rbd = RBD(io)
+    block = 1 << 16
+    blocks_per_img = max(4, spec.n_objects // max(spec.clients, 1))
+    img_size = blocks_per_img * block
+    payload = np.random.default_rng(6).integers(
+        0, 256, spec.size, dtype=np.uint8).tobytes()
+    for w in range(spec.clients):
+        rbd.create(f"hl_img_{w}", img_size)
+
+    def make_op(widx: int):
+        img = Image(client.open_ioctx(pool), f"hl_img_{widx}")
+
+        def op(is_read: bool, obj: int) -> None:
+            off = (obj % blocks_per_img) * block
+            if is_read:
+                img.read(off, min(spec.size, block))
+            else:
+                img.write(off, payload[:min(spec.size, block)])
+        return op
+
+    spec_blocks = WorkloadSpec(**{**spec.__dict__,
+                                  "n_objects": blocks_per_img})
+    lat = _run_workers(spec_blocks, make_op)
+    return {"metric": "harness_rbd_mixed", "pool": pool,
+            **spec_blocks.meta(), **lat.summary(),
+            "stages": cluster_stage_quantiles(cluster)}
+
+
+def run_s3_mixed(cluster, client, spec: WorkloadSpec) -> dict:
+    """Mixed PUT/GET over the RGW S3 gateway (SigV4-signed raw HTTP,
+    the full client->gateway->rados path)."""
+    import urllib.request
+
+    from ..rgw import S3Gateway, sigv4
+    creds = ("loadkey", "loadsecret")
+    gw = S3Gateway(client, creds={creds[0]: creds[1]})
+    host = f"{gw.addr[0]}:{gw.addr[1]}"
+    base = f"http://{host}"
+
+    def request(method: str, path: str, body: bytes = b"") -> None:
+        headers = {"host": host}
+        headers.update(sigv4.sign_request(
+            method, path, "", headers, body, creds[0], creds[1]))
+        req = urllib.request.Request(
+            base + path, data=body if body else None,
+            method=method, headers=headers)
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            resp.read()
+
+    payload = np.random.default_rng(8).integers(
+        0, 256, spec.size, dtype=np.uint8).tobytes()
+    try:
+        request("PUT", "/loadbucket")
+        for i in range(spec.n_objects):
+            request("PUT", f"/loadbucket/h_{i}", payload)
+
+        def make_op(widx: int):
+            def op(is_read: bool, obj: int) -> None:
+                if is_read:
+                    request("GET", f"/loadbucket/h_{obj}")
+                else:
+                    request("PUT", f"/loadbucket/h_{obj}", payload)
+            return op
+
+        lat = _run_workers(spec, make_op)
+    finally:
+        gw.shutdown()
+    return {"metric": "harness_s3_mixed", **spec.meta(),
+            **lat.summary(),
+            "stages": cluster_stage_quantiles(cluster)}
+
+
+# -- QoS isolation: virtual-time mClock experiments -------------------------
+
+def _sim_isolation(profiles: dict[str, ClientProfile],
+                   victim_class: str, victim_rate: float,
+                   greedy_class: str, greedy: bool,
+                   service_rate: float, duration: float,
+                   seed: int, shared_queue: bool = False) -> dict:
+    """Drive an MClockScheduler in VIRTUAL time: one server of
+    `service_rate` ops/sec, a victim arriving Poisson at
+    `victim_rate`, and (optionally) a greedy class with an
+    inexhaustible backlog.  Deterministic given the seed — no
+    threads, no sleeps, no wall clock — so the isolation bound can be
+    asserted in tier-1 without flake.  shared_queue collapses both
+    tenants into one scheduler class — the single-FIFO behavior of
+    the non-mClock op path, the contrast case QoS must beat.  Returns
+    the victim's queue-wait percentiles and the greedy class's served
+    share."""
+    sched = MClockScheduler(profiles)
+    rng = np.random.default_rng(seed)
+    arrivals = []
+    t = 0.0
+    while t < duration:
+        t += float(rng.exponential(1.0 / victim_rate))
+        if t < duration:
+            arrivals.append(t)
+    victim_waits: list[float] = []
+    served = {victim_class: 0, greedy_class: 0}
+    svc = 1.0 / service_rate
+    now, next_arrival = 0.0, 0
+    greedy_backlog = 0
+
+    def qclass(cls: str) -> str:
+        return "client" if shared_queue else cls
+
+    while next_arrival < len(arrivals) or not sched.empty():
+        while next_arrival < len(arrivals) and \
+                arrivals[next_arrival] <= now:
+            ts = arrivals[next_arrival]
+            sched.enqueue((victim_class, ts), qclass(victim_class),
+                          now=ts)
+            next_arrival += 1
+        if greedy and now < duration:
+            while greedy_backlog < 16:
+                sched.enqueue((greedy_class, now),
+                              qclass(greedy_class), now=now)
+                greedy_backlog += 1
+        item = sched.dequeue(now=now)
+        if item is None:
+            if next_arrival < len(arrivals):
+                now = arrivals[next_arrival]
+                continue
+            break
+        cls, ts = item
+        served[cls] = served.get(cls, 0) + 1
+        if cls == victim_class:
+            victim_waits.append(now - ts)
+        else:
+            greedy_backlog -= 1
+        now += svc
+    pcts = percentiles_from_samples(
+        victim_waits, [(0.5, "p50"), (0.99, "p99"), (0.999, "p999")])
+    return {"victim_ops": len(victim_waits),
+            "victim_p50_ms": round(pcts.get("p50", 0.0) * 1e3, 4),
+            "victim_p99_ms": round(pcts.get("p99", 0.0) * 1e3, 4),
+            "victim_p999_ms": round(pcts.get("p999", 0.0) * 1e3, 4),
+            "greedy_ops": served.get(greedy_class, 0)}
+
+
+def run_qos_isolation_sim(scenario: str = "tenant",
+                          service_rate: float = 2000.0,
+                          victim_rate: float = 200.0,
+                          duration: float = 4.0,
+                          seed: int = 7) -> dict:
+    """The gated isolation experiment, three runs in virtual time:
+    victim alone (baseline p99), victim + greedy under mClock QoS
+    (must stay within QOS_ISOLATION_MAX of baseline), and victim +
+    greedy with QoS neutralized (no reservation — shows the contrast
+    that proves the scheduler, not the light load, kept the tail).
+
+    scenario 'tenant': two tenant classes, the victim holding a
+    reservation above its offered rate.  scenario 'recovery': the
+    victim is the client class and recovery floods, using the
+    balanced profile's shipped triples."""
+    if scenario == "recovery":
+        profiles = {c: ClientProfile(p.reservation, p.weight, p.limit)
+                    for c, p in MCLOCK_PROFILES["balanced"].items()}
+        victim_class, greedy_class = "client", "recovery"
+        profiles[victim_class] = ClientProfile(
+            reservation=victim_rate * 1.5, weight=2.0)
+    else:
+        victim_class, greedy_class = "tenant_victim", "tenant_greedy"
+        profiles = {
+            victim_class: ClientProfile(reservation=victim_rate * 1.5,
+                                        weight=2.0),
+            greedy_class: ClientProfile(reservation=0.0, weight=1.0),
+        }
+    base = _sim_isolation(profiles, victim_class, victim_rate,
+                          greedy_class, False, service_rate, duration,
+                          seed)
+    qos = _sim_isolation(profiles, victim_class, victim_rate,
+                         greedy_class, True, service_rate, duration,
+                         seed)
+    # contrast: both tenants through ONE FIFO class — the non-mClock
+    # op path's behavior; the greedy backlog sits in front of every
+    # victim arrival and the tail blows up
+    raw = _sim_isolation({"client": ClientProfile(weight=1.0)},
+                         victim_class, victim_rate,
+                         greedy_class, True, service_rate, duration,
+                         seed, shared_queue=True)
+    # floor at one service time: an idle-baseline p99 below the
+    # service quantum would make the ratio noise, not signal
+    floor = 1e3 / service_rate
+    denom = max(base["victim_p99_ms"], floor)
+    ratio = max(qos["victim_p99_ms"], floor) / denom
+    ratio_no_qos = max(raw["victim_p99_ms"], floor) / denom
+    return {"metric": f"harness_qos_sim_{scenario}",
+            "service_rate": service_rate,
+            "victim_rate": victim_rate,
+            "duration_s": duration,
+            "victim_alone_p99_ms": base["victim_p99_ms"],
+            "victim_qos_p99_ms": qos["victim_p99_ms"],
+            "victim_no_qos_p99_ms": raw["victim_p99_ms"],
+            "greedy_ops_qos": qos["greedy_ops"],
+            "qos_isolation_ratio": round(ratio, 3),
+            "no_qos_ratio": round(ratio_no_qos, 3),
+            "bound": QOS_ISOLATION_MAX,
+            "isolated": ratio <= QOS_ISOLATION_MAX}
+
+
+def run_qos_cluster_tenants(n_osds: int = 4, clients: int = 4,
+                            greedy_clients: int = 12,
+                            seconds: float = 3.0,
+                            size: int = 16 << 10) -> dict:
+    """End-to-end tenant isolation on a live cluster: OSDs run the
+    mClock op queue, the victim tenant holds a reservation, the
+    greedy tenant is weight-only and floods.  Reports the victim's
+    e2e p99 alone vs contended plus the schedulers' per-class serve
+    counts.  Wall-clock and GIL noise make this a `slow`-marker
+    experiment; the virtual-time sim is the gated bound."""
+    from .vstart import Cluster
+    custom = ("tenant_victim:400,4,0;"
+              "tenant_greedy:0,1,0")
+    with Cluster(n_osds=n_osds,
+                 conf={"osd_op_queue": "mclock",
+                       "osd_mclock_custom_profile": custom}) as c:
+        client = c.client()
+        client.create_pool("qospool", "replicated", size=3, pg_num=16)
+        payload = np.random.default_rng(9).integers(
+            0, 256, size, dtype=np.uint8).tobytes()
+        seed_io = client.open_ioctx("qospool")
+        for i in range(64):
+            seed_io.write_full(f"q_{i}", payload)
+
+        def tenant_load(qos_class: str, n_workers: int,
+                        stop_at: float, lat: LatencyRecorder) -> list:
+            def worker(w: int) -> None:
+                io = client.open_ioctx("qospool")
+                io.set_qos_class(qos_class)
+                rng = np.random.default_rng(40 + w)
+                while time.perf_counter() < stop_at:
+                    obj = int(rng.integers(0, 64))
+                    t0 = time.perf_counter()
+                    try:
+                        if rng.random() < 0.5:
+                            io.read(f"q_{obj}", size)
+                        else:
+                            io.write_full(f"q_{obj}", payload)
+                        lat.record(time.perf_counter() - t0)
+                    except Exception as e:  # noqa: BLE001
+                        lat.error(e)
+            ts = [threading.Thread(target=worker, args=(w,),
+                                   daemon=True)
+                  for w in range(n_workers)]
+            for t in ts:
+                t.start()
+            return ts
+
+        # phase 1: victim alone
+        alone = LatencyRecorder()
+        ts = tenant_load("tenant_victim", clients,
+                         time.perf_counter() + seconds, alone)
+        for t in ts:
+            t.join()
+        # phase 2: victim + greedy flood
+        contended = LatencyRecorder()
+        greedy = LatencyRecorder()
+        stop_at = time.perf_counter() + seconds
+        ts = tenant_load("tenant_victim", clients, stop_at, contended)
+        ts += tenant_load("tenant_greedy", greedy_clients, stop_at,
+                          greedy)
+        for t in ts:
+            t.join()
+        sched = {f"osd.{osd.osd_id}": osd.op_wq.dump()
+                 for osd in c.osds
+                 if osd is not None and osd.op_wq is not None}
+        stages = cluster_stage_quantiles(c)
+    a, b = alone.summary(), contended.summary()
+    denom = max(a.get("p99_ms", 0.0) or 0.0, 0.05)
+    ratio = (b.get("p99_ms", 0.0) or 0.0) / denom
+    return {"metric": "harness_qos_cluster_tenants",
+            "clients": clients, "greedy_clients": greedy_clients,
+            "victim_alone": a, "victim_contended": b,
+            "greedy": greedy.summary(),
+            "qos_isolation_ratio": round(ratio, 3),
+            "schedulers": sched, "stages": stages}
+
+
+# -- CLI --------------------------------------------------------------------
+
+def _emit(row: dict) -> None:
+    print(json.dumps(row), flush=True)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="load_harness")
+    ap.add_argument("--scenario", default="all",
+                    choices=("rados", "rbd", "s3", "qos-sim",
+                             "qos-sim-recovery", "qos-cluster", "all"))
+    ap.add_argument("--clients", type=int, default=32,
+                    help="concurrent client sessions")
+    ap.add_argument("--seconds", type=float, default=3.0)
+    ap.add_argument("--size", type=int, default=64 << 10)
+    ap.add_argument("--read-frac", type=float, default=0.5)
+    ap.add_argument("--objects", type=int, default=256)
+    ap.add_argument("--zipf-alpha", type=float, default=1.1)
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="per-session open-loop ops/sec (0=closed loop)")
+    ap.add_argument("--sessions", type=int, default=1,
+                    help="logical client sessions multiplexed per "
+                         "worker thread (open-loop only): "
+                         "--clients 50 --sessions 100 --rate 2 = "
+                         "5000 clients' worth of arrivals")
+    ap.add_argument("--burst-every", type=int, default=0)
+    ap.add_argument("--burst-len", type=int, default=0)
+    ap.add_argument("--burst-factor", type=float, default=4.0)
+    ap.add_argument("--osds", type=int, default=4)
+    ap.add_argument("--ec", action="store_true",
+                    help="EC k=8,m=3 pool for the rados scenario")
+    args = ap.parse_args(argv)
+
+    scenarios = [args.scenario] if args.scenario != "all" else \
+        ["qos-sim", "qos-sim-recovery", "rados", "rbd", "s3"]
+    spec = WorkloadSpec(
+        clients=args.clients, seconds=args.seconds, size=args.size,
+        read_frac=args.read_frac, n_objects=args.objects,
+        zipf_alpha=args.zipf_alpha, rate=args.rate,
+        burst_factor=args.burst_factor, burst_every=args.burst_every,
+        burst_len=args.burst_len, sessions_per_client=args.sessions)
+
+    sims = [s for s in scenarios if s.startswith("qos-sim")]
+    for s in sims:
+        _emit(run_qos_isolation_sim(
+            "recovery" if s == "qos-sim-recovery" else "tenant"))
+    if "qos-cluster" in scenarios:
+        _emit(run_qos_cluster_tenants(
+            n_osds=args.osds, clients=max(2, args.clients // 8),
+            greedy_clients=args.clients, seconds=args.seconds,
+            size=args.size))
+    cluster_scenarios = [s for s in scenarios
+                         if s in ("rados", "rbd", "s3")]
+    if cluster_scenarios:
+        if args.ec and "rados" in cluster_scenarios and args.osds < 11:
+            print("--ec needs >= 11 OSDs for k=8,m=3 (pass --osds 12)",
+                  file=sys.stderr)
+            return 2
+        from .vstart import Cluster
+        with Cluster(n_osds=args.osds) as c:
+            client = c.client()
+            if "rados" in cluster_scenarios:
+                if args.ec:
+                    client.set_ec_profile("hl83", {
+                        "plugin": "jerasure", "k": "8", "m": "3",
+                        "stripe_unit": "4096"})
+                    client.create_pool("hl_rados", "erasure",
+                                       erasure_code_profile="hl83",
+                                       pg_num=16)
+                else:
+                    client.create_pool("hl_rados", "replicated",
+                                       size=3, pg_num=16)
+                _emit(run_rados_mixed(c, client, "hl_rados", spec))
+            if "rbd" in cluster_scenarios:
+                client.create_pool("hl_rbd", "replicated", size=3,
+                                   pg_num=16)
+                _emit(run_rbd_mixed(c, client, "hl_rbd", spec))
+            if "s3" in cluster_scenarios:
+                _emit(run_s3_mixed(c, client, spec))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,6 +16,9 @@ import http.server
 import os
 import sys
 
+from ..common.perf_counters import (LATENCY_QUANTILES,
+                                    quantile_from_cumulative)
+
 
 # perf-counter type -> prometheus metric type (u64 counters are
 # monotonic; gauges settable; time/avg expand to _sum/_count pairs,
@@ -89,6 +92,18 @@ def collect(asok_dir: str) -> str:
                         f'{name}_sum{labels} {val.get("sum", 0)}')
                     lines.append(
                         f'{name}_count{labels} {val.get("count", 0)}')
+                    # precomputed tail gauges (p50/p95/p99/p999,
+                    # bucket-interpolated): dashboards and alerts read
+                    # these directly instead of re-deriving quantiles
+                    # from _bucket series (docs/QOS.md)
+                    for q, qlabel in LATENCY_QUANTILES:
+                        est = quantile_from_cumulative(
+                            val["buckets"], q)
+                        if est is None:
+                            continue
+                        emit_type(f"{name}_{qlabel}", "gauge")
+                        lines.append(
+                            f"{name}_{qlabel}{labels} {est[0]:.9f}")
                 elif isinstance(val, dict):   # time-avg
                     emit_type(f"{name}_sum", ctype)
                     emit_type(f"{name}_count", ctype)

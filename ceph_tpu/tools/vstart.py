@@ -94,19 +94,13 @@ class Cluster:
             osd = OSDDaemon(i, self.mon_addrs, store=store,
                             heartbeat_interval=self.heartbeat_interval,
                             asok_path=asok, auth=self._daemon_auth(i),
-                            secure=self.secure)
+                            secure=self.secure,
+                            conf={**self.conf,
+                                  **self.osd_conf.get(i, {})})
             self.osds.append(osd)
         for osd in self.osds:
-            self._apply_conf(osd)
             osd.boot()
         return self
-
-    def _apply_conf(self, osd: OSDDaemon) -> None:
-        """Cluster-wide conf, then this OSD's recorded overrides."""
-        for k, v in self.conf.items():
-            osd.cct.conf.set(k, v)
-        for k, v in self.osd_conf.get(osd.osd_id, {}).items():
-            osd.cct.conf.set(k, v)
 
     def set_osd_conf(self, osd_id: int, key: str, value) -> None:
         """Set a conf override that sticks across kill/revive (the
@@ -158,9 +152,10 @@ class Cluster:
         osd = OSDDaemon(osd_id, self.mon_addrs, store=old.store,
                         heartbeat_interval=self.heartbeat_interval,
                         asok_path=asok, auth=self._daemon_auth(osd_id),
-                        secure=self.secure)
+                        secure=self.secure,
+                        conf={**self.conf,
+                              **self.osd_conf.get(osd_id, {})})
         self.osds[osd_id] = osd
-        self._apply_conf(osd)
         osd.boot()
 
     def remove_osd(self, osd_id: int) -> None:

@@ -11,6 +11,11 @@ set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu pyt
 # overhead guard (ISSUE 4, docs/TRACING.md): always-on op tracking must
 # cost < TRACK_OVERHEAD_MAX_PCT (default 2%) + measured noise on the
 # pipelined write bench, so tracking-overhead regressions fail fast.
+# ISSUE 9 guards ride the same smoke (docs/QOS.md): per-stage p99 tail
+# latency on the pipelined EC write path (ec_write_p99_ms + stage p99s
+# must be present and positive) and the deterministic virtual-time QoS
+# isolation experiment (qos_isolation_ratio <= QOS_ISOLATION_MAX,
+# default 2.0, with the FIFO contrast required to sit ABOVE the bound).
 if [ "$rc" -eq 0 ]; then
   timeout -k 10 300 env JAX_PLATFORMS=cpu python bench.py --smoke || rc=$?
 fi

@@ -1,0 +1,206 @@
+"""Percentile pipeline tests (ISSUE 9): histogram quantile()
+correctness on known distributions including the +Inf bucket and
+empty-histogram edge cases, dump_latencies summaries, the
+dump_latencies asok command, and the exporter's precomputed
+p50/p95/p99/p999 gauges.
+"""
+
+import math
+
+import pytest
+
+from ceph_tpu.common.perf_counters import (DEFAULT_LAT_BUCKETS,
+                                           LATENCY_QUANTILES,
+                                           PerfCountersBuilder,
+                                           PerfCountersCollection,
+                                           percentiles_from_samples,
+                                           quantile_from_cumulative)
+
+
+def _cum(bounds, counts):
+    """Build the dumped cumulative form from per-bucket counts
+    (counts has one extra entry for +Inf)."""
+    out, c = [], 0
+    for le, n in zip(bounds, counts):
+        c += n
+        out.append([le, c])
+    out.append(["+Inf", c + counts[-1]])
+    return out
+
+
+# -- quantile_from_cumulative ------------------------------------------------
+
+def test_quantile_uniform_in_one_bucket():
+    """All mass in (0.1, 0.2]: every quantile interpolates inside that
+    bucket and the error bounds are exactly its edges."""
+    buckets = _cum([0.1, 0.2, 0.4], [0, 100, 0, 0])
+    est, lo, hi = quantile_from_cumulative(buckets, 0.5)
+    assert (lo, hi) == (0.1, 0.2)
+    assert est == pytest.approx(0.15)
+    est99, _, _ = quantile_from_cumulative(buckets, 0.99)
+    assert est99 == pytest.approx(0.199)
+    est0, _, _ = quantile_from_cumulative(buckets, 0.0)
+    assert 0.1 <= est0 <= 0.2
+
+
+def test_quantile_known_two_bucket_split():
+    """90 samples in (0, 1], 10 in (1, 2]: p50 sits mid-first-bucket,
+    p95 in the second."""
+    buckets = _cum([1.0, 2.0], [90, 10, 0])
+    est50, lo50, hi50 = quantile_from_cumulative(buckets, 0.5)
+    assert (lo50, hi50) == (0.0, 1.0)
+    assert est50 == pytest.approx(50 / 90)
+    est95, lo95, hi95 = quantile_from_cumulative(buckets, 0.95)
+    assert (lo95, hi95) == (1.0, 2.0)
+    assert est95 == pytest.approx(1.5)
+
+
+def test_quantile_exact_bucket_boundary():
+    """rank == a bucket's cumulative count: the estimate is that
+    bucket's upper edge (interpolation hits 1.0)."""
+    buckets = _cum([1.0, 2.0], [50, 50, 0])
+    est, _, _ = quantile_from_cumulative(buckets, 0.5)
+    assert est == pytest.approx(1.0)
+
+
+def test_quantile_inf_bucket():
+    """Tail mass beyond the axis: the estimate honestly degrades to
+    the last finite bound with an infinite upper error bar."""
+    buckets = _cum([0.5, 1.0], [10, 0, 90])
+    est, lo, hi = quantile_from_cumulative(buckets, 0.99)
+    assert est == 1.0 and lo == 1.0 and math.isinf(hi)
+    # a quantile still inside the finite range is unaffected
+    est05, _, hi05 = quantile_from_cumulative(buckets, 0.05)
+    assert est05 <= 0.5 and hi05 == 0.5
+
+
+def test_quantile_empty_histogram():
+    assert quantile_from_cumulative([], 0.5) is None
+    assert quantile_from_cumulative(_cum([1.0], [0, 0]), 0.5) is None
+
+
+def test_quantile_rejects_bad_q():
+    with pytest.raises(ValueError):
+        quantile_from_cumulative(_cum([1.0], [1, 0]), 1.5)
+
+
+def test_quantile_error_bounds_contain_truth():
+    """Synthetic lognormal-ish sample set pushed through a real
+    histogram: every interpolated quantile stays within its own
+    published [lo, hi] and brackets the exact sample percentile."""
+    import numpy as np
+    rng = np.random.default_rng(3)
+    samples = np.exp(rng.normal(-6.0, 1.0, 5000)).tolist()
+    pc = PerfCountersBuilder("t").create_perf_counters()
+    for s in samples:
+        pc.hinc("lat_x", s)
+    exact = percentiles_from_samples(samples)
+    for q, label in LATENCY_QUANTILES:
+        est, lo, hi = pc.quantile("lat_x", q)
+        assert lo <= est <= hi
+        assert lo <= exact[label] <= hi, \
+            f"{label}: exact {exact[label]} outside [{lo}, {hi}]"
+
+
+# -- PerfCounters.dump_latencies ---------------------------------------------
+
+def test_dump_latencies_summary_shape():
+    pc = PerfCountersBuilder("t").create_perf_counters()
+    for v in (0.0002, 0.0004, 0.0008, 0.02, 0.02):
+        pc.hinc("lat_commit", v)
+    pc.dinc("not_a_histogram")
+    lat = pc.dump_latencies()
+    assert set(lat) == {"lat_commit"}       # non-histograms excluded
+    row = lat["lat_commit"]
+    assert row["count"] == 5
+    assert row["sum"] == pytest.approx(0.0414)
+    for _q, label in LATENCY_QUANTILES:
+        assert row[label] is not None and row[label] > 0
+    lo, hi = row["p99_err"]
+    assert lo <= row["p99"] <= hi
+    # p50 must sit in the bucket holding the 3rd sample (0.0005, 0.001]
+    assert 0.0005 <= row["p50"] <= 0.001
+
+
+def test_dump_latencies_collection_and_asok():
+    """The collection-level dump groups per set, and the builtin
+    `dump_latencies` asok command serves it."""
+    import tempfile
+
+    from ceph_tpu.common.admin_socket import admin_command
+    from ceph_tpu.common.context import CephContext
+    coll = PerfCountersCollection()
+    a = coll.add(PerfCountersBuilder("optracker.x")
+                 .create_perf_counters())
+    coll.add(PerfCountersBuilder("plain").add_u64_counter("n")
+             .create_perf_counters())
+    a.hinc("lat_queued", 0.003)
+    lat = coll.dump_latencies()
+    assert "optracker.x" in lat and "plain" not in lat
+    assert lat["optracker.x"]["lat_queued"]["count"] == 1
+    with tempfile.TemporaryDirectory() as d:
+        cct = CephContext("test", f"{d}/t.asok")
+        try:
+            cct.perf.add(a)
+            out = admin_command(f"{d}/t.asok",
+                                {"prefix": "dump_latencies"})
+            assert out["optracker.x"]["lat_queued"]["count"] == 1
+            assert out["optracker.x"]["lat_queued"]["p99"] > 0
+        finally:
+            cct.shutdown()
+
+
+def test_percentiles_from_samples_exact():
+    samples = [float(i) for i in range(1, 101)]    # 1..100
+    p = percentiles_from_samples(samples)
+    assert p["p50"] == 50.0
+    assert p["p99"] == 99.0
+    assert p["p999"] == 100.0
+    assert percentiles_from_samples([]) == {}
+
+
+def test_dinc_auto_creates_u64():
+    pc = PerfCountersBuilder("t").create_perf_counters()
+    pc.dinc("mclock_queued_tenant_a")
+    pc.dinc("mclock_queued_tenant_a", 2)
+    assert pc.dump()["mclock_queued_tenant_a"] == 3
+    assert pc.schema()["mclock_queued_tenant_a"] == "u64"
+
+
+# -- exporter emission -------------------------------------------------------
+
+def test_exporter_emits_percentile_gauges():
+    """The prometheus exposition carries precomputed _p50/_p99/_p999
+    gauges next to the histogram series."""
+    import tempfile
+
+    from ceph_tpu.common.context import CephContext
+    from ceph_tpu.tools.metrics_exporter import collect
+    with tempfile.TemporaryDirectory() as d:
+        cct = CephContext("osd.0", f"{d}/osd.0.asok")
+        try:
+            pc = cct.perf.add(PerfCountersBuilder("optracker.osd.0")
+                              .create_perf_counters())
+            for v in (0.0002, 0.0009, 0.004, 0.04):
+                pc.hinc("lat_commit", v)
+            text = collect(d)
+        finally:
+            cct.shutdown()
+    assert "ceph_tpu_lat_commit_bucket" in text
+    for label in ("p50", "p95", "p99", "p999"):
+        line = next((ln for ln in text.splitlines()
+                     if ln.startswith(f"ceph_tpu_lat_commit_{label}{{")),
+                    None)
+        assert line is not None, f"missing {label} gauge"
+        assert float(line.rsplit(" ", 1)[1]) > 0
+    assert "# TYPE ceph_tpu_lat_commit_p99 gauge" in text
+
+
+def test_histogram_axis_covers_default_buckets():
+    """Guard: the merged-stage math in the harness assumes every
+    latency histogram shares DEFAULT_LAT_BUCKETS."""
+    pc = PerfCountersBuilder("t").create_perf_counters()
+    pc.hinc("lat_a", 0.001)
+    dumped = pc.dump()["lat_a"]["buckets"]
+    assert [le for le, _ in dumped[:-1]] == list(DEFAULT_LAT_BUCKETS)
+    assert dumped[-1][0] == "+Inf"
