@@ -445,6 +445,201 @@ def bench_end_to_end(on_tpu: bool, passes: int, spacing: float) -> dict:
     return out
 
 
+# -- multichip mesh bench (ISSUE 10, docs/MULTICHIP.md) ----------------------
+#
+# The aggregate-GB/s numbers the MULTICHIP artifacts were missing:
+# encode, encode+crc (what a mesh drain actually pays: sharded parity
+# contraction + the vectorized host crc fold), and repair — each as a
+# mesh vs single-chip A/B on the same host-resident inputs, so the
+# published speedup isolates exactly what the collective program buys
+# (or costs, on a virtual CPU mesh where the collectives are memcpys
+# and the win is only correctness coverage).  Repair is measured the
+# way the OSD now runs it: a BATCH of objects missing the same shards,
+# one decode_flat_batch launch on the mesh vs the per-object
+# decode_chunks loop the single-chip plane pays (reference accounting:
+# original-object bytes per pass, like `-w decode`).
+
+def _wall_rate(fn, nbytes: int, iters: int) -> float:
+    """Wall-clock host-to-host bytes/sec: warm once, then time `iters`
+    calls.  Both sides of every multichip A/B go through this so the
+    comparison includes the real staging/transfer cost a drain pays."""
+    fn()                                             # warm/compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    dt = time.perf_counter() - t0
+    if dt <= 0:
+        raise RuntimeError("multichip bench: timer elided")
+    return iters * nbytes / dt
+
+
+def measure_multichip(jax_codec, dcodec, on_tpu: bool,
+                      quick: bool = True) -> dict:
+    """Mesh vs single-chip A/B on prebuilt codecs; returns the metric
+    dict (all rates in GB/s of input bytes).  quick = CPU smoke sizes."""
+    from ceph_tpu.common import crc32c as _crc
+
+    k, m = dcodec.k, dcodec.m
+    n = k + m
+    if on_tpu and not quick:
+        width, iters, nobj = 1 << 20, 8, 8
+    else:
+        width, iters, nobj = 1 << 15, 3, 4
+    # byte width must satisfy the mesh quantum (per-device lanes)
+    q = dcodec._quantum()
+    width = max(q, width - width % q)
+    rng = np.random.default_rng(5)
+    flat = rng.integers(0, 256, (k, width), dtype=np.uint8)
+    out: dict = {"phases": {}}
+
+    # correctness gate first: mesh parity must be bit-identical to the
+    # single-chip plane before any of its rates mean anything
+    par_mesh = np.asarray(dcodec.encode_flat(flat))
+    par_single = np.asarray(jax_codec.encode_chunks(flat))
+    out["phases"]["encode_parity"] = bool(
+        np.array_equal(par_mesh, par_single))
+
+    nbytes = k * width
+    out["mc_encode_mesh_GBps"] = round(_wall_rate(
+        lambda: dcodec.encode_flat(flat), nbytes, iters) / 1e9, 3)
+    out["mc_encode_single_GBps"] = round(_wall_rate(
+        lambda: np.asarray(jax_codec.encode_chunks(flat)),
+        nbytes, iters) / 1e9, 3)
+
+    # encode+crc: the drain configuration (parity + per-shard crc32c)
+    seeds = [0xFFFFFFFF] * n
+
+    def mesh_encode_crc():
+        par = np.asarray(dcodec.encode_flat(flat))
+        return _crc.crc32c_rows(np.concatenate([flat, par]), seeds)
+
+    def single_encode_crc():
+        if hasattr(jax_codec, "encode_extents_with_crc_submit"):
+            h = jax_codec.encode_extents_with_crc_submit([flat])
+            par, l, tail, body = \
+                jax_codec.encode_extents_with_crc_finalize(h)[0]
+            return jax_codec.fold_extent_crcs(l, tail, seeds, body)
+        par = np.asarray(jax_codec.encode_chunks(flat))
+        return _crc.crc32c_rows(np.concatenate([flat, par]), seeds)
+
+    crc_mesh = mesh_encode_crc()
+    crc_single = single_encode_crc()
+    out["phases"]["crc_parity"] = bool(list(crc_mesh) ==
+                                       list(crc_single))
+    out["mc_encode_crc_mesh_GBps"] = round(_wall_rate(
+        mesh_encode_crc, nbytes, iters) / 1e9, 3)
+    out["mc_encode_crc_single_GBps"] = round(_wall_rate(
+        single_encode_crc, nbytes, iters) / 1e9, 3)
+
+    # repair storm: `nobj` distinct objects all missing the same 3
+    # shards — one batched mesh launch vs the per-object loop
+    erased = (0, k - 1, k + 1)
+    survivors = tuple(s for s in range(n) if s not in erased)[:k]
+    objs = []
+    for i in range(nobj):
+        d = np.bitwise_xor(flat, np.uint8((i * 37 + 1) % 256))
+        p = np.asarray(jax_codec.encode_chunks(d))
+        objs.append(np.concatenate([d, p]))
+    avail_list = [o[list(survivors)] for o in objs]
+
+    def mesh_repair():
+        return dcodec.decode_flat_batch(avail_list, survivors, erased)
+
+    def single_repair():
+        res = []
+        for o in objs:
+            dense = o.copy()
+            for e in erased:
+                dense[e] = 0
+            res.append(jax_codec.decode_chunks(dense, list(erased)))
+        return res
+
+    reb_mesh = mesh_repair()
+    reb_single = single_repair()
+    ok = True
+    for i, o in enumerate(objs):
+        for j, e in enumerate(erased):
+            ok = ok and np.array_equal(reb_mesh[i][j], o[e]) and \
+                np.array_equal(reb_single[i][e], o[e])
+    out["phases"]["repair_parity"] = bool(ok)
+    repair_bytes = nobj * k * width       # original-object accounting
+    out["mc_repair_mesh_GBps"] = round(_wall_rate(
+        mesh_repair, repair_bytes, iters) / 1e9, 3)
+    out["mc_repair_single_GBps"] = round(_wall_rate(
+        single_repair, repair_bytes, iters) / 1e9, 3)
+    out["mc_repair_batch_objects"] = nobj
+    for a, b, key in (("mc_encode_mesh_GBps", "mc_encode_single_GBps",
+                       "mc_encode_speedup"),
+                      ("mc_repair_mesh_GBps", "mc_repair_single_GBps",
+                       "mc_repair_speedup")):
+        out[key] = round(out[a] / out[b], 3) if out[b] else None
+    return out
+
+
+def run_multichip() -> int:
+    """`bench.py --multichip`: build the host mesh through the
+    MeshService deployment path and publish the aggregate mesh-vs-
+    single-chip A/B as ONE JSON line (the MULTICHIP artifact row).
+    CPU meshes get their virtual devices via XLA_FLAGS before jax
+    initializes; returns nonzero when any phase or rate is bad, so
+    scripts/tier1.sh can gate on it."""
+    n_req = int(os.environ.get("MULTICHIP_DEVICES", "8"))
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n_req}"
+        ).strip()
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from ceph_tpu.utils.platform import ensure_usable_backend
+    backend = ensure_usable_backend(
+        prefer_cpu=os.environ.get("JAX_PLATFORMS") == "cpu")
+    import jax
+    on_tpu = jax.default_backend() != "cpu"
+    have = len(jax.devices())
+    out = {"metric": "ec_multichip", "unit": "GB/s",
+           "backend": backend, "n_devices": min(n_req, have)}
+    if have < 2:
+        out["skipped"] = True
+        out["error"] = f"only {have} device(s) visible"
+        print(json.dumps(out))
+        return 1
+    from ceph_tpu.ec import ErasureCodePluginRegistry
+    from ceph_tpu.parallel.service import MeshService
+    jax_codec = ErasureCodePluginRegistry.instance().factory(
+        "jax", {"k": str(K), "m": str(M), "technique": "cauchy"})
+    try:
+        svc = MeshService.configure(min(n_req, have))
+        dcodec = svc.acquire(K, M, technique="cauchy",
+                             matrix=jax_codec.matrix)
+    except Exception as e:  # noqa: BLE001 — MeshError et al.
+        out["skipped"] = True
+        out["error"] = f"mesh service: {e}"
+        print(json.dumps(out))
+        return 1
+    out["mesh"] = {"shard": dcodec.n_shard, "data": dcodec.n_data}
+    try:
+        out.update(measure_multichip(jax_codec, dcodec, on_tpu,
+                                     quick=not on_tpu))
+    except Exception as e:  # noqa: BLE001
+        out["error"] = f"multichip bench: {e}"
+        print(json.dumps(out))
+        return 1
+    print(json.dumps(out))
+    bad = [p for p, ok in out["phases"].items() if not ok]
+    bad += [key for key in ("mc_encode_mesh_GBps",
+                            "mc_encode_crc_mesh_GBps",
+                            "mc_encode_crc_single_GBps",
+                            "mc_repair_mesh_GBps",
+                            "mc_encode_single_GBps",
+                            "mc_repair_single_GBps")
+            if not isinstance(out.get(key), (int, float))
+            or out[key] <= 0]
+    if bad:
+        print(f"# multichip FAILED: {bad}", file=sys.stderr)
+        return 1
+    return 0
+
+
 SMOKE_KEYS = ("ec_write_pipeline_k8_m3_GBps",
               "ec_write_pipeline_sync_GBps",
               "ec_write_pipeline_speedup",
@@ -682,4 +877,6 @@ def main():
 if __name__ == "__main__":
     if "--smoke" in sys.argv[1:]:
         sys.exit(run_smoke())
+    if "--multichip" in sys.argv[1:]:
+        sys.exit(run_multichip())
     main()

@@ -167,6 +167,18 @@ register_options([
            "verify deep-scrub crc32c with the device kernel when an "
            "accelerator backend is active (host crc fallback otherwise)",
            Level.DEV),
+    # multichip mesh scale-out (docs/MULTICHIP.md)
+    Option("osd_ec_use_mesh", bool, False,
+           "acquire the per-host MeshService multichip data plane for "
+           "EC PGs: batched drains and distributed repair run as "
+           "sharded collective programs across the device mesh; "
+           "geometry/matrix mismatches log a config error and fall "
+           "back to the single-chip codec", flags=("startup",)),
+    Option("mesh_devices", str, "",
+           "device mesh shape 'SHARDxDATA' (e.g. '4x2') or a device "
+           "count; empty = all visible devices with the default "
+           "shard-axis heuristic.  One mesh per host: the first "
+           "daemon to configure it wins", flags=("startup",)),
 ])
 
 

@@ -445,6 +445,13 @@ class OSDDaemon:
             self.cct.asok.register_command(
                 "dump_historic_slow_ops",
                 lambda cmd: self.op_tracker.dump_historic_slow_ops())
+            # multichip plane state (docs/MULTICHIP.md); both
+            # spellings: `ceph daemon ASOK mesh status` and the
+            # one-word form
+            self.cct.asok.register_command(
+                "mesh status", self._asok_mesh_status)
+            self.cct.asok.register_command(
+                "mesh_status", self._asok_mesh_status)
         self.store = store or MemStore()
         self.store.mount()
         self._raw_tid = 1 << 32   # raw-RPC tids, disjoint from backends'
@@ -1350,6 +1357,12 @@ class OSDDaemon:
                      if crush_hash32(h.key or h.name) % pool.pg_num ==
                      pgid.seed}
         all_ok = True
+        # decode-needing objects are DEFERRED and rebuilt in one
+        # batched pass after the sweep: grouped by recovery geometry,
+        # an OSD-loss storm becomes a handful of distributed decode
+        # launches on the mesh plane (or concatenated host decodes)
+        # instead of a per-object crawl — docs/MULTICHIP.md
+        decode_queue: list[tuple] = []
         for oid in names:
             if self._hb_stop.is_set():
                 return
@@ -1364,12 +1377,43 @@ class OSDDaemon:
             if not self._recover_object(pgid, acting, be, prev_acting,
                                         up_osds, oid, missing,
                                         unreachable,
-                                        src_pgs=[pgid] + ancestors):
+                                        src_pgs=[pgid] + ancestors,
+                                        decode_queue=decode_queue):
+                all_ok = False
+        if decode_queue:
+            if not self._recover_decode_batch(pgid, acting, be,
+                                              decode_queue):
                 all_ok = False
         if all_ok:
             self._pgs_needing_recovery.discard(pgid)
         else:
             self._pgs_needing_recovery.add(pgid)
+
+    def _recover_decode_batch(self, pgid, acting, be,
+                              decode_queue: list[tuple]) -> bool:
+        """Reconstruct-from-k for every deferred object of one PG in
+        grouped decode launches (ECBackend.recover_shards_batch)."""
+        try:
+            results = be.recover_shards_batch(
+                decode_queue,
+                lambda oid: self._make_recovery_push(pgid, acting,
+                                                     oid))
+        except Exception as e:  # noqa: BLE001 — whole-batch failure
+            self.cct.dout("osd", 1,
+                          f"batched recovery of pg {pgid} failed: "
+                          f"{e!r}")
+            return False
+        ok = True
+        for oid, err in results.items():
+            if err is None:
+                self.cct.dout("osd", 5,
+                              f"recovered {oid.name} of pg {pgid} by "
+                              f"batched decode")
+            else:
+                ok = False
+                self.cct.dout("osd", 1,
+                              f"recovery of {oid.name} failed: {err!r}")
+        return ok
 
     def _names_from_ancestors(self, pgid: pg_t, ancestors, shard_ids,
                               pg_num: int, up_osds,
@@ -1396,12 +1440,15 @@ class OSDDaemon:
 
     def _recover_object(self, pgid, acting, be, prev_acting, up_osds,
                         oid, missing, unreachable=None,
-                        src_pgs=None) -> bool:
+                        src_pgs=None, decode_queue=None) -> bool:
         """Rebuild one object's missing shards: backfill-by-copy from
         any surviving holder, else reconstruct-from-k (runs under the
         osd_max_backfills reservation).  src_pgs lists the PGs whose
         collections may hold the shard (the PG itself plus, after a
-        split, its ancestors on not-yet-swept holders)."""
+        split, its ancestors on not-yet-swept holders).  When
+        decode_queue is given, objects needing the decode path are
+        appended there instead of decoded inline — the caller rebuilds
+        the whole queue in grouped (mesh-collective) launches."""
         # 1: backfill-by-copy from wherever the shard still lives
         # (previous holder first, then any up OSD).  A leftover
         # copy from an older interval could be stale, so candidates
@@ -1491,7 +1538,12 @@ class OSDDaemon:
                           f"{oid.name}: {len(still_missing)} shards "
                           f"unrecoverable in pg {pgid}")
             return False
-        # 2: reconstruct-from-k via the EC decode path
+        # 2: reconstruct-from-k via the EC decode path — deferred to
+        # the caller's batched pass when one is running (the storm
+        # case: one grouped launch rebuilds the whole queue)
+        if decode_queue is not None:
+            decode_queue.append((oid, still_missing))
+            return True     # outcome decided by the batch pass
         try:
             be.recover_shard(
                 oid, still_missing,
@@ -2202,9 +2254,12 @@ class OSDDaemon:
                     shards = MessengerShardBackend(self, pgid, acting)
                     backend = ECBackend(
                         codec, sinfo, shards,
+                        mesh_service=self._mesh_service(),
                         dispatch_depth=int(self.cct.conf.get(
                             "ec_dispatch_ahead_depth") or 2),
-                        perf_name=f"ec.{pgid}")
+                        perf_name=f"ec.{pgid}",
+                        logger=lambda msg: self.cct.dout(
+                            "osd", 1, msg))
                     # surface the backend's pipeline counters in this
                     # daemon's `perf dump` / prometheus scrape
                     self.cct.perf.add(backend.perf)
@@ -3179,6 +3234,44 @@ class OSDDaemon:
         top.mark_event("scrub_done")
         self.op_tracker.unregister(top, 0)
         return out
+
+    # -- multichip mesh plane (docs/MULTICHIP.md) ---------------------------
+
+    def _mesh_service(self):
+        """The per-host MeshService when osd_ec_use_mesh is on; None
+        otherwise (EC backends then run the single-chip plane).
+        Configuration failures (not enough devices, bad shape) are
+        logged config errors, never daemon-fatal."""
+        if not bool(self.cct.conf.get("osd_ec_use_mesh")):
+            return None
+        from ..parallel.service import MeshService
+        try:
+            return MeshService.get_or_configure(
+                str(self.cct.conf.get("mesh_devices")))
+        except Exception as e:  # noqa: BLE001 — MeshError et al.
+            self.cct.dout("osd", 1,
+                          f"mesh service unavailable ({e}); EC PGs "
+                          f"will use the single-chip plane")
+            return None
+
+    def _asok_mesh_status(self, cmd: dict) -> dict:
+        """`ceph daemon osd.N.asok mesh status`: the host service's
+        mesh + per-PG plane state (active / fallen-back / config
+        error), so an operator can see exactly which plane serves
+        which PG and why."""
+        from ..parallel.service import MeshService
+        svc = MeshService.get()
+        with self.pg_lock:
+            pgs = {str(pgid): st.backend.mesh_status()
+                   for pgid, st in self.pgs.items()
+                   if st.kind == "ec"}
+        return {
+            "osd": self.osd_id,
+            "use_mesh": bool(self.cct.conf.get("osd_ec_use_mesh")),
+            "mesh_devices": str(self.cct.conf.get("mesh_devices")),
+            "service": svc.status() if svc is not None else None,
+            "pgs": pgs,
+        }
 
     # -- snap trim (reference PrimaryLogPG SnapTrimmer / snap trim queue;
     #    runs with scrub here: both walk the same object listing) ----------

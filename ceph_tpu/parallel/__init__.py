@@ -1,5 +1,7 @@
 """Device-mesh parallelism for the EC data plane."""
 
 from .mesh import DistributedStripeCodec, make_mesh
+from .service import MeshError, MeshService
 
-__all__ = ["DistributedStripeCodec", "make_mesh"]
+__all__ = ["DistributedStripeCodec", "make_mesh",
+           "MeshError", "MeshService"]

@@ -271,6 +271,30 @@ class DistributedStripeCodec:
         mats = self._decode_bitmats(survivors, targets)
         return self._apply_flat(mats, avail, len(targets))
 
+    def decode_flat_batch(self, avail_list, survivors, targets
+                          ) -> list[np.ndarray]:
+        """Batched distributed repair: MANY objects' survivor rows
+        (same survivor/target pattern — the common case in an OSD-loss
+        storm, where every object of a PG misses the same shards) ride
+        ONE sharded contraction.  avail_list: [(k, W_i) uint8] in
+        `survivors` order; returns the rebuilt targets per object.
+        The byte axes concatenate (stripes are independent), so a
+        recovery queue of N objects costs one launch instead of N —
+        the reference's per-object continue_recovery_op decode loop
+        collapsed into a single collective program."""
+        if not avail_list:
+            return []
+        widths = [a.shape[1] for a in avail_list]
+        big = np.concatenate(avail_list, axis=1) \
+            if len(avail_list) > 1 else avail_list[0]
+        out = self.decode_flat(big, survivors, targets)
+        res = []
+        col = 0
+        for w in widths:
+            res.append(out[:, col:col + w])
+            col += w
+        return res
+
     def decode(self, stripes_avail, survivors, targets):
         """(B, k, C) survivor stripes -> (B, len(targets), C)."""
         a = np.ascontiguousarray(stripes_avail, dtype=np.uint8)

@@ -1,0 +1,212 @@
+"""MeshService: the per-host owner of the multichip EC data plane.
+
+Deployment model (docs/MULTICHIP.md): a host that drives a multichip
+accelerator runs ONE process-wide MeshService owning the
+('shard', 'data') `jax.sharding.Mesh`; every OSD daemon on the host
+(and every EC PG backend inside each daemon) acquires its
+`DistributedStripeCodec` handle from the service instead of building a
+private mesh — one compiled collective program per EC geometry, shared
+launch queue, shared failure accounting.  This is the mesh analog of
+the reference scaling writes with CRUSH fan-out over OSD hosts
+(ECBackend.cc MOSDECSubOpWrite): where the reference's unit of scale-
+out is a host on the network, ours is a chip on the ICI mesh, and the
+service is the host-side broker that hands the chips out.
+
+Acquisition is geometry-checked: the codec's k must divide over the
+mesh's 'shard' axis and, when the caller supplies its plugin's
+generator matrix, the mesh codec's matrix must be bit-identical
+(cauchy parity written by the mesh is garbage to a reed_sol_van
+decode).  Violations raise MeshError — callers (ECBackend, the OSD)
+treat that as a surfaced config error and fall back to the single-chip
+plane rather than crashing the daemon.
+
+The service also keeps the containment ledger: when a mesh launch
+fails mid-pipeline the owning ECBackend aborts the op, permanently
+falls back to the single-chip plane for that PG, and reports the
+failure here so `mesh status` (asok) shows a cluster operator exactly
+which plane is serving and why.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class MeshError(RuntimeError):
+    """Mesh configuration/geometry error: the caller must fall back to
+    the single-chip plane (never fatal to a daemon)."""
+
+
+def parse_mesh_shape(spec: str | None, have: int) -> tuple[int, int]:
+    """'SxD' -> (S, D); a bare count (or empty = all `have` devices)
+    gets the dryrun heuristic: the largest of 4/2/1 dividing the count
+    becomes the 'shard' axis (k=8 work shards 4-ways; odd meshes
+    degrade to data-parallel only)."""
+    spec = (spec or "").strip().lower()
+    if "x" in spec:
+        s, _, d = spec.partition("x")
+        try:
+            shape = (int(s), int(d))
+        except ValueError as e:
+            raise MeshError(f"bad mesh_devices spec {spec!r}: {e}") from e
+        if shape[0] < 1 or shape[1] < 1:
+            raise MeshError(f"bad mesh_devices spec {spec!r}")
+        return shape
+    try:
+        n = int(spec) if spec else have
+    except ValueError as e:
+        raise MeshError(f"bad mesh_devices spec {spec!r}: {e}") from e
+    if n < 1:
+        raise MeshError(f"bad mesh_devices count {n}")
+    n_shard = 4 if n % 4 == 0 else (2 if n % 2 == 0 else 1)
+    return n_shard, n // n_shard
+
+
+class MeshService:
+    """Process-wide (= per-host in the thread topology; per-daemon in
+    the multi-process simulation, where each process stands in for a
+    host) broker of the device mesh."""
+
+    _instance: "MeshService | None" = None
+    _lock = threading.Lock()
+
+    def __init__(self, mesh, spec: str):
+        from .mesh import DistributedStripeCodec  # noqa: F401 (doc link)
+        self.mesh = mesh
+        self.spec = spec
+        self.n_shard = mesh.shape["shard"]
+        self.n_data = mesh.shape["data"]
+        self._codecs: dict[tuple, object] = {}
+        self._codec_lock = threading.Lock()
+        self.created_at = time.time()
+        self.failures = 0
+        self.last_error: str | None = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @classmethod
+    def configure(cls, devices: str | int | None = None,
+                  ) -> "MeshService":
+        """Build (or return) the host's mesh service.  First
+        configuration wins: one mesh per host is the deployment
+        contract — a second configure with a conflicting shape raises
+        MeshError instead of silently rebuilding compiled programs
+        under live backends."""
+        spec = "" if devices is None else str(devices)
+        with cls._lock:
+            if cls._instance is not None:
+                inst = cls._instance
+                if spec and spec != inst.spec:
+                    # resolve count specs through the same parser a
+                    # fresh configure would use — a silently-ignored
+                    # conflicting count would leave `mesh status`
+                    # contradicting the conf the operator set
+                    import jax
+                    want = parse_mesh_shape(spec, len(jax.devices()))
+                    if want != (inst.n_shard, inst.n_data):
+                        raise MeshError(
+                            f"mesh already configured as "
+                            f"{inst.n_shard}x{inst.n_data} "
+                            f"(requested {spec!r} = "
+                            f"{want[0]}x{want[1]})")
+                return inst
+            import jax
+
+            from .mesh import make_mesh
+            have = len(jax.devices())
+            n_shard, n_data = parse_mesh_shape(spec, have)
+            if n_shard * n_data > have:
+                raise MeshError(
+                    f"mesh {n_shard}x{n_data} needs "
+                    f"{n_shard * n_data} devices, have {have} "
+                    f"(pre-set XLA_FLAGS="
+                    f"--xla_force_host_platform_device_count=N for "
+                    f"CPU meshes)")
+            cls._instance = cls(make_mesh(n_shard, n_data), spec)
+            return cls._instance
+
+    @classmethod
+    def get(cls) -> "MeshService | None":
+        """The configured instance, or None (mesh mode off)."""
+        return cls._instance
+
+    @classmethod
+    def get_or_configure(cls, devices: str | int | None = None
+                         ) -> "MeshService":
+        return cls.configure(devices)
+
+    @classmethod
+    def reset(cls) -> None:
+        """Drop the singleton (tests only: compiled programs cache per
+        geometry, so production never resets a live service)."""
+        with cls._lock:
+            cls._instance = None
+
+    # -- acquisition --------------------------------------------------------
+
+    def acquire(self, k: int, m: int, technique: str = "cauchy",
+                matrix=None):
+        """Geometry-checked DistributedStripeCodec handle, cached per
+        (k, m, technique) so every PG of every OSD on the host shares
+        one compiled collective program per EC profile.
+
+        matrix: the acquiring plugin's generator matrix when it has
+        one — must match the mesh codec's bit for bit (parity written
+        on the mesh plane must decode on the single-chip plane and
+        vice versa)."""
+        import numpy as np
+
+        from .mesh import DistributedStripeCodec
+        if k % self.n_shard:
+            raise MeshError(
+                f"EC k={k} not divisible by mesh shard axis "
+                f"{self.n_shard} (mesh {self.n_shard}x{self.n_data})")
+        tech = "cauchy" if technique in ("cauchy", "cauchy_good") \
+            else "reed_sol_van"
+        key = (k, m, tech)
+        with self._codec_lock:
+            codec = self._codecs.get(key)
+            if codec is None:
+                try:
+                    codec = DistributedStripeCodec(
+                        k, m, self.mesh,
+                        technique="cauchy" if tech == "cauchy"
+                        else "vandermonde")
+                except Exception as e:  # noqa: BLE001 — geometry/build
+                    raise MeshError(f"mesh codec build failed: {e}") \
+                        from e
+                self._codecs[key] = codec
+        if matrix is not None and \
+                not np.array_equal(np.asarray(matrix), codec.matrix):
+            raise MeshError(
+                f"plugin generator matrix (technique={technique!r}) "
+                f"does not match the mesh codec's {tech} matrix — "
+                f"mesh parity would not decode on the plugin plane")
+        return codec
+
+    # -- containment ledger -------------------------------------------------
+
+    def note_failure(self, err: BaseException | str) -> None:
+        """Record a mesh launch failure (the owning backend has
+        already aborted the op and fallen back to the single-chip
+        plane); surfaced via status() / the `mesh status` asok."""
+        self.failures += 1
+        self.last_error = repr(err) if isinstance(err, BaseException) \
+            else str(err)
+
+    # -- observability ------------------------------------------------------
+
+    def status(self) -> dict:
+        import jax
+        return {
+            "shape": {"shard": self.n_shard, "data": self.n_data},
+            "n_devices": self.n_shard * self.n_data,
+            "devices_visible": len(jax.devices()),
+            "backend": jax.default_backend(),
+            "codecs": sorted(
+                f"k={k} m={m} {t}" for (k, m, t) in self._codecs),
+            "failures": self.failures,
+            "last_error": self.last_error,
+            "uptime_s": round(time.time() - self.created_at, 1),
+        }

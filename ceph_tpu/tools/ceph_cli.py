@@ -26,8 +26,9 @@ commands this build's mon implements:
   python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd mclock profile \
       set PROFILE [CLASS:RES,WGT,LIM;...]   # rides central config to OSDs
   python -m ceph_tpu.tools.ceph_cli daemon /path/to/osd.N.asok \
-      {dump_latencies | dump_mclock | perf dump | ...}   # local asok,
-      # no mon needed (reference `ceph daemon`)
+      {dump_latencies | dump_mclock | perf dump | mesh status | ...}
+      # local asok, no mon needed (reference `ceph daemon`);
+      # `mesh status` = the multichip plane state (docs/MULTICHIP.md)
 """
 
 from __future__ import annotations
@@ -54,12 +55,20 @@ def daemon_command(argv: list[str]) -> int:
         return 22
     from ..common.admin_socket import admin_command
     path, prefix = argv[0], argv[1]
-    cmd = {"prefix": prefix}
     extra = argv[2:]
+    # multi-word prefixes ride unquoted (`daemon ASOK mesh status`,
+    # `daemon ASOK perf dump`): fold the second word into the prefix —
+    # but ONLY for the known two-word command families, so an arg typo
+    # elsewhere (`config set debug_osd` missing its value) still fails
+    # fast instead of becoming a bogus prefix
+    if len(extra) % 2 and prefix in ("perf", "config", "log", "mesh"):
+        prefix = f"{prefix} {extra[0]}"
+        extra = extra[1:]
     if len(extra) % 2:
         print("ceph daemon: trailing KEY without VALUE",
               file=sys.stderr)
         return 22
+    cmd = {"prefix": prefix}
     for k, v in zip(extra[::2], extra[1::2]):
         cmd[k] = v
     out = admin_command(path, cmd)

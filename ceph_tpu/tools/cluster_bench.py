@@ -101,13 +101,20 @@ def bench_pool(cluster, client, pool: str, seconds: float,
     }
 
 
-def _setup_profiles(client) -> None:
+def _setup_profiles(client, mesh: bool = False) -> None:
     client.set_ec_profile("cb21", {
         "plugin": "jerasure", "k": "2", "m": "1",
         "stripe_unit": "4096"})
     client.set_ec_profile("cb83", {
         "plugin": "jerasure", "k": "8", "m": "3",
         "stripe_unit": "4096"})
+    if mesh:
+        # the mesh plane requires a matrix-compatible plugin (the jax
+        # cauchy codec shares the MeshService generator matrix;
+        # jerasure's cauchy_good would fall back with a config error)
+        client.set_ec_profile("cb83x", {
+            "plugin": "jax", "k": "8", "m": "3",
+            "technique": "cauchy", "stripe_unit": "4096"})
 
 
 def _make_pool(client, name: str, profile: str | None) -> str:
@@ -127,7 +134,39 @@ def _matrix(args) -> list[tuple[str, str | None, float]]:
         rows.append(("ec_k2m1", "cb21", 0.0))
     rows += [("ec_k8m3", "cb83", 0.0),
              ("ec_k8m3_batched", "cb83", args.window_ms)]
+    if args.mesh is not None:
+        # mesh-plane A/B row: jax-plugin profile so the EC backends
+        # actually acquire the MeshService codec (docs/MULTICHIP.md)
+        rows.append(("ec_k8m3_mesh", "cb83x", 0.0))
     return rows
+
+
+def _row_mesh(c, args, profile) -> str | None:
+    """The `mesh` field for a published row: the shape string only
+    when a mesh plane ACTUALLY served the row, else null.  Thread
+    topology reads the live backends (an ECBackend that fell back to
+    the single-chip plane must not be published as a mesh run); the
+    process topology can't introspect other interpreters, so it
+    reports the shape the daemons' parser resolves — the best honest
+    claim available there."""
+    if args.mesh is None or profile != "cb83x":
+        return None
+    from ..parallel.service import MeshError, parse_mesh_shape
+    if hasattr(c, "osds"):          # thread topology: inspect planes
+        for osd in c.osds:
+            for st in getattr(osd, "pgs", {}).values():
+                if st.kind != "ec":
+                    continue
+                ms = st.backend.mesh_status()
+                if ms["active"]:
+                    m = ms["mesh"]
+                    return f"{m['shard']}x{m['data']}"
+        return None
+    try:
+        s, d = parse_mesh_shape(args.mesh, 8)
+        return f"{s}x{d}"
+    except MeshError:
+        return None
 
 
 def _bench_row(c, client, args, name, profile, window,
@@ -135,9 +174,13 @@ def _bench_row(c, client, args, name, profile, window,
     pool = _make_pool(client, name, profile)
     res = bench_pool(c, client, pool, args.seconds, args.threads,
                      args.size)
+    # `mesh` distinguishes mesh-plane rows from single-chip rows in
+    # the published JSON (shape string, or null) — resolved from the
+    # cluster AFTER the row ran, not from the CLI flag
     row = {"config": name, "objectstore": args.objectstore,
            "threads": args.threads, "obj_size": args.size,
-           "batch_window_ms": window, **res, **extra}
+           "batch_window_ms": window,
+           "mesh": _row_mesh(c, args, profile), **res, **extra}
     print(json.dumps(row), flush=True)
     return row
 
@@ -153,11 +196,33 @@ def main(argv=None) -> int:
                     help="batch window for the windowed EC rows")
     ap.add_argument("--quick", action="store_true",
                     help="small matrix (replicated + one EC profile)")
+    ap.add_argument("--mesh", nargs="?", const="", default=None,
+                    metavar="SxD|N",
+                    help="add a mesh-plane EC row: enable the "
+                         "multichip MeshService on the cluster "
+                         "('SxD' shape, device count, or bare flag = "
+                         "all visible devices)")
     ap.add_argument("--processes", action="store_true",
                     help="multi-process topology (ProcCluster): each "
                          "daemon its own interpreter — cluster numbers "
                          "measure the system, not one GIL")
     args = ap.parse_args(argv)
+
+    if args.mesh is not None:
+        # CPU hosts need the virtual devices BEFORE jax initializes
+        # (in the process topology daemon_main does this per daemon;
+        # the thread topology shares THIS interpreter's backend)
+        import os
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            from ..parallel.service import MeshError, parse_mesh_shape
+            try:
+                s, d = parse_mesh_shape(args.mesh, 8)
+                os.environ["XLA_FLAGS"] = (
+                    f"{flags} --xla_force_host_platform_device_"
+                    f"count={s * d}").strip()
+            except MeshError:
+                pass    # the service will surface the bad spec
 
     if args.processes:
         return _main_processes(args)
@@ -168,9 +233,9 @@ def main(argv=None) -> int:
     data_dir = tempfile.mkdtemp(prefix="cbench_") \
         if args.objectstore != "memstore" else None
     with Cluster(n_osds=args.osds, objectstore=args.objectstore,
-                 data_dir=data_dir) as c:
+                 data_dir=data_dir, mesh_devices=args.mesh) as c:
         client = c.client()
-        _setup_profiles(client)
+        _setup_profiles(client, mesh=args.mesh is not None)
         for name, profile, window in _matrix(args):
             for osd in c.osds:
                 osd.cct.conf.set("tpu_batch_window_ms", window)
@@ -212,9 +277,9 @@ def _main_processes(args) -> int:
         conf = {"tpu_batch_window_ms": window} if window else {}
         with ProcCluster(n_osds=args.osds,
                          objectstore=args.objectstore,
-                         conf=conf) as c:
+                         conf=conf, mesh_devices=args.mesh) as c:
             client = c.client()
-            _setup_profiles(client)
+            _setup_profiles(client, mesh=args.mesh is not None)
             for name, profile, w in rows:
                 _bench_row(c, client, args, name, profile, w,
                            {"topology": "processes"})

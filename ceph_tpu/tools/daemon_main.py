@@ -54,15 +54,43 @@ def run_mon(args) -> int:
     return 0
 
 
+def _prep_mesh_env(conf: dict) -> None:
+    """CPU meshes need their virtual devices BEFORE the jax backend
+    initializes: when this daemon is mesh-enabled and XLA_FLAGS does
+    not already force a host device count, derive one from the
+    mesh_devices conf (shape product, count, or the 8-device default).
+    A no-op for daemons without mesh mode or with the flag pre-set."""
+    import os
+    val = str(conf.get("osd_ec_use_mesh", "")).lower()
+    if val not in ("true", "1", "yes", "on"):
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        return
+    # same parser the MeshService will apply (parallel/service.py is
+    # jax-free at module level, so importing it here cannot trip the
+    # backend init this function exists to pre-empt)
+    from ..parallel.service import MeshError, parse_mesh_shape
+    try:
+        n_shard, n_data = parse_mesh_shape(
+            str(conf.get("mesh_devices", "")), 8)
+        n = n_shard * n_data
+    except MeshError:
+        n = 8      # the service will surface the bad spec itself
+    os.environ["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={n}").strip()
+
+
 def run_osd(args) -> int:
-    from ..osd.daemon import OSDDaemon
-    from ..store import create_store
-    store = create_store(args.objectstore, args.data_dir)
-    mons = [_parse_addr(a) for a in args.mon.split(",")]
     conf = {}
     for kv in args.conf or []:
         k, _, v = kv.partition("=")
         conf[k] = v
+    _prep_mesh_env(conf)   # before create_store/daemon import any jax
+    from ..osd.daemon import OSDDaemon
+    from ..store import create_store
+    store = create_store(args.objectstore, args.data_dir)
+    mons = [_parse_addr(a) for a in args.mon.split(",")]
     # conf rides the constructor: startup options (osd_op_queue) pick
     # construction-time shape and must precede anything reading them
     osd = OSDDaemon(args.id, mons, store=store,

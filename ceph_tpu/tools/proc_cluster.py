@@ -83,10 +83,21 @@ class ProcCluster:
                  heartbeat_interval: float = 1.0,
                  failure_quorum: int = 2,
                  conf: dict | None = None,
-                 boot_timeout: float = 120.0):
+                 boot_timeout: float = 120.0,
+                 mesh_devices: str | None = None):
         self.n_osds = n_osds
         self.n_mons = n_mons
         self.objectstore = objectstore
+        # multichip mode in the process topology: each OSD process
+        # stands in for a host and owns its OWN mesh (a jax mesh
+        # cannot span OS processes here); daemon_main pre-sets
+        # XLA_FLAGS from this conf before jax initializes so CPU
+        # meshes get their virtual devices — docs/MULTICHIP.md
+        self.mesh_devices = mesh_devices
+        if mesh_devices is not None:
+            conf = dict(conf or {})
+            conf.setdefault("osd_ec_use_mesh", True)
+            conf.setdefault("mesh_devices", mesh_devices)
         self.data_dir = Path(data_dir or tempfile.mkdtemp(
             prefix="ceph_tpu_proc_"))
         self.heartbeat_interval = heartbeat_interval

@@ -31,8 +31,16 @@ class Cluster:
                  objectstore: str = "memstore",
                  data_dir: str | None = None, n_mons: int = 1,
                  auth: str = "none", secure: bool = False,
-                 conf: dict | None = None):
+                 conf: dict | None = None,
+                 mesh_devices: str | None = None):
         self.conf = dict(conf or {})   # applied to every OSD pre-boot
+        # multichip deployment mode (docs/MULTICHIP.md): every OSD in
+        # this (one-host) cluster shares the process-wide MeshService,
+        # so EC PGs drain and repair on the device mesh.  '' = all
+        # visible devices, 'SxD' pins the shape; None = single-chip.
+        if mesh_devices is not None:
+            self.conf.setdefault("osd_ec_use_mesh", True)
+            self.conf.setdefault("mesh_devices", mesh_devices)
         # per-OSD conf overrides that SURVIVE revive: a revived daemon
         # gets a fresh CephContext, so anything set only via
         # cct.conf.set (chaos knobs like ms_inject_socket_failures)
@@ -293,6 +301,10 @@ def main(argv=None) -> int:
     ap.add_argument("--asok-dir", default=None)
     ap.add_argument("--auth", choices=("none", "cephx"), default="none")
     ap.add_argument("--secure", action="store_true")
+    ap.add_argument("--mesh-devices", default=None, metavar="SxD|N",
+                    help="enable the multichip EC mesh plane on this "
+                         "host: 'SHARDxDATA' shape, a device count, "
+                         "or '' for all visible devices")
     ap.add_argument("--keyring-out", default=None,
                     help="write the client keyring here (cephx)")
     args = ap.parse_args(argv)
@@ -304,7 +316,8 @@ def main(argv=None) -> int:
                       asok_dir=args.asok_dir,
                       objectstore=args.objectstore,
                       data_dir=args.data_dir, n_mons=args.mons,
-                      auth=args.auth, secure=args.secure).start()
+                      auth=args.auth, secure=args.secure,
+                      mesh_devices=args.mesh_devices).start()
     if args.auth == "cephx" and args.keyring_out:
         cluster.keyring.save(args.keyring_out)
         print(f"keyring written to {args.keyring_out}", flush=True)

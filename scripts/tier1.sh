@@ -19,4 +19,13 @@ set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu pyt
 if [ "$rc" -eq 0 ]; then
   timeout -k 10 300 env JAX_PLATFORMS=cpu python bench.py --smoke || rc=$?
 fi
+# CPU-mesh smoke (ISSUE 10, docs/MULTICHIP.md): an 8-virtual-device
+# host mesh runs the aggregate encode / encode+crc / batched-repair
+# mesh-vs-single-chip A/B at tiny sizes and asserts bit-parity plus
+# positive GB/s for every published key — mesh-plane regressions
+# (service acquisition, collective program, decode_flat_batch) fail
+# tier-1 before a TPU round ever sees them.
+if [ "$rc" -eq 0 ]; then
+  timeout -k 10 180 env JAX_PLATFORMS=cpu python bench.py --multichip || rc=$?
+fi
 exit $rc

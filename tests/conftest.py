@@ -23,3 +23,20 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def mesh_service():
+    """The per-host MeshService on the virtual 8-device CPU mesh (the
+    XLA_FLAGS force above ran in this process before jax initialized —
+    the same trick `bench.py --multichip` / daemon_main use in their
+    own subprocesses).  Reset afterwards so each test configures its
+    own shape; production never resets a live service."""
+    from ceph_tpu.parallel.service import MeshService
+    MeshService.reset()
+    try:
+        yield MeshService.configure("4x2")
+    finally:
+        MeshService.reset()

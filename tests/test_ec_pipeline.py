@@ -562,6 +562,108 @@ def test_pipeline_encode_failure_aborts_cleanly():
     assert not backend._sim_chunk and not backend._sim_refs
 
 
+def _mesh_pipeline_backend(k=4, m=2, chunk=64):
+    from ceph_tpu.parallel.mesh import DistributedStripeCodec, make_mesh
+    mc = DistributedStripeCodec(k, m, make_mesh(2, 2))
+    codec = REG.factory("jax", {"k": str(k), "m": str(m)})
+    store = MemStore()
+    store.mount()
+    shards = LocalShardBackend(store, pg_t(1, 0), k + m)
+    return ECBackend(codec, ec_util.StripeInfo(k * chunk, chunk),
+                     shards, mesh_codec=mc), mc
+
+
+def test_pipeline_mesh_finalize_failure_falls_back():
+    """Satellite (ISSUE 10): a mesh encode_flat_finalize failure at
+    depth 2 must _abort_op the drain's ops, release their pinned
+    extents (zero balance), and leave every SUBSEQUENT drain on the
+    single-chip fallback plane — the mesh never wedges the queue."""
+    backend, mc = _mesh_pipeline_backend()
+    orig = mc.encode_flat_finalize
+    boom = {"armed": True}
+
+    def failing(handle):
+        if boom["armed"]:
+            boom["armed"] = False
+            raise RuntimeError("injected mesh finalize failure")
+        return orig(handle)
+
+    mc.encode_flat_finalize = failing
+    rng = np.random.default_rng(40)
+    payloads = [rng.integers(0, 256, 512, dtype=np.uint8)
+                for _ in range(4)]
+    acks = []
+    ops = []
+    with backend.pipeline():
+        for i, p in enumerate(payloads):
+            txn = PGTransaction()
+            txn.write(oid(f"mf{i}"), 0, p)
+            ops.append(backend.submit_transaction(
+                txn, eversion_t(1, i + 1), lambda i=i: acks.append(i)))
+    assert acks == [0, 1, 2, 3]           # order kept, nothing wedged
+    assert ops[0].state == "failed" and ops[0].error is not None
+    # the mesh plane fell back for good; later drains took the
+    # single-chip path and committed
+    assert backend.mesh_codec is None
+    assert "disabled after failure" in backend.mesh_error
+    assert backend.mesh_status()["active"] is False
+    for i in (1, 2, 3):
+        assert ops[i].state == "done", ops[i].error
+        np.testing.assert_array_equal(
+            backend.read(oid(f"mf{i}"), 0, 512), payloads[i])
+    # zero-balance: pins, projections, and cross-drain refs all freed
+    assert len(backend.extent_cache) == 0
+    assert not backend._projected
+    assert not backend._sim_chunk and not backend._sim_refs
+    # the pipeline still serves new ops on the fallback plane
+    t = PGTransaction()
+    t.write(oid("mf_post"), 0, payloads[0])
+    done = []
+    backend.submit_transaction(t, eversion_t(1, 5),
+                               lambda: done.append(1))
+    assert done == [1]
+    np.testing.assert_array_equal(backend.read(oid("mf_post"), 0, 512),
+                                  payloads[0])
+
+
+def test_pipeline_mesh_submit_failure_falls_back():
+    """A mesh launch (submit-half) failure aborts the staging drain's
+    ops in order and flips the backend to the fallback plane — same
+    containment as the finalize case, caught one stage earlier."""
+    backend, mc = _mesh_pipeline_backend()
+    boom = {"armed": True}
+    orig = mc.encode_flat_submit
+
+    def failing(chunks):
+        if boom["armed"]:
+            boom["armed"] = False
+            raise RuntimeError("injected mesh submit failure")
+        return orig(chunks)
+
+    mc.encode_flat_submit = failing
+    rng = np.random.default_rng(41)
+    payloads = [rng.integers(0, 256, 512, dtype=np.uint8)
+                for _ in range(3)]
+    acks = []
+    ops = []
+    with backend.pipeline():
+        for i, p in enumerate(payloads):
+            txn = PGTransaction()
+            txn.write(oid(f"ms{i}"), 0, p)
+            ops.append(backend.submit_transaction(
+                txn, eversion_t(1, i + 1), lambda i=i: acks.append(i)))
+    assert acks == [0, 1, 2]
+    assert ops[0].state == "failed" and ops[0].error is not None
+    assert backend.mesh_codec is None
+    for i in (1, 2):
+        assert ops[i].state == "done", ops[i].error
+        np.testing.assert_array_equal(
+            backend.read(oid(f"ms{i}"), 0, 512), payloads[i])
+    assert len(backend.extent_cache) == 0
+    assert not backend._projected
+    assert not backend._sim_chunk and not backend._sim_refs
+
+
 def test_mesh_drain_matches_single_chip_fused_hashes():
     """Satellite: a multi-chip (CPU-mesh) drain must produce the same
     cumulative shard hashes as the single-chip fused path — the mesh
