@@ -1,25 +1,38 @@
 #!/usr/bin/env python
-"""Headline benchmark: EC encode GB/s, k=8 m=3, 1 MiB stripes (vs CPU).
+"""Headline benchmark: FUSED EC encode+crc GB/s, k=8 m=3, 1 MiB stripes.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N,
    "value_min": ..., "value_max": ..., "n_passes": ..., "cpu_abs_GBps": ...}
 
 value       = MEDIAN of n_passes independent slope measurements of the
-              jax-plugin (TPU when available) encode throughput, input
-              GB/s over 1 MiB objects split k=8 + m=3 parity, batched
-              and device-resident (the OSD worker keeps stripes on
-              device; reference analog is the in-memory buffer of
-              ceph_erasure_code_benchmark).  Passes are SPACED over
-              minutes: the shared axon tunnel swings single samples
-              2-3x by hour-of-day, so one sample is weather, the
-              median of spaced samples is climate.  value_min/max
+              jax-plugin FUSED parity+crc throughput (the point every
+              production write actually pays: the OSD always updates
+              HashInfo, reference ECUtil.cc:172), input GB/s over
+              1 MiB objects split k=8 + m=3 parity, batched and
+              device-resident.  Bare encode (the old headline) rides
+              along as ec_encode_k8_m3_1MiB_GBps with its own spread —
+              the fused:bare gap IS the crc tax the overlapped kernel
+              attacks.  On a CPU-only run the fused TPU kernel cannot
+              execute, so the row falls back to the bare-encode
+              headline (marked via "headline").  Passes are SPACED
+              over minutes: the shared axon tunnel swings single
+              samples 2-3x by hour-of-day, so one sample is weather,
+              the median of spaced samples is climate.  value_min/max
               publish the observed spread so two runs can be compared
-              honestly.
-vs_baseline = value / cpu_abs_GBps, the PINNED CPU denominator: best
-              CPU plugin, fixed iteration count, median of repeats —
-              recorded absolutely so the ratio's movement can always
-              be attributed to the numerator or denominator.
+              honestly.  fused_point/fused_path record the autotuned
+              operating point (tile, wb, extraction variant, combine
+              depth — ops/autotune.py) and the kernel path the passes
+              ran through, so a round-over-round move is attributable
+              to kernel vs tuning changes.
+vs_baseline = value / the PINNED CPU denominator: best CPU plugin,
+              fixed iteration count, median of repeats — recorded
+              absolutely so the ratio's movement can always be
+              attributed to the numerator or denominator.  For the
+              fused headline the denominator is cpu_crc_abs_GBps (CPU
+              encode + the host crc pass over every shard — the
+              reference's two-pass cost); bare-encode fallback rows
+              keep cpu_abs_GBps.
 
 Measurement method (each pass): the encode is chained through a
 `lax.fori_loop` (each iteration's input depends on the previous
@@ -69,6 +82,42 @@ def time_encode_cpu(codec, chunks, iters=CPU_ITERS, repeats=CPU_REPEATS):
         t0 = time.perf_counter()
         for _ in range(iters):
             codec.encode_chunks(chunks)
+        rates.append(iters * SIZE / (time.perf_counter() - t0))
+    rates.sort()
+    return rates[len(rates) // 2]
+
+
+CPU_CRC_ITERS = 300             # fixed work per CPU fused-repeat
+
+
+def time_encode_crc_cpu(codec, chunks, iters=CPU_CRC_ITERS,
+                        repeats=CPU_REPEATS):
+    """Pinned denominator of the FUSED headline: the reference's
+    two-pass cost — plugin encode, then a full host crc walk over
+    every data+parity shard (ECUtil.cc HashInfo::append) — at fixed
+    iteration count, median of repeats.  Uses the native crc path when
+    built; the numpy table fallback is ~1000x slower, so iterations
+    drop to keep the (rarely exercised) fallback run bounded."""
+    from ceph_tpu.common import crc32c as _crc
+    from ceph_tpu.common import native
+    if native.load() is None:
+        iters = max(iters // 100, 1)
+    k = chunks.shape[0]
+    n = codec.get_chunk_count()
+    seeds = [0xFFFFFFFF] * n
+    par = codec.encode_chunks(chunks)    # warm
+    # two row-wise passes (data, then parity) — the reference walks
+    # existing buffers; a concatenate memcpy inside the timed loop
+    # would deflate the denominator by its copy cost
+    _crc.crc32c_rows(chunks, seeds[:k])
+    _crc.crc32c_rows(par, seeds[k:])
+    rates = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            par = codec.encode_chunks(chunks)
+            _crc.crc32c_rows(chunks, seeds[:k])
+            _crc.crc32c_rows(par, seeds[k:])
         rates.append(iters * SIZE / (time.perf_counter() - t0))
     rates.sort()
     return rates[len(rates) // 2]
@@ -608,6 +657,12 @@ def run_multichip() -> int:
     jax_codec = ErasureCodePluginRegistry.instance().factory(
         "jax", {"k": str(K), "m": str(M), "technique": "cauchy"})
     try:
+        # the fused operating point rides every published row so
+        # mesh-vs-single moves stay attributable to tuning changes
+        out["fused_point"] = jax_codec.fused_point()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
         svc = MeshService.configure(min(n_req, have))
         dcodec = svc.acquire(K, M, technique="cauchy",
                              matrix=jax_codec.matrix)
@@ -647,6 +702,52 @@ SMOKE_KEYS = ("ec_write_pipeline_k8_m3_GBps",
               "ec_deep_scrub_GBps")
 
 
+def check_fused_kernel_smoke(out: dict) -> str | None:
+    """--smoke gate (ISSUE 11): the fused metric must come from the
+    hier kernel family — specifically the overlapped ACCUMULATOR
+    kernel at an autotune-style operating point — not the XLA
+    fallback.  On this CPU gate the kernel runs through the Pallas
+    interpreter (the same kernel body and scalar-prefetch grid the
+    TPU compiles), and its parity + crc are checked byte-exact against
+    the host oracles, tail-free (the accumulator's L must cover the
+    run's every byte).  Returns an error string, or None when the
+    hier path produced the metric."""
+    import jax.numpy as jnp
+
+    from ceph_tpu.common import crc32c as _crc
+    from ceph_tpu.ec import gf
+    from ceph_tpu.ops import bitsliced as bs
+    from ceph_tpu.ops import crc32c_linear as cl
+    k, m = 4, 2
+    tile, wb = 4096, 128
+    mat = gf.cauchy_rs_matrix(k, m)[k:]
+    bitmat = jnp.asarray(bs.interleave_bitmatrix(mat), dtype=jnp.int8)
+    bitmat32 = jnp.asarray(bs._w32_bitmat(mat), dtype=jnp.int8)
+    rng = np.random.default_rng(23)
+    runs = [rng.integers(0, 256, (k, tile + 513), dtype=np.uint8)]
+    handle = bs.gf_encode_extents_with_crc_submit(
+        bitmat, bitmat32, runs, m, use_w32=True, force_xla=False,
+        interpret=True, tile=tile, wb=wb, extract="wide",
+        combine="kernel")
+    out["ec_fused_path"] = handle.get("path")
+    if handle.get("path") != "hier_acc":
+        return (f"fused metric not produced by the hier accumulator "
+                f"kernel (path={handle.get('path')!r})")
+    [(par, l, tail, body)] = \
+        bs.gf_encode_extents_with_crc_finalize(handle)
+    if body != runs[0].shape[1] or tail.shape[1] != 0:
+        return (f"accumulator L does not cover the run "
+                f"(body={body}, tail={tail.shape[1]})")
+    if not np.array_equal(np.asarray(par), gf.gf_matvec(mat, runs[0])):
+        return "hier accumulator parity diverged from gf_matvec"
+    allsh = np.concatenate([runs[0], np.asarray(par)], axis=0)
+    for s in range(k + m):
+        got = cl.fold_run_crc(int(l[s]), body, 0xFFFFFFFF)
+        if got != _crc.crc32c(allsh[s].tobytes(), 0xFFFFFFFF):
+            return f"hier accumulator crc diverged on shard {s}"
+    return None
+
+
 def run_smoke() -> int:
     """CPU-mode smoke for tier-1 (scripts/tier1.sh): tiny sizes, runs
     the full end-to-end benches, and asserts the published JSON keys
@@ -657,6 +758,7 @@ def run_smoke() -> int:
     ensure_usable_backend(prefer_cpu=True)
     out = bench_end_to_end(on_tpu=False, passes=1, spacing=0.0)
     out["metric"] = "ec_write_pipeline_smoke"
+    fused_why = check_fused_kernel_smoke(out)   # fills ec_fused_path
     print(json.dumps(out))
     missing = [k for k in SMOKE_KEYS
                if not isinstance(out.get(k), (int, float))
@@ -669,6 +771,14 @@ def run_smoke() -> int:
     if out.get("ec_deep_scrub_host_bytes", 0) <= 0:
         print("# smoke FAILED: host crc fallback not exercised",
               file=sys.stderr)
+        return 1
+    # fused-kernel provenance guard (ISSUE 11): the headline fused
+    # metric must come from the hier accumulator kernel, bit-exact —
+    # a dispatch regression that silently falls back to XLA (or a
+    # kernel change that breaks the L contract) fails here, not in a
+    # TPU round
+    if fused_why is not None:
+        print(f"# smoke FAILED: {fused_why}", file=sys.stderr)
         return 1
     # tracking-overhead guard (docs/TRACING.md): always-on tracking
     # must cost < TRACK_OVERHEAD_MAX_PCT (default 2%) beyond the
@@ -747,16 +857,27 @@ def main():
     jax_codec = reg.factory("jax", dict(prof))
     chunks = jax_codec.encode_prepare(payload)
 
-    # CPU denominator: best available CPU plugin (native C if built).
-    cpu_best = 0.0
+    # CPU denominators: best available CPU plugin (native C if built)
+    # for bare encode, and the SAME winning plugin + host crc pass for
+    # the fused headline (the reference's two-pass configuration)
+    cpu_best, cpu_codec = 0.0, None
     for plugin, p in (("isa", {"k": str(K), "m": str(M)}),
                       ("jerasure", {"k": str(K), "m": str(M),
                                     "technique": "cauchy_good"})):
         try:
             c = reg.factory(plugin, p)
-            cpu_best = max(cpu_best, time_encode_cpu(c, chunks))
+            rate = time_encode_cpu(c, chunks)
+            if rate > cpu_best:
+                cpu_best, cpu_codec = rate, c
         except Exception as e:  # noqa: BLE001
             print(f"# cpu plugin {plugin} failed: {e}", file=sys.stderr)
+    cpu_crc_best = 0.0
+    if cpu_codec is not None:
+        try:
+            cpu_crc_best = time_encode_crc_cpu(cpu_codec, chunks)
+        except Exception as e:  # noqa: BLE001
+            print(f"# cpu fused denominator failed: {e}",
+                  file=sys.stderr)
 
     import jax
     on_tpu = jax.default_backend() != "cpu"
@@ -785,14 +906,14 @@ def main():
         value = 0.0
 
     # fused parity+crc — the write path's real configuration (the OSD
-    # always updates HashInfo; reference ECUtil.cc:172).  FIRST-CLASS
-    # metric: the same number of spaced passes as the headline, its
-    # own published spread (min/max/n) so the fused-path trajectory is
-    # comparable round over round, and the same roofline elision gate
-    # (inside _slope_time).  TPU only (the kernel is Mosaic-compiled).
+    # always updates HashInfo; reference ECUtil.cc:172) and, since the
+    # overlapped/accumulator kernel, THE HEADLINE: the same number of
+    # spaced passes, its own published spread (min/max/n), the same
+    # roofline elision gate (inside _slope_time).  TPU only (the
+    # kernel is Mosaic-compiled) — CPU rows fall back to bare encode.
     extras = {}
+    crc_samples = []
     if on_tpu:
-        crc_samples = []
         for i in range(passes):
             if i and spacing:
                 time.sleep(spacing)
@@ -804,25 +925,26 @@ def main():
             except Exception as e:  # noqa: BLE001
                 print(f"# encode+crc pass {i + 1} failed: {e}",
                       file=sys.stderr)
+        crc_samples.sort()
+        if not crc_samples and error is None:
+            error = "encode+crc: all passes failed"
         if crc_samples:
-            crc_samples.sort()
-            extras["ec_encode_crc_k8_m3_1MiB_GBps"] = round(
-                crc_samples[len(crc_samples) // 2] / 1e9, 3)
-            extras["ec_encode_crc_min_GBps"] = round(
-                crc_samples[0] / 1e9, 3)
-            extras["ec_encode_crc_max_GBps"] = round(
-                crc_samples[-1] / 1e9, 3)
-            extras["ec_encode_crc_n_passes"] = len(crc_samples)
-        else:
-            extras["ec_encode_crc_k8_m3_1MiB_GBps"] = None
-            if error is None:
-                error = "encode+crc: all passes failed"
-        try:
-            # the autotuned (tile, wb, packed) the fused passes ran at,
-            # so a perf move can be attributed to tuning vs kernel
-            extras["fused_point"] = jax_codec.fused_point()
-        except Exception:  # noqa: BLE001
-            pass
+            # only when fused passes actually landed: fused_path records
+            # the kernel path the passes ran through, so a bare-encode
+            # fallback row must not claim one
+            try:
+                # the autotuned cache entry the fused passes ran at
+                # (tile, wb, extraction variant, combine depth) + the
+                # kernel path it selects, so a perf move is
+                # attributable to tuning vs kernel changes; the
+                # headline must come from the hier kernel family,
+                # never the XLA fallback
+                point = jax_codec.fused_point()
+                extras["fused_point"] = point
+                extras["fused_path"] = "hier_acc" \
+                    if point.get("combine") == "kernel" else "hier_lsub"
+            except Exception:  # noqa: BLE001
+                pass
 
     # decode-1/2/3 tracked alongside the headline (BASELINE.json
     # north_star; reference `-w decode -e 1/2/3`)
@@ -848,23 +970,64 @@ def main():
         if error is None:
             error = f"end_to_end: {e}"
 
+    # headline selection: the fused point when it landed (TPU rounds —
+    # ISSUE 11 promotes it: the gap between fused and bare IS the tax
+    # production writes pay), bare encode otherwise (CPU fallback).
+    # Both series always publish their full spread under stable keys.
+    bare = {
+        "ec_encode_k8_m3_1MiB_GBps":
+            round(value / 1e9, 3) if samples else None,
+        "ec_encode_min_GBps":
+            round(samples[0] / 1e9, 3) if samples else None,
+        "ec_encode_max_GBps":
+            round(samples[-1] / 1e9, 3) if samples else None,
+        "ec_encode_n_passes": len(samples),
+    }
+    fused_value = crc_samples[len(crc_samples) // 2] \
+        if crc_samples else None
+    fused = {
+        "ec_encode_crc_k8_m3_1MiB_GBps":
+            round(fused_value / 1e9, 3) if crc_samples else None,
+        "ec_encode_crc_min_GBps":
+            round(crc_samples[0] / 1e9, 3) if crc_samples else None,
+        "ec_encode_crc_max_GBps":
+            round(crc_samples[-1] / 1e9, 3) if crc_samples else None,
+        "ec_encode_crc_n_passes": len(crc_samples),
+    }
+    if crc_samples:
+        metric, headline = "ec_encode_crc_k8_m3_1MiB", "fused_encode_crc"
+        head_value, head_samples = fused_value, crc_samples
+        denom = cpu_crc_best
+    else:
+        metric, headline = "ec_encode_k8_m3_1MiB", "bare_encode"
+        head_value, head_samples = value, samples
+        denom = cpu_best
     out = {
-        "metric": "ec_encode_k8_m3_1MiB",
-        "value": round(value / 1e9, 3),
+        "metric": metric,
+        "value": round(head_value / 1e9, 3) if head_samples else 0.0,
         "unit": "GB/s",
-        "vs_baseline": round(value / cpu_best, 3) if cpu_best else None,
+        "headline": headline,
+        "vs_baseline": round(head_value / denom, 3)
+        if denom and head_samples else None,
         # spread of the spaced passes: two driver runs whose medians
         # fall inside each other's [min, max] agree
-        "value_min": round(samples[0] / 1e9, 3) if samples else None,
-        "value_max": round(samples[-1] / 1e9, 3) if samples else None,
-        "n_passes": len(samples),
+        "value_min":
+            round(head_samples[0] / 1e9, 3) if head_samples else None,
+        "value_max":
+            round(head_samples[-1] / 1e9, 3) if head_samples else None,
+        "n_passes": len(head_samples),
         "pass_spacing_s": spacing,
-        # PINNED absolute denominator (fixed iters, median of repeats)
+        # PINNED absolute denominators (fixed iters, median of repeats):
+        # bare CPU encode, and encode + host crc pass for the fused row
         "cpu_abs_GBps": round(cpu_best / 1e9, 3) if cpu_best else None,
+        "cpu_crc_abs_GBps":
+            round(cpu_crc_best / 1e9, 3) if cpu_crc_best else None,
         # numerator is device-resident batched slope timing; denominator
         # is per-call synchronous CPU encode (includes Python dispatch) —
         # see BASELINE.md for the methodology note
         "baseline_method": "cpu_per_call_sync_fixed_iters",
+        **bare,
+        **fused,
         **extras,
     }
     if error is not None:

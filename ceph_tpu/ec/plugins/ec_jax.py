@@ -137,10 +137,11 @@ class ErasureCodeJax(ErasureCode):
         return bs.gf_bitmatmul_w32(self._enc_bitmat32, words, self.m)
 
     def fused_point(self) -> dict:
-        """The fused kernel's (tile, wb, packed) operating point for
-        this device, resolved lazily through the ops/autotune cache
-        (first fused call on a fresh accelerator pays the sweep; CPU
-        and opted-out runs get the static defaults)."""
+        """The fused kernel's (tile, wb, extract, combine) operating
+        point for this device, resolved lazily through the
+        ops/autotune cache (first fused call on a fresh accelerator
+        pays the sweep; CPU and opted-out runs get the static
+        defaults)."""
         if self._fused_point is None:
             from ...ops import autotune
             try:
@@ -154,8 +155,9 @@ class ErasureCodeJax(ErasureCode):
     def encode_words_with_crc(self, words, tile: int | None = None,
                               wb: int | None = None):
         """Device-resident fused parity + crc over word-packed input at
-        the autotuned operating point (the hier-crc kernel with the
-        device-side log-depth combine; see
+        the autotuned operating point (the overlapped hier-crc kernel
+        with the device-side combine — in-kernel VMEM accumulator or
+        XLA log-fold per the point's `combine` axis; see
         ops/bitsliced.gf_encode_with_crc_w32_fold).  words (k, W)
         int32; W bytes per shard must be a tile multiple.  Returns
         (parity (m, W) int32, crc L-bits (k+m, 32) int32 — ONE
@@ -175,7 +177,8 @@ class ErasureCodeJax(ErasureCode):
         cmat_sub = jnp.asarray(cl.crc_tile_matrix_w32(wb))
         return bs.gf_encode_with_crc_w32_fold(
             self._enc_bitmat32, cmat_sub, words, self.m,
-            tile=tile, wb=wb, packed=point["packed"])
+            tile=tile, wb=wb, extract=point["extract"],
+            combine=point["combine"])
 
     def encode_stripes(self, stripes):
         """Batched encode: (B, k, C) -> (B, m, C), one kernel launch.
@@ -210,7 +213,8 @@ class ErasureCodeJax(ErasureCode):
             use_w32=self._use_w32,
             tile=point["tile"] if point else None,
             wb=point["wb"] if point else None,
-            packed=point["packed"] if point else False)
+            extract=point["extract"] if point else "planar",
+            combine=point["combine"] if point else "xla")
 
     def encode_extents_with_crc_submit(self, runs: list[np.ndarray]):
         """Dispatch half of encode_extents_with_crc for the OSD's
@@ -226,7 +230,8 @@ class ErasureCodeJax(ErasureCode):
             use_w32=self._use_w32,
             tile=point["tile"] if point else None,
             wb=point["wb"] if point else None,
-            packed=point["packed"] if point else False)
+            extract=point["extract"] if point else "planar",
+            combine=point["combine"] if point else "xla")
 
     def encode_extents_with_crc_finalize(self, handle):
         """Completion half: blocks on one submit handle's device work

@@ -1,14 +1,27 @@
 """Cached autotuner for the fused parity+crc kernel's operating point.
 
-The fused kernel has three knobs with hardware-dependent optima:
-`tile` (bytes per grid step — DMA granularity vs VMEM pressure), `wb`
-(crc sub-block words — the crc matmul's M dimension is (k+m) * tile/4/wb,
-so wb trades MXU row utilization against matrix VMEM), and `packed`
-(the 4-bits-per-pass crc extraction, whose strided sublane slice only
-lowers on some Mosaic generations).  tools/fused_tile_sweep.py used to
-sweep these by hand and the winners were frozen into
-bitsliced.FUSED_TILE_HIER / FUSED_WB; this module replaces the
-hardcoded constants with a measured, per-device choice:
+The fused kernel has four knobs with hardware-dependent optima:
+
+  * `tile` — bytes per grid step (DMA granularity vs VMEM pressure);
+  * `wb` — crc sub-block words (the crc matmul's M dimension is
+    (k+m) * tile/4/wb, so wb trades MXU row utilization against matrix
+    VMEM, and with the in-kernel combine also the accumulator size);
+  * `extract` — the crc bit-extraction variant: "planar" (32
+    single-bit passes, lowers everywhere), "packed" (4 bits per masked
+    pass) or "wide" (mask-free shift-only passes, mod-2 junk
+    cancellation) — the non-planar variants use a strided sublane
+    slice that only lowers on some Mosaic generations;
+  * `combine` — the L combine depth: "xla" streams per-grid-step
+    sub-block L-blocks to HBM and log-folds them in XLA (parallel grid
+    semantics), "kernel" folds them into a VMEM-resident per-run
+    accumulator inside the kernel (sequential grid, no HBM round-trip
+    or relayout).  Which wins depends on how the generation prices
+    sequential-grid pipelining vs the XLA epilogue.
+
+tools/fused_tile_sweep.py used to sweep tile/wb by hand and the
+winners were frozen into bitsliced.FUSED_TILE_HIER / FUSED_WB; this
+module replaces the hardcoded constants with a measured, per-device
+choice:
 
   * the sweep runs at plugin init (first fused encode) on accelerator
     backends only — CPU/interpret callers get the static defaults;
@@ -18,8 +31,10 @@ hardcoded constants with a measured, per-device choice:
   * results persist in a JSON cache keyed by (platform, device_kind,
     k, m), so only the first init on a given device pays the sweep;
   * a wall-clock budget (CEPH_TPU_AUTOTUNE_BUDGET_S, default 75 s)
-    bounds init latency — candidates are ordered best-guess-first and
-    the sweep keeps the best fully-measured point when time runs out.
+    bounds init latency — candidates are ordered best-guess-first
+    (the cached winner of the nearest (platform, device_kind) key
+    when this exact (k, m) is cold, then the static default) and the
+    sweep keeps the best fully-measured point when time runs out.
 
 Env knobs: CEPH_TPU_AUTOTUNE=0 disables sweeping (cache hits are still
 honored); CEPH_TPU_AUTOTUNE_CACHE overrides the cache path.
@@ -39,19 +54,31 @@ import numpy as np
 # spanning crc-matmul M from ~(k+m)*32 to ~(k+m)*256
 SWEEP_TILES = (32768, 65536, 131072, 262144)
 SWEEP_WBS = (256, 512, 1024)
+SWEEP_EXTRACTS = ("planar", "packed", "wide")
+SWEEP_COMBINES = ("xla", "kernel")
 
 # measurement input: bytes per shard (multiple of every sweep tile)
 MEASURE_BYTES = 1 << 21
 MEASURE_ITERS = (5, 15)
 ROOFLINE_BPS = 1e12           # same elision gate as bench.py
 
+# the cache's kernel-generation tag: bumped when the kernel family
+# changes shape (r2 = the overlapped/accumulator kernel), so winners
+# measured under an older kernel never satisfy a lookup — they remain
+# visible to the nearest-key SEEDING below, which only affects sweep
+# ordering, never skips validation
+KERNEL_GEN = "fused_w32r2"
+
 _lock = threading.Lock()
 
 
 def default_point() -> dict:
+    """The static fallback point: the frozen tile/wb with the planar
+    extraction and XLA combine — the only variant shipped without a
+    per-device validation run (it is the one that lowers everywhere)."""
     from . import bitsliced as bs
     return {"tile": bs.FUSED_TILE_HIER, "wb": bs.FUSED_WB,
-            "packed": False}
+            "extract": "planar", "combine": "xla"}
 
 
 def _cache_path() -> Path:
@@ -61,14 +88,33 @@ def _cache_path() -> Path:
     return Path.home() / ".cache" / "ceph_tpu" / "autotune.json"
 
 
+def _migrate_v1_entry(ent: dict) -> dict | None:
+    """v1 cache rows ({tile, wb, packed}) become v2 rows so they can
+    still SEED candidate ordering; their keys carry the old kernel
+    generation, so they never satisfy a lookup directly."""
+    if "tile" not in ent or "wb" not in ent:
+        return None
+    return {"tile": ent["tile"], "wb": ent["wb"],
+            "extract": "packed" if ent.get("packed") else "planar",
+            "combine": "xla", "gbps": ent.get("gbps", 0.0),
+            "when": ent.get("when", "")}
+
+
 def _load_cache() -> dict:
     try:
         data = json.loads(_cache_path().read_text())
-        if data.get("version") == 1:
-            return data
     except (OSError, ValueError):
-        pass
-    return {"version": 1, "entries": {}}
+        return {"version": 2, "entries": {}}
+    if data.get("version") == 2:
+        return data
+    if data.get("version") == 1:
+        entries = {}
+        for key, ent in data.get("entries", {}).items():
+            migrated = _migrate_v1_entry(ent)
+            if migrated is not None:
+                entries[key] = migrated
+        return {"version": 2, "entries": entries}
+    return {"version": 2, "entries": {}}
 
 
 def _save_cache(data: dict) -> None:
@@ -83,21 +129,57 @@ def _save_cache(data: dict) -> None:
         pass
 
 
-def _device_key(k: int, m: int) -> str:
+def _device_prefix() -> str:
     import jax
     dev = jax.devices()[0]
     kind = getattr(dev, "device_kind", "?")
-    # the jax/jaxlib version is part of the key: the packed variant's
-    # lowering is Mosaic-generation-dependent, so a point validated on
-    # one runtime must NOT be trusted (unvalidated) on another — an
-    # upgrade simply re-sweeps
-    return (f"{dev.platform}/{kind}/jax{jax.__version__}"
-            f"/fused_w32/k{k}m{m}")
+    return f"{dev.platform}/{kind}/"
 
 
-def candidates(k: int, m: int, tiles=None, wbs=None) -> list[dict]:
-    """Legal (tile, wb, packed) points, best-guess-first: the frozen
-    default leads so a budget-capped sweep still measures a baseline."""
+def _device_key(k: int, m: int) -> str:
+    import jax
+    # the jax/jaxlib version is part of the key: the packed/wide
+    # variants' lowering is Mosaic-generation-dependent, so a point
+    # validated on one runtime must NOT be trusted (unvalidated) on
+    # another — an upgrade simply re-sweeps
+    return (f"{_device_prefix()}jax{jax.__version__}"
+            f"/{KERNEL_GEN}/k{k}m{m}")
+
+
+def _nearest_point(cache: dict, k: int, m: int) -> dict | None:
+    """Seed for a cold (k, m): the cached winner whose key shares this
+    device's (platform, device_kind) prefix — any geometry, jax
+    version or kernel generation.  A cold k=4,m=2 plugin init on a
+    device that already swept k=8,m=3 starts from that winner's
+    neighborhood instead of the static best-guess order, so a
+    budget-capped sweep measures the likely-best region first.  Seeds
+    only ORDER candidates; every candidate still validates."""
+    import jax
+    prefix = _device_prefix()
+    ver_tag = f"/jax{jax.__version__}/"
+    best, best_rank = None, None
+    for key, ent in cache.get("entries", {}).items():
+        if not key.startswith(prefix):
+            continue
+        point = {kk: ent.get(kk) for kk in
+                 ("tile", "wb", "extract", "combine")}
+        if point["tile"] is None or point["wb"] is None:
+            continue
+        # prefer: same jax version, then same kernel generation, then
+        # the fastest measured winner (gbps 0.0 = failure sentinel)
+        rank = (ver_tag not in key, f"/{KERNEL_GEN}/" not in key,
+                -float(ent.get("gbps") or 0.0))
+        if best_rank is None or rank < best_rank:
+            best, best_rank = point, rank
+    return best
+
+
+def candidates(k: int, m: int, tiles=None, wbs=None,
+               seed: dict | None = None) -> list[dict]:
+    """Legal (tile, wb, extract, combine) points, best-guess-first:
+    the `seed` point (a cached neighbor's winner) leads when given,
+    then the frozen default, then the seed's (tile, wb) neighborhood —
+    so a budget-capped sweep still measures a meaningful baseline."""
     r = k + m
     out = []
     for tile in tiles or SWEEP_TILES:
@@ -106,21 +188,38 @@ def candidates(k: int, m: int, tiles=None, wbs=None) -> list[dict]:
             if wt % wb:
                 continue
             s = wt // wb
-            if (r * s) % 8:      # lsub out-block sublane alignment
+            if (r * s) % 8:      # lsub/lacc out-block sublane alignment
                 continue
-            for packed in (False, True):
-                out.append({"tile": tile, "wb": wb, "packed": packed})
+            for combine in SWEEP_COMBINES:
+                for extract in SWEEP_EXTRACTS:
+                    out.append({"tile": tile, "wb": wb,
+                                "extract": extract, "combine": combine})
     dflt = default_point()
-    out.sort(key=lambda c: (c["tile"] != dflt["tile"],
-                            c["wb"] != dflt["wb"], c["packed"]))
+
+    def _match(c: dict, p: dict | None) -> bool:
+        return p is not None and \
+            all(c[kk] == p.get(kk) for kk in c)
+
+    out.sort(key=lambda c: (
+        not _match(c, seed),
+        not _match(c, dflt),
+        seed is None or c["tile"] != seed.get("tile"),
+        seed is None or c["wb"] != seed.get("wb"),
+        c["tile"] != dflt["tile"], c["wb"] != dflt["wb"],
+        c["extract"] != "planar", c["combine"] != "xla"))
     return out
 
 
-def _validate(mat: np.ndarray, bitmat32, cand: dict) -> bool:
-    """Bit-exactness gate: one small fused launch vs the host parity
-    and crc32c oracles.  A candidate that fails to compile, lower, or
-    match (e.g. the packed extraction's strided slice on an older
-    Mosaic) is rejected here — never silently shipped."""
+def _validate(mat: np.ndarray, bitmat32, cand: dict,
+              interpret: bool = False) -> bool:
+    """Bit-exactness gate: one small fused launch (TWO grid steps, so
+    the accumulator's cross-step advance fold is exercised) vs the
+    host parity and crc32c oracles.  A candidate that fails to
+    compile, lower, or match (e.g. the packed/wide extraction's
+    strided slice on an older Mosaic, or the accumulator kernel's
+    scalar-prefetch grid) is rejected here — never silently shipped.
+    `interpret` runs the same check through the Pallas interpreter
+    (the CPU tier-1 gate, fused_tile_sweep --validate-only)."""
     import jax.numpy as jnp
 
     from ..common import crc32c as _crc
@@ -130,15 +229,16 @@ def _validate(mat: np.ndarray, bitmat32, cand: dict) -> bool:
     m_, k = mat.shape
     tile, wb = cand["tile"], cand["wb"]
     rng = np.random.default_rng(0xC5C)
-    chunks = rng.integers(0, 256, (k, tile), dtype=np.uint8)
+    chunks = rng.integers(0, 256, (k, 2 * tile), dtype=np.uint8)
     words = jnp.asarray(chunks.view("<u4").view(np.int32))
     cmat_sub = jnp.asarray(cl.crc_tile_matrix_w32(wb))
     try:
         par_w, lbits = bs.gf_encode_with_crc_w32_fold(
             bitmat32, cmat_sub, words, m_, tile=tile, wb=wb,
-            packed=cand["packed"])
+            interpret=interpret, extract=cand["extract"],
+            combine=cand["combine"])
         parity = np.asarray(par_w).view("<u4").view(np.uint8) \
-            .reshape(m_, tile)
+            .reshape(m_, 2 * tile)
         ls = cl.bits_to_u32(np.asarray(lbits))
     except Exception:  # noqa: BLE001 — any lowering/compile failure
         return False
@@ -146,7 +246,7 @@ def _validate(mat: np.ndarray, bitmat32, cand: dict) -> bool:
         return False
     allsh = np.concatenate([chunks, parity], axis=0)
     return all(
-        cl.fold_run_crc(int(ls[s]), tile, 0xFFFFFFFF)
+        cl.fold_run_crc(int(ls[s]), 2 * tile, 0xFFFFFFFF)
         == _crc.crc32c(allsh[s].tobytes(), 0xFFFFFFFF)
         for s in range(k + m_))
 
@@ -169,7 +269,7 @@ def _measure(bitmat32, k: int, m: int, cand: dict) -> float:
     def step(x):
         par, lbits = bs.gf_encode_with_crc_w32_fold(
             bitmat32, cmat_sub, x, m, tile=tile, wb=wb,
-            packed=cand["packed"])
+            extract=cand["extract"], combine=cand["combine"])
         return par ^ jnp.sum(lbits)      # crc feeds the chain: no DCE
 
     def make(iters):
@@ -203,44 +303,58 @@ def _measure(bitmat32, k: int, m: int, cand: dict) -> float:
 def fused_operating_point(k: int, m: int, mat: np.ndarray | None = None,
                           bitmat32=None, tiles=None, wbs=None,
                           force: bool = False,
-                          report: list | None = None) -> dict:
-    """The (tile, wb, packed) point the fused encode+crc path should
-    run at on THIS device, sweeping and caching on first use.
+                          report: list | None = None,
+                          interpret: bool = False) -> dict:
+    """The (tile, wb, extract, combine) point the fused encode+crc
+    path should run at on THIS device, sweeping and caching on first
+    use.
 
     `mat` (m, k) GF(2^8) generator rows and `bitmat32` (its
     _w32_bitmat device array) enable the sweep; without them (or on
     CPU, or with CEPH_TPU_AUTOTUNE=0) the cached or default point is
     returned as-is.  `report`, when given, collects per-candidate
-    (cand, gbps|None) tuples for the sweep CLI."""
+    (cand, gbps|None) tuples for the sweep CLI; `interpret` runs
+    candidate validation through the Pallas interpreter and waives
+    the accelerator-backend requirement (tests and the CPU validate
+    gate — measurement still runs on whatever backend is live)."""
     import jax
-    if jax.default_backend() == "cpu":
+    if jax.default_backend() == "cpu" and not interpret:
         return default_point()
     with _lock:
         key = _device_key(k, m)
         cache = _load_cache()
         hit = cache["entries"].get(key)
         if hit is not None and not force:
-            return {kk: hit[kk] for kk in ("tile", "wb", "packed")}
+            return {kk: hit[kk]
+                    for kk in ("tile", "wb", "extract", "combine")}
         if os.environ.get("CEPH_TPU_AUTOTUNE", "1") == "0" or \
                 mat is None or bitmat32 is None:
             return default_point()
         budget = float(os.environ.get("CEPH_TPU_AUTOTUNE_BUDGET_S", "75"))
+        seed = _nearest_point(cache, k, m)
         t0 = time.perf_counter()
         best, best_rate = None, 0.0
         tried = 0
-        for cand in candidates(k, m, tiles, wbs):
+        for cand in candidates(k, m, tiles, wbs, seed=seed):
             # honor the budget once ANY candidate has been attempted —
             # even if every sample so far was roofline-gated to 0.0 —
             # so a noisy/elision-prone runtime cannot turn plugin init
-            # into an unbounded 24-candidate sweep
+            # into an unbounded 72-candidate sweep
             if tried and time.perf_counter() - t0 > budget:
                 break
             tried += 1
-            if not _validate(mat, bitmat32, cand):
+            if not _validate(mat, bitmat32, cand, interpret=interpret):
                 if report is not None:
                     report.append((cand, None))
                 continue
-            rate = _measure(bitmat32, k, m, cand)
+            try:
+                rate = _measure(bitmat32, k, m, cand)
+            except Exception:  # noqa: BLE001 — e.g. interpret-mode
+                # validation on a CPU backend, where the compiled
+                # measurement kernel cannot lower: a candidate that
+                # validates but cannot be timed scores 0.0 instead of
+                # crashing the sweep out of plugin init
+                rate = 0.0
             if report is not None:
                 report.append((cand, rate))
             if rate > best_rate:

@@ -493,34 +493,98 @@ FUSED_WB = 512       # hier-crc sub-block, words (2 KiB); lane multiple
 FUSED_TILE_HIER = W32_TILE   # hier matrices are tile-size-independent
 
 
+def _hier_crc_step(bitmat_ref, cmat_sub_ref, in_ref, par_ref, wb: int,
+                   extract: str, interpret: bool):
+    """Shared per-grid-step body of the hier fused kernels: parity +
+    per-sub-block L-bits, with the crc extraction OVERLAPPED against
+    the parity MXU work instead of run as a tail.
+
+    The old kernel concatenated data and parity words before the crc
+    extraction, which made even the data shards' VPU shift/mask passes
+    data-dependent on the parity matmul — the whole crc half
+    serialized behind the MXU.  Split per shard class, the data-shard
+    extraction+matmuls depend only on the input block, so Mosaic is
+    free to interleave them with the parity matmul (VPU and MXU run
+    concurrently) and with the next block's HBM->VMEM DMA; only the
+    parity-shard crc (m of k+m rows, ~27% of the crc work at k=8,m=3)
+    still waits on the parity output.  Row order of the concatenated
+    result is unchanged (shard*S + si, data shards first)."""
+    from . import crc32c_linear as cl
+    w = in_ref[:]                                      # (k, Wt) i32
+    lsub_data = cl.subblock_crc_bits_w32_extract(
+        w, cmat_sub_ref[:], wb, extract, interpret)    # (k*S, 32)
+    par_words = _w32_parity_words(bitmat_ref[:], w, interpret)
+    par_ref[:] = par_words
+    lsub_par = cl.subblock_crc_bits_w32_extract(
+        par_words, cmat_sub_ref[:], wb, extract, interpret)  # (m*S, 32)
+    return jnp.concatenate([lsub_data, lsub_par], axis=0)
+
+
 def _make_gf_crc_kernel_w32_hier(interpret: bool, wb: int,
-                                 packed: bool = False):
+                                 extract: str = "planar"):
     def _kern(bitmat_ref, cmat_sub_ref, in_ref, par_ref, lsub_ref):
         """Fused parity + level-1 hierarchical crc at the headline
         kernel's tile: the same VMEM-resident words feed the MXU parity
         matmul and the sub-block crc matmuls (see
         crc32c_linear.subblock_crc_bits_w32 for why the flat crc matmul
-        capped the fused tile at 2 KiB).  `packed` selects the
-        4-bits-per-pass crc extraction (subblock_crc_bits_w32_packed) —
-        autotune-gated, as its strided sublane slice is generation-
-        dependent in Mosaic."""
-        from . import crc32c_linear as cl
-        w = in_ref[:]                                  # (k, Wt) i32
-        par_words = _w32_parity_words(bitmat_ref[:], w, interpret)
-        par_ref[:] = par_words
-        allw = jnp.concatenate([w, par_words], axis=0)  # (k+m, Wt)
-        if packed:
-            lsub = cl.subblock_crc_bits_w32_packed(
-                allw, cmat_sub_ref[:], wb, interpret)
-        else:
-            lsub = cl.subblock_crc_bits_w32(
-                allw, cmat_sub_ref[:], wb)              # ((k+m)*S, 32)
-        lsub_ref[:] = lsub
+        capped the fused tile at 2 KiB).  `extract` selects the crc
+        bit-extraction variant (planar / packed / wide) — non-planar
+        variants are autotune-gated, as their strided sublane slice is
+        generation-dependent in Mosaic."""
+        lsub_ref[:] = _hier_crc_step(bitmat_ref, cmat_sub_ref, in_ref,
+                                     par_ref, wb, extract, interpret)
+    return _kern
+
+
+def _make_gf_crc_kernel_w32_hier_acc(interpret: bool, wb: int,
+                                     extract: str):
+    """The VMEM-resident L accumulator kernel (the tentpole of the
+    overlapped fused path): instead of writing every grid step's
+    (r*S, 32) sub-block L-block to HBM and re-laying it out in XLA
+    (combine_crcs_pow2's transpose + log-depth folds), the kernel
+    folds each step's L-bits into a REVISITED output block that Mosaic
+    keeps resident in VMEM for the whole run:
+
+        acc[shard, si] <- A_tile . acc[shard, si]  ^  L(B_{t,si})
+
+    — one (r*S, 32) x (32, 32) int8 matmul per step against the
+    constant `tile`-byte advance matrix (crc_advance_matrix; advance
+    powers commute, so per-si streams fold independently and the
+    si-position advance is applied ONCE per run by the tiny XLA
+    combine_subblock_crcs epilogue).  Each launch therefore writes one
+    (r*S, 32) block per RUN, not per grid step, and the epilogue's
+    input no longer scales with extent length.
+
+    Run boundaries ride scalar prefetch: `run_map[t]` indexes the
+    output block (monotonic, so Mosaic flushes an accumulator block
+    exactly when its run's last step retires) and `first_map[t]` marks
+    each run's first step (accumulator init).  The grid is sequential
+    (no `parallel` dimension semantics — cross-step accumulation
+    orders the steps), which trades the reorder freedom for the HBM
+    round-trip; the autotuner's `combine` axis decides per device
+    whether that trade wins."""
+    def _kern(run_ref, first_ref, bitmat_ref, cmat_sub_ref, adv_ref,
+              in_ref, par_ref, lacc_ref):
+        t = pl.program_id(0)
+        lsub = _hier_crc_step(bitmat_ref, cmat_sub_ref, in_ref,
+                              par_ref, wb, extract, interpret)
+
+        @pl.when(first_ref[t] == 1)
+        def _init():
+            lacc_ref[:] = lsub
+
+        @pl.when(first_ref[t] == 0)
+        def _fold():
+            adv = jax.lax.dot_general(
+                lacc_ref[:].astype(jnp.int8), adv_ref[:],
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32) & 1
+            lacc_ref[:] = adv ^ lsub
     return _kern
 
 
 def _fused_hier_call(bitmat32, cmat_sub, words, m: int, tile: int,
-                     wb: int, interpret: bool, packed: bool = False):
+                     wb: int, interpret: bool, extract: str = "planar"):
     """Raw pallas_call of the hier fused kernel over a byte-axis grid
     with double-buffered input blocks (the `parallel` dimension
     semantics let Mosaic overlap each block's HBM->VMEM DMA with the
@@ -539,7 +603,7 @@ def _fused_hier_call(bitmat32, cmat_sub, words, m: int, tile: int,
     assert (r * s) % 8 == 0, (r, s)     # lsub out-block sublane align
     grid = (wtot // wt,)
     return pl.pallas_call(
-        _make_gf_crc_kernel_w32_hier(interpret, wb, packed),
+        _make_gf_crc_kernel_w32_hier(interpret, wb, extract),
         grid=grid,
         in_specs=[
             pl.BlockSpec((32 * m, 32 * k), lambda t: (0, 0)),
@@ -557,6 +621,100 @@ def _fused_hier_call(bitmat32, cmat_sub, words, m: int, tile: int,
         interpret=interpret,
         **_parallel_grid(1, interpret),
     )(bitmat32.astype(jnp.int8), cmat_sub, words)
+
+
+def _fused_hier_acc_call(bitmat32, cmat_sub, adv, run_map, first_map,
+                         words, m: int, tile: int, wb: int, nruns: int,
+                         interpret: bool, extract: str):
+    """Raw pallas_call of the accumulator hier kernel: sequential
+    byte-axis grid, per-run VMEM-resident L accumulation (see
+    _make_gf_crc_kernel_w32_hier_acc).  run_map/first_map are (ntiles,)
+    i32 scalar-prefetch arrays (run index per grid step, monotonic;
+    1 at each run's first step).  Returns (parity (m, W) i32, lacc
+    (nruns * (k+m) * S, 32) i32 — ONE accumulator block per run,
+    row-major [run, shard, sub])."""
+    k, wtot = words.shape
+    wt = tile // 4
+    assert wtot % wt == 0, (wtot, wt)
+    assert wt % wb == 0, (wt, wb)
+    s = wt // wb
+    r = k + m
+    assert (r * s) % 8 == 0, (r, s)     # lacc out-block sublane align
+    if pltpu is None:
+        raise ValueError("accumulator hier kernel unavailable: "
+                         "pallas tpu module not importable")
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(wtot // wt,),
+        in_specs=[
+            pl.BlockSpec((32 * m, 32 * k), lambda t, rm, fm: (0, 0)),
+            pl.BlockSpec((32 * wb, 32), lambda t, rm, fm: (0, 0)),
+            pl.BlockSpec((32, 32), lambda t, rm, fm: (0, 0)),
+            pl.BlockSpec((k, wt), lambda t, rm, fm: (0, t)),
+        ],
+        out_specs=[
+            pl.BlockSpec((m, wt), lambda t, rm, fm: (0, t)),
+            pl.BlockSpec((r * s, 32), lambda t, rm, fm: (rm[t], 0)),
+        ],
+    )
+    return pl.pallas_call(
+        _make_gf_crc_kernel_w32_hier_acc(interpret, wb, extract),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((m, wtot), jnp.int32),
+            jax.ShapeDtypeStruct((nruns * r * s, 32), jnp.int32),
+        ],
+        interpret=interpret,
+    )(run_map, first_map, bitmat32.astype(jnp.int8), cmat_sub, adv,
+      words)
+
+
+def _acc_launch_args(ntiles_run, tile: int, wb: int):
+    """Scalar-prefetch maps + fold matrices for one accumulator
+    launch: run_map (run index per grid step, monotonic), first_map
+    (1 at each run's first step), the per-step tile advance matrix and
+    the per-run si-position combine matrix.  Single source of truth
+    for the single-extent fold entry and the extents path — the two
+    must never diverge on the accumulator contract."""
+    from . import crc32c_linear as cl
+    run_map = np.repeat(np.arange(len(ntiles_run), dtype=np.int32),
+                        ntiles_run)
+    first_map = np.zeros(len(run_map), dtype=np.int32)
+    first_map[np.cumsum([0] + list(ntiles_run)[:-1])] = 1
+    adv = jnp.asarray(cl.crc_advance_matrix(tile), dtype=jnp.int8)
+    comb = jnp.asarray(
+        cl.crc_combine_matrix((tile // 4) // wb, 4 * wb),
+        dtype=jnp.int8)
+    return jnp.asarray(run_map), jnp.asarray(first_map), adv, comb
+
+
+def _hier_acc_core(bitmat32, cmat_sub, adv, combine, run_map, first_map,
+                   words, m: int, tile: int, wb: int, nruns: int,
+                   interpret: bool, extract: str):
+    """Accumulator launch + the per-run si-position fold: returns
+    (parity (m, W) i32, L-bits (nruns, k+m, 32) i32 — one combined L
+    per shard per run, covering every byte of the run including the
+    sub-block tail).  The epilogue is ONE tiny combine_subblock_crcs
+    matmul over (nruns * (k+m) * S, 32) — independent of extent
+    length, vs the old per-step lsub round-trip + log-depth
+    combine_crcs_pow2 chain."""
+    from . import crc32c_linear as cl
+    k = words.shape[0]
+    s = (tile // 4) // wb
+    parity, lacc = _fused_hier_acc_call(
+        bitmat32, cmat_sub, adv, run_map, first_map, words, m, tile,
+        wb, nruns, interpret, extract)
+    return parity, cl.combine_subblock_crcs(lacc, combine, k + m, s)
+
+
+_hier_acc = functools.partial(jax.jit, static_argnames=(
+    "m", "tile", "wb", "nruns", "interpret", "extract"))(_hier_acc_core)
+
+# donated twin (see _fused_hier_lsub_donate): the staged drain words
+# are single-use, so real accelerators may reuse their HBM for parity
+_hier_acc_donate = functools.partial(jax.jit, static_argnames=(
+    "m", "tile", "wb", "nruns", "interpret", "extract"),
+    donate_argnums=(6,))(_hier_acc_core)
 
 
 @functools.partial(jax.jit, static_argnames=("m", "tile", "wb",
@@ -589,26 +747,46 @@ def gf_encode_with_crc_pallas_w32_hier(bitmat32, cmat_sub, combine,
 
 
 @functools.partial(jax.jit, static_argnames=("m", "tile", "wb",
-                                             "interpret", "packed"))
+                                             "interpret", "extract",
+                                             "combine"))
 def gf_encode_with_crc_w32_fold(bitmat32, cmat_sub, words, m: int,
                                 tile: int = FUSED_TILE_HIER,
                                 wb: int = FUSED_WB,
                                 interpret: bool = False,
-                                packed: bool = False):
+                                extract: str = "planar",
+                                combine: str = "xla"):
     """The device-side-combine fused launch: parity AND one 32-bit
     crc32c L-vector per shard from a single dispatch.
 
     words (k, W) i32, W bytes a `tile` multiple; cmat_sub from
     crc_tile_matrix_w32(wb).  Returns (parity (m, W) i32, L-bits
-    (k+m, 32) i32).  The kernel streams byte-axis blocks (double-
-    buffered DMA, see _fused_hier_call) emitting per-sub-block
-    L-vectors; the across-extent log-depth combine
-    (crc32c_linear.combine_crcs_pow2) runs inside this same jit, so
-    the host sees ONE L per shard and pays a single seed-advance per
-    extent (fold_run_crc) instead of the old O(ntiles) Python loop."""
+    (k+m, 32) i32).  `extract` picks the crc bit-extraction variant
+    (planar/packed/wide) and `combine` the combine depth — both
+    autotuner axes:
+
+      * combine="kernel": the accumulator kernel folds per-tile Ls in
+        VMEM across grid steps (A_tile advance matmul per step, see
+        _make_gf_crc_kernel_w32_hier_acc); the only epilogue is the
+        tiny si-position fold.
+      * combine="xla": the legacy shape — the kernel streams per-step
+        (r*S, 32) L-blocks to HBM (parallel grid semantics) and the
+        log-depth combine_crcs_pow2 runs as XLA inside this jit.
+
+    Either way the host sees ONE L per shard and pays a single
+    seed-advance per extent (fold_run_crc), never a per-tile loop."""
     from . import crc32c_linear as cl
+    if combine == "kernel":
+        wtot = words.shape[1]
+        run_map, first_map, adv, comb = _acc_launch_args(
+            [wtot // (tile // 4)], tile, wb)
+        parity, lb = _hier_acc_core(
+            bitmat32, cmat_sub, adv, comb, run_map, first_map, words,
+            m, tile, wb, 1, interpret, extract)
+        return parity, lb[0]
+    if combine != "xla":
+        raise ValueError(f"unknown combine depth {combine!r}")
     parity, lb = _hier_lsub_core(bitmat32, cmat_sub, words, m,
-                                 tile, wb, interpret, packed)
+                                 tile, wb, interpret, extract)
     # fold the whole extent's sub-block Ls in log2(nsub) matmuls
     return parity, cl.combine_crcs_pow2(lb, 4 * wb)
 
@@ -645,7 +823,7 @@ def gf_encode_with_crc_xla(bitmat, cmat, chunks, m: int,
 
 
 def _hier_lsub_core(bitmat32, cmat_sub, words, m: int, tile: int,
-                    wb: int, interpret: bool, packed: bool):
+                    wb: int, interpret: bool, extract: str):
     """Hier launch + re-layout: (parity, per-sub-block L-bits reordered
     [tile, shard, sub] -> (k+m, total_sub_blocks, 32) stream order).
     Shared by the single-extent fold entry and the extents path."""
@@ -655,21 +833,21 @@ def _hier_lsub_core(bitmat32, cmat_sub, words, m: int, tile: int,
     r = k + m
     nt = wtot // wt
     parity, lsub = _fused_hier_call(bitmat32, cmat_sub, words, m,
-                                    tile, wb, interpret, packed)
+                                    tile, wb, interpret, extract)
     lb = lsub.reshape(nt, r, s, 32).transpose(1, 0, 2, 3) \
         .reshape(r, nt * s, 32)
     return parity, lb
 
 
 _fused_hier_lsub = functools.partial(jax.jit, static_argnames=(
-    "m", "tile", "wb", "interpret", "packed"))(_hier_lsub_core)
+    "m", "tile", "wb", "interpret", "extract"))(_hier_lsub_core)
 
 # donated twin for the dispatch-ahead pipeline: the staged device input
 # words are single-use (one drain's concatenated runs), so XLA may
 # reuse their HBM for the parity output instead of allocating fresh —
 # only selected on real accelerators (CPU ignores donation and warns)
 _fused_hier_lsub_donate = functools.partial(jax.jit, static_argnames=(
-    "m", "tile", "wb", "interpret", "packed"),
+    "m", "tile", "wb", "interpret", "extract"),
     donate_argnums=(2,))(_hier_lsub_core)
 
 
@@ -679,7 +857,8 @@ def gf_encode_extents_with_crc(bitmat, bitmat32, runs, m: int,
                                interpret: bool = False,
                                tile: int | None = None,
                                wb: int | None = None,
-                               packed: bool = False):
+                               extract: str = "planar",
+                               combine: str = "xla"):
     """Multi-extent fused launch: parity + ONE device-combined crc
     L-vector per shard per run, for a whole pipeline drain in one
     kernel call (lifting the round-1 restriction that only a single-op
@@ -695,20 +874,23 @@ def gf_encode_extents_with_crc(bitmat, bitmat32, runs, m: int,
     padding is benign for parity (linear code) and the padded block's
     L-row is simply unused.
 
-    `tile`/`wb`/`packed` override the hier kernel's operating point
-    (fed by ops/autotune via the plugin); defaults keep the static
-    FUSED_TILE_HIER/FUSED_WB constants.
+    `tile`/`wb`/`extract`/`combine` override the hier kernel's
+    operating point (fed by ops/autotune via the plugin); defaults
+    keep the static FUSED_TILE_HIER/FUSED_WB constants with the
+    planar/xla variants.
 
     Returns a list of (parity (m, Wi) uint8, l (k+m,) uint32 over the
     run's body, tail_bytes (k+m, tail_len) uint8, body_bytes) per run —
     fold with crc32c_linear.fold_run_crc seeded per shard: O(1) host
-    combines per extent, no per-tile Python loop.
+    combines per extent, no per-tile Python loop.  On the accumulator
+    path (combine="kernel") the kernel's L covers the run's every byte,
+    so tail_bytes is empty and body_bytes == Wi.
     """
     return gf_encode_extents_with_crc_finalize(
         gf_encode_extents_with_crc_submit(
             bitmat, bitmat32, runs, m, use_w32=use_w32,
             force_xla=force_xla, interpret=interpret, tile=tile,
-            wb=wb, packed=packed))
+            wb=wb, extract=extract, combine=combine))
 
 
 def gf_encode_extents_with_crc_submit(bitmat, bitmat32, runs, m: int,
@@ -717,16 +899,25 @@ def gf_encode_extents_with_crc_submit(bitmat, bitmat32, runs, m: int,
                                       interpret: bool = False,
                                       tile: int | None = None,
                                       wb: int | None = None,
-                                      packed: bool = False,
+                                      extract: str = "planar",
+                                      combine: str = "xla",
                                       donate: bool | None = None):
     """Dispatch half of gf_encode_extents_with_crc: stages the drain's
-    runs, launches parity + per-block L + the per-run device combines,
-    and returns an opaque handle holding ONLY device arrays (futures)
-    plus host metadata — no np.asarray anywhere, so the caller never
-    blocks on the device.  `donate=True` (resolved to the backend: real
+    runs, launches parity + the per-run device L folds, and returns an
+    opaque handle holding ONLY device arrays (futures) plus host
+    metadata — no np.asarray anywhere, so the caller never blocks on
+    the device.  `donate=True` (resolved to the backend: real
     accelerators only) hands the staged input words' HBM to XLA for
-    reuse.  Pair with gf_encode_extents_with_crc_finalize."""
+    reuse.  The handle records the kernel `path` that served the drain
+    ("hier_acc" / "hier_lsub" / "w32_flat" / "bytes" / "xla") so bench
+    and the backend can attribute a perf move to kernel vs dispatch
+    changes.  Pair with gf_encode_extents_with_crc_finalize."""
     from . import crc32c_linear as cl
+    if combine not in ("xla", "kernel"):
+        # reject up front like the words-path twin — a malformed cache
+        # entry must not silently demote to the legacy lsub path while
+        # the backend still counts the drain as kernel-served
+        raise ValueError(f"unknown combine depth {combine!r}")
     if force_xla is None:
         force_xla = jax.default_backend() == "cpu"
     if use_w32 is None:
@@ -747,23 +938,37 @@ def gf_encode_extents_with_crc_submit(bitmat, bitmat32, runs, m: int,
             min(r.shape[1] for r in runs) >= tile_hier:
         tile = tile_hier
         hier = True
+    acc = hier and combine == "kernel"
     meta = []           # width per run
+    pads = []           # front pad per run (accumulator path only)
     padded = []
     for r in runs:
         w = r.shape[1]
         pad = -w % tile
         meta.append(w)
-        padded.append(np.pad(r, ((0, 0), (0, pad))) if pad else r)
+        # accumulator path: pad each run at the FRONT — a zero prefix
+        # is free for the crc (L(0^n || B) = L(B)), so the in-kernel
+        # per-run accumulator covers the run's every byte (no host
+        # tail fold at all); the legacy paths keep the back pad and
+        # drop the padded tail blocks' L rows on the host instead
+        pads.append(pad if acc else 0)
+        if pad:
+            padded.append(np.pad(r, ((0, 0), (pad, 0)) if acc
+                          else ((0, 0), (0, pad))))
+        else:
+            padded.append(r)
     big = np.concatenate(padded, axis=1)               # (k, ntiles*tile)
     ntiles_total = big.shape[1] // tile
     rows = _crc_rows(r_tot)
     w32_out = False
+    lbits_devs = None
     if force_xla:
         cmat = jnp.asarray(cl.crc_tile_matrix(tile))
         parity_dev, crc_bits = gf_encode_with_crc_xla(
             bitmat, cmat, jnp.asarray(big), m)
         lb_all = jnp.transpose(crc_bits, (1, 0, 2))    # (r, ntiles, 32)
         block_bytes = tile
+        path = "xla"
     elif not use_w32:
         # byte-path Pallas kernel (TPU without the w32 layout): per-tile
         # L rows, device-combined per run below like the flat w32 path
@@ -774,15 +979,34 @@ def gf_encode_extents_with_crc_submit(bitmat, bitmat32, runs, m: int,
             crc_flat.reshape(ntiles_total, rows, 32)[:, :r_tot],
             (1, 0, 2))                                 # (r, ntiles, 32)
         block_bytes = tile
+        path = "bytes"
+    elif acc:
+        # the overlapped accumulator kernel: one L block per RUN from
+        # the launch itself — no per-step lsub round-trip, no per-run
+        # combine dispatches, no sub-block host tail
+        cmat_sub = jnp.asarray(cl.crc_tile_matrix_w32(wb))
+        words = big.view("<u4").view(np.int32)
+        run_map, first_map, adv, comb = _acc_launch_args(
+            [p.shape[1] // tile for p in padded], tile, wb)
+        acc_fn = _hier_acc_donate if donate else _hier_acc
+        parity_dev, lb = acc_fn(
+            bitmat32, cmat_sub, adv, comb, run_map, first_map,
+            jnp.asarray(words), m, tile, wb,
+            len(runs), interpret, extract)             # (nruns, r, 32)
+        lbits_devs = [lb[i] for i in range(len(runs))]
+        block_bytes = 4 * wb
+        w32_out = True
+        path = "hier_acc"
     elif hier:
         cmat_sub = jnp.asarray(cl.crc_tile_matrix_w32(wb))
         words = big.view("<u4").view(np.int32)
         hier_fn = _fused_hier_lsub_donate if donate else _fused_hier_lsub
         parity_dev, lb_all = hier_fn(
             bitmat32, cmat_sub, jnp.asarray(words), m, tile, wb,
-            interpret, packed)                         # (r, nsub, 32)
+            interpret, extract)                        # (r, nsub, 32)
         block_bytes = 4 * wb
         w32_out = True
+        path = "hier_lsub"
     else:
         wt = tile // 4
         cmat32 = jnp.asarray(cl.crc_tile_matrix_w32(wt))
@@ -794,58 +1018,71 @@ def gf_encode_extents_with_crc_submit(bitmat, bitmat32, runs, m: int,
             (1, 0, 2))                                 # (r, ntiles, 32)
         block_bytes = tile
         w32_out = True
-    # per-run device combines dispatched NOW (still no host sync): each
-    # run's full blocks fold to one L per shard on device
-    lbits_devs = []
-    coff = 0
-    for w, pr in zip(meta, padded):
-        nb = w // block_bytes
-        if nb:
-            boff = coff // block_bytes
-            lb_run = lb_all[:, boff:boff + nb]
-            # zero-PREFIX pad to the next power of two before the
-            # jitted combine: L(0^n || B) = L(B), so the pad is free,
-            # and it collapses the jit-cache key space from "every
-            # distinct extent length" to ~log2 shapes (a drain of
-            # varied object sizes must not recompile per length)
-            nb2 = 1 << (nb - 1).bit_length()
-            if nb2 != nb:
-                lb_run = jnp.pad(lb_run, ((0, 0), (nb2 - nb, 0),
-                                          (0, 0)))
-            lbits_devs.append(_combine_run(lb_run, block_bytes))
-        else:
-            lbits_devs.append(None)
-        coff += pr.shape[1]
-    return {"meta": meta, "padded": padded, "parity_dev": parity_dev,
-            "lbits_devs": lbits_devs, "block_bytes": block_bytes,
-            "r_tot": r_tot, "m": m, "w32_out": w32_out,
-            "big_width": big.shape[1]}
+        path = "w32_flat"
+    if lbits_devs is None:
+        # per-run device combines dispatched NOW (still no host sync):
+        # each run's full blocks fold to one L per shard on device
+        lbits_devs = []
+        coff = 0
+        for w, pr in zip(meta, padded):
+            nb = w // block_bytes
+            if nb:
+                boff = coff // block_bytes
+                lb_run = lb_all[:, boff:boff + nb]
+                # zero-PREFIX pad to the next power of two before the
+                # jitted combine: L(0^n || B) = L(B), so the pad is
+                # free, and it collapses the jit-cache key space from
+                # "every distinct extent length" to ~log2 shapes (a
+                # drain of varied object sizes must not recompile per
+                # length)
+                nb2 = 1 << (nb - 1).bit_length()
+                if nb2 != nb:
+                    lb_run = jnp.pad(lb_run, ((0, 0), (nb2 - nb, 0),
+                                              (0, 0)))
+                lbits_devs.append(_combine_run(lb_run, block_bytes))
+            else:
+                lbits_devs.append(None)
+            coff += pr.shape[1]
+    return {"meta": meta, "padded": padded, "pads": pads,
+            "parity_dev": parity_dev, "lbits_devs": lbits_devs,
+            "block_bytes": block_bytes, "r_tot": r_tot, "m": m,
+            "w32_out": w32_out, "big_width": big.shape[1],
+            "path": path, "acc": acc}
 
 
 def gf_encode_extents_with_crc_finalize(handle):
     """Completion half: blocks on the device results of one submit
     handle and materializes the per-run
     (parity, l, tail_bytes, body_bytes) tuples (the contract of
-    gf_encode_extents_with_crc)."""
+    gf_encode_extents_with_crc).  Accumulator-path handles
+    (path "hier_acc") carry per-run Ls covering EVERY run byte, so
+    body == run width and tail_bytes is empty — the host pays one
+    seed-advance per extent and never touches a byte."""
     from . import crc32c_linear as cl
     meta, padded = handle["meta"], handle["padded"]
+    pads = handle.get("pads") or [0] * len(meta)
     r_tot = handle["r_tot"]
     block_bytes = handle["block_bytes"]
+    acc = handle.get("acc", False)
     parity_big = np.asarray(handle["parity_dev"])
     if handle["w32_out"]:
         parity_big = parity_big.view("<u4").view(np.uint8) \
             .reshape(handle["m"], handle["big_width"])
     out = []
     coff = 0
-    for w, pr, lbits in zip(meta, padded, handle["lbits_devs"]):
-        par = parity_big[:, coff:coff + w]
-        nb = w // block_bytes                 # full blocks = run body
-        body = nb * block_bytes
+    for w, pr, pad, lbits in zip(meta, padded, pads,
+                                 handle["lbits_devs"]):
+        par = parity_big[:, coff + pad:coff + pad + w]
+        if acc:
+            body = w                     # kernel L covers the full run
+        else:
+            nb = w // block_bytes        # full blocks = run body
+            body = nb * block_bytes
         if lbits is not None:
             l = cl.bits_to_u32(np.asarray(lbits))      # (k+m,) u32
         else:
             l = np.zeros(r_tot, dtype=np.uint32)
-        tail_data = pr[:, body:w]
+        tail_data = pr[:, pad + body:pad + w]
         tail_par = par[:, body:w]
         tail_bytes = np.concatenate([tail_data, tail_par], axis=0) \
             if w > body else np.zeros((r_tot, 0), dtype=np.uint8)

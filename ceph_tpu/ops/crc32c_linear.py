@@ -88,6 +88,22 @@ def tile_crc_bits_w32(words, cmat32):
     return acc.astype(jnp.int32) & 1
 
 
+@functools.lru_cache(maxsize=16)
+def crc_advance_matrix(nbytes: int) -> np.ndarray:
+    """(32, 32) int8: row j = bits of A_{nbytes} e_j, so advancing an
+    L-vector over `nbytes` zero bytes is `lbits @ this` (mod 2) — the
+    per-grid-step fold matrix of the in-kernel L accumulator
+    (bitsliced._make_gf_crc_kernel_w32_hier_acc): a (rows, 32) x
+    (32, 32) int8 matmul whose sublane layout never changes, so it
+    lowers in Mosaic where the pairwise combine's sublane-to-lane
+    relayout does not."""
+    out = np.zeros((32, 32), dtype=np.int8)
+    for j in range(32):
+        v = _crc.crc32c_zeros(1 << j, nbytes)
+        out[j] = [(v >> b) & 1 for b in range(32)]
+    return out
+
+
 @functools.lru_cache(maxsize=8)
 def crc_combine_matrix(s: int, block_bytes: int) -> np.ndarray:
     """(s*32, 32) int8 level-2 matrix: row [si*32 + j] = bits of
@@ -313,6 +329,62 @@ def subblock_crc_bits_w32_packed(words, cmat_sub, wb: int,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.int32)
     return acc & 1
+
+
+def subblock_crc_bits_w32_wide(words, cmat_sub, wb: int,
+                               interpret: bool = False):
+    """Widest extraction variant: the mask drops out entirely — 8
+    shift-only passes (pass 0 is the raw words), half the packed
+    variant's VPU work and a quarter of planar's.
+
+    Why no mask is needed: the matmul already reduces mod 2 (`& 1`
+    after int32 accumulation), and every non-LSB bit of an operand
+    byte contributes an EVEN multiple (bit p of a byte weighs 2^p in
+    the int8 product), so it self-cancels.  Byte b of `(w >> i)` holds
+    word-bit 8b+i in its LSB plus junk above it; matching it against
+    cmat_sub's rows for bit 8b+i therefore yields exactly that
+    bit-plane's contribution mod 2.  Signed int8 wrap (bytes >= 0x80
+    read as v-256) is a multiple of 256 — also even — and the int32
+    accumulator cannot overflow (|sum| <= 128 * 4wb * 8 passes << 2^31).
+
+    Same matmul shapes and strided sublane slice as the packed
+    variant, so it carries the same Mosaic-generation risk and ships
+    only through the autotuner's bit-exactness gate."""
+    import jax
+    import jax.numpy as jnp
+    from .bitsliced import _words_to_bytes
+    r, wt = words.shape
+    s = wt // wb
+    w2 = words.reshape(r * s, wb)
+    acc = jnp.zeros((r * s, 32), dtype=jnp.int32)
+    for i in range(8):
+        plane = _words_to_bytes(w2 >> i if i else w2, interpret)  # (4rS, wb)
+        cat = jnp.concatenate(
+            [plane[b::4] for b in range(4)], axis=1)              # (rS, 4wb)
+        ccat = jnp.concatenate(
+            [cmat_sub[(8 * b + i) * wb:(8 * b + i + 1) * wb]
+             for b in range(4)], axis=0)                          # (4wb, 32)
+        acc = acc + jax.lax.dot_general(
+            cat, ccat,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+    return acc & 1
+
+
+def subblock_crc_bits_w32_extract(words, cmat_sub, wb: int, extract: str,
+                                  interpret: bool = False):
+    """Single dispatch point for the level-1 crc extraction variants
+    (the autotuner's `extract` axis): "planar" (32 single-bit passes,
+    lowers everywhere), "packed" (4 bits per masked pass), "wide"
+    (mask-free, mod-2 junk cancellation).  Looked up at call time so
+    tests can substitute a deliberately-miscompiling variant."""
+    if extract == "packed":
+        return subblock_crc_bits_w32_packed(words, cmat_sub, wb, interpret)
+    if extract == "wide":
+        return subblock_crc_bits_w32_wide(words, cmat_sub, wb, interpret)
+    if extract != "planar":
+        raise ValueError(f"unknown crc extraction variant {extract!r}")
+    return subblock_crc_bits_w32(words, cmat_sub, wb)
 
 
 def combine_subblock_crcs(lsub, combine, r: int, s: int):

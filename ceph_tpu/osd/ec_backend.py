@@ -241,6 +241,10 @@ def _build_ec_perf(name: str):
                           "device materialize (block) time per drain")
             .add_time_avg("ec_drain_commit",
                           "sub-write issue time per drain")
+            .add_u64_counter("ec_fused_kernel_drains",
+                             "fused drains served by the hier kernels")
+            .add_u64_counter("ec_fused_fallback_drains",
+                             "fused drains served by a fallback path")
             .add_u64_counter("ec_scrub_device_bytes",
                              "deep-scrub bytes crc'd on device")
             .add_u64_counter("ec_scrub_host_bytes",
@@ -312,6 +316,9 @@ class ECBackend:
         self.completed: int = 0
         self.batched_launches: int = 0
         self.batched_extents: int = 0
+        # kernel path of the last fused drain ("hier_acc"/"hier_lsub"/
+        # "w32_flat"/"bytes"/"xla"; None before the first fused drain)
+        self.fused_path: str | None = None
         self._hold = 0
         # dispatch-ahead pipeline (docs/PIPELINE.md): submitted drains
         # whose device work is in flight, completion in submit order
@@ -812,6 +819,20 @@ class ECBackend:
                 drain.fused_handle = \
                     self.ec_impl.encode_extents_with_crc_submit(
                         [runs[i] for i in fused_idx])
+                # kernel-path provenance (ISSUE 11): which fused
+                # kernel served this drain — hier_acc/hier_lsub are
+                # the overlapped Pallas family, anything else is a
+                # fallback; surfaced as perf counters + fused_path so
+                # a silent fallback at plugin init is attributable
+                # from `perf dump`, not just a slower bench row
+                path = drain.fused_handle.get("path") \
+                    if isinstance(drain.fused_handle, dict) else None
+                self.fused_path = path
+                if self.perf:
+                    self.perf.inc(
+                        "ec_fused_kernel_drains"
+                        if path and path.startswith("hier")
+                        else "ec_fused_fallback_drains")
             if plain_idx:
                 col = 0
                 for i in plain_idx:

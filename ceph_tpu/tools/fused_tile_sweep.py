@@ -8,33 +8,107 @@ explicitly, prints the per-candidate table, and refreshes the cache —
 use it to inspect WHY the plugin picked its point, or to re-tune after
 a runtime/hardware change.
 
-Usage: python -m ceph_tpu.tools.fused_tile_sweep [--keep-cache] [tiles...]
+Usage: python -m ceph_tpu.tools.fused_tile_sweep
+           [--keep-cache | --validate-only] [tiles...]
 
 By default the sweep is forced (the cache entry is refreshed); pass
 --keep-cache to only print the cached point without re-measuring.
-Candidates that fail the bit-exactness validation (e.g. the packed
-extraction on a Mosaic generation without strided sublane slices)
-print as INVALID.
-"""
-import sys
+Candidates that fail the bit-exactness validation (e.g. the packed or
+wide extraction on a Mosaic generation without strided sublane slices,
+or the accumulator kernel's scalar-prefetch grid) print as INVALID.
 
-import numpy as np
+--validate-only runs ONLY the bit-exactness gate over every kernel
+variant (no measurement, no cache writes), through the Pallas
+interpreter when the backend is CPU — the tier-1 hook
+(scripts/tier1.sh): a structural regression in any shipped variant
+fails the gate instead of silently falling back at plugin init.
+Exits nonzero on any invalid candidate.  Defaults to one small tile
+(the variant grid is what matters); pass tiles to widen.  Budget-
+capped by CEPH_TPU_AUTOTUNE_BUDGET_S like the init sweep.
+"""
+import os
+import sys
+import time
 
 from ..ec.registry import ErasureCodePluginRegistry
 from ..ops import autotune
 
 K, M = 8, 3
+VALIDATE_TILES = (32768,)
+
+
+def _cand_tag(cand: dict) -> str:
+    return (f"tile={cand['tile']:6d} wb={cand['wb']:5d} "
+            f"extract={cand['extract']:6s} combine={cand['combine']:6s}")
+
+
+def validate_only(codec, tiles) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import bitsliced as bs
+    interpret = jax.default_backend() == "cpu"
+    # the CPU plugin skips the w32 matrix build (no w32 kernel runs in
+    # production there) — the interpret gate needs it regardless
+    bitmat32 = codec._enc_bitmat32
+    if bitmat32 is None:
+        bitmat32 = jnp.asarray(bs._w32_bitmat(codec.matrix[K:]),
+                               dtype=jnp.int8)
+    budget = float(os.environ.get("CEPH_TPU_AUTOTUNE_BUDGET_S", "75"))
+    mode = "interpret" if interpret else "compiled"
+    print(f"# validate-only ({mode}, budget {budget:.0f}s): every "
+          f"kernel variant must stay bit-exact vs gf_matvec + host "
+          f"crc32c")
+    t0 = time.perf_counter()
+    bad, checked, skipped = [], 0, 0
+    # variant-diverse order: one candidate of EVERY (extract, combine)
+    # kernel variant before any repeats at other (tile, wb) shapes —
+    # a budget-capped run on a loaded box must still have checked each
+    # variant once (the autotuner's best-guess order would leave the
+    # accumulator variants, the likeliest to regress, for last)
+    cands = autotune.candidates(K, M, tiles=tiles or VALIDATE_TILES)
+    seen_variants: dict = {}
+    for c in cands:
+        seen_variants.setdefault((c["extract"], c["combine"]),
+                                 []).append(c)
+    rounds = max(len(v) for v in seen_variants.values())
+    ordered = [v[i] for i in range(rounds)
+               for v in seen_variants.values() if i < len(v)]
+    # round 0 (the first candidate of every variant class) is exempt
+    # from the budget: the gate's guarantee is that NO shipped kernel
+    # variant goes unvalidated, so budget pressure may only drop
+    # repeats at other (tile, wb) shapes, never a whole variant class
+    for i, cand in enumerate(ordered):
+        if i >= len(seen_variants) and \
+                time.perf_counter() - t0 > budget:
+            skipped += 1
+            continue
+        checked += 1
+        ok = autotune._validate(codec.matrix[K:], bitmat32,
+                                cand, interpret=interpret)
+        print(f"{_cand_tag(cand)}  "
+              f"{'ok' if ok else 'INVALID (failed bit-exactness)'}")
+        if not ok:
+            bad.append(cand)
+    if skipped:
+        print(f"# budget exhausted: {skipped} candidate(s) unchecked")
+    if bad:
+        print(f"# {len(bad)}/{checked} variants INVALID")
+        return 1
+    print(f"# all {checked} checked variants bit-exact")
+    return 0
 
 
 def main():
-    known = {"--keep-cache"}
+    known = {"--keep-cache", "--validate-only"}
     unknown = [a for a in sys.argv[1:]
                if a.startswith("-") and a not in known]
     if unknown:
         print(f"unknown option(s): {' '.join(unknown)} — this tool now "
               "drives ops/autotune (the old --flat mode is gone; the "
-              "flat 2 KiB kernel is not a tuning candidate).  "
-              "Usage: fused_tile_sweep [--keep-cache] [tiles...]")
+              "flat 2 KiB kernel is not a tuning candidate).  Usage: "
+              "fused_tile_sweep [--keep-cache | --validate-only] "
+              "[tiles...]")
         raise SystemExit(2)
     tiles = [int(t) for t in sys.argv[1:]
              if not t.startswith("-")] or None
@@ -42,9 +116,13 @@ def main():
     codec = reg.factory("jax", {"k": str(K), "m": str(M),
                                 "technique": "cauchy"})
     import jax
+    if "--validate-only" in sys.argv:
+        raise SystemExit(validate_only(codec, tiles))
     if jax.default_backend() == "cpu":
         print("backend is cpu: the fused w32 kernel is TPU-only; "
-              f"static default point = {autotune.default_point()}")
+              f"static default point = {autotune.default_point()} "
+              "(use --validate-only for the interpret-mode "
+              "bit-exactness gate)")
         return
     if "--keep-cache" in sys.argv:
         print(f"cached/current point: {codec.fused_point()}")
@@ -55,12 +133,11 @@ def main():
         K, M, mat=codec.matrix[K:], bitmat32=codec._enc_bitmat32,
         tiles=tiles, force=True, report=report)
     for cand, rate in report:
-        tag = (f"tile={cand['tile']:6d} wb={cand['wb']:5d} "
-               f"packed={int(cand['packed'])}")
         if rate is None:
-            print(f"{tag}  INVALID (failed compile or bit-exactness)")
+            print(f"{_cand_tag(cand)}  INVALID (failed compile or "
+                  f"bit-exactness)")
         else:
-            print(f"{tag}  {rate / 1e9:7.2f} GB/s")
+            print(f"{_cand_tag(cand)}  {rate / 1e9:7.2f} GB/s")
     print(f"best: {best}")
     print(f"cache file: {autotune._cache_path()}")
 

@@ -28,4 +28,15 @@ fi
 if [ "$rc" -eq 0 ]; then
   timeout -k 10 180 env JAX_PLATFORMS=cpu python bench.py --multichip || rc=$?
 fi
+# Fused-kernel variant gate (ISSUE 11, docs/FUSED_CRC.md): every
+# shipped (extract, combine) variant of the fused parity+crc kernel —
+# planar/packed/wide extraction through the XLA log-fold AND the
+# in-kernel VMEM accumulator — must stay bit-exact vs gf_matvec + host
+# crc32c on the Pallas interpret path (no measurement, budget-capped).
+# A structural kernel regression fails tier-1 here instead of silently
+# falling back at plugin init on the next TPU round.
+if [ "$rc" -eq 0 ]; then
+  timeout -k 10 240 env JAX_PLATFORMS=cpu CEPH_TPU_AUTOTUNE_BUDGET_S=120 \
+    python -m ceph_tpu.tools.fused_tile_sweep --validate-only || rc=$?
+fi
 exit $rc

@@ -1,0 +1,156 @@
+"""Autotuner gate tests: the fused kernel's operating-point sweep must
+never ship (or cache) a variant that fails bit-exactness, and a cold
+(k, m) key must seed its candidate ordering from the nearest cached
+device winner instead of the static best-guess order."""
+
+import json
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import gf
+from ceph_tpu.ops import autotune
+from ceph_tpu.ops import bitsliced as bs
+from ceph_tpu.ops import crc32c_linear as cl
+
+K, M = 4, 2
+
+
+def _mats():
+    import jax.numpy as jnp
+    mat = gf.cauchy_rs_matrix(K, M)[K:]
+    return mat, jnp.asarray(bs._w32_bitmat(mat), dtype=jnp.int8)
+
+
+def test_validate_rejects_miscompiling_candidate(monkeypatch):
+    """A deliberately-miscompiling extraction variant (returns a
+    wrong-but-well-shaped L matrix, the signature of a bad Mosaic
+    lowering) must be marked INVALID by the gate while its planar
+    sibling still passes."""
+    mat, bitmat32 = _mats()
+
+    def _zeros(words, cmat_sub, wb, interpret=False):
+        import jax.numpy as jnp
+        r, wt = words.shape
+        return jnp.zeros((r * (wt // wb), 32), dtype=jnp.int32)
+
+    monkeypatch.setattr(cl, "subblock_crc_bits_w32_wide", _zeros)
+    # fresh (tile, wb) so no earlier good compile is cached for these
+    # static args (the jit cache would otherwise mask the corruption)
+    bad = {"tile": 1024, "wb": 64, "extract": "wide", "combine": "xla"}
+    good = {"tile": 1024, "wb": 64, "extract": "planar",
+            "combine": "xla"}
+    assert not autotune._validate(mat, bitmat32, bad, interpret=True)
+    assert autotune._validate(mat, bitmat32, good, interpret=True)
+
+
+def test_invalid_candidate_never_cached(monkeypatch, tmp_path):
+    """The full sweep flow with a corrupted variant that MEASURES
+    fastest: it must be rejected at validation (reported as INVALID),
+    never win, and never appear in the persisted cache."""
+    cache_file = tmp_path / "autotune.json"
+    monkeypatch.setenv("CEPH_TPU_AUTOTUNE_CACHE", str(cache_file))
+    monkeypatch.setenv("CEPH_TPU_AUTOTUNE_BUDGET_S", "600")
+
+    def _garbage(words, cmat_sub, wb, interpret=False):
+        import jax.numpy as jnp
+        r, wt = words.shape
+        return jnp.ones((r * (wt // wb), 32), dtype=jnp.int32)
+
+    monkeypatch.setattr(cl, "subblock_crc_bits_w32_packed", _garbage)
+    # the corrupted variant "benchmarks" 10x faster than anything else:
+    # only the validation gate stands between it and the cache
+    monkeypatch.setattr(
+        autotune, "_measure",
+        lambda bitmat32, k, m, cand:
+            50e9 if cand["extract"] == "packed" else 5e9)
+    mat, bitmat32 = _mats()
+    report = []
+    # (tile, wb) unique across the suite: the jit cache is keyed on
+    # static args, so a good compile of the same shape from another
+    # test would mask the monkeypatched corruption
+    best = autotune.fused_operating_point(
+        K, M, mat=mat, bitmat32=bitmat32, tiles=(8192,), wbs=(256,),
+        force=True, report=report, interpret=True)
+    assert best["extract"] != "packed"
+    packed_rows = [r for c, r in report if c["extract"] == "packed"]
+    assert packed_rows and all(r is None for r in packed_rows)
+    data = json.loads(cache_file.read_text())
+    assert data["version"] == 2
+    assert data["entries"]
+    for ent in data["entries"].values():
+        assert ent["extract"] != "packed"
+        assert ent["gbps"] > 0          # a measured winner, not the
+        #                                 failure sentinel
+
+
+def test_cold_key_seeds_from_nearest_device_winner(monkeypatch,
+                                                   tmp_path):
+    """Satellite: a cold (k, m) key must start its capped sweep from
+    the cached winner of the nearest (platform, device_kind) key — a
+    zero-budget sweep measures exactly one candidate, and it is the
+    neighbor's point, not the static default."""
+    cache_file = tmp_path / "autotune.json"
+    monkeypatch.setenv("CEPH_TPU_AUTOTUNE_CACHE", str(cache_file))
+    seed_point = {"tile": 65536, "wb": 256, "extract": "wide",
+                  "combine": "kernel"}
+    assert seed_point != autotune.default_point()
+    # a k=8,m=3 winner cached for THIS device under an older jax tag
+    # (nearest-key matching is on platform/kind, not version/geometry)
+    prefix = autotune._device_prefix()
+    cache_file.write_text(json.dumps({
+        "version": 2,
+        "entries": {f"{prefix}jax0.0.0/{autotune.KERNEL_GEN}/k8m3":
+                    {**seed_point, "gbps": 123.0, "when": "x"}}}))
+    tried = []
+    monkeypatch.setattr(autotune, "_validate",
+                        lambda mat, bm, cand, interpret=False:
+                        (tried.append(dict(cand)) or True))
+    monkeypatch.setattr(autotune, "_measure",
+                        lambda bitmat32, k, m, cand: 7e9)
+    monkeypatch.setenv("CEPH_TPU_AUTOTUNE_BUDGET_S", "0")
+    mat, bitmat32 = _mats()
+    best = autotune.fused_operating_point(
+        K, M, mat=mat, bitmat32=bitmat32, force=True, interpret=True)
+    assert len(tried) == 1          # zero budget: one candidate only
+    assert tried[0] == seed_point
+    assert best == seed_point
+
+
+def test_candidates_ordering_and_legality():
+    """candidates(): every point satisfies the sublane rule, the seed
+    leads when given, and the static default leads otherwise."""
+    cands = autotune.candidates(8, 3)
+    for c in cands:
+        s = (c["tile"] // 4) // c["wb"]
+        assert (11 * s) % 8 == 0
+    dflt = autotune.default_point()
+    assert cands[0] == dflt
+    seed = {"tile": 262144, "wb": 1024, "extract": "packed",
+            "combine": "kernel"}
+    seeded = autotune.candidates(8, 3, seed=seed)
+    assert seeded[0] == seed
+    assert seeded[1] == dflt
+
+
+def test_v1_cache_migrates_to_seedable_v2(tmp_path, monkeypatch):
+    """A version-1 cache file (tile/wb/packed rows) loads as v2 rows
+    (extract/combine mapped) so old winners can still seed ordering —
+    but their keys carry the old kernel generation, so they never
+    satisfy a lookup for the new kernels directly."""
+    cache_file = tmp_path / "autotune.json"
+    monkeypatch.setenv("CEPH_TPU_AUTOTUNE_CACHE", str(cache_file))
+    cache_file.write_text(json.dumps({
+        "version": 1,
+        "entries": {"tpu/TPU v5e/jax0.4.0/fused_w32/k8m3":
+                    {"tile": 131072, "wb": 512, "packed": True,
+                     "gbps": 40.0, "when": "x"}}}))
+    data = autotune._load_cache()
+    assert data["version"] == 2
+    ent = data["entries"]["tpu/TPU v5e/jax0.4.0/fused_w32/k8m3"]
+    assert ent["extract"] == "packed"
+    assert ent["combine"] == "xla"
+    # the migrated row keeps its v1 key: the current kernel generation
+    # must NOT appear in it, so a fresh lookup can never hit this entry
+    (key,) = data["entries"]
+    assert f"/{autotune.KERNEL_GEN}/" not in key

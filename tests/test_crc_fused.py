@@ -309,12 +309,17 @@ def test_fold_run_crc_degenerate_cases():
         C.crc32c(b"", 0x1234)
 
 
-@pytest.mark.parametrize("packed", [False, True])
-def test_device_fold_launch_interpret(packed):
+@pytest.mark.parametrize("extract,combine",
+                         [("planar", "xla"), ("packed", "xla"),
+                          ("packed", "kernel"), ("wide", "kernel")])
+def test_device_fold_launch_interpret(extract, combine):
     """gf_encode_with_crc_w32_fold (the bench/write-path launch): one
-    L per shard per dispatch, multi-tile extents, both crc extraction
-    variants (planar and packed), bit-exact against the host crc32c
-    with a caller seed."""
+    L per shard per dispatch, multi-tile extents, the crc extraction
+    variants (planar / packed / wide) through both combine depths (the
+    XLA log-fold and the in-kernel VMEM accumulator), bit-exact
+    against the host crc32c with a caller seed.  (The full 18-point
+    extract x combine x wb grid runs in tier-1 via
+    `fused_tile_sweep --validate-only` — outside the pytest budget.)"""
     import jax.numpy as jnp
     from ceph_tpu.ops import bitsliced as bs
     from ceph_tpu.ec import gf
@@ -330,7 +335,7 @@ def test_device_fold_launch_interpret(packed):
     words = jnp.asarray(chunks.view("<u4").view(np.int32))
     par_w, lbits = bs.gf_encode_with_crc_w32_fold(
         bitmat32, cmat_sub, words, m, tile=tile, wb=wb,
-        interpret=True, packed=packed)
+        interpret=True, extract=extract, combine=combine)
     assert lbits.shape == (k + m, 32)     # ONE L per shard per launch
     parity = np.asarray(par_w).view("<u4").view(np.uint8).reshape(m, n)
     np.testing.assert_array_equal(parity, gf.gf_matvec(mat, chunks))
@@ -357,6 +362,153 @@ def test_packed_subblock_extraction_matches_planar():
     packed = np.asarray(cl.subblock_crc_bits_w32_packed(
         words, cmat_sub, wb, interpret=True))
     np.testing.assert_array_equal(planar, packed)
+
+
+def test_wide_subblock_extraction_matches_planar():
+    """subblock_crc_bits_w32_wide (mask-free shift-only passes; every
+    non-LSB operand bit contributes an even multiple that the mod-2
+    reduction cancels) must produce exactly the planar variant's
+    L-bit matrix — including operand bytes >= 0x80, whose signed int8
+    reading differs by a multiple of 256 (also even)."""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(16)
+    r, wb, s = 5, 32, 4
+    wt = wb * s
+    chunks = rng.integers(0, 256, (r, 4 * wt), dtype=np.uint8)
+    chunks[0, :64] = 0xFF          # force the signed-wrap corner
+    words = jnp.asarray(chunks.view("<u4").view(np.int32))
+    cmat_sub = jnp.asarray(cl.crc_tile_matrix_w32(wb))
+    planar = np.asarray(cl.subblock_crc_bits_w32(words, cmat_sub, wb))
+    wide = np.asarray(cl.subblock_crc_bits_w32_wide(
+        words, cmat_sub, wb, interpret=True))
+    np.testing.assert_array_equal(planar, wide)
+
+
+def _legal_points(k, m, tiles, wbs):
+    """Every (tile, wb) the sublane rule (k+m)*(tile/4/wb) % 8 == 0
+    allows from the given axes — the alignment edges the accumulator
+    kernel must survive."""
+    out = []
+    for tile in tiles:
+        for wb in wbs:
+            wt = tile // 4
+            if wt % wb == 0 and ((k + m) * (wt // wb)) % 8 == 0:
+                out.append((tile, wb))
+    return out
+
+
+def test_acc_kernel_every_legal_alignment_edge():
+    """The in-kernel combine accumulator at EVERY (tile, wb) alignment
+    edge the sublane rule allows from the small-tile axes, three grid
+    steps each (init + two advance folds), interpret mode, bit-exact
+    vs the host crc."""
+    import jax.numpy as jnp
+    from ceph_tpu.ops import bitsliced as bs
+    from ceph_tpu.ec import gf
+
+    k, m = 4, 2
+    points = _legal_points(k, m, (1024, 2048, 4096), (64, 128, 256))
+    assert len(points) >= 5       # the rule must not silence the sweep
+    mat = gf.cauchy_rs_matrix(k, m)[k:]
+    bitmat32 = jnp.asarray(bs._w32_bitmat(mat), dtype=jnp.int8)
+    rng = np.random.default_rng(17)
+    for tile, wb in points:
+        n = tile * 3
+        chunks = rng.integers(0, 256, (k, n), dtype=np.uint8)
+        words = jnp.asarray(chunks.view("<u4").view(np.int32))
+        cmat_sub = jnp.asarray(cl.crc_tile_matrix_w32(wb))
+        par_w, lbits = bs.gf_encode_with_crc_w32_fold(
+            bitmat32, cmat_sub, words, m, tile=tile, wb=wb,
+            interpret=True, extract="wide", combine="kernel")
+        parity = np.asarray(par_w).view("<u4").view(np.uint8) \
+            .reshape(m, n)
+        np.testing.assert_array_equal(parity, gf.gf_matvec(mat, chunks))
+        ls = cl.bits_to_u32(np.asarray(lbits))
+        allsh = np.concatenate([chunks, parity], axis=0)
+        for s in range(k + m):
+            assert cl.fold_run_crc(int(ls[s]), n, 0xFFFFFFFF) == \
+                C.crc32c(allsh[s].tobytes(), 0xFFFFFFFF), \
+                f"tile={tile} wb={wb} shard {s}"
+
+
+def test_multi_extent_acc_kernel_interpret():
+    """The accumulator extents path (combine="kernel"): several runs of
+    different multi-tile lengths INCLUDING odd sub-block tails in one
+    launch — per-run L must cover the run's every byte (empty
+    tail_bytes, body == width: the host tail fold is gone), runs are
+    front-padded (prefix zeros are crc-free), parity and seed-CHAINED
+    crcs byte-exact vs the reference."""
+    import jax.numpy as jnp
+    from ceph_tpu.ops import bitsliced as bs
+    from ceph_tpu.ec import gf
+
+    k, m = 4, 2
+    tile, wb = 4096, 128
+    mat = gf.cauchy_rs_matrix(k, m)[k:]
+    bitmat = jnp.asarray(bs.interleave_bitmatrix(mat), dtype=jnp.int8)
+    bitmat32 = jnp.asarray(bs._w32_bitmat(mat), dtype=jnp.int8)
+    rng = np.random.default_rng(18)
+    # odd tail, exact multiple, sub-block-odd tail, single tile
+    widths = [tile * 2 + 513, tile * 3, tile + 1, tile]
+    runs = [rng.integers(0, 256, (k, w), dtype=np.uint8)
+            for w in widths]
+    handle = bs.gf_encode_extents_with_crc_submit(
+        bitmat, bitmat32, runs, m, use_w32=True, force_xla=False,
+        interpret=True, tile=tile, wb=wb, extract="wide",
+        combine="kernel")
+    assert handle["path"] == "hier_acc"
+    results = bs.gf_encode_extents_with_crc_finalize(handle)
+    seeds = [0xFFFFFFFF] * (k + m)
+    for run, (par, l, tail, body) in zip(runs, results):
+        w = run.shape[1]
+        assert body == w                  # L covers the whole run
+        assert tail.shape[1] == 0         # no host tail fold
+        np.testing.assert_array_equal(
+            np.asarray(par), gf.gf_matvec(mat, run))
+        allsh = np.concatenate([run, np.asarray(par)], axis=0)
+        crcs = [cl.fold_run_crc(int(l[s]), body, seeds[s])
+                for s in range(k + m)]
+        for s in range(k + m):
+            assert crcs[s] == C.crc32c(allsh[s].tobytes(), seeds[s]), \
+                f"shard {s}"
+        seeds = crcs                      # hinfo chain across runs
+
+
+def test_acc_chained_seeds_across_pipelined_drains():
+    """Two accumulator drains IN FLIGHT at once (submit A, submit B,
+    then finalize in submit order — the dispatch-ahead window), with
+    drain B's hinfo seeds chained off drain A's crcs: the projected-
+    seed pipeline the ECBackend runs at depth 2."""
+    import jax.numpy as jnp
+    from ceph_tpu.ops import bitsliced as bs
+    from ceph_tpu.ec import gf
+
+    k, m = 4, 2
+    tile, wb = 4096, 128
+    mat = gf.cauchy_rs_matrix(k, m)[k:]
+    bitmat = jnp.asarray(bs.interleave_bitmatrix(mat), dtype=jnp.int8)
+    bitmat32 = jnp.asarray(bs._w32_bitmat(mat), dtype=jnp.int8)
+    rng = np.random.default_rng(19)
+    drains = [[rng.integers(0, 256, (k, tile + 257), dtype=np.uint8)],
+              [rng.integers(0, 256, (k, tile * 2 + 99), dtype=np.uint8)]]
+    handles = [bs.gf_encode_extents_with_crc_submit(
+        bitmat, bitmat32, d, m, use_w32=True, force_xla=False,
+        interpret=True, tile=tile, wb=wb, extract="planar",
+        combine="kernel") for d in drains]       # both launched first
+    seeds = [0xFFFFFFFF] * (k + m)
+    streams = [b""] * (k + m)
+    for d, h in zip(drains, handles):            # finalize in order
+        [(par, l, tail, body)] = \
+            bs.gf_encode_extents_with_crc_finalize(h)
+        allsh = np.concatenate([d[0], np.asarray(par)], axis=0)
+        crcs = [cl.fold_run_crc(int(l[s]), body, seeds[s],
+                                tail[s].tobytes())
+                for s in range(k + m)]
+        for s in range(k + m):
+            streams[s] += allsh[s].tobytes()
+            assert crcs[s] == C.crc32c(streams[s], 0xFFFFFFFF), \
+                f"shard {s}"
+        seeds = crcs
 
 
 @pytest.mark.parametrize("n_bytes", [2047, 2048 + 1, 2048 * 4 + 100])

@@ -315,6 +315,13 @@ def test_fused_crc_pipeline_matches_host_crc():
         want = C.crc32c(shards[s].tobytes(), 0xFFFFFFFF)
         assert hinfo.get_chunk_hash(s) == want, f"shard {s}"
     np.testing.assert_array_equal(backend.read(o, 0, 768), whole)
+    # kernel-path provenance (ISSUE 11): fused drains ran, and the
+    # backend attributed them — on this CPU run the submit resolves to
+    # the XLA twin, counted as a fallback (hier counters stay 0)
+    assert backend.fused_path == "xla"
+    perf = backend.perf.dump()
+    assert perf["ec_fused_fallback_drains"] >= 2
+    assert perf["ec_fused_kernel_drains"] == 0
 
 
 def test_fused_crc_covers_batched_multi_op_drain():
