@@ -464,6 +464,31 @@ def bench_end_to_end(on_tpu: bool, passes: int, spacing: float) -> dict:
     out["ec_write_pipeline_k8_m3_GBps"] = round(pipe_med / 1e9, 3)
     out["ec_write_pipeline_sync_GBps"] = round(sync_med / 1e9, 3)
     out["ec_write_pipeline_speedup"] = round(pipe_med / sync_med, 3)
+    # many-PG continuous batching (ISSUE 12, docs/PIPELINE.md "Host
+    # launch queue"): the same total op count written through 64 PGs
+    # sharing one per-host launch queue vs through 1 PG on the same
+    # harness — aggregate GB/s must survive PG fan-out (gated in
+    # --smoke within EC_64PG_MIN_FRAC of the 1-PG point), and the
+    # queue's counters must prove multi-PG runs coalesced into shared
+    # launches
+    from ceph_tpu.tools.load_harness import run_ec_pg_sweep
+    npg = int(os.environ.get("BENCH_PGS", "64"))
+    mp_objs = 2 * npg
+    mp_size = (2 << 20) if on_tpu else (1 << 16)
+    # one measurement methodology for the fan-out claim: delegate to
+    # the tier-1 sweep harness (warm passes at the MEASURED shapes,
+    # best PAIRED pass per fan-out — see run_ec_pg_sweep); min_frac=0
+    # because the gate lives in --smoke, not here
+    sweep = run_ec_pg_sweep(pg_counts=(1, npg), total_objs=mp_objs,
+                            objsize=mp_size, chunk=chunk, min_frac=0.0)
+    out["ec_write_pipeline_64pg_GBps"] = sweep["agg_GBps"][str(npg)]
+    out["ec_write_pipeline_64pg_base_GBps"] = sweep["agg_GBps"]["1"]
+    out["ec_write_pipeline_64pg_frac"] = sweep["degradation_frac"]
+    out["ec_write_pipeline_64pg_n"] = npg
+    out["ec_host_queue_launches"] = sweep["launches"]
+    out["ec_host_queue_runs_per_launch"] = sweep["runs_per_launch"]
+    out["ec_host_queue_cross_pg_launches"] = sweep["cross_pg_launches"]
+    out["ec_host_queue_occupancy_pct"] = sweep["occupancy_pct"]
     rate, meta = time_deep_scrub(nobj, objsize, chunk,
                                  use_device=on_tpu)
     out["ec_deep_scrub_GBps"] = round(rate / 1e9, 3)
@@ -699,6 +724,8 @@ SMOKE_KEYS = ("ec_write_pipeline_k8_m3_GBps",
               "ec_write_pipeline_sync_GBps",
               "ec_write_pipeline_speedup",
               "ec_write_pipeline_tracked_GBps",
+              "ec_write_pipeline_64pg_GBps",
+              "ec_write_pipeline_64pg_base_GBps",
               "ec_deep_scrub_GBps")
 
 
@@ -779,6 +806,30 @@ def run_smoke() -> int:
     # TPU round
     if fused_why is not None:
         print(f"# smoke FAILED: {fused_why}", file=sys.stderr)
+        return 1
+    # many-PG continuous-batching guard (ISSUE 12): aggregate GB/s
+    # through 64 PGs sharing the host launch queue must stay within
+    # EC_64PG_MIN_FRAC (default 0.8 = the "within 20%" acceptance) of
+    # the 1-PG pipelined point on the same harness, and the occupancy
+    # counters must prove runs from different PGs actually coalesced
+    # into shared launches — otherwise the queue is pass-through and
+    # PG fan-out will shred TPU launch occupancy
+    pg_min = float(os.environ.get("EC_64PG_MIN_FRAC", "0.8"))
+    frac = out.get("ec_write_pipeline_64pg_frac")
+    if not isinstance(frac, (int, float)) or frac < pg_min:
+        print(f"# smoke FAILED: ec_write_pipeline_64pg_frac={frac!r} "
+              f"< {pg_min} (aggregate GB/s degraded under PG fan-out)",
+              file=sys.stderr)
+        return 1
+    if out.get("ec_host_queue_runs_per_launch", 0) <= 1.0:
+        print(f"# smoke FAILED: launch queue did not coalesce "
+              f"(runs/launch="
+              f"{out.get('ec_host_queue_runs_per_launch')!r})",
+              file=sys.stderr)
+        return 1
+    if out.get("ec_host_queue_cross_pg_launches", 0) < 1:
+        print("# smoke FAILED: no launch coalesced runs from more "
+              "than one PG", file=sys.stderr)
         return 1
     # tracking-overhead guard (docs/TRACING.md): always-on tracking
     # must cost < TRACK_OVERHEAD_MAX_PCT (default 2%) beyond the

@@ -167,6 +167,25 @@ register_options([
            "verify deep-scrub crc32c with the device kernel when an "
            "accelerator backend is active (host crc fallback otherwise)",
            Level.DEV),
+    # per-host EC launch queue (cross-PG continuous batching on the
+    # MeshService seam; docs/PIPELINE.md "Host launch queue")
+    Option("osd_ec_host_batch", bool, True,
+           "route EC encode launches of every PG on the host through "
+           "one per-device launch queue that coalesces runs from "
+           "different PGs into super-batch launches (per-PG in-order "
+           "completion and failure containment preserved); off = each "
+           "PG launches its own drains"),
+    Option("osd_ec_host_batch_window_us", float, 250.0,
+           "max microseconds a submitted run waits in the host launch "
+           "queue for co-batching before the window fires; 0 launches "
+           "every submission immediately (no cross-PG batching).  A "
+           "ticket finalized earlier flushes the queue on demand, so "
+           "a lone synchronous writer never waits the window out",
+           min=0.0),
+    Option("osd_ec_host_batch_max_bytes", int, 32 << 20,
+           "input-byte cap per super-batch launch (the occupancy "
+           "denominator of the launch-queue counters); reaching it "
+           "launches immediately", min=1 << 16),
     # multichip mesh scale-out (docs/MULTICHIP.md)
     Option("osd_ec_use_mesh", bool, False,
            "acquire the per-host MeshService multichip data plane for "

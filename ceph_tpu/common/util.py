@@ -1,0 +1,15 @@
+"""Small shared helpers with no dependencies above common/."""
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (n >= 1).
+
+    The launch-shape bucketing rule: continuous batching produces a
+    new super-batch width on every launch, and each distinct width is
+    a fresh XLA compile — rounding every launch dimension (tile
+    counts, run counts, column widths) up to a power of two keeps the
+    number of jit keys ~log2 of the largest width ever seen.  Every
+    bucketing site must use the SAME rounding rule, or a width one
+    site considers cached recompiles at another.
+    """
+    return 1 << (n - 1).bit_length()

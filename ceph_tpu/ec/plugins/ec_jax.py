@@ -62,6 +62,7 @@ class ErasureCodeJax(ErasureCode):
         super().__init__()
         self.technique = technique
         self.matrix: np.ndarray | None = None
+        self._codec_sig: tuple | None = None
         self._enc_bitmat = None           # device array, interleaved layout
         self._decode_cache: dict[tuple, tuple] = {}
         self._lock = threading.Lock()
@@ -93,6 +94,22 @@ class ErasureCodeJax(ErasureCode):
 
     def get_alignment(self) -> int:
         return 64
+
+    def codec_signature(self) -> tuple:
+        """Coalescing key for the per-host launch queue
+        (parallel/launch_queue.py): two instances with equal
+        signatures produce bit-identical parity via the same launch
+        paths, so their runs may share one cross-PG super-batch.
+        Plugin-typed on purpose — a jax instance never co-batches
+        with a CPU plugin even when the matrices match, because the
+        super-batch launches through the FIRST submitter's plugin and
+        the capability sets (submit/finalize halves, device layout)
+        must be uniform within a launch."""
+        if self._codec_sig is None:
+            from ...parallel.launch_queue import matrix_signature
+            self._codec_sig = ("jax",) + matrix_signature(
+                self.matrix, self.k, self.m)
+        return self._codec_sig
 
     # -- encode -------------------------------------------------------------
 

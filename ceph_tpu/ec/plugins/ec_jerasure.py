@@ -51,6 +51,14 @@ class ErasureCodeJerasure(ErasureCode):
 
     MINIMAL_DENSITY = ("liberation", "blaum_roth", "liber8tion")
 
+    # launch-queue coalescing (parallel/launch_queue.codec_signature):
+    # for every technique that SETS self.matrix, encode_chunks is
+    # exactly gf_matvec(matrix[k:]) — equal matrices mean bit-equal
+    # parity, so such instances may share a cross-PG super-batch.
+    # Minimal-density techniques encode via bitmatrix packets instead,
+    # and leave self.matrix None (instance-identity batching only).
+    matrix_determines_encode = True
+
     def __init__(self, technique: str = "reed_sol_van"):
         super().__init__()
         self.technique = technique

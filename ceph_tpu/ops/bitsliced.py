@@ -40,6 +40,7 @@ try:  # pltpu imports fail off-TPU for some symbols; guard for CPU tests
 except ImportError:  # pragma: no cover
     pltpu = None
 
+from ..common.util import next_pow2
 from ..ec import gf
 
 LANE = 128           # TPU lane width: byte-axis tiles must be multiples
@@ -677,10 +678,14 @@ def _acc_launch_args(ntiles_run, tile: int, wb: int):
     for the single-extent fold entry and the extents path — the two
     must never diverge on the accumulator contract."""
     from . import crc32c_linear as cl
-    run_map = np.repeat(np.arange(len(ntiles_run), dtype=np.int32),
-                        ntiles_run)
+    counts = np.asarray(list(ntiles_run), dtype=np.int64)
+    run_map = np.repeat(np.arange(len(counts), dtype=np.int32), counts)
     first_map = np.zeros(len(run_map), dtype=np.int32)
-    first_map[np.cumsum([0] + list(ntiles_run)[:-1])] = 1
+    starts = np.concatenate(([0], np.cumsum(counts)[:-1]))
+    # zero-tile filler runs (launch-shape bucketing) have no first
+    # step; their start index aliases the next run's (or falls off the
+    # end) and must not set a flag
+    first_map[starts[counts > 0]] = 1
     adv = jnp.asarray(cl.crc_advance_matrix(tile), dtype=jnp.int8)
     comb = jnp.asarray(
         cl.crc_combine_matrix((tile // 4) // wb, 4 * wb),
@@ -813,13 +818,13 @@ def gf_encode_with_crc_xla(bitmat, cmat, chunks, m: int,
         preferred_element_type=jnp.int32,
     ) & 1
     parity = _pack_bits(prod, m)
-    crcs = []
-    for t in range(ntiles):
-        sl = slice(t * tile, (t + 1) * tile)
-        d = cl.tile_crc_bits(bits[:, sl], cmat)
-        p = cl.tile_crc_bits(prod[:, sl].astype(jnp.int8), cmat)
-        crcs.append(jnp.concatenate([d, p], axis=0))
-    return parity, jnp.stack(crcs)
+    # one batched crc contraction over every tile (program size
+    # independent of ntiles — the old per-tile loop unrolled into the
+    # program and made compile time scale with launch width)
+    d = cl.tile_crc_bits_tiled(bits, cmat, tile)             # (nt,k,32)
+    p = cl.tile_crc_bits_tiled(prod.astype(jnp.int8), cmat,
+                               tile)                         # (nt,m,32)
+    return parity, jnp.concatenate([d, p], axis=1)
 
 
 def _hier_lsub_core(bitmat32, cmat_sub, words, m: int, tile: int,
@@ -926,9 +931,33 @@ def gf_encode_extents_with_crc_submit(bitmat, bitmat32, runs, m: int,
         donate = jax.default_backend() != "cpu"
     runs = [np.ascontiguousarray(r, dtype=np.uint8) for r in runs]
     k = runs[0].shape[0]
+    assert all(r.shape[0] == k for r in runs), \
+        "all runs of one launch must share k (one codec per batch)"
     r_tot = k + m
     tile_hier = tile or FUSED_TILE_HIER
     wb = wb or FUSED_WB
+    # Mixed-width batches (a cross-PG super-batch mixing big
+    # sequential appends with small writes) must not demote EVERY run
+    # off the hier kernel just because one run is under the hier tile:
+    # split into a hier-eligible launch and a flat-tile launch, demuxed
+    # back to the caller's run order at finalize.  Two launches instead
+    # of one, but the big runs keep the headline kernel — the
+    # occupancy-preserving trade continuous batching needs.
+    if use_w32 and not force_xla:
+        big_idx = [i for i, r in enumerate(runs)
+                   if r.shape[1] >= tile_hier]
+        if 0 < len(big_idx) < len(runs):
+            small_idx = [i for i, r in enumerate(runs)
+                         if r.shape[1] < tile_hier]
+            parts = []
+            for idxs in (big_idx, small_idx):
+                parts.append((idxs, gf_encode_extents_with_crc_submit(
+                    bitmat, bitmat32, [runs[i] for i in idxs], m,
+                    use_w32=use_w32, force_xla=force_xla,
+                    interpret=interpret, tile=tile, wb=wb,
+                    extract=extract, combine=combine, donate=donate)))
+            return {"split": parts, "n_runs": len(runs),
+                    "path": "+".join(h["path"] for _, h in parts)}
     # operating point: big sequential drains ride the hier-crc kernel at
     # the autotuned tile; small/mixed drains keep the flat 2 KiB tile
     # where padding waste would dominate
@@ -959,6 +988,21 @@ def gf_encode_extents_with_crc_submit(bitmat, bitmat32, runs, m: int,
             padded.append(r)
     big = np.concatenate(padded, axis=1)               # (k, ntiles*tile)
     ntiles_total = big.shape[1] // tile
+    # Launch-shape bucketing: continuous batching (the per-host launch
+    # queue) makes every super-batch a different total width, and every
+    # distinct width is a fresh XLA/Mosaic compile — seconds each,
+    # paid per launch instead of once.  Zero-pad the concatenated
+    # launch to the next power-of-two tile count so the jit key space
+    # collapses to ~log2 shapes per path; the pad tiles sit AFTER
+    # every real run (per-run demux never reaches them) and zero bytes
+    # encode to zero parity, so the bucket is free for correctness.
+    ntiles2 = next_pow2(ntiles_total)
+    pad_tiles = ntiles2 - ntiles_total
+    if pad_tiles:
+        big = np.concatenate(
+            [big, np.zeros((k, pad_tiles * tile), dtype=np.uint8)],
+            axis=1)
+        ntiles_total = ntiles2
     rows = _crc_rows(r_tot)
     w32_out = False
     lbits_devs = None
@@ -986,13 +1030,22 @@ def gf_encode_extents_with_crc_submit(bitmat, bitmat32, runs, m: int,
         # combine dispatches, no sub-block host tail
         cmat_sub = jnp.asarray(cl.crc_tile_matrix_w32(wb))
         words = big.view("<u4").view(np.int32)
+        # the L out-block is keyed by run count: bucket it to a power
+        # of two as well (pad tiles ride a dummy trailing run, empty
+        # filler runs contribute no grid steps), so (tiles, runs) jit
+        # keys stay ~log2 x log2 under cross-PG batching
+        ntiles_run = [p.shape[1] // tile for p in padded]
+        if pad_tiles:
+            ntiles_run.append(pad_tiles)
+        nruns_acc = next_pow2(len(ntiles_run))
+        ntiles_run += [0] * (nruns_acc - len(ntiles_run))
         run_map, first_map, adv, comb = _acc_launch_args(
-            [p.shape[1] // tile for p in padded], tile, wb)
+            ntiles_run, tile, wb)
         acc_fn = _hier_acc_donate if donate else _hier_acc
         parity_dev, lb = acc_fn(
             bitmat32, cmat_sub, adv, comb, run_map, first_map,
             jnp.asarray(words), m, tile, wb,
-            len(runs), interpret, extract)             # (nruns, r, 32)
+            nruns_acc, interpret, extract)             # (nruns, r, 32)
         lbits_devs = [lb[i] for i in range(len(runs))]
         block_bytes = 4 * wb
         w32_out = True
@@ -1035,7 +1088,7 @@ def gf_encode_extents_with_crc_submit(bitmat, bitmat32, runs, m: int,
                 # "every distinct extent length" to ~log2 shapes (a
                 # drain of varied object sizes must not recompile per
                 # length)
-                nb2 = 1 << (nb - 1).bit_length()
+                nb2 = next_pow2(nb)
                 if nb2 != nb:
                     lb_run = jnp.pad(lb_run, ((0, 0), (nb2 - nb, 0),
                                               (0, 0)))
@@ -1059,6 +1112,15 @@ def gf_encode_extents_with_crc_finalize(handle):
     body == run width and tail_bytes is empty — the host pays one
     seed-advance per extent and never touches a byte."""
     from . import crc32c_linear as cl
+    if "split" in handle:
+        # mixed-width batch: finalize both sub-launches and restore
+        # the caller's run order
+        out = [None] * handle["n_runs"]
+        for idxs, sub in handle["split"]:
+            for i, res in zip(idxs,
+                              gf_encode_extents_with_crc_finalize(sub)):
+                out[i] = res
+        return out
     meta, padded = handle["meta"], handle["padded"]
     pads = handle.get("pads") or [0] * len(meta)
     r_tot = handle["r_tot"]

@@ -31,6 +31,7 @@ import functools
 import numpy as np
 
 from ..common import crc32c as _crc
+from ..common.util import next_pow2
 
 
 @functools.lru_cache(maxsize=8)
@@ -231,7 +232,7 @@ def crc32c_rows_device(row_list, seeds,
     for i, b in enumerate(bodies):
         if b:
             nb = b // block_bytes
-            buckets.setdefault(1 << (nb - 1).bit_length(), []).append(i)
+            buckets.setdefault(next_pow2(nb), []).append(i)
     for nb2, idxs in sorted(buckets.items()):
         w = block_bytes * nb2
         mat = np.zeros((len(idxs), w), dtype=np.uint8)
@@ -428,6 +429,30 @@ def fold_tile_crcs(tile_ls: np.ndarray, tile: int, seed: int,
 # ----------------------------------------------------------------------------
 # device-side tile CRC (jnp; callable inside the Pallas kernel too)
 # ----------------------------------------------------------------------------
+
+def tile_crc_bits_tiled(bits, cmat, tile: int):
+    """Batched tile_crc_bits over EVERY tile of a launch in one rank-3
+    dot per bit plane: bits (8r, ntiles*T) -> (ntiles, r, 32).  The
+    per-tile Python loop this replaces unrolled O(ntiles) matmuls into
+    the traced program, so XLA compile time scaled with the launch
+    width — fatal once the per-host launch queue started bucketing
+    cross-PG super-batches (one multi-minute compile per bucket);
+    here the program size is width-independent."""
+    import jax
+    import jax.numpy as jnp
+    r8, n = bits.shape
+    r = r8 // 8
+    nt = n // tile
+    acc = jnp.zeros((nt, r, 32), dtype=jnp.float32)
+    for i in range(8):
+        plane = (bits[i * r:(i + 1) * r].astype(jnp.float32)
+                 .reshape(r, nt, tile).transpose(1, 0, 2))
+        acc = acc + jax.lax.dot_general(
+            plane, cmat[i * tile:(i + 1) * tile].astype(jnp.float32),
+            dimension_numbers=(((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    return acc.astype(jnp.int32) & 1
+
 
 def tile_crc_bits(bits, cmat):
     """bits: (8r, T) int8 bit-major rows; cmat: (8T, 32) with rows

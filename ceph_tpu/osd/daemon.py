@@ -452,6 +452,12 @@ class OSDDaemon:
                 "mesh status", self._asok_mesh_status)
             self.cct.asok.register_command(
                 "mesh_status", self._asok_mesh_status)
+            # per-host EC launch queue occupancy (cross-PG continuous
+            # batching, docs/PIPELINE.md); both spellings like mesh
+            self.cct.asok.register_command(
+                "launch queue status", self._asok_launch_queue_status)
+            self.cct.asok.register_command(
+                "launch_queue_status", self._asok_launch_queue_status)
         self.store = store or MemStore()
         self.store.mount()
         self._raw_tid = 1 << 32   # raw-RPC tids, disjoint from backends'
@@ -2255,6 +2261,7 @@ class OSDDaemon:
                     backend = ECBackend(
                         codec, sinfo, shards,
                         mesh_service=self._mesh_service(),
+                        launch_queue=self._host_launch_queue(),
                         dispatch_depth=int(self.cct.conf.get(
                             "ec_dispatch_ahead_depth") or 2),
                         perf_name=f"ec.{pgid}",
@@ -3253,6 +3260,54 @@ class OSDDaemon:
                           f"mesh service unavailable ({e}); EC PGs "
                           f"will use the single-chip plane")
             return None
+
+    def _host_launch_queue(self):
+        """The per-host EC launch queue (cross-PG continuous batching,
+        parallel/launch_queue.py) when osd_ec_host_batch is on; None
+        otherwise (each PG then launches its own drains).  Handed out
+        through the MeshService seam — it brokers the device plane, so
+        it brokers the launch queue — and works with or without a
+        configured mesh.  The queue's perf counters (launches,
+        coalescing, occupancy, lat_ec_batch_wait) register into
+        exactly ONE daemon's collection per host (the first to wire
+        the queue) so `perf dump` / `dump_latencies` / the prometheus
+        exporter surface them ONCE: the set is host-level, and every
+        daemon re-exporting the shared singleton would make the
+        normal sum-across-daemons aggregation read n_daemons times
+        the real launch/byte counts.  Every daemon still serves the
+        host truth via the `launch queue status` asok."""
+        if not bool(self.cct.conf.get("osd_ec_host_batch")):
+            return None
+        from ..parallel.service import MeshService
+        queue = MeshService.host_launch_queue(
+            window_us=float(self.cct.conf.get(
+                "osd_ec_host_batch_window_us")),
+            max_bytes=int(self.cct.conf.get(
+                "osd_ec_host_batch_max_bytes")))
+        if not getattr(queue, "_perf_registered", False):
+            queue._perf_registered = True
+            self.cct.perf.add(queue.perf)
+        return queue
+
+    def _asok_launch_queue_status(self, cmd: dict) -> dict:
+        """`ceph daemon osd.N.asok launch queue status`: the host
+        queue's batching knobs + launch/coalescing/occupancy
+        aggregates, plus this OSD's per-PG routed-drain counts — an
+        operator reads occupancy % and runs-per-launch here to see
+        whether PG fan-out is actually coalescing."""
+        from ..parallel.launch_queue import ECLaunchQueue
+        queue = ECLaunchQueue.host_get()
+        with self.pg_lock:
+            pgs = {
+                str(pgid): st.backend.perf.dump().get(
+                    "ec_host_queue_drains", 0)
+                for pgid, st in self.pgs.items() if st.kind == "ec"}
+        return {
+            "osd": self.osd_id,
+            "enabled": bool(self.cct.conf.get("osd_ec_host_batch")),
+            "queue": queue.status() if queue is not None else None,
+            "pg_queue_drains": pgs,
+        }
 
     def _asok_mesh_status(self, cmd: dict) -> dict:
         """`ceph daemon osd.N.asok mesh status`: the host service's

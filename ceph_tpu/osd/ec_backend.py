@@ -245,6 +245,9 @@ def _build_ec_perf(name: str):
                              "fused drains served by the hier kernels")
             .add_u64_counter("ec_fused_fallback_drains",
                              "fused drains served by a fallback path")
+            .add_u64_counter("ec_host_queue_drains",
+                             "drains routed through the per-host "
+                             "launch queue (cross-PG batching)")
             .add_u64_counter("ec_scrub_device_bytes",
                              "deep-scrub bytes crc'd on device")
             .add_u64_counter("ec_scrub_host_bytes",
@@ -262,7 +265,7 @@ class ECBackend:
     def __init__(self, ec_impl: ErasureCodeInterface, sinfo: StripeInfo,
                  shards: ShardBackend, log: PGLog | None = None,
                  mesh_codec=None, mesh_service=None,
-                 dispatch_depth: int = 2,
+                 launch_queue=None, dispatch_depth: int = 2,
                  perf=None, perf_name: str = "ec", logger=None):
         self.ec_impl = ec_impl
         self.sinfo = sinfo
@@ -308,6 +311,14 @@ class ECBackend:
                 self._mesh_config_error(why)
                 mesh_codec = None
         self.mesh_codec = mesh_codec
+        # Per-host EC launch queue (parallel/launch_queue.py): when
+        # set, this backend's drains submit their encode runs to the
+        # shared queue — which coalesces them with OTHER PGs' runs
+        # into one super-batch launch per window — instead of issuing
+        # a private partial-occupancy launch.  Completion, in-order
+        # acks, and failure containment stay per-PG; the queue only
+        # owns the launch.
+        self._launch_queue = launch_queue
         self.log = log or PGLog()
         self.lock = threading.RLock()
         self.waiting_state: list[ECOp] = []
@@ -395,6 +406,19 @@ class ECBackend:
             self.perf.inc("ec_mesh_errors")
         if self._mesh_service is not None:
             self._mesh_service.note_failure(err)
+
+    def _note_fused_path(self, path: str | None) -> None:
+        """Record which fused kernel family served a drain (hier_* =
+        the overlapped Pallas kernels, anything else a fallback).
+        Direct submits attribute at launch; launch-queue drains at
+        completion (the super-batch's path is unknown until the
+        shared launch fires)."""
+        self.fused_path = path
+        if self.perf:
+            self.perf.inc(
+                "ec_fused_kernel_drains"
+                if path and path.startswith("hier")
+                else "ec_fused_fallback_drains")
 
     def mesh_status(self) -> dict:
         """Per-backend plane state (surfaced by the OSD's
@@ -816,23 +840,32 @@ class ECBackend:
             if fused_idx:
                 drain.fused_pos = {wi: p
                                    for p, wi in enumerate(fused_idx)}
-                drain.fused_handle = \
-                    self.ec_impl.encode_extents_with_crc_submit(
-                        [runs[i] for i in fused_idx])
-                # kernel-path provenance (ISSUE 11): which fused
-                # kernel served this drain — hier_acc/hier_lsub are
-                # the overlapped Pallas family, anything else is a
-                # fallback; surfaced as perf counters + fused_path so
-                # a silent fallback at plugin init is attributable
-                # from `perf dump`, not just a slower bench row
-                path = drain.fused_handle.get("path") \
-                    if isinstance(drain.fused_handle, dict) else None
-                self.fused_path = path
-                if self.perf:
-                    self.perf.inc(
-                        "ec_fused_kernel_drains"
-                        if path and path.startswith("hier")
-                        else "ec_fused_fallback_drains")
+                fused_runs = [runs[i] for i in fused_idx]
+                if self._launch_queue is not None:
+                    # per-host continuous batching: the queue
+                    # coalesces these runs with other PGs' into one
+                    # super-batch launch; kernel-path attribution
+                    # waits for the launch (completion half)
+                    drain.fused_handle = \
+                        self._launch_queue.submit_extents(
+                            self.ec_impl, fused_runs, owner=id(self))
+                    if self.perf:
+                        self.perf.inc("ec_host_queue_drains")
+                else:
+                    drain.fused_handle = \
+                        self.ec_impl.encode_extents_with_crc_submit(
+                            fused_runs)
+                    # kernel-path provenance (ISSUE 11): which fused
+                    # kernel served this drain — hier_acc/hier_lsub
+                    # are the overlapped Pallas family, anything else
+                    # is a fallback; surfaced as perf counters +
+                    # fused_path so a silent fallback at plugin init
+                    # is attributable from `perf dump`, not just a
+                    # slower bench row
+                    self._note_fused_path(
+                        drain.fused_handle.get("path")
+                        if isinstance(drain.fused_handle, dict)
+                        else None)
             if plain_idx:
                 col = 0
                 for i in plain_idx:
@@ -854,6 +887,12 @@ class ECBackend:
                         raise
                     if self.perf:
                         self.perf.inc("ec_mesh_drains")
+                elif self._launch_queue is not None:
+                    drain.plain_handle = (
+                        "queue", self._launch_queue.submit_chunks(
+                            self.ec_impl, big, owner=id(self)))
+                    if self.perf and not fused_idx:
+                        self.perf.inc("ec_host_queue_drains")
                 elif hasattr(self.ec_impl, "encode_chunks_submit"):
                     drain.plain_handle = (
                         "plugin", self.ec_impl.encode_chunks_submit(big))
@@ -862,6 +901,12 @@ class ECBackend:
                     drain.plain_handle = (
                         "np", np.asarray(self.ec_impl.encode_chunks(big)))
         except Exception:
+            # withdraw any queue submissions this drain already made:
+            # the owning ops are about to abort, and an orphaned
+            # pending submission would launch (and hold) work nobody
+            # will ever finalize
+            if getattr(drain.fused_handle, "is_launch_ticket", False):
+                drain.fused_handle.cancel()
             # undo this drain's projection refs before the caller
             # aborts the ops (a stale projection would quietly push
             # every later append of these objects off the fused path)
@@ -935,13 +980,25 @@ class ECBackend:
         t0 = _time.perf_counter()
         try:
             try:
-                fused_res = self.ec_impl.encode_extents_with_crc_finalize(
-                    drain.fused_handle) if drain.fused_handle is not None \
-                    else []
+                fh = drain.fused_handle
+                if fh is None:
+                    fused_res = []
+                elif getattr(fh, "is_launch_ticket", False):
+                    # launch-queue drain: result() forces the shared
+                    # super-batch to launch if the window hasn't fired
+                    # (flush-on-demand keeps lone-PG sync semantics)
+                    # and demuxes THIS submission's per-run results
+                    fused_res = fh.result()
+                    self._note_fused_path(fh.path)
+                else:
+                    fused_res = \
+                        self.ec_impl.encode_extents_with_crc_finalize(fh)
                 plain_par = None
                 if drain.plain_handle is not None:
                     kind, h = drain.plain_handle
-                    if kind == "mesh":
+                    if kind == "queue":
+                        plain_par = np.asarray(h.result())
+                    elif kind == "mesh":
                         # _mesh_fallen: the plane was disabled after
                         # this drain launched — its own future may
                         # still materialize (and aborts cleanly if not)
@@ -958,6 +1015,16 @@ class ECBackend:
             except Exception as e:  # noqa: BLE001 — device/encode failure
                 if self.perf:
                     self.perf.inc("ec_drain_errors")
+                # the fused and plain halves are separate queue
+                # tickets: when one raises, withdraw the other if it
+                # is still pending — otherwise the window worker
+                # launches it for nobody (post-launch cancel is a
+                # no-op and the unread results are simply dropped)
+                for h in (drain.fused_handle,
+                          drain.plain_handle[1]
+                          if drain.plain_handle is not None else None):
+                    if getattr(h, "is_launch_ticket", False):
+                        h.cancel()
                 if drain.plain_handle is not None and \
                         drain.plain_handle[0] == "mesh":
                     # mesh finalize failure: abort THIS drain's ops,

@@ -1,0 +1,636 @@
+"""Per-host EC launch queue: cross-PG continuous batching.
+
+The single-PG bench numbers (BENCH_r05: ~147 GB/s bare encode) come
+from large, full-occupancy device launches; a loaded OSD host with
+hundreds of post-split PGs issues hundreds of partial-occupancy
+launches instead, because every ECBackend drains per-PG.  This module
+is the fix ROADMAP item 2 names: one per-device launch queue per host,
+owned by the same `MeshService` seam that already owns the device
+plane (parallel/service.py) — every ECBackend on the host submits its
+assemble-complete extent runs here instead of launching its own
+`encode_extents_with_crc_submit`, and the queue coalesces runs from
+DIFFERENT PGs into autotuned super-batches: the continuous-batching
+move inference servers use to keep an accelerator at full occupancy
+under many small request streams.
+
+Why cross-PG concatenation is safe: the fused extents contract (PR 9,
+ops/bitsliced.gf_encode_extents_with_crc_submit) pads every run to a
+tile multiple (front-padded on the accumulator path), emits ONE
+per-run L per shard, and parity is a columnwise-linear GF map — so a
+super-batch is just a longer list of independent runs, and the
+per-run results demultiplex exactly.  The plain (no-crc) chunk path
+concatenates along the byte axis and demuxes by column for the same
+reason.
+
+Contract with the owning backends (docs/PIPELINE.md "Host launch
+queue"):
+
+* `submit_*` returns a `LaunchTicket` immediately — the submitting
+  drain never blocks.  The queue launches a super-batch when the
+  batching window (`osd_ec_host_batch_window_us`) expires, when the
+  pending input bytes reach the super-batch cap
+  (`osd_ec_host_batch_max_bytes`), or when any ticket's `result()` is
+  called first (flush-on-demand: a lone PG with nothing behind it
+  keeps the synchronous flush-on-idle semantics of the per-PG
+  pipeline).
+* Per-PG in-order completion is untouched: the queue only owns the
+  LAUNCH; each backend still materializes its drains in submit order
+  through its own `_complete_drain` / `_try_finish_rmw` path.
+* Failure containment: submissions only coalesce when their codecs
+  are provably identical (generator-matrix signature).  If a combined
+  launch still fails, the queue retries each submission on its OWN
+  plugin, so a poison run aborts only the owning PG's ops while
+  co-batched PGs' runs launch and commit.  A finalize (device)
+  failure fails every ticket of that batch — each backend aborts its
+  own ops and the queue keeps serving (the mesh-failure analog).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ..common.util import next_pow2
+
+
+def codec_signature(plugin) -> tuple:
+    """Coalescing key for a plugin instance: two submissions may share
+    one launch only when this is equal — same geometry AND bit-equal
+    generator matrix (cauchy parity is garbage to a reed_sol_van
+    decode; an unproven match must never batch).  Plugins may provide
+    their own `codec_signature()`; without a generator matrix the
+    signature degrades to instance identity, so such plugins still
+    batch with themselves but never across instances."""
+    own = getattr(plugin, "codec_signature", None)
+    if callable(own):
+        return own()
+    mat = getattr(plugin, "matrix", None)
+    if mat is None or \
+            not getattr(plugin, "matrix_determines_encode", False):
+        # exposing a matrix is NOT proof the encode uses it (jerasure's
+        # minimal-density techniques encode via bitmatrix packets) —
+        # only plugins that explicitly declare matrix-determined
+        # encode semantics may batch across instances on the matrix
+        return ("instance", id(plugin))
+    # plugin-typed: the super-batch launches and finalizes through the
+    # FIRST submitter's plugin, so the capability set must be uniform
+    # within a launch — two plugin classes with bit-equal matrices
+    # must never co-batch on the matrix alone
+    return (type(plugin).__name__,) + matrix_signature(
+        mat, plugin.get_data_chunk_count(),
+        plugin.get_coding_chunk_count())
+
+
+def matrix_signature(matrix, k, m) -> tuple:
+    """The geometry + bit-equal-generator-matrix fields every
+    coalescing key shares (the fallback above and plugin
+    `codec_signature()` implementations prepend their type tag).
+    The RAW matrix bytes ride the key — a hash would make "provably
+    identical" probabilistic, and a collision would silently encode
+    one pool's runs with another pool's matrix; generator matrices
+    are tiny and plugins cache the signature, so exact bytes cost
+    nothing."""
+    a = np.ascontiguousarray(np.asarray(matrix))
+    return (int(k), int(m), a.shape, a.tobytes())
+
+
+class LaunchQueueError(RuntimeError):
+    """A ticket whose launch/finalize died; the owning backend aborts
+    its drain's ops (never other PGs')."""
+
+
+class _Sub:
+    """One backend drain's submission (all its fused runs, or its one
+    concatenated plain chunk run)."""
+    __slots__ = ("ticket", "plugin", "runs", "n_runs", "width",
+                 "nbytes", "t_submit", "owner")
+
+    def __init__(self, ticket, plugin, runs, owner):
+        self.ticket = ticket
+        self.plugin = plugin
+        self.runs = runs
+        self.n_runs = len(runs)
+        self.width = runs[0].shape[1]
+        self.nbytes = sum(r.shape[0] * r.shape[1] for r in runs)
+        self.t_submit = time.perf_counter()
+        self.owner = owner
+
+
+class _Batch:
+    """One launched super-batch.  `combined` holds the shared handle
+    (launched through the first submission's plugin) plus the demux
+    order; `per_sub` is the containment fallback — each submission
+    launched on its own plugin after a combined-launch failure."""
+
+    def __init__(self, kind: str, subs: list[_Sub]):
+        self.kind = kind
+        self.subs = subs
+        self.lock = threading.Lock()
+        # set once _do_launch has issued (or containment-retried) the
+        # device submit; finalizers wait on it, so a result() racing
+        # the launching thread never sees a half-built batch
+        self.launch_done = threading.Event()
+        # one-shot claim on the device submit: the window worker
+        # launches popped batches sequentially, so a finalizer whose
+        # batch is still unclaimed steals the launch instead of
+        # head-of-line-blocking behind another key's multi-second
+        # compile (or a CPU plugin's synchronous encode)
+        self._launch_claim = threading.Lock()
+        self.finalized = False
+        self.combined = None        # (plugin, handle)
+        self.per_sub = None         # [(sub, handle | None)]
+        self.path = None
+
+
+class LaunchTicket:
+    """What a backend drain holds instead of a plugin submit handle.
+    `result()` blocks until the super-batch containing this
+    submission has launched (forcing the launch if the window hasn't
+    fired — flush-on-demand) and finalized, then returns this
+    submission's demultiplexed share of the results."""
+
+    is_launch_ticket = True
+
+    def __init__(self, queue: "ECLaunchQueue", kind: str, key: tuple):
+        self._queue = queue
+        self.kind = kind
+        self._key = key
+        self._batch: _Batch | None = None
+        self._result = None
+        self._error: Exception | None = None
+        self._done = False
+        self.path: str | None = None
+        self.cancelled = False
+
+    @property
+    def launched(self) -> bool:
+        return self._batch is not None
+
+    def cancel(self) -> None:
+        """Withdraw a not-yet-launched submission (the owning drain
+        died during its own submit half); post-launch this is a no-op
+        and the results are simply never read."""
+        self._queue._cancel(self)
+
+    def result(self):
+        if not self._done:
+            if self._batch is None:
+                self._queue.flush(self._key)
+            batch = self._batch
+            if batch is None:
+                if self._error is None:
+                    self._error = LaunchQueueError(
+                        "launch ticket cancelled before launch")
+            else:
+                self._queue._finalize_batch(batch)
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+def _build_queue_perf(name: str):
+    from ..common.perf_counters import PerfCountersBuilder
+    return (PerfCountersBuilder(name)
+            .add_u64_counter("ec_host_launches",
+                             "super-batch device launches issued")
+            .add_u64_counter("ec_host_launch_runs",
+                             "extent runs coalesced into launches")
+            .add_u64_counter("ec_host_launch_bytes",
+                             "input bytes coalesced into launches")
+            .add_u64_counter("ec_host_launch_pg_mix",
+                             "sum of distinct submitters per launch")
+            .add_u64_counter("ec_host_cross_pg_launches",
+                             "launches coalescing >1 PG's runs")
+            .add_u64_counter("ec_host_launch_retries",
+                             "combined launches retried per-submission "
+                             "(containment)")
+            .add_u64_counter("ec_host_launch_errors",
+                             "submissions whose launch failed")
+            .add_gauge("ec_host_occupancy_pct",
+                       "last launch bytes / max super-batch bytes")
+            .add_histogram("lat_ec_batch_wait",
+                           "submit -> launch batching wait")
+            .create_perf_counters())
+
+
+class ECLaunchQueue:
+    """The per-host (per-process in the multi-process simulation,
+    where each process stands in for a host — same topology rule as
+    MeshService) EC launch queue."""
+
+    # one queue per host: the MeshService seam hands this out
+    _host: "ECLaunchQueue | None" = None
+    _host_lock = threading.Lock()
+
+    def __init__(self, window_us: float = 250.0,
+                 max_bytes: int = 32 << 20, perf=None,
+                 perf_name: str = "ec_host_queue"):
+        self.window_us = float(window_us)
+        self.max_bytes = max(1, int(max_bytes))
+        self.perf = perf if perf is not None \
+            else _build_queue_perf(perf_name)
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        # aggregates have their own leaf lock: launch/finalize threads
+        # bump error counters while holding a batch lock, and must not
+        # contend with (or deadlock against) the pending-queue lock
+        self._stats_lock = threading.Lock()
+        self._pending: dict[tuple, list[_Sub]] = {}
+        self._pending_bytes: dict[tuple, int] = {}
+        self._deadline: float | None = None
+        self._worker: threading.Thread | None = None
+        self._closed = False
+        self.created_at = time.time()
+        # aggregates for status()
+        self.launches = 0
+        self.launched_runs = 0
+        self.launched_bytes = 0
+        self.launched_subs = 0
+        self.pg_mix_total = 0
+        self.cross_pg_launches = 0
+        self.launch_retries = 0
+        self.launch_errors = 0
+        self.last_launch: dict | None = None
+
+    # -- host singleton (MeshService wiring rides this) ----------------------
+
+    @classmethod
+    def host_instance(cls, window_us: float | None = None,
+                      max_bytes: int | None = None) -> "ECLaunchQueue":
+        """The host's queue, built on first use (first caller's knobs
+        win — one queue per host is the deployment contract, like the
+        mesh shape)."""
+        with cls._host_lock:
+            if cls._host is None:
+                kw = {}
+                if window_us is not None:
+                    kw["window_us"] = window_us
+                if max_bytes is not None:
+                    kw["max_bytes"] = max_bytes
+                cls._host = cls(**kw)
+            return cls._host
+
+    @classmethod
+    def host_get(cls) -> "ECLaunchQueue | None":
+        return cls._host
+
+    @classmethod
+    def reset_host(cls) -> None:
+        """Tests only (in-flight tickets of the old queue still
+        resolve through their own references)."""
+        with cls._host_lock:
+            if cls._host is not None:
+                cls._host.close()
+            cls._host = None
+
+    # -- submission ----------------------------------------------------------
+
+    def submit_extents(self, plugin, runs: list[np.ndarray],
+                       owner=None) -> LaunchTicket:
+        """Queue a drain's fused append runs (each (k, Wi) uint8) for
+        a coalesced `encode_extents_with_crc_submit` launch;
+        `result()` yields the per-run (parity, l, tail, body) tuples
+        in this submission's run order."""
+        return self._submit("x", plugin, [
+            np.ascontiguousarray(r, dtype=np.uint8) for r in runs],
+            owner)
+
+    def submit_chunks(self, plugin, chunks: np.ndarray,
+                      owner=None) -> LaunchTicket:
+        """Queue a drain's concatenated plain (k, W) run for a
+        coalesced parity-only launch; `result()` yields this
+        submission's (m, W) parity columns."""
+        return self._submit("c", plugin, [
+            np.ascontiguousarray(chunks, dtype=np.uint8)], owner)
+
+    def _submit(self, kind: str, plugin, runs, owner) -> LaunchTicket:
+        key = (kind,) + codec_signature(plugin)
+        ticket = LaunchTicket(self, kind, key)
+        sub = _Sub(ticket, plugin, runs, owner)
+        batch = None
+        with self._lock:
+            self._pending.setdefault(key, []).append(sub)
+            nb = self._pending_bytes.get(key, 0) + sub.nbytes
+            self._pending_bytes[key] = nb
+            if nb >= self.max_bytes or self.window_us <= 0:
+                # occupancy cap reached (or batching disabled): launch
+                # this key's super-batch immediately
+                batch = self._pop_batch_locked(key)
+            else:
+                self._arm_window_locked()
+        if batch is not None:
+            self._do_launch(batch)
+        return ticket
+
+    def _cancel(self, ticket: LaunchTicket) -> None:
+        with self._lock:
+            subs = self._pending.get(ticket._key)
+            if subs:
+                for sub in subs:
+                    if sub.ticket is ticket:
+                        subs.remove(sub)
+                        self._pending_bytes[ticket._key] -= sub.nbytes
+                        if not subs:
+                            del self._pending[ticket._key]
+                            del self._pending_bytes[ticket._key]
+                        if not self._pending:
+                            self._deadline = None
+                        break
+        ticket.cancelled = True
+
+    # -- window --------------------------------------------------------------
+
+    def _arm_window_locked(self) -> None:
+        """First pending submission of a window sets the deadline (a
+        later submit never extends it) and wakes the single persistent
+        window worker — NOT a fresh Timer thread per window, which at
+        a 250 us default would be thousands of thread spawns per
+        second on the write hot path."""
+        if self._deadline is None:
+            self._deadline = time.perf_counter() + self.window_us / 1e6
+            self._cv.notify()
+        if self._worker is None:
+            self._worker = threading.Thread(
+                target=self._window_loop, daemon=True,
+                name="ec-launch-window")
+            self._worker.start()
+
+    def close(self) -> None:
+        """Flush pending batches and retire the window worker.  For
+        throwaway queues (benches, tests) — a host queue lives for
+        the process.  Tickets submitted after close still launch via
+        byte cap or flush-on-demand; only the window stops firing."""
+        self.flush()
+        with self._lock:
+            self._closed = True
+            self._cv.notify()
+
+    def _window_loop(self) -> None:
+        while True:
+            with self._cv:
+                if self._closed:
+                    return
+                if self._deadline is None:
+                    self._cv.wait()
+                    continue
+                delay = self._deadline - time.perf_counter()
+                if delay > 0:
+                    self._cv.wait(delay)
+                    continue
+                batches = [self._pop_batch_locked(k)
+                           for k in list(self._pending)
+                           if self._pending.get(k)]
+                self._deadline = None
+            for batch in batches:
+                self._do_launch(batch)
+
+    def flush(self, key: tuple | None = None) -> None:
+        """Launch pending super-batches now (all keys, or one):
+        flush-on-demand for tickets finalized before the window
+        fires, and the idle-flush hook."""
+        with self._lock:
+            keys = [key] if key is not None else list(self._pending)
+            batches = [self._pop_batch_locked(k) for k in keys
+                       if self._pending.get(k)]
+        for batch in batches:
+            self._do_launch(batch)
+
+    # -- launch --------------------------------------------------------------
+
+    def _pop_batch_locked(self, key: tuple) -> _Batch:
+        """Under self._lock: claim a key's pending submissions as one
+        batch, bind every ticket to it (so a racing result() waits on
+        the batch instead of re-flushing an empty key), and account
+        the launch.  The device submit itself happens OUTSIDE the
+        queue lock in _do_launch — a multi-second first-bucket compile
+        (or a CPU plugin's synchronous encode) must stall only this
+        batch, not every PG's submit path on the host."""
+        subs = self._pending.pop(key)
+        self._pending_bytes.pop(key, None)
+        if not self._pending:
+            self._deadline = None
+        batch = _Batch(key[0], subs)
+        now = time.perf_counter()
+        for s in subs:
+            s.ticket._batch = batch
+            if self.perf:
+                self.perf.hinc("lat_ec_batch_wait", now - s.t_submit)
+        nbytes = sum(s.nbytes for s in subs)
+        nruns = sum(s.n_runs for s in subs)
+        owners = {s.owner for s in subs}
+        # a single submission larger than max_bytes launches alone and
+        # oversizes the batch (the cap is checked after append); clamp
+        # so the gauge stays a percentage
+        occupancy = min(100.0, 100.0 * nbytes / self.max_bytes)
+        with self._stats_lock:
+            self.launches += 1
+            self.launched_runs += nruns
+            self.launched_bytes += nbytes
+            self.launched_subs += len(subs)
+            self.pg_mix_total += len(owners)
+            if len(owners) > 1:
+                self.cross_pg_launches += 1
+            self.last_launch = {"runs": nruns, "bytes": nbytes,
+                                "submissions": len(subs),
+                                "pg_mix": len(owners),
+                                "occupancy_pct": round(occupancy, 2)}
+        if self.perf:
+            self.perf.inc("ec_host_launches")
+            self.perf.inc("ec_host_launch_runs", nruns)
+            self.perf.inc("ec_host_launch_bytes", nbytes)
+            self.perf.inc("ec_host_launch_pg_mix", len(owners))
+            if len(owners) > 1:
+                self.perf.inc("ec_host_cross_pg_launches")
+            self.perf.set("ec_host_occupancy_pct", round(occupancy, 2))
+        return batch
+
+    def _note_launch_error(self) -> None:
+        with self._stats_lock:
+            self.launch_errors += 1
+        if self.perf:
+            self.perf.inc("ec_host_launch_errors")
+
+    def _do_launch(self, batch: _Batch) -> None:
+        if not batch._launch_claim.acquire(blocking=False):
+            # another thread owns the submit (a finalizer stole its
+            # batch's launch, or vice versa); it sets launch_done
+            return
+        subs = batch.subs
+        kind = batch.kind
+        try:
+            plugin = subs[0].plugin
+            if kind == "x":
+                all_runs = [r for s in subs for r in s.runs]
+                handle = plugin.encode_extents_with_crc_submit(all_runs)
+                batch.path = handle.get("path") \
+                    if isinstance(handle, dict) else None
+            else:
+                bigs = [s.runs[0] for s in subs]
+                big = np.concatenate(bigs, axis=1) if len(bigs) > 1 \
+                    else bigs[0]
+                if hasattr(plugin, "encode_chunks_submit"):
+                    if len(bigs) > 1:
+                        # launch-shape bucketing (see bitsliced.py):
+                        # a jit'd plugin would recompile per distinct
+                        # super-batch width — pad coalesced launches
+                        # to the next power of two (zero columns
+                        # encode to zero parity; the column demux
+                        # never reads them)
+                        w = big.shape[1]
+                        w2 = next_pow2(w)
+                        if w2 != w:
+                            big = np.concatenate(
+                                [big, np.zeros((big.shape[0], w2 - w),
+                                               dtype=np.uint8)],
+                                axis=1)
+                    handle = ("h", plugin.encode_chunks_submit(big))
+                else:
+                    # host-synchronous CPU plugins: ONE concatenated
+                    # encode for the whole super-batch (fewer, larger
+                    # host matmuls — the CPU analog of occupancy)
+                    handle = ("np", np.asarray(plugin.encode_chunks(big)))
+            batch.combined = (plugin, handle)
+        except Exception:  # noqa: BLE001 — containment retry
+            # a poison submission must fail only its owner: launch
+            # each submission on its OWN plugin, recording per-ticket
+            # errors instead of failing the super-batch wholesale
+            with self._stats_lock:
+                self.launch_retries += 1
+            if self.perf:
+                self.perf.inc("ec_host_launch_retries")
+            batch.per_sub = []
+            for s in subs:
+                try:
+                    if kind == "x":
+                        h = s.plugin.encode_extents_with_crc_submit(
+                            s.runs)
+                    elif hasattr(s.plugin, "encode_chunks_submit"):
+                        h = ("h", s.plugin.encode_chunks_submit(
+                            s.runs[0]))
+                    else:
+                        h = ("np", np.asarray(
+                            s.plugin.encode_chunks(s.runs[0])))
+                    batch.per_sub.append((s, h))
+                except Exception as e:  # noqa: BLE001 — the poison sub
+                    self._note_launch_error()
+                    s.ticket._error = LaunchQueueError(
+                        f"launch failed for this submission: {e!r}")
+                    s.ticket._error.__cause__ = e
+                    s.ticket._done = True
+                    batch.per_sub.append((s, None))
+        finally:
+            for s in subs:
+                s.runs = None   # the launch holds the staged arrays now
+            batch.launch_done.set()
+
+    # -- finalize ------------------------------------------------------------
+
+    def _finalize_batch(self, batch: _Batch) -> None:
+        """Materialize one super-batch ONCE and demultiplex each
+        submission's share onto its ticket; errors are memoized so
+        every co-batched ticket sees the same outcome.  Runs on the
+        first finalizing backend's thread (completion stays in each
+        PG's own submit order — the queue imposes no ordering across
+        PGs)."""
+        if not batch.launch_done.is_set():
+            # steal the launch if the window worker hasn't started it
+            # yet — a bound ticket must not wait behind other keys'
+            # batches in the worker's sequential loop
+            self._do_launch(batch)
+        batch.launch_done.wait()
+        with batch.lock:
+            if batch.finalized:
+                return
+            try:
+                if batch.per_sub is not None:
+                    for sub, handle in batch.per_sub:
+                        if handle is None:
+                            continue        # launch already failed
+                        try:
+                            self._finalize_sub(batch.kind, sub, handle)
+                        except Exception as e:  # noqa: BLE001
+                            self._note_launch_error()
+                            sub.ticket._error = e
+                            sub.ticket._done = True
+                else:
+                    plugin, handle = batch.combined
+                    if batch.kind == "x":
+                        res = plugin.encode_extents_with_crc_finalize(
+                            handle)
+                        pos = 0
+                        for sub in batch.subs:
+                            sub.ticket._result = \
+                                res[pos:pos + sub.n_runs]
+                            sub.ticket.path = batch.path
+                            sub.ticket._done = True
+                            pos += sub.n_runs
+                    else:
+                        kind_h, h = handle
+                        par = plugin.encode_chunks_finalize(h) \
+                            if kind_h == "h" else h
+                        col = 0
+                        for sub in batch.subs:
+                            sub.ticket._result = \
+                                par[:, col:col + sub.width]
+                            sub.ticket._done = True
+                            col += sub.width
+            except Exception as e:  # noqa: BLE001 — device finalize
+                # died: every ticket of the batch carries the error;
+                # each backend aborts ITS ops and the queue lives on
+                for sub in batch.subs:
+                    if not sub.ticket._done:
+                        self._note_launch_error()
+                        sub.ticket._error = e
+                        sub.ticket._done = True
+            finally:
+                batch.finalized = True
+
+    def _finalize_sub(self, kind: str, sub: _Sub, handle) -> None:
+        if kind == "x":
+            sub.ticket._result = \
+                sub.plugin.encode_extents_with_crc_finalize(handle)
+            sub.ticket.path = handle.get("path") \
+                if isinstance(handle, dict) else None
+        else:
+            kind_h, h = handle
+            sub.ticket._result = sub.plugin.encode_chunks_finalize(h) \
+                if kind_h == "h" else h
+        sub.ticket._done = True
+
+    # -- observability -------------------------------------------------------
+
+    def status(self) -> dict:
+        """The `launch queue status` asok payload: batching knobs,
+        launch/coalescing/occupancy aggregates, pending backlog."""
+        with self._lock:
+            pending_subs = sum(len(v) for v in self._pending.values())
+            pending_bytes = sum(self._pending_bytes.values())
+        with self._stats_lock:
+            launches = self.launches
+            return {
+                "window_us": self.window_us,
+                "max_super_batch_bytes": self.max_bytes,
+                "launches": launches,
+                "coalesced_runs": self.launched_runs,
+                "coalesced_bytes": self.launched_bytes,
+                "submissions": self.launched_subs,
+                "avg_runs_per_launch": round(
+                    self.launched_runs / launches, 2)
+                if launches else 0.0,
+                "occupancy_pct_avg": round(min(
+                    100.0, 100.0 * self.launched_bytes
+                    / (launches * self.max_bytes)), 2)
+                if launches else 0.0,
+                "cross_pg_launches": self.cross_pg_launches,
+                "pg_mix_avg": round(
+                    self.pg_mix_total / launches, 2)
+                if launches else 0.0,
+                "launch_retries": self.launch_retries,
+                "launch_errors": self.launch_errors,
+                "last_launch": self.last_launch,
+                "pending_submissions": pending_subs,
+                "pending_bytes": pending_bytes,
+                "uptime_s": round(time.time() - self.created_at, 1),
+            }

@@ -72,6 +72,7 @@ class MeshService:
     _lock = threading.Lock()
 
     def __init__(self, mesh, spec: str):
+        from .launch_queue import ECLaunchQueue
         from .mesh import DistributedStripeCodec  # noqa: F401 (doc link)
         self.mesh = mesh
         self.spec = spec
@@ -82,6 +83,11 @@ class MeshService:
         self.created_at = time.time()
         self.failures = 0
         self.last_error: str | None = None
+        # the host's EC launch queue (cross-PG continuous batching,
+        # launch_queue.py) when one has been wired; the service owns
+        # the device plane, so it also brokers the launch queue —
+        # codec-owner AND launch-queue-owner (ROADMAP item 2)
+        self.launch_queue = ECLaunchQueue.host_get()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -142,6 +148,24 @@ class MeshService:
         geometry, so production never resets a live service)."""
         with cls._lock:
             cls._instance = None
+
+    # -- launch queue (cross-PG continuous batching) ------------------------
+
+    @classmethod
+    def host_launch_queue(cls, window_us: float | None = None,
+                          max_bytes: int | None = None):
+        """The host's EC launch queue (launch_queue.ECLaunchQueue),
+        built on first use — the service seam hands it out exactly
+        like codec handles, and it works with OR without a configured
+        mesh (single-chip hosts batch across PGs too).  First caller's
+        knobs win, like the mesh shape."""
+        from .launch_queue import ECLaunchQueue
+        queue = ECLaunchQueue.host_instance(window_us=window_us,
+                                            max_bytes=max_bytes)
+        inst = cls.get()
+        if inst is not None:
+            inst.launch_queue = queue
+        return queue
 
     # -- acquisition --------------------------------------------------------
 
@@ -208,5 +232,7 @@ class MeshService:
                 f"k={k} m={m} {t}" for (k, m, t) in self._codecs),
             "failures": self.failures,
             "last_error": self.last_error,
+            "launch_queue": (self.launch_queue.status()
+                             if self.launch_queue is not None else None),
             "uptime_s": round(time.time() - self.created_at, 1),
         }

@@ -57,11 +57,14 @@ def daemon_command(argv: list[str]) -> int:
     path, prefix = argv[0], argv[1]
     extra = argv[2:]
     # multi-word prefixes ride unquoted (`daemon ASOK mesh status`,
-    # `daemon ASOK perf dump`): fold the second word into the prefix —
-    # but ONLY for the known two-word command families, so an arg typo
-    # elsewhere (`config set debug_osd` missing its value) still fails
-    # fast instead of becoming a bogus prefix
-    if len(extra) % 2 and prefix in ("perf", "config", "log", "mesh"):
+    # `daemon ASOK perf dump`, `daemon ASOK launch queue status`):
+    # fold words into the prefix while it is still a known INCOMPLETE
+    # command head — so an arg typo elsewhere (`config set debug_osd`
+    # missing its value) still fails fast instead of becoming a bogus
+    # prefix.  Parity-based folding alone cannot reach the three-word
+    # `launch queue status`, hence the head-driven loop.
+    heads = ("perf", "config", "log", "mesh", "launch", "launch queue")
+    while extra and prefix in heads:
         prefix = f"{prefix} {extra[0]}"
         extra = extra[1:]
     if len(extra) % 2:

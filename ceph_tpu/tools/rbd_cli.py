@@ -54,7 +54,14 @@ def main(argv=None) -> int:
     ap.add_argument("--from-snap", default=None,
                     help="export-diff: the base snapshot")
     add_auth_args(ap)
-    args = ap.parse_args(argv)
+    # parse_intermixed_args, not parse_args: with the greedy
+    # (command, args*) positional pattern, plain parse_args consumes
+    # the positional group BEFORE a following option and then rejects
+    # positionals after it — `rbd create --size N NAME` died with
+    # "unrecognized arguments: NAME" while `rbd create NAME --size N`
+    # worked; intermixed parsing collects positionals across option
+    # boundaries the way the reference rbd CLI accepts them
+    args = ap.parse_intermixed_args(argv)
 
     from ..rados import RadosClient
     from ..rados.client import RadosError

@@ -28,6 +28,17 @@ fi
 if [ "$rc" -eq 0 ]; then
   timeout -k 10 180 env JAX_PLATFORMS=cpu python bench.py --multichip || rc=$?
 fi
+# Many-PG continuous-batching gate (ISSUE 12, docs/PIPELINE.md "Host
+# launch queue"): the same op count spread over 1→8→32 PGs sharing one
+# per-host launch queue — aggregate GB/s at the largest fan-out must
+# keep ≥ EC_PG_SWEEP_MIN_FRAC (default 0.8) of the 1-PG rate and the
+# queue counters must show real cross-PG coalescing, so a pass-through
+# queue (PG fan-out shredding launch occupancy) fails tier-1.  The
+# 64-PG bench A/B + its coalescing asserts ride bench.py --smoke above.
+if [ "$rc" -eq 0 ]; then
+  timeout -k 10 240 env JAX_PLATFORMS=cpu python -m ceph_tpu.tools.load_harness \
+    --scenario ec-pg-sweep --pg-counts 1,8,32 --objects 96 --size 32768 || rc=$?
+fi
 # Fused-kernel variant gate (ISSUE 11, docs/FUSED_CRC.md): every
 # shipped (extract, combine) variant of the fused parity+crc kernel —
 # planar/packed/wide extraction through the XLA log-fold AND the

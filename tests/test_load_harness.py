@@ -264,3 +264,24 @@ def test_harness_cli_all_sim_scenarios_slow():
     assert len(lines) == 1
     row = json.loads(lines[0])
     assert row["metric"] == "harness_qos_sim_tenant"
+
+
+def test_ec_pg_sweep_structure_and_coalescing():
+    """The many-PG sweep driver: structure of the BENCH row, and the
+    queue counters proving cross-PG runs coalesced into shared
+    launches.  The aggregate-GB/s fraction is NOT hard-bounded here
+    (wall-clock A/B on a loaded 2-core box measures box noise; the
+    gated run is scripts/tier1.sh's, with warmed jit buckets and
+    paired passes) — min_frac=0 keeps this structural."""
+    from ceph_tpu.tools.load_harness import run_ec_pg_sweep
+    row = run_ec_pg_sweep(pg_counts=(1, 4), total_objs=16,
+                          objsize=64 << 10, passes=1, min_frac=0.0)
+    assert row["metric"] == "harness_ec_pg_sweep"
+    assert row["ok"]
+    assert set(row["agg_GBps"]) == {"1", "4"}
+    assert all(v > 0 for v in row["agg_GBps"].values())
+    assert row["launches"] >= 1
+    assert row["runs_per_launch"] > 1.0          # coalescing happened
+    assert row["cross_pg_launches"] >= 1         # ...across PGs
+    assert 0 < row["occupancy_pct"] <= 100.0
+    json.dumps(row)
