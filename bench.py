@@ -642,11 +642,88 @@ def measure_multichip(jax_codec, dcodec, on_tpu: bool,
     out["mc_repair_single_GBps"] = round(_wall_rate(
         single_repair, repair_bytes, iters) / 1e9, 3)
     out["mc_repair_batch_objects"] = nobj
+
+    # CLAY repair storm (docs/REPAIR.md): the coupled-layer single-
+    # failure repair lowered to one batched GF matmul — the mesh
+    # collective vs the host plane-solver on identical repair-plane
+    # inputs, bit-parity gated against the encoded original.  Helper
+    # bytes (d helpers x 1/q chunk) are published beside the k-shard
+    # full-read cost so the bandwidth claim stays falsifiable.
+    out.update(measure_clay_repair(dcodec, k, m, on_tpu and not quick,
+                                   phases=out["phases"]))
+
     for a, b, key in (("mc_encode_mesh_GBps", "mc_encode_single_GBps",
                        "mc_encode_speedup"),
                       ("mc_repair_mesh_GBps", "mc_repair_single_GBps",
-                       "mc_repair_speedup")):
-        out[key] = round(out[a] / out[b], 3) if out[b] else None
+                       "mc_repair_speedup"),
+                      ("clay_repair_GBps", "clay_repair_host_GBps",
+                       "clay_repair_speedup")):
+        out[key] = round(out[a] / out[b], 3) if out.get(b) else None
+    return out
+
+
+def measure_clay_repair(dcodec, k: int, m: int, big: bool,
+                        phases: dict | None = None) -> dict:
+    """clay_repair_GBps: a storm of `nobj` objects that each lost the
+    same chunk of a CLAY (k, m, d=k+m-1) pool, rebuilt from repair-
+    plane reads only.  A/B: ONE mesh collective launch over the
+    batched repair plan (`clay_repair_batch`) vs the per-object host
+    plane-solver (`repair()`), both bit-parity-gated against the
+    encoded originals.  Accounting matches mc_repair: original-object
+    bytes per pass."""
+    from ceph_tpu.ec import ErasureCodePluginRegistry
+    from ceph_tpu.parallel.mesh import ClayRepairPlan
+    clay = ErasureCodePluginRegistry.instance().factory(
+        "clay", {"k": str(k), "m": str(m)})      # d = k+m-1
+    n = k + m
+    sub = clay.get_sub_chunk_count()
+    sub_size = 2048 if big else 128
+    chunk = sub * sub_size
+    nobj = 8 if big else 3
+    iters = 6 if big else 3
+    lost = 2                                     # a data shard
+    plan = ClayRepairPlan.build(clay, lost)
+    planes = clay.repair_planes(lost)
+    rng = np.random.default_rng(17)
+    rows_list, helpers_list, originals = [], [], []
+    for i in range(nobj):
+        payload = rng.integers(0, 256, k * chunk,
+                               dtype=np.uint8).tobytes()
+        enc = clay.encode(set(range(n)), payload)
+        helpers = {ch: np.asarray(enc[ch]).reshape(sub, sub_size)[planes]
+                   for ch in plan.helper_ids}
+        helpers_list.append(helpers)
+        rows_list.append(clay.repair_rows(lost, helpers))
+        originals.append(np.asarray(enc[lost]))
+
+    def mesh_clay():
+        return dcodec.clay_repair_batch(plan, rows_list)
+
+    def host_clay():
+        return [clay.repair(lost, h, sub_size) for h in helpers_list]
+
+    reb_mesh = mesh_clay()
+    reb_host = host_clay()
+    ok = True
+    for i in range(nobj):
+        ok = ok and np.array_equal(
+            np.asarray(reb_mesh[i]).reshape(-1), originals[i])
+        ok = ok and np.array_equal(reb_host[i], originals[i])
+    if phases is not None:
+        phases["clay_repair_parity"] = bool(ok)
+    nbytes = nobj * k * chunk                    # original-object bytes
+    out = {
+        "clay_repair_GBps": round(_wall_rate(
+            mesh_clay, nbytes, iters) / 1e9, 3),
+        "clay_repair_host_GBps": round(_wall_rate(
+            host_clay, nbytes, iters) / 1e9, 3),
+        "clay_repair_batch_objects": nobj,
+        "clay_sub_chunks": sub,
+        "clay_d": clay.d,
+        # the bandwidth claim, falsifiable: plane reads vs k full chunks
+        "clay_helper_bytes_per_obj": clay.d * len(planes) * sub_size,
+        "clay_full_read_bytes_per_obj": k * chunk,
+    }
     return out
 
 
@@ -711,9 +788,16 @@ def run_multichip() -> int:
                             "mc_encode_crc_single_GBps",
                             "mc_repair_mesh_GBps",
                             "mc_encode_single_GBps",
-                            "mc_repair_single_GBps")
+                            "mc_repair_single_GBps",
+                            "clay_repair_GBps",
+                            "clay_repair_host_GBps")
             if not isinstance(out.get(key), (int, float))
             or out[key] <= 0]
+    # the CLAY bandwidth claim itself is a gate: plane reads must
+    # undercut the RS k-shard full read
+    if not (0 < out.get("clay_helper_bytes_per_obj", 0) <
+            out.get("clay_full_read_bytes_per_obj", 0)):
+        bad.append("clay_helper_bytes_per_obj")
     if bad:
         print(f"# multichip FAILED: {bad}", file=sys.stderr)
         return 1
@@ -775,6 +859,156 @@ def check_fused_kernel_smoke(out: dict) -> str | None:
     return None
 
 
+def check_clay_repair_smoke(out: dict) -> str | None:
+    """--smoke gate (docs/REPAIR.md): the CLAY repair lowering must be
+    bit-exact at both deployed geometries — the batched device plan
+    (jitted XLA bit-sliced matmul) vs the host plane-solver vs the
+    full-decode oracle — and the plane-read helper bytes must undercut
+    the RS k-shard baseline.  Returns an error string, or None."""
+    from ceph_tpu.ec import ErasureCodePluginRegistry
+    from ceph_tpu.parallel.mesh import ClayRepairPlan
+    reg = ErasureCodePluginRegistry.instance()
+    rng = np.random.default_rng(29)
+    for k, m in ((4, 2), (8, 3)):
+        clay = reg.factory("clay", {"k": str(k), "m": str(m)})
+        n = k + m
+        sub = clay.get_sub_chunk_count()
+        sub_size = 16
+        payload = rng.integers(0, 256, k * sub * sub_size,
+                               dtype=np.uint8).tobytes()
+        enc = clay.encode(set(range(n)), payload)
+        dense = np.stack([np.asarray(enc[i]) for i in range(n)])
+        lost = 1
+        erased = dense.copy()
+        erased[lost] = 0
+        full = clay.decode_chunks(erased, [lost])[lost]
+        if not np.array_equal(full, dense[lost]):
+            return f"clay full decode diverged at k={k},m={m}"
+        plan = ClayRepairPlan.build(clay, lost)
+        planes = clay.repair_planes(lost)
+        helpers = {ch: dense[ch].reshape(sub, sub_size)[planes]
+                   for ch in plan.helper_ids}
+        rows = clay.repair_rows(lost, helpers)
+        host = clay.repair(lost, helpers, sub_size)
+        dev = plan.apply_device(rows).reshape(-1)
+        if not np.array_equal(host, full):
+            return f"clay repair() != full decode at k={k},m={m}"
+        if not np.array_equal(dev, full):
+            return (f"clay device plan != host plane-solver at "
+                    f"k={k},m={m}")
+        helper_bytes = clay.d * len(planes) * sub_size
+        if helper_bytes >= k * sub * sub_size:
+            return (f"clay helper bytes {helper_bytes} not below the "
+                    f"k-shard baseline {k * sub * sub_size}")
+        out[f"clay_helper_frac_k{k}m{m}"] = round(
+            helper_bytes / (k * sub * sub_size), 3)
+    out["clay_repair_parity"] = True
+    return None
+
+
+def check_degraded_read_smoke(out: dict) -> str | None:
+    """--smoke gate (docs/REPAIR.md): k=8,m=3 client reads during a
+    shard-loss storm — a data shard down, background rebuild running
+    concurrently — must ALL complete via reconstruct-on-read served by
+    the batched decode path (perf counter + launch-queue decode
+    launches asserted), zero loss, p99 published as
+    degraded_read_p99_ms."""
+    from ceph_tpu.common.perf_counters import percentiles_from_samples
+    from ceph_tpu.ec import ErasureCodePluginRegistry
+    from ceph_tpu.osd.ec_backend import ECBackend, LocalShardBackend
+    from ceph_tpu.osd.ec_transaction import PGTransaction
+    from ceph_tpu.osd.ec_util import StripeInfo
+    from ceph_tpu.osd.types import eversion_t, hobject_t, pg_t
+    from ceph_tpu.parallel.launch_queue import ECLaunchQueue
+    from ceph_tpu.store import MemStore
+    import threading
+
+    class DegradedShards(LocalShardBackend):
+        down: set = set()
+
+        def sub_read(self, shard, oid, off, length, on_done):
+            if shard in self.down:
+                on_done(shard, None)
+                return
+            super().sub_read(shard, oid, off, length, on_done)
+
+    K_, M_, CH = 8, 3, 1024
+    reg = ErasureCodePluginRegistry.instance()
+    codec = reg.factory("jax", {"k": str(K_), "m": str(M_),
+                                "technique": "cauchy"})
+    store = MemStore()
+    store.mount()
+    shards = DegradedShards(store, pg_t(1, 0), K_ + M_)
+    queue = ECLaunchQueue(window_us=500.0)
+    try:
+        be = ECBackend(codec, StripeInfo(K_ * CH, CH), shards,
+                       launch_queue=queue, read_timeout=5.0)
+        rng = np.random.default_rng(31)
+        nobj = 8
+        payloads = {}
+        acked = []
+        for i in range(nobj):
+            oid = hobject_t(pool=1, name=f"dr{i}")
+            p = rng.integers(0, 256, K_ * CH * 2, dtype=np.uint8)
+            payloads[oid] = p
+            txn = PGTransaction()
+            txn.write(oid, 0, p)
+            be.submit_transaction(txn, eversion_t(1, i + 1),
+                                  lambda: acked.append(1))
+        if len(acked) != nobj:
+            return f"degraded-read smoke: {len(acked)}/{nobj} acked"
+        shards.down = {2}                    # lose a data shard
+        # the storm: background rebuild of every object runs while the
+        # client reads land (pushes go nowhere — the point is the
+        # concurrent decode load, not the store writes)
+        def rebuild():
+            be.recover_shards_batch(
+                [(oid, [2]) for oid in payloads],
+                lambda _oid: (lambda s, d, h: None))
+        storm = threading.Thread(target=rebuild, daemon=True)
+        storm.start()
+        be.read(next(iter(payloads)))        # warm the decode plan
+        samples = []
+        bad = 0
+        for _pass in range(2):
+            for oid, p in payloads.items():
+                t0 = time.perf_counter()
+                got = be.read(oid)
+                samples.append(time.perf_counter() - t0)
+                if not np.array_equal(got, p):
+                    bad += 1
+        storm.join(timeout=30)
+        pcts = percentiles_from_samples(samples, [(0.99, "p99"),
+                                                  (0.5, "p50")])
+        out["degraded_read_p99_ms"] = round(pcts.get("p99", 0.0) * 1e3,
+                                            3)
+        out["degraded_read_p50_ms"] = round(pcts.get("p50", 0.0) * 1e3,
+                                            3)
+        out["degraded_read_reads"] = len(samples)
+        out["degraded_read_zero_loss"] = bad == 0
+        d = be.perf.dump()
+        out["degraded_read_reconstructs"] = int(
+            d.get("ec_reconstruct_reads", 0))
+        out["degraded_read_decode_launches"] = \
+            queue.status()["decode_launches"]
+        if bad:
+            return f"{bad} degraded reads returned wrong bytes"
+        if d.get("ec_reconstruct_reads", 0) < len(samples):
+            return ("degraded reads not served by reconstruct-on-read "
+                    f"({d.get('ec_reconstruct_reads')}/{len(samples)})")
+        if queue.status()["decode_launches"] < 1:
+            return "reconstruct-on-read bypassed the batched decode path"
+        p99_max = float(os.environ.get("DEGRADED_READ_P99_MAX_MS",
+                                       "2000.0"))
+        if not out["degraded_read_p99_ms"] or \
+                out["degraded_read_p99_ms"] > p99_max:
+            return (f"degraded_read_p99_ms="
+                    f"{out['degraded_read_p99_ms']} > {p99_max}")
+        return None
+    finally:
+        queue.close()
+
+
 def run_smoke() -> int:
     """CPU-mode smoke for tier-1 (scripts/tier1.sh): tiny sizes, runs
     the full end-to-end benches, and asserts the published JSON keys
@@ -786,6 +1020,8 @@ def run_smoke() -> int:
     out = bench_end_to_end(on_tpu=False, passes=1, spacing=0.0)
     out["metric"] = "ec_write_pipeline_smoke"
     fused_why = check_fused_kernel_smoke(out)   # fills ec_fused_path
+    clay_why = check_clay_repair_smoke(out)     # fills clay_* keys
+    degraded_why = check_degraded_read_smoke(out)  # degraded_read_*
     print(json.dumps(out))
     missing = [k for k in SMOKE_KEYS
                if not isinstance(out.get(k), (int, float))
@@ -806,6 +1042,17 @@ def run_smoke() -> int:
     # TPU round
     if fused_why is not None:
         print(f"# smoke FAILED: {fused_why}", file=sys.stderr)
+        return 1
+    # repair-subsystem guards (docs/REPAIR.md): CLAY repair bit-parity
+    # (device plan vs host plane-solver vs full decode, helper bytes
+    # under the k-shard baseline) and the degraded-read SLO — client
+    # reads during a shard-loss storm complete via reconstruct-on-read
+    # through the batched decode path, zero loss, p99 published
+    if clay_why is not None:
+        print(f"# smoke FAILED: {clay_why}", file=sys.stderr)
+        return 1
+    if degraded_why is not None:
+        print(f"# smoke FAILED: {degraded_why}", file=sys.stderr)
         return 1
     # many-PG continuous-batching guard (ISSUE 12): aggregate GB/s
     # through 64 PGs sharing the host launch queue must stay within

@@ -113,6 +113,26 @@ register_options([
            "also how tenant classes get their QoS triples"),
     Option("osd_max_backfills", int, 1,
            "concurrent recovery ops per OSD", min=1),
+    # repair subsystem (docs/REPAIR.md)
+    Option("osd_ec_read_timeout", float, 30.0,
+           "seconds a degraded EC client-read fan-out waits for shard "
+           "replies before widening to parity shards / giving up; "
+           "expiries count in the ec_read_timeouts perf counter "
+           "(was a hardcoded 30 s in ec_backend.read)", min=0.05),
+    Option("osd_ec_clay_repair", bool, True,
+           "serve single-shard repair of sub-chunked (CLAY) pools "
+           "from repair-plane reads + the batched GF-matmul repair "
+           "plan (1/q of each helper chunk read, d helpers); off = "
+           "always full-read decode"),
+    Option("osd_recovery_max_bytes_per_sec", int, 0,
+           "repair-bandwidth throttle: cap on rebuilt shard bytes "
+           "pushed per second per OSD (token bucket; 0 = unlimited).  "
+           "Client reads of degraded objects are NOT throttled — they "
+           "reconstruct inline via reconstruct-on-read", min=0),
+    Option("osd_recovery_sleep", float, 0.0,
+           "seconds to pause between recovery object pushes "
+           "(reference osd_recovery_sleep); coarse-grain brake "
+           "alongside the byte-rate throttle", min=0.0),
     Option("osd_scrub_auto", bool, False, "run background scrub"),
     Option("osd_scrub_interval", float, 60.0,
            "seconds between background shallow scrubs (reference "

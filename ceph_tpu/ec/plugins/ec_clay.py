@@ -67,6 +67,9 @@ class ErasureCodeClay(ErasureCode):
         self.nu = 0                       # virtual (shortening) nodes
         self.sub_chunks = 0
         self.H: np.ndarray | None = None  # (m, N) parity check of base MDS
+        # cached single-failure repair matrices (the device lowering,
+        # docs/REPAIR.md): (lost, helper tuple) -> (sub_chunks, d*P)
+        self._repair_mats: dict[tuple, np.ndarray] = {}
 
     # -- setup --------------------------------------------------------------
 
@@ -421,6 +424,81 @@ class ErasureCodeClay(ErasureCode):
                 # C_B@z' = g U_A + U_B
                 out[zpi] = lut[GAMMA][u_a] ^ u_b
         return out.reshape(-1)
+
+    # -- device lowering: repair as ONE GF(2^8) matrix -----------------------
+    #
+    # Every step of repair() is GF(2^8)-linear in the helper symbols:
+    # the pairwise decouple transform is a constant 2x2 GF matrix, the
+    # per-plane solve inverts a system whose coefficient matrix depends
+    # only on the erasure pattern (never the data), and the final
+    # re-coupling is again constant gf_muls and XORs.  The whole
+    # coupled-layer contraction therefore collapses to a single
+    # (sub_chunks x d*P) matrix R over GF(2^8) applied to the stacked
+    # helper repair-plane symbols — which is exactly the shape the
+    # TPU/mesh data plane wants: one batched GF matmul per
+    # (lost, helpers) group, objects concatenated along the byte axis
+    # (parallel/mesh.py ClayRepairPlan / clay_repair_batch).  R is
+    # extracted by probing repair() with an identity payload: helper
+    # h's plane row p carries unit vector e_{h*P+p} (sub_size = d*P),
+    # so the output IS the matrix, in one host repair call.
+
+    def repair_helper_order(self, lost_chunk: int,
+                            helper_ids=None) -> tuple[int, ...]:
+        """Canonical helper row order of the repair matrix (sorted
+        real chunk ids); helper h at index hi owns input rows
+        [hi*P, (hi+1)*P)."""
+        if helper_ids is None:
+            helper_ids = self.choose_helpers(
+                lost_chunk,
+                set(range(self.get_chunk_count())) - {lost_chunk})
+            if helper_ids is None:
+                raise ErasureCodeError(
+                    errno.EIO, f"clay: no helper set for {lost_chunk}")
+        return tuple(sorted(helper_ids))
+
+    def repair_matrix(self, lost_chunk: int,
+                      helper_ids=None) -> np.ndarray:
+        """(sub_chunks, d*P) GF(2^8) matrix R with
+        rebuilt_chunk = R @ rows, rows[hi*P + p] = helper hi's p-th
+        repair-plane sub-chunk (repair_helper_order order).  Cached
+        per (lost, helpers) — the plane-by-plane host solver runs once
+        per geometry, every later repair is a matmul."""
+        helpers = self.repair_helper_order(lost_chunk, helper_ids)
+        key = (lost_chunk, helpers)
+        hit = self._repair_mats.get(key)
+        if hit is not None:
+            return hit
+        P = len(self.repair_planes(lost_chunk))
+        J = self.d * P
+        probes = {}
+        for hi, ch in enumerate(helpers):
+            arr = np.zeros((P, J), dtype=np.uint8)
+            arr[np.arange(P), hi * P + np.arange(P)] = 1
+            probes[ch] = arr
+        mat = self.repair(lost_chunk, probes, J) \
+            .reshape(self.sub_chunks, J)
+        self._repair_mats[key] = mat
+        return mat
+
+    def repair_rows(self, lost_chunk: int,
+                    helper_planes: dict[int, np.ndarray],
+                    helper_ids=None) -> np.ndarray:
+        """Stack a repair() helper dict into the (d*P, sub_size) row
+        layout repair_matrix expects."""
+        helpers = self.repair_helper_order(
+            lost_chunk, helper_ids if helper_ids is not None
+            else helper_planes.keys())
+        return np.concatenate(
+            [np.asarray(helper_planes[ch], dtype=np.uint8)
+             for ch in helpers], axis=0)
+
+    def repair_signature(self, lost_chunk: int,
+                         helper_ids=None) -> tuple:
+        """Cache/coalescing key of one repair plan: geometry +
+        (lost, helpers) fully determine the matrix (the base MDS
+        parity check is derived from (k+nu, m) deterministically)."""
+        return ("clay", self.k, self.m, self.d, lost_chunk,
+                self.repair_helper_order(lost_chunk, helper_ids))
 
 
 class ErasureCodePluginClay(ErasureCodePlugin):

@@ -66,14 +66,13 @@ def interleave_bitmatrix(mat: np.ndarray) -> np.ndarray:
     """
     r, k = mat.shape
     math_layout = gf.expand_to_bitmatrix(mat)          # (8r, 8k) chunk-major
-    out = np.zeros_like(math_layout)
-    for ri in range(r):
-        for i in range(8):
-            for cj in range(k):
-                for j in range(8):
-                    out[i * r + ri, j * k + cj] = \
-                        math_layout[ri * 8 + i, cj * 8 + j]
-    return out
+    # pure index shuffle, vectorized: the CLAY repair lowering feeds
+    # matrices of hundreds of rows/columns through here (81 x 272 at
+    # k=8,m=3 vs the (m, k) encode matrices), where the elementwise
+    # python loop costs seconds per plan build
+    return np.ascontiguousarray(
+        math_layout.reshape(r, 8, k, 8)
+        .transpose(1, 0, 3, 2).reshape(8 * r, 8 * k))
 
 
 def _unpack_bits(block: jnp.ndarray) -> jnp.ndarray:
@@ -173,15 +172,13 @@ def _w32_bitmat(mat: np.ndarray) -> np.ndarray:
     r, k = mat.shape
     m8 = interleave_bitmatrix(mat)                     # (8r, 8k)
     out = np.zeros((32 * r, 32 * k), dtype=m8.dtype)
-    for i in range(8):
-        for ri in range(r):
-            for j in range(8):
-                for cj in range(k):
-                    v = m8[i * r + ri, j * k + cj]
-                    if v:
-                        for b in range(4):
-                            out[i * 4 * r + 4 * ri + b,
-                                j * 4 * k + 4 * cj + b] = v
+    # vectorized block-diagonal expansion (see interleave_bitmatrix on
+    # why the elementwise loop can't serve the big repair matrices):
+    # view as [i, ri, b_r, j, cj, b_c] and fill the b_r == b_c diagonal
+    o6 = out.reshape(8, r, 4, 8, k, 4)
+    m4 = m8.reshape(8, r, 8, k)
+    for b in range(4):
+        o6[:, :, b, :, :, b] = m4
     return out
 
 

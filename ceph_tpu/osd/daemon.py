@@ -403,6 +403,15 @@ class OSDDaemon:
             .add_u64_counter("subop_w", "shard sub-writes applied")
             .add_u64_counter("subop_r", "shard sub-reads served")
             .add_time_avg("op_latency", "client op latency")
+            .add_u64_counter("recovery_queued_ops",
+                             "rebuild units routed through the "
+                             "scheduler's recovery class")
+            .add_u64_counter("recovery_pushed_bytes",
+                             "rebuilt shard bytes pushed to acting "
+                             "homes")
+            .add_time_avg("recovery_throttle_wait",
+                          "time recovery pushes spent waiting on the "
+                          "bandwidth throttle")
             .add_gauge("pg_degraded", "led PGs with recovery pending")
             .add_gauge("pg_misplaced",
                        "objects with split/merge pushes pending")
@@ -458,6 +467,12 @@ class OSDDaemon:
                 "launch queue status", self._asok_launch_queue_status)
             self.cct.asok.register_command(
                 "launch_queue_status", self._asok_launch_queue_status)
+            # repair subsystem state (docs/REPAIR.md); both spellings
+            # like mesh/launch-queue
+            self.cct.asok.register_command(
+                "repair status", self._asok_repair_status)
+            self.cct.asok.register_command(
+                "repair_status", self._asok_repair_status)
         self.store = store or MemStore()
         self.store.mount()
         self._raw_tid = 1 << 32   # raw-RPC tids, disjoint from backends'
@@ -594,6 +609,10 @@ class OSDDaemon:
         # across this daemon's recovery threads
         self._recovery_sem = threading.BoundedSemaphore(
             max(1, int(conf.get("osd_max_backfills"))))
+        # repair-bandwidth throttle (docs/REPAIR.md): token-bucket
+        # timestamp shared by every recovery push on this daemon
+        self._rec_throttle_lock = threading.Lock()
+        self._rec_next_free = 0.0
         for _opt in ("ms_inject_socket_failures",
                      "ms_inject_delay_probability",
                      "ms_inject_delay_max", "ms_compress",
@@ -1078,13 +1097,14 @@ class OSDDaemon:
                         # one reservation per PG recovery (reference
                         # osd_max_backfills: concurrent backfilling PGs)
                         with self._recovery_sem:
-                            self._recover_ec_pg(pgid, acting,
-                                                unreachable, prevmap)
+                            self._run_recovery_op(
+                                lambda: self._recover_ec_pg(
+                                    pgid, acting, unreachable, prevmap))
                     else:
                         with self._recovery_sem:
-                            self._recover_replicated_pg(pgid, acting,
-                                                        prevmap,
-                                                        unreachable)
+                            self._run_recovery_op(
+                                lambda: self._recover_replicated_pg(
+                                    pgid, acting, prevmap, unreachable))
                 except ErasureCodeError as e:
                     # peering-incomplete (EAGAIN) or similar on ONE PG
                     # must not kill the recovery pass for the rest —
@@ -1103,6 +1123,66 @@ class OSDDaemon:
                         self._pgs_needing_recovery.add(pgid)
                     self.cct.dout("osd", 2,
                                   f"recovery of {pgid} deferred: {e}")
+
+    # -- prioritized recovery (docs/REPAIR.md, docs/QOS.md) -----------------
+
+    def _run_recovery_op(self, fn) -> None:
+        """Route one background rebuild unit (a PG's recovery pass)
+        through the scheduler's `recovery` class: with osd_op_queue=
+        mclock the unit dequeues under the recovery reservation/limit
+        triple — degraded-object client reads (which arrive as client-
+        class ops and reconstruct inline) preempt rebuild work instead
+        of queueing behind it.  Without the mClock queue the unit runs
+        inline on the recovery pass thread, as before."""
+        if self.op_wq is None:
+            fn()
+            return
+        done = threading.Event()
+        box: dict = {}
+
+        def thunk():
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 — re-raised below
+                box["err"] = e
+            finally:
+                done.set()
+        self.op_wq.queue(thunk, op_class="recovery")
+        self.perf.inc("recovery_queued_ops")
+        # the pass thread paces on the scheduler: wake periodically so
+        # daemon teardown never hangs on a drained queue
+        while not done.wait(0.5):
+            if self._hb_stop.is_set():
+                return
+        if "err" in box:
+            raise box["err"]
+
+    def _recovery_throttle(self, nbytes: int) -> None:
+        """Repair-bandwidth brake on rebuilt-shard pushes: a token
+        bucket at osd_recovery_max_bytes_per_sec (0 = unlimited) plus
+        the coarse osd_recovery_sleep pause.  Applied ONLY to
+        background pushes — reconstruct-on-read serves client reads
+        inline and never waits here."""
+        import time as _time
+        sleep = float(self.cct.conf.get("osd_recovery_sleep") or 0.0)
+        rate = int(self.cct.conf.get(
+            "osd_recovery_max_bytes_per_sec") or 0)
+        wait = sleep
+        if rate > 0:
+            with self._rec_throttle_lock:
+                now = _time.monotonic()
+                base = max(now, self._rec_next_free)
+                wait += max(0.0, base - now)
+                self._rec_next_free = base + nbytes / rate
+        if wait <= 0:
+            return
+        self.perf.tinc("recovery_throttle_wait", wait)
+        deadline = _time.monotonic() + wait
+        while not self._hb_stop.is_set():
+            left = deadline - _time.monotonic()
+            if left <= 0:
+                break
+            _time.sleep(min(left, 0.2))
 
     def _pg_object_names(self, pgid: pg_t, acting, shard_ids,
                          unreachable: set | None = None) -> set:
@@ -1179,11 +1259,19 @@ class OSDDaemon:
         from .ec_util import recovery_attrs
 
         def push(s, data, hinfo):
+            # background rebuild pays the repair-bandwidth throttle
+            # BEFORE the push so a tiny cap can't be overshot by a
+            # burst of already-decoded shards (docs/REPAIR.md)
+            self._recovery_throttle(int(np.asarray(data).size))
             txn = Transaction()
             goid = shard_oid(oid, s)
             txn.write(goid, 0, data)
             txn.setattrs(goid, recovery_attrs(hinfo, data))
-            self._push_shard_txn(acting[s], spg_t(pgid, s), txn)
+            # count only DELIVERED bytes: a push that times out on a
+            # dead peer must not inflate the repair ledger
+            if self._push_shard_txn(acting[s], spg_t(pgid, s), txn):
+                self.perf.inc("recovery_pushed_bytes",
+                              int(np.asarray(data).size))
         return push
 
     def _push_shard_txn(self, osd: int, spg: spg_t, txn,
@@ -2266,7 +2354,11 @@ class OSDDaemon:
                             "ec_dispatch_ahead_depth") or 2),
                         perf_name=f"ec.{pgid}",
                         logger=lambda msg: self.cct.dout(
-                            "osd", 1, msg))
+                            "osd", 1, msg),
+                        read_timeout=float(self.cct.conf.get(
+                            "osd_ec_read_timeout") or 30.0),
+                        clay_repair=bool(self.cct.conf.get(
+                            "osd_ec_clay_repair")))
                     # surface the backend's pipeline counters in this
                     # daemon's `perf dump` / prometheus scrape
                     self.cct.perf.add(backend.perf)
@@ -3307,6 +3399,52 @@ class OSDDaemon:
             "enabled": bool(self.cct.conf.get("osd_ec_host_batch")),
             "queue": queue.status() if queue is not None else None,
             "pg_queue_drains": pgs,
+        }
+
+    def _asok_repair_status(self, cmd: dict) -> dict:
+        """`ceph daemon osd.N.asok repair status` (docs/REPAIR.md):
+        recovery backlog + throttle knobs + the scheduler's recovery-
+        class serve counts, and each led EC PG's repair ledger
+        (helper-bytes-read vs reconstructed-bytes — the CLAY savings —
+        plus reconstruct-on-read / read-timeout provenance)."""
+        from ..parallel.launch_queue import ECLaunchQueue
+        with self.pg_lock:
+            pgs = {str(pgid): st.backend.repair_status()
+                   for pgid, st in self.pgs.items()
+                   if st.kind == "ec"}
+            needing = sorted(str(p)
+                             for p in self._pgs_needing_recovery)
+            inflight = self._recovery_inflight
+            unfound = {str(p): len(objs)
+                       for p, objs in self._unfound.items()}
+        sched = None
+        if self.op_wq is not None:
+            sched = self.op_wq.dump().get("classes", {}).get("recovery")
+        perf = self.perf.dump()
+        queue = ECLaunchQueue.host_get()
+        qst = queue.status() if queue is not None else {}
+        return {
+            "osd": self.osd_id,
+            "recovery": {
+                "inflight_passes": inflight,
+                "pgs_needing_recovery": needing,
+                "unfound": unfound,
+                "queued_ops": perf.get("recovery_queued_ops", 0),
+                "pushed_bytes": perf.get("recovery_pushed_bytes", 0),
+                "throttle": {
+                    "max_bytes_per_sec": int(self.cct.conf.get(
+                        "osd_recovery_max_bytes_per_sec") or 0),
+                    "sleep_s": float(self.cct.conf.get(
+                        "osd_recovery_sleep") or 0.0),
+                    "wait": perf.get("recovery_throttle_wait"),
+                },
+            },
+            "scheduler_recovery_class": sched,
+            "host_queue": {
+                "decode_launches": qst.get("decode_launches", 0),
+                "repair_launches": qst.get("repair_launches", 0),
+            },
+            "pgs": pgs,
         }
 
     def _asok_mesh_status(self, cmd: dict) -> dict:

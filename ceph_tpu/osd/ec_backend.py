@@ -258,6 +258,30 @@ def _build_ec_perf(name: str):
                              "batched distributed repair decodes")
             .add_u64_counter("ec_mesh_errors",
                              "mesh launch failures (plane fell back)")
+            # repair subsystem (docs/REPAIR.md): the CLAY savings made
+            # visible — helper bytes actually read vs bytes rebuilt —
+            # plus the degraded-read path's provenance
+            .add_u64_counter("ec_repair_helper_bytes",
+                             "survivor/helper bytes read for repair")
+            .add_u64_counter("ec_repair_reconstructed_bytes",
+                             "shard bytes rebuilt by repair decodes")
+            .add_u64_counter("ec_clay_repairs",
+                             "objects repaired from repair-plane reads "
+                             "(bandwidth-optimal CLAY path)")
+            .add_u64_counter("ec_clay_repair_launches",
+                             "batched CLAY repair-plan launches")
+            .add_u64_counter("ec_clay_repair_fallbacks",
+                             "CLAY plane-read repairs that fell back "
+                             "to the full-read decode path")
+            .add_u64_counter("ec_reconstruct_reads",
+                             "degraded client reads served by "
+                             "reconstruct-on-read")
+            .add_u64_counter("ec_reconstruct_read_bytes",
+                             "logical bytes served by "
+                             "reconstruct-on-read")
+            .add_u64_counter("ec_read_timeouts",
+                             "client-read shard fan-outs that hit "
+                             "osd_ec_read_timeout")
             .create_perf_counters())
 
 
@@ -266,7 +290,9 @@ class ECBackend:
                  shards: ShardBackend, log: PGLog | None = None,
                  mesh_codec=None, mesh_service=None,
                  launch_queue=None, dispatch_depth: int = 2,
-                 perf=None, perf_name: str = "ec", logger=None):
+                 perf=None, perf_name: str = "ec", logger=None,
+                 read_timeout: float = 30.0,
+                 clay_repair: bool = True):
         self.ec_impl = ec_impl
         self.sinfo = sinfo
         self.shards = shards
@@ -319,6 +345,16 @@ class ECBackend:
         # acks, and failure containment stay per-PG; the queue only
         # owns the launch.
         self._launch_queue = launch_queue
+        # degraded-read fan-out wait (conf osd_ec_read_timeout): was a
+        # hardcoded 30 s; timeouts now count (ec_read_timeouts) instead
+        # of silently shaping latency
+        self.read_timeout = max(0.05, float(read_timeout))
+        # CLAY plane-read repair (docs/REPAIR.md): when the plugin is
+        # sub-chunked with a repair lowering, single-shard recovery
+        # reads only the repair planes of d helpers and rebuilds via a
+        # batched GF matmul; off = always full-read decode
+        self._clay_repair = bool(clay_repair)
+        self._clay_plans: dict[tuple, object] = {}
         self.log = log or PGLog()
         self.lock = threading.RLock()
         self.waiting_state: list[ECOp] = []
@@ -419,6 +455,35 @@ class ECBackend:
                 "ec_fused_kernel_drains"
                 if path and path.startswith("hier")
                 else "ec_fused_fallback_drains")
+
+    def repair_status(self) -> dict:
+        """Per-PG repair state (surfaced by the OSD's `repair status`
+        asok, docs/REPAIR.md): the helper-bytes-read vs
+        reconstructed-bytes ledger — the CLAY savings made visible —
+        plus reconstruct-on-read and read-timeout provenance."""
+        dump = self.perf.dump() if self.perf else {}
+
+        def u64(key):
+            v = dump.get(key, 0)
+            return int(v) if isinstance(v, (int, float)) else 0
+        helper = u64("ec_repair_helper_bytes")
+        rebuilt = u64("ec_repair_reconstructed_bytes")
+        return {
+            "helper_bytes_read": helper,
+            "reconstructed_bytes": rebuilt,
+            "helper_bytes_per_rebuilt": round(helper / rebuilt, 3)
+            if rebuilt else None,
+            "clay_repairs": u64("ec_clay_repairs"),
+            "clay_repair_launches": u64("ec_clay_repair_launches"),
+            "clay_repair_fallbacks": u64("ec_clay_repair_fallbacks"),
+            "clay_plans_cached": len(self._clay_plans),
+            "mesh_repair_launches": u64("ec_mesh_repair_launches"),
+            "reconstruct_reads": u64("ec_reconstruct_reads"),
+            "reconstruct_read_bytes": u64("ec_reconstruct_read_bytes"),
+            "read_timeouts": u64("ec_read_timeouts"),
+            "read_timeout_s": self.read_timeout,
+            "clay_plane_repair": self._clay_repair,
+        }
 
     def mesh_status(self) -> dict:
         """Per-backend plane state (surfaced by the OSD's
@@ -1252,6 +1317,17 @@ class ECBackend:
 
     def read(self, oid: hobject_t, off: int = 0,
              length: int | None = None) -> np.ndarray:
+        """Client read.  Healthy path: the k data shards answer and the
+        logical bytes reassemble without a decode.  Degraded path
+        (reconstruct-on-read, docs/REPAIR.md): any data-shard failure
+        fans out to the parity shards IMMEDIATELY — known-down holders
+        fail synchronously, so a degraded object pays one extra fan-out,
+        not a timeout — and the missing rows rebuild through the
+        batched decode path (launch queue / mesh / plugin decode), the
+        same machinery background repair uses.  The fan-out wait is
+        `osd_ec_read_timeout` (was a hardcoded 30 s) and every expiry
+        counts in ec_read_timeouts instead of silently returning
+        short."""
         size = self._get_size(oid)
         if length is None:
             length = size - off
@@ -1260,18 +1336,25 @@ class ECBackend:
         start, span = self.sinfo.offset_len_to_stripe_bounds(off, length)
         chunk_off = self.sinfo.aligned_logical_offset_to_chunk_offset(start)
         chunk_len = span // self.k
+        glock = threading.Lock()
         got: dict[int, np.ndarray] = {}
         failed: set[int] = set()
         ready = threading.Event()
         issued = [0]
 
         def on_done(shard, data):
-            if data is None:
-                failed.add(shard)
-            else:
-                got[shard] = data
-            if len(got) >= self.k or len(got) + len(failed) >= issued[0]:
-                ready.set()
+            with glock:       # replies race on reader threads
+                if data is None:
+                    failed.add(shard)
+                else:
+                    got[shard] = data
+                # set INSIDE the lock: the degraded transition below
+                # clears + re-arms (issued k -> n) under the same
+                # lock, so a reply's stale-issued fire decision can
+                # never land after the clear
+                if len(got) >= self.k or \
+                        len(got) + len(failed) >= issued[0]:
+                    ready.set()
         on_done.loop_safe = True      # store + Event.set only: may run
         #                               inline on the reactor
 
@@ -1279,20 +1362,89 @@ class ECBackend:
         self.shards.sub_read_batch(
             [(s, oid, chunk_off, chunk_len) for s in range(self.k)],
             on_done)
-        if not ready.wait(timeout=30) or (failed and len(got) < self.k):
+        timeout = self.read_timeout
+        with glock:
+            need_parity = bool(failed) and len(got) < self.k
+        if not need_parity:
+            if not ready.wait(timeout=timeout):
+                if self.perf:
+                    self.perf.inc("ec_read_timeouts")
+            with glock:
+                need_parity = len(got) < self.k
+        if need_parity:
             # degraded: fan out to parity shards until k gathered
             # (reference get_remaining_shards :1633 / fast_read)
-            ready.clear()
-            issued[0] = self.n
+            with glock:
+                ready.clear()
+                issued[0] = self.n
+                if len(got) >= self.k or \
+                        len(got) + len(failed) >= self.n:
+                    ready.set()
             self.shards.sub_read_batch(
                 [(s, oid, chunk_off, chunk_len)
                  for s in range(self.k, self.n)], on_done)
-            ready.wait(timeout=30)
-        if len(got) < self.k:
+            if not ready.wait(timeout=timeout) and self.perf:
+                self.perf.inc("ec_read_timeouts")
+        with glock:
+            have = dict(got)
+        if len(have) < self.k:
             raise ErasureCodeError(5, f"unrecoverable read {oid}")
-        use = dict(list(sorted(got.items()))[: self.k])
-        logical = ec_util.decode(self.sinfo, self.ec_impl, use, span)
+        if set(range(self.k)) <= set(have):
+            use = {s: have[s] for s in range(self.k)}
+            logical = ec_util.decode(self.sinfo, self.ec_impl, use, span)
+        else:
+            logical = self._reconstruct_read(oid, have, chunk_len, span)
         return logical[off - start:off - start + length]
+
+    def _reconstruct_read(self, oid: hobject_t,
+                          have: dict[int, np.ndarray],
+                          chunk_len: int, span: int) -> np.ndarray:
+        """Reconstruct-on-read: rebuild the missing data shards of a
+        degraded read through the batched decode path — the per-host
+        launch queue (co-batched with other PGs' repair decodes) when
+        one is wired, the mesh collective when that plane is up, the
+        plugin decode otherwise.  Sub-chunked codes (CLAY) keep the
+        dict-decode path: a partial chunk run does not respect their
+        plane layout."""
+        if self.perf:
+            self.perf.inc("ec_reconstruct_reads")
+            self.perf.inc("ec_reconstruct_read_bytes", span)
+        use = dict(list(sorted(have.items()))[: self.k])
+        if self.ec_impl.get_sub_chunk_count() != 1:
+            return ec_util.decode(self.sinfo, self.ec_impl, use, span)
+        survivors = tuple(sorted(use))
+        erasures = [s for s in range(self.n) if s not in use]
+        targets = tuple(s for s in range(self.k) if s not in use)
+        dec = None
+        if self.mesh_codec is not None:
+            try:
+                avail = np.stack([use[s] for s in survivors])
+                rows = self.mesh_codec.decode_flat(avail, survivors,
+                                                   targets)
+                dec = np.zeros((self.n, chunk_len), dtype=np.uint8)
+                for s, d in use.items():
+                    dec[s] = d
+                for i, t in enumerate(targets):
+                    dec[t] = rows[i]
+            except Exception as e:  # noqa: BLE001 — mesh died mid-read
+                self._disable_mesh(e)
+                dec = None
+        if dec is None:
+            dense = np.zeros((self.n, chunk_len), dtype=np.uint8)
+            for s, d in use.items():
+                dense[s] = d
+            if self._launch_queue is not None:
+                ticket = self._launch_queue.submit_decode(
+                    self.ec_impl, dense, erasures, owner=id(self))
+                dec = np.asarray(ticket.result())
+            else:
+                dec = np.asarray(
+                    self.ec_impl.decode_chunks(dense, erasures))
+        nstripes = chunk_len // self.sinfo.chunk_size
+        logical = dec[: self.k] \
+            .reshape(self.k, nstripes, self.sinfo.chunk_size) \
+            .transpose(1, 0, 2).reshape(-1)
+        return logical[:span]
 
     # -- recovery (reference continue_recovery_op :570) ---------------------
     #
@@ -1404,17 +1556,60 @@ class ECBackend:
             push_for: Callable[[hobject_t], Callable]) -> dict:
         results: dict[hobject_t, Exception | None] = {}
         states: list[dict] = []
+        clay_states: list[dict] = []
         # phase 1: every object's survivor reads in flight before any
-        # wait (the fan-out IS the storm's concurrency)
+        # wait (the fan-out IS the storm's concurrency).  Single-shard
+        # losses of a sub-chunked plugin with a repair lowering take
+        # the bandwidth-optimal CLAY path: only the q^{t-1} repair
+        # planes of d helpers are read (1/q of each helper chunk)
         for oid, missing in items:
             try:
-                states.append(self._start_recovery_reads(oid, missing))
+                st = None
+                if self._clay_repair_eligible(missing):
+                    st = self._start_clay_repair_reads(oid, missing[0])
+                if st is not None:
+                    clay_states.append(st)
+                else:
+                    states.append(self._start_recovery_reads(
+                        oid, missing))
             except Exception as e:  # noqa: BLE001
                 results[oid] = e
-        # phase 2: collect; drop objects that can't reach k survivors
+        # phase 2 (CLAY): collect plane reads; any helper failure falls
+        # back to the full-read decode path for that object
+        clay_groups: dict[tuple, list[dict]] = {}
+        for st in clay_states:
+            st["ready"].wait(timeout=self.read_timeout)
+            with st["glock"]:
+                complete = not st["failed"] and st["left"] == 0
+            if not complete:
+                if self.perf:
+                    self.perf.inc("ec_clay_repair_fallbacks")
+                try:
+                    states.append(self._start_recovery_reads(
+                        st["oid"], st["missing"]))
+                except Exception as e:  # noqa: BLE001
+                    results[st["oid"]] = e
+                continue
+            if self.perf:
+                self.perf.inc("ec_repair_helper_bytes",
+                              st["helper_bytes"])
+            clay_groups.setdefault(
+                (st["lost"], st["helpers"], st["chunk_len"]),
+                []).append(st)
+        for (lost, helpers, _clen), sts in clay_groups.items():
+            try:
+                self._clay_repair_group(lost, helpers, sts, push_for)
+            except Exception as e:  # noqa: BLE001 — whole-group launch
+                for st in sts:
+                    results.setdefault(st["oid"], e)
+                continue
+            for st in sts:
+                results.setdefault(st["oid"], st.get("error"))
+        # phase 2 (full): collect; drop objects that can't reach k
+        # survivors
         groups: dict[tuple, list[dict]] = {}
         for st in states:
-            st["ready"].wait(timeout=30)
+            st["ready"].wait(timeout=self.read_timeout)
             with st["glock"]:
                 # snapshot under a DIFFERENT name: `got` is the
                 # closure cell late on_done callbacks still write into
@@ -1425,6 +1620,9 @@ class ECBackend:
                        f"{len(have)} < k={self.k}")
                 continue
             st["have"] = have
+            if self.perf:
+                self.perf.inc("ec_repair_helper_bytes",
+                              len(have) * st["chunk_len"])
             survivors = tuple(sorted(have))[: self.k]
             targets = tuple(sorted(st["missing"]))
             erasures = tuple(s for s in range(self.n) if s not in have)
@@ -1444,6 +1642,145 @@ class ECBackend:
                 results.setdefault(st["oid"],
                                    st.get("error"))
         return results
+
+    # -- CLAY plane-read repair (docs/REPAIR.md) ----------------------------
+
+    def _clay_repair_eligible(self, missing: list[int]) -> bool:
+        return (self._clay_repair and len(missing) == 1 and
+                self.ec_impl.get_sub_chunk_count() > 1 and
+                hasattr(self.ec_impl, "repair_matrix"))
+
+    def _clay_plan(self, lost: int, helpers: tuple[int, ...]):
+        """Cached ClayRepairPlan for one (lost, helper set) — the host
+        plane-solver runs once, every repair after is a batched GF
+        matmul (parallel/mesh.ClayRepairPlan)."""
+        key = (lost, helpers)
+        plan = self._clay_plans.get(key)
+        if plan is None:
+            from ..parallel.mesh import ClayRepairPlan
+            plan = ClayRepairPlan.build(self.ec_impl, lost, helpers)
+            self._clay_plans[key] = plan
+        return plan
+
+    def _start_clay_repair_reads(self, oid: hobject_t,
+                                 lost: int) -> dict | None:
+        """Phase 1 of a CLAY repair: fan out the repair-plane sub-chunk
+        runs of the d chosen helpers — 1/q of each helper chunk, the
+        bandwidth-optimal read set — without waiting.  Returns None
+        when the geometry can't serve the plane path (no helper set,
+        chunk not sub-aligned): the caller falls back to full reads."""
+        impl = self.ec_impl
+        sub = impl.get_sub_chunk_count()
+        hinfo = self._get_hinfo(oid)
+        chunk_len = None
+        for s in range(self.n):
+            if s == lost:
+                continue
+            chunk_len = self.shards.stat(s, oid)
+            if chunk_len is not None:
+                break
+        if chunk_len is None:
+            raise ErasureCodeError(5,
+                                   f"cannot recover {oid}: no survivor")
+        if chunk_len % sub:
+            return None
+        helpers = impl.choose_helpers(
+            lost, set(range(self.n)) - {lost})
+        if helpers is None:
+            return None
+        helpers = tuple(sorted(helpers))
+        sub_size = chunk_len // sub
+        planes = impl.repair_planes(lost)
+        runs = impl._runs(planes)
+        row0 = []
+        acc = 0
+        for _s0, cnt in runs:
+            row0.append(acc)
+            acc += cnt
+        got = {h: np.zeros((len(planes), sub_size), dtype=np.uint8)
+               for h in helpers}
+        glock = threading.Lock()
+        state = {"oid": oid, "missing": [lost], "lost": lost,
+                 "helpers": helpers, "hinfo": hinfo,
+                 "chunk_len": chunk_len, "sub_size": sub_size,
+                 "got": got, "glock": glock, "failed": set(),
+                 "left": len(helpers) * len(runs),
+                 "helper_bytes": len(helpers) * len(planes) * sub_size,
+                 "ready": threading.Event()}
+
+        # one callback closure per run index: on_done only reports the
+        # shard, so the run identity must ride the closure
+        for ri, (s0, cnt) in enumerate(runs):
+            def make_cb(r0=row0[ri], cnt=cnt):
+                def cb(sh, d):
+                    with glock:
+                        if d is None:
+                            state["failed"].add(sh)
+                        else:
+                            if d.size < cnt * sub_size:
+                                # sparse tail: pad like the healthy
+                                # shard-read path does
+                                d = np.concatenate(
+                                    [d, np.zeros(cnt * sub_size - d.size,
+                                                 dtype=np.uint8)])
+                            got[sh][r0:r0 + cnt] = \
+                                d.reshape(cnt, sub_size)
+                        state["left"] -= 1
+                        fire = state["left"] == 0 or state["failed"]
+                    if fire:
+                        state["ready"].set()
+                cb.loop_safe = True      # store + Event.set only
+                return cb
+            self.shards.sub_read_batch(
+                [(h, oid, s0 * sub_size, cnt * sub_size)
+                 for h in helpers], make_cb())
+        return state
+
+    def _clay_repair_group(self, lost: int, helpers: tuple[int, ...],
+                           sts: list[dict], push_for) -> None:
+        """Rebuild one (lost, helpers) CLAY group: every object's
+        stacked helper plane rows ride ONE batched GF matmul — the
+        mesh collective when that plane is up, the per-host launch
+        queue (co-batched with writes and other PGs' repairs)
+        otherwise, the plan's own device/host apply as the floor."""
+        plan = self._clay_plan(lost, helpers)
+        rows_list = [
+            self.ec_impl.repair_rows(
+                lost, {h: st["got"][h] for h in helpers}, helpers)
+            for st in sts]
+        rebuilt_list = None
+        if self.mesh_codec is not None:
+            try:
+                rebuilt_list = self.mesh_codec.clay_repair_batch(
+                    plan, rows_list)
+                if self.perf:
+                    self.perf.inc("ec_mesh_repair_launches")
+            except Exception as e:  # noqa: BLE001 — mesh died mid-storm
+                self._disable_mesh(e)
+                rebuilt_list = None
+        if rebuilt_list is None:
+            if self._launch_queue is not None:
+                from ..common.util import concat_columns, split_columns
+                big, widths = concat_columns(rows_list)
+                out = np.asarray(self._launch_queue.submit_clay_repair(
+                    plan, big, owner=id(self)).result())
+                rebuilt_list = split_columns(out, widths)
+            else:
+                rebuilt_list = plan.apply_batch(rows_list)
+        if self.perf:
+            self.perf.inc("ec_clay_repair_launches")
+            self.perf.inc("ec_clay_repairs", len(sts))
+        for st, rebuilt in zip(sts, rebuilt_list):
+            try:
+                data = np.ascontiguousarray(
+                    np.asarray(rebuilt), dtype=np.uint8).reshape(-1)
+                self._verify_recovered(st, lost, data)
+                push_for(st["oid"])(lost, data, st["hinfo"])
+                if self.perf:
+                    self.perf.inc("ec_repair_reconstructed_bytes",
+                                  st["chunk_len"])
+            except Exception as e:  # noqa: BLE001 — per-object verify
+                st["error"] = e
 
     def _decode_recovery_group(self, survivors, targets, erasures,
                                sts: list[dict], push_for) -> None:
@@ -1479,8 +1816,12 @@ class ECBackend:
                 self._disable_mesh(e)
                 meshed = False
         if not meshed:
-            if self.ec_impl.get_sub_chunk_count() == 1 and len(sts) > 1:
-                # one concatenated host decode for the whole group
+            if self.ec_impl.get_sub_chunk_count() == 1:
+                # one concatenated decode for the whole group — through
+                # the per-host launch queue when one is wired, so
+                # recovery decodes coalesce with OTHER PGs' repairs
+                # (and share occupancy accounting with writes) instead
+                # of issuing a private launch
                 widths = [st["chunk_len"] for st in sts]
                 big = np.zeros((self.n, sum(widths)), dtype=np.uint8)
                 col = 0
@@ -1488,7 +1829,13 @@ class ECBackend:
                     for s, d in st["have"].items():
                         big[s, col:col + w] = d
                     col += w
-                dec = self.ec_impl.decode_chunks(big, list(erasures))
+                if self._launch_queue is not None:
+                    dec = np.asarray(self._launch_queue.submit_decode(
+                        self.ec_impl, big, list(erasures),
+                        owner=id(self)).result())
+                else:
+                    dec = self.ec_impl.decode_chunks(big,
+                                                     list(erasures))
                 col = 0
                 for st, w in zip(sts, widths):
                     rebuilt_per_st.append(
@@ -1510,5 +1857,8 @@ class ECBackend:
                     data = rebuilt[s]
                     self._verify_recovered(st, s, data)
                     push(s, data, st["hinfo"])
+                    if self.perf:
+                        self.perf.inc("ec_repair_reconstructed_bytes",
+                                      int(np.asarray(data).size))
             except Exception as e:  # noqa: BLE001 — per-object verify
                 st["error"] = e

@@ -36,6 +36,13 @@ queue"):
 * Per-PG in-order completion is untouched: the queue only owns the
   LAUNCH; each backend still materializes its drains in submit order
   through its own `_complete_drain` / `_try_finish_rmw` path.
+* Repair rides the same machinery (docs/REPAIR.md): `submit_decode`
+  coalesces recovery / reconstruct-on-read `decode_chunks` runs across
+  PGs per (codec, erasure pattern), and `submit_clay_repair` coalesces
+  CLAY repair-plan applies per plan signature — an OSD-loss storm's
+  decode launches share window/byte-cap/flush-on-demand semantics and
+  occupancy accounting with the write path instead of issuing
+  per-object launches beside it.
 * Failure containment: submissions only coalesce when their codecs
   are provably identical (generator-matrix signature).  If a combined
   launch still fails, the queue retries each submission on its OWN
@@ -102,12 +109,14 @@ class LaunchQueueError(RuntimeError):
 
 
 class _Sub:
-    """One backend drain's submission (all its fused runs, or its one
-    concatenated plain chunk run)."""
+    """One backend drain's submission (all its fused runs, its one
+    concatenated plain chunk run, or one recovery decode / CLAY repair
+    run).  `extra` carries kind-specific launch arguments (the decode
+    erasure list)."""
     __slots__ = ("ticket", "plugin", "runs", "n_runs", "width",
-                 "nbytes", "t_submit", "owner")
+                 "nbytes", "t_submit", "owner", "extra")
 
-    def __init__(self, ticket, plugin, runs, owner):
+    def __init__(self, ticket, plugin, runs, owner, extra=None):
         self.ticket = ticket
         self.plugin = plugin
         self.runs = runs
@@ -116,6 +125,7 @@ class _Sub:
         self.nbytes = sum(r.shape[0] * r.shape[1] for r in runs)
         self.t_submit = time.perf_counter()
         self.owner = owner
+        self.extra = extra
 
 
 class _Batch:
@@ -208,6 +218,11 @@ def _build_queue_perf(name: str):
                              "(containment)")
             .add_u64_counter("ec_host_launch_errors",
                              "submissions whose launch failed")
+            .add_u64_counter("ec_host_decode_launches",
+                             "recovery/reconstruct decode super-batch "
+                             "launches")
+            .add_u64_counter("ec_host_repair_launches",
+                             "CLAY repair-plan super-batch launches")
             .add_gauge("ec_host_occupancy_pct",
                        "last launch bytes / max super-batch bytes")
             .add_histogram("lat_ec_batch_wait",
@@ -252,6 +267,8 @@ class ECLaunchQueue:
         self.cross_pg_launches = 0
         self.launch_retries = 0
         self.launch_errors = 0
+        self.decode_launches = 0
+        self.repair_launches = 0
         self.last_launch: dict | None = None
 
     # -- host singleton (MeshService wiring rides this) ----------------------
@@ -305,10 +322,42 @@ class ECLaunchQueue:
         return self._submit("c", plugin, [
             np.ascontiguousarray(chunks, dtype=np.uint8)], owner)
 
-    def _submit(self, kind: str, plugin, runs, owner) -> LaunchTicket:
-        key = (kind,) + codec_signature(plugin)
+    def submit_decode(self, plugin, dense: np.ndarray, erasures,
+                      owner=None) -> LaunchTicket:
+        """Queue one recovery/reconstruct decode: `dense` is the
+        (k+m, W) array with zeros in the erased rows.  Submissions
+        sharing (codec, erasure pattern) coalesce into one
+        `decode_chunks` launch across PGs — repair rides the same
+        launch-occupancy machinery as writes (ROADMAP item 2's named
+        remainder); `result()` yields this submission's decoded
+        (k+m, W) columns."""
+        erasures = tuple(sorted(int(e) for e in erasures))
+        return self._submit(
+            "d", plugin,
+            [np.ascontiguousarray(dense, dtype=np.uint8)], owner,
+            key_suffix=(erasures,), extra=erasures)
+
+    def submit_clay_repair(self, plan, rows: np.ndarray,
+                           owner=None) -> LaunchTicket:
+        """Queue one CLAY repair-plan apply: `rows` are the stacked
+        helper repair-plane symbols (d*P, W) of ONE object (or a
+        backend's own concatenation of several).  Submissions sharing
+        a plan signature — same (geometry, lost chunk, helper set) —
+        coalesce into one batched GF matmul launch
+        (parallel/mesh.ClayRepairPlan); `result()` yields this
+        submission's (sub_chunks, W) rebuilt columns."""
+        return self._submit(
+            "r", plan, [np.ascontiguousarray(rows, dtype=np.uint8)],
+            owner, key_suffix=())
+
+    def _submit(self, kind: str, plugin, runs, owner,
+                key_suffix: tuple = (), extra=None) -> LaunchTicket:
+        if kind == "r":
+            key = (kind,) + tuple(plugin.signature)
+        else:
+            key = (kind,) + codec_signature(plugin) + key_suffix
         ticket = LaunchTicket(self, kind, key)
-        sub = _Sub(ticket, plugin, runs, owner)
+        sub = _Sub(ticket, plugin, runs, owner, extra=extra)
         batch = None
         with self._lock:
             self._pending.setdefault(key, []).append(sub)
@@ -432,6 +481,10 @@ class ECLaunchQueue:
             self.pg_mix_total += len(owners)
             if len(owners) > 1:
                 self.cross_pg_launches += 1
+            if batch.kind == "d":
+                self.decode_launches += 1
+            elif batch.kind == "r":
+                self.repair_launches += 1
             self.last_launch = {"runs": nruns, "bytes": nbytes,
                                 "submissions": len(subs),
                                 "pg_mix": len(owners),
@@ -443,6 +496,10 @@ class ECLaunchQueue:
             self.perf.inc("ec_host_launch_pg_mix", len(owners))
             if len(owners) > 1:
                 self.perf.inc("ec_host_cross_pg_launches")
+            if batch.kind == "d":
+                self.perf.inc("ec_host_decode_launches")
+            elif batch.kind == "r":
+                self.perf.inc("ec_host_repair_launches")
             self.perf.set("ec_host_occupancy_pct", round(occupancy, 2))
         return batch
 
@@ -466,6 +523,32 @@ class ECLaunchQueue:
                 handle = plugin.encode_extents_with_crc_submit(all_runs)
                 batch.path = handle.get("path") \
                     if isinstance(handle, dict) else None
+            elif kind == "r":
+                # CLAY repair plan: one batched GF matmul for every
+                # co-submitted object (plugin slot holds the shared
+                # ClayRepairPlan — signatures matched, so it IS shared)
+                bigs = [s.runs[0] for s in subs]
+                big = np.concatenate(bigs, axis=1) if len(bigs) > 1 \
+                    else bigs[0]
+                handle = ("np", np.asarray(plugin.apply(big)))
+            elif kind == "d":
+                # recovery/reconstruct decode: erasure patterns match
+                # within a key, so the concatenated dense array decodes
+                # in one launch; zero pad columns (launch-shape
+                # bucketing, like the plain path) decode to zeros the
+                # demux never reads
+                bigs = [s.runs[0] for s in subs]
+                big = np.concatenate(bigs, axis=1) if len(bigs) > 1 \
+                    else bigs[0]
+                if len(bigs) > 1:
+                    w = big.shape[1]
+                    w2 = next_pow2(w)
+                    if w2 != w:
+                        big = np.concatenate(
+                            [big, np.zeros((big.shape[0], w2 - w),
+                                           dtype=np.uint8)], axis=1)
+                handle = ("np", np.asarray(plugin.decode_chunks(
+                    big, list(subs[0].extra))))
             else:
                 bigs = [s.runs[0] for s in subs]
                 big = np.concatenate(bigs, axis=1) if len(bigs) > 1 \
@@ -506,6 +589,12 @@ class ECLaunchQueue:
                     if kind == "x":
                         h = s.plugin.encode_extents_with_crc_submit(
                             s.runs)
+                    elif kind == "r":
+                        h = ("np", np.asarray(
+                            s.plugin.apply(s.runs[0])))
+                    elif kind == "d":
+                        h = ("np", np.asarray(s.plugin.decode_chunks(
+                            s.runs[0], list(s.extra))))
                     elif hasattr(s.plugin, "encode_chunks_submit"):
                         h = ("h", s.plugin.encode_chunks_submit(
                             s.runs[0]))
@@ -629,6 +718,8 @@ class ECLaunchQueue:
                 if launches else 0.0,
                 "launch_retries": self.launch_retries,
                 "launch_errors": self.launch_errors,
+                "decode_launches": self.decode_launches,
+                "repair_launches": self.repair_launches,
                 "last_launch": self.last_launch,
                 "pending_submissions": pending_subs,
                 "pending_bytes": pending_bytes,

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..common.util import concat_columns, split_columns
 from ..ec import gf
 from ..ops import bitsliced
 
@@ -104,18 +105,23 @@ class DistributedStripeCodec:
         self.enc_bitmats = self._column_bitmats(self.matrix[k:])
         self._apply_cache: dict[int, object] = {}
         self._decode_plans: dict[tuple, object] = {}
+        self._clay_plans: dict[tuple, object] = {}
 
     # -- bitmatrix plumbing -------------------------------------------------
 
-    def _column_bitmats(self, coeff: np.ndarray):
-        """(r, k) GF(2^8) matrix -> device-put stack of per-shard column
+    def _column_bitmats(self, coeff: np.ndarray,
+                        cols_per_shard: int | None = None):
+        """(r, j) GF(2^8) matrix -> device-put stack of per-shard column
         slices in the kernel's layout: device s gets the columns for its
-        k_local chunk rows ((n_shard, 32r, 32k_local) w32 or
-        (n_shard, 8r, 8k_local) byte), 'shard'-sharded on dim 0."""
+        cols_per_shard input rows ((n_shard, 32r, 32c) w32 or
+        (n_shard, 8r, 8c) byte), 'shard'-sharded on dim 0.  Defaults to
+        the k_local encode/decode split; the CLAY repair lowering passes
+        its own (padded) split."""
+        cps = self.k_local if cols_per_shard is None else cols_per_shard
         build = bitsliced._w32_bitmat if self.use_w32 \
             else bitsliced.interleave_bitmatrix
         mats = [build(np.ascontiguousarray(
-                    coeff[:, s * self.k_local:(s + 1) * self.k_local]))
+                    coeff[:, s * cps:(s + 1) * cps]))
                 for s in range(self.n_shard)]
         stacked = np.stack(mats).astype(np.int8)
         return jax.device_put(
@@ -295,6 +301,41 @@ class DistributedStripeCodec:
             col += w
         return res
 
+    # -- CLAY repair (docs/REPAIR.md) ---------------------------------------
+
+    def clay_repair_batch(self, plan: "ClayRepairPlan",
+                          rows_list) -> list[np.ndarray]:
+        """Batched distributed CLAY repair: MANY objects lost the same
+        chunk to the same helper set (the storm case), each object's
+        stacked helper repair-plane rows (d*P, S_i) riding ONE sharded
+        GF contraction — the coupled-layer host plane-solver collapsed
+        to the same collective program shape as decode_flat_batch
+        (input rows shard over 'shard', byte axes concatenate over
+        'data').  The repair matrix's input rows pad with zero rows
+        (and zero matrix columns) to divide over the shard axis; zero
+        rows XOR-fold to nothing."""
+        if not rows_list:
+            return []
+        j = plan.in_rows
+        pad = -j % self.n_shard
+        mats = self._clay_plans.get(plan.signature)
+        if mats is None:
+            coeff = plan.matrix
+            if pad:
+                coeff = np.concatenate(
+                    [coeff, np.zeros((plan.out_rows, pad),
+                                     dtype=np.uint8)], axis=1)
+            mats = self._column_bitmats(
+                coeff, cols_per_shard=(j + pad) // self.n_shard)
+            self._clay_plans[plan.signature] = mats
+        big, widths = concat_columns(rows_list)
+        if pad:
+            big = np.concatenate(
+                [big, np.zeros((pad, big.shape[1]), dtype=np.uint8)],
+                axis=0)
+        out = self._apply_flat(mats, big, plan.out_rows)
+        return split_columns(out, widths)
+
     def decode(self, stripes_avail, survivors, targets):
         """(B, k, C) survivor stripes -> (B, len(targets), C)."""
         a = np.ascontiguousarray(stripes_avail, dtype=np.uint8)
@@ -312,3 +353,85 @@ class DistributedStripeCodec:
         for s in np.asarray(stripes, dtype=np.uint8):
             out.append(gf.gf_matvec(coding, s))
         return np.stack(out)
+
+
+# ----------------------------------------------------------------------------
+# CLAY repair on the device plane (docs/REPAIR.md)
+# ----------------------------------------------------------------------------
+#
+# ec/plugins/ec_clay.py's repair() is GF(2^8)-linear in the helper
+# symbols, so the whole coupled-layer contraction — pairwise decouple
+# transforms, per-plane parity-check solves in score order, final
+# re-coupling — collapses to ONE (sub_chunks x d*P) matrix per
+# (lost chunk, helper set), extracted host-side by an identity probe
+# (ErasureCodeClay.repair_matrix) and applied here as a batched GF
+# matmul: the same bit-sliced contraction the encode/decode paths ride,
+# on a single device (apply_device) or sharded over the mesh
+# (DistributedStripeCodec.clay_repair_batch).  What used to be a
+# per-object, per-plane host crawl during the exact storm CLAY was
+# built for becomes a handful of device launches.
+
+
+class ClayRepairPlan:
+    """One (lost, helpers) repair lowering: the GF(2^8) matrix plus its
+    lazily-built device bitmatrix.  Shareable across PGs/backends of
+    the same geometry (the signature is the coalescing key the launch
+    queue batches on)."""
+
+    def __init__(self, matrix: np.ndarray, signature: tuple,
+                 lost_chunk: int, helper_ids: tuple[int, ...]):
+        self.matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+        self.out_rows, self.in_rows = self.matrix.shape
+        self.signature = signature
+        self.lost_chunk = lost_chunk
+        self.helper_ids = tuple(helper_ids)
+        self._bitmat = None
+
+    @classmethod
+    def build(cls, plugin, lost_chunk: int,
+              helper_ids=None) -> "ClayRepairPlan":
+        """Lower one single-failure repair of a sub-chunked plugin
+        (ErasureCodeClay.repair_matrix) into a plan."""
+        helpers = plugin.repair_helper_order(lost_chunk, helper_ids)
+        return cls(plugin.repair_matrix(lost_chunk, helpers),
+                   plugin.repair_signature(lost_chunk, helpers),
+                   lost_chunk, helpers)
+
+    # -- host oracle ---------------------------------------------------------
+
+    def apply_host(self, rows: np.ndarray) -> np.ndarray:
+        """(in_rows, W) helper rows -> (out_rows, W) rebuilt sub-chunk
+        rows via the host GF matvec (the fallback/oracle path)."""
+        return gf.gf_matvec(self.matrix, rows)
+
+    # -- single-device path (the launch-queue / smoke configuration) --------
+
+    def apply_device(self, rows: np.ndarray) -> np.ndarray:
+        """Same contraction through the jitted XLA bit-sliced matmul
+        on the default jax device — the batched path a host without a
+        configured mesh serves repair from (one launch for every
+        object of a (lost, helpers) group, byte axes concatenated)."""
+        if self._bitmat is None:
+            self._bitmat = jnp.asarray(
+                bitsliced.interleave_bitmatrix(self.matrix),
+                dtype=jnp.int8)
+        rows = np.ascontiguousarray(rows, dtype=np.uint8)
+        return np.asarray(bitsliced.gf_bitmatmul_xla(
+            self._bitmat, jnp.asarray(rows), self.out_rows))
+
+    def apply(self, rows: np.ndarray) -> np.ndarray:
+        """Device contraction with host fallback (a dead/absent
+        accelerator must never fail a repair)."""
+        try:
+            return self.apply_device(rows)
+        except Exception:  # noqa: BLE001 — device unavailable
+            return self.apply_host(rows)
+
+    def apply_batch(self, rows_list) -> list[np.ndarray]:
+        """Batched single-device apply: objects' byte axes concatenate
+        into one launch, results demux per object (the non-mesh analog
+        of clay_repair_batch)."""
+        if not rows_list:
+            return []
+        big, widths = concat_columns(rows_list)
+        return split_columns(self.apply(big), widths)

@@ -26,9 +26,12 @@ commands this build's mon implements:
   python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd mclock profile \
       set PROFILE [CLASS:RES,WGT,LIM;...]   # rides central config to OSDs
   python -m ceph_tpu.tools.ceph_cli daemon /path/to/osd.N.asok \
-      {dump_latencies | dump_mclock | perf dump | mesh status | ...}
+      {dump_latencies | dump_mclock | perf dump | mesh status |
+       repair status | ...}
       # local asok, no mon needed (reference `ceph daemon`);
-      # `mesh status` = the multichip plane state (docs/MULTICHIP.md)
+      # `mesh status` = the multichip plane state (docs/MULTICHIP.md);
+      # `repair status` = recovery backlog/throttle + per-PG repair
+      # ledger (docs/REPAIR.md)
 """
 
 from __future__ import annotations
@@ -63,7 +66,8 @@ def daemon_command(argv: list[str]) -> int:
     # missing its value) still fails fast instead of becoming a bogus
     # prefix.  Parity-based folding alone cannot reach the three-word
     # `launch queue status`, hence the head-driven loop.
-    heads = ("perf", "config", "log", "mesh", "launch", "launch queue")
+    heads = ("perf", "config", "log", "mesh", "launch", "launch queue",
+             "repair")
     while extra and prefix in heads:
         prefix = f"{prefix} {extra[0]}"
         extra = extra[1:]

@@ -661,6 +661,167 @@ def run_ec_pg_sweep(pg_counts=(1, 8, 64), total_objs: int = 128,
     }
 
 
+# -- degraded-read SLO: client reads DURING a kill/revive storm -------------
+#
+# The repair subsystem's acceptance metric (docs/REPAIR.md): a cluster
+# that is only fast when healthy is not production, so the benchmarked
+# path here is failure itself — an EC k=8,m=3 pool under a kill/revive
+# storm, client reads landing THROUGH the degraded window, p99 of those
+# reads published, every acked byte verified after heal (zero acked
+# loss), and the reconstruct-on-read / recovery-class counters proving
+# WHICH path served them.
+
+def run_degraded_read_storm(n_osds: int = 12, objects: int = 6,
+                            size: int = 32 << 10, cycles: int = 1,
+                            read_passes: int = 3,
+                            heartbeat: float = 1.0) -> dict:
+    """Kill/revive storm on a k=8,m=3 pool with timed degraded reads.
+
+    Box realities (see test_mesh_service's thrash notes): first writes
+    pay per-PG peering + codec compile, so the write phase retries;
+    heartbeats get the 1 s interval multi-daemon tests need on loaded
+    boxes.  The fast CPU variant (small counts) is the tier-1 gate;
+    bigger counts are the TPU-round configuration."""
+    import numpy as np
+
+    from ..crush.hash import crush_hash32
+    from ..osd.types import pg_t
+    from ..osdc.objecter import TimedOut
+    from ..rados.client import RadosError
+    from .vstart import Cluster
+    t_start = time.perf_counter()
+    rng = np.random.default_rng(41)
+    with Cluster(n_osds=n_osds, heartbeat_interval=heartbeat,
+                 conf={"osd_ec_read_timeout": 10.0,
+                       # the production-shaped configuration: rebuild
+                       # units ride the mClock recovery class so client
+                       # reads preempt them (docs/QOS.md)
+                       "osd_op_queue": "mclock"}) as c:
+        client = c.client()
+        client.set_ec_profile("dr83", {
+            "plugin": "jax", "k": "8", "m": "3",
+            "technique": "cauchy", "stripe_unit": "1024"})
+        client.create_pool("drpool", "erasure",
+                           erasure_code_profile="dr83", pg_num=4)
+        io = client.open_ioctx("drpool")
+        acked: dict[str, bytes] = {}
+
+        def write_some(tag: str, count: int, retries: int = 3) -> None:
+            for j in range(count):
+                name = f"{tag}{j}"
+                payload = rng.integers(0, 256, size,
+                                       dtype=np.uint8).tobytes()
+                for _ in range(retries):
+                    try:
+                        io.write_full(name, payload)
+                        acked[name] = payload
+                        break
+                    except (TimedOut, RadosError):
+                        time.sleep(0.5)
+
+        write_some("base", objects)
+        if not acked:
+            return {"metric": "harness_degraded_read", "ok": False,
+                    "error": "no base object acked"}
+        # victim: a DATA-shard holder (acting position < k) of the
+        # first acked object's PG — its loss forces reconstruct-on-
+        # read for that object, and killing a real holder (possibly
+        # mid-acting) is the storm the SLO is about
+        osdmap = c.osds[0].osdmap
+        pool_id = [pid for pid, pl in osdmap.pools.items()
+                   if pl.name == "drpool"][0]
+        pgnum = osdmap.pools[pool_id].pg_num
+        probe = sorted(acked)[0]
+        seed = crush_hash32(probe) % pgnum
+        _, acting, _, _primary = osdmap.pg_to_up_acting_osds(
+            pg_t(pool_id, seed))
+        lat = LatencyRecorder()
+        mismatches = 0
+        for cycle in range(cycles):
+            victim = acting[(2 + cycle) % 8]     # a data shard holder
+            c.kill_osd(victim)
+            c.mark_osd_down(victim)
+            # degraded window: timed reads of every acked object, plus
+            # fresh writes (the storm keeps serving both directions)
+            for _p in range(read_passes):
+                for name, payload in sorted(acked.items()):
+                    t0 = time.perf_counter()
+                    try:
+                        got = io.read(name, len(payload))
+                        lat.record(time.perf_counter() - t0)
+                        if got != payload:
+                            mismatches += 1
+                    except Exception as e:  # noqa: BLE001
+                        lat.error(e)
+            write_some(f"deg{cycle}_", 2)
+            c.revive_osd(victim)
+            write_some(f"rev{cycle}_", 1)
+        c.wait_active_clean(timeout=180)
+        # zero acked loss: every acked byte readable and intact after
+        # the storm heals (bounded retry sweep for map refresh)
+        missing = dict(acked)
+        for _ in range(3):
+            for name in list(missing):
+                try:
+                    if io.read(name, len(missing[name])) == \
+                            missing[name]:
+                        del missing[name]
+                    else:
+                        mismatches += 1
+                        del missing[name]
+                except Exception:  # noqa: BLE001
+                    pass
+            if not missing:
+                break
+            time.sleep(1.0)
+        # provenance: reconstruct-on-read + recovery counters summed
+        # over the cluster's EC backends / daemons
+        recon = timeouts = helper = rebuilt = 0
+        recovery_q = 0
+        for osd in c.osds:
+            if osd is None:
+                continue
+            for cname, counters in osd.cct.perf.dump().items():
+                if not isinstance(counters, dict):
+                    continue
+                if cname.startswith("ec."):
+                    recon += int(counters.get(
+                        "ec_reconstruct_reads", 0) or 0)
+                    timeouts += int(counters.get(
+                        "ec_read_timeouts", 0) or 0)
+                    helper += int(counters.get(
+                        "ec_repair_helper_bytes", 0) or 0)
+                    rebuilt += int(counters.get(
+                        "ec_repair_reconstructed_bytes", 0) or 0)
+                elif cname == f"osd.{osd.osd_id}":
+                    recovery_q += int(counters.get(
+                        "recovery_queued_ops", 0) or 0)
+        summary = lat.summary()
+    row = {
+        "metric": "harness_degraded_read",
+        "osds": n_osds, "objects_acked": len(acked),
+        "cycles": cycles, "obj_size": size,
+        **{f"read_{key}": val for key, val in summary.items()},
+        "mismatches": mismatches,
+        "unreadable": len(missing),
+        "zero_acked_loss": mismatches == 0 and not missing,
+        "reconstruct_reads": recon,
+        "read_timeouts": timeouts,
+        "repair_helper_bytes": helper,
+        "repair_reconstructed_bytes": rebuilt,
+        "recovery_queued_ops": recovery_q,
+        "duration_s": round(time.perf_counter() - t_start, 1),
+    }
+    errors = summary.get("errors", 0) or 0
+    row["ok"] = bool(
+        row["zero_acked_loss"] and summary.get("ops", 0) and
+        not errors and
+        isinstance(summary.get("p99_ms"), (int, float)) and
+        summary["p99_ms"] > 0 and
+        recon >= 1)
+    return row
+
+
 def _emit(row: dict) -> None:
     print(json.dumps(row), flush=True)
 
@@ -670,7 +831,12 @@ def main(argv=None) -> int:
     ap.add_argument("--scenario", default="all",
                     choices=("rados", "rbd", "s3", "qos-sim",
                              "qos-sim-recovery", "qos-cluster",
-                             "ec-pg-sweep", "all"))
+                             "ec-pg-sweep", "degraded-read", "all"))
+    ap.add_argument("--cycles", type=int, default=1,
+                    help="degraded-read: kill/revive cycles")
+    ap.add_argument("--read-passes", type=int, default=3,
+                    help="degraded-read: timed read sweeps per "
+                         "degraded window")
     ap.add_argument("--pg-counts", default="1,8,64",
                     help="ec-pg-sweep: comma-separated PG fan-outs")
     ap.add_argument("--clients", type=int, default=32,
@@ -724,6 +890,22 @@ def main(argv=None) -> int:
             print(f"ec-pg-sweep: aggregate GB/s degraded to "
                   f"{row['degradation_frac']} of the 1-PG rate "
                   f"(min {row['min_frac']})", file=sys.stderr)
+            rc = 1
+    if "degraded-read" in scenarios:
+        row = run_degraded_read_storm(
+            n_osds=max(args.osds, 12), objects=min(args.objects, 32),
+            size=args.size, cycles=args.cycles,
+            read_passes=args.read_passes)
+        _emit(row)
+        if not row.get("ok"):
+            # the degraded-read SLO is a gate: reads during the storm
+            # must complete via reconstruct-on-read with zero acked
+            # loss (rc != 0 fails tier-1)
+            print(f"degraded-read: gate failed "
+                  f"(zero_acked_loss={row.get('zero_acked_loss')}, "
+                  f"errors={row.get('read_errors')}, "
+                  f"reconstructs={row.get('reconstruct_reads')}, "
+                  f"p99={row.get('read_p99_ms')})", file=sys.stderr)
             rc = 1
     if "qos-cluster" in scenarios:
         _emit(run_qos_cluster_tenants(

@@ -39,6 +39,17 @@ if [ "$rc" -eq 0 ]; then
   timeout -k 10 240 env JAX_PLATFORMS=cpu python -m ceph_tpu.tools.load_harness \
     --scenario ec-pg-sweep --pg-counts 1,8,32 --objects 96 --size 32768 || rc=$?
 fi
+# Degraded-read SLO gate (ISSUE 13, docs/REPAIR.md): the fast CPU
+# kill/revive variant — an EC k=8,m=3 pool loses a data-shard holder,
+# client reads land THROUGH the degraded window (p99 published), every
+# acked byte verified after heal (zero acked loss), reconstruct-on-read
+# and the mClock recovery class asserted as the serving paths.  The
+# direct-backend degraded-read micro-gate + CLAY repair bit-parity ride
+# bench.py --smoke above.
+if [ "$rc" -eq 0 ]; then
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python -m ceph_tpu.tools.load_harness \
+    --scenario degraded-read --osds 12 --objects 5 --size 16384 || rc=$?
+fi
 # Fused-kernel variant gate (ISSUE 11, docs/FUSED_CRC.md): every
 # shipped (extract, combine) variant of the fused parity+crc kernel —
 # planar/packed/wide extraction through the XLA log-fold AND the

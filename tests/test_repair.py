@@ -1,0 +1,458 @@
+"""Repair subsystem tests (docs/REPAIR.md): CLAY plane-read recovery
+through the batched GF-matmul lowering, recovery decodes riding the
+per-host launch queue, reconstruct-on-read with the conf'd fan-out
+timeout, and prioritized recovery through the mClock recovery class.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import ErasureCodePluginRegistry
+from ceph_tpu.osd.ec_backend import ECBackend, LocalShardBackend
+from ceph_tpu.osd.ec_transaction import PGTransaction, shard_oid
+from ceph_tpu.osd.ec_util import StripeInfo
+from ceph_tpu.osd.types import eversion_t, hobject_t, pg_t
+from ceph_tpu.parallel.launch_queue import ECLaunchQueue
+from ceph_tpu.parallel.mesh import ClayRepairPlan
+from ceph_tpu.store import MemStore
+from ceph_tpu.store.object_store import Transaction
+
+REG = ErasureCodePluginRegistry.instance()
+
+
+class InstrumentedShards(LocalShardBackend):
+    """LocalShardBackend with failure injection + read accounting:
+    `down` shards fail reads synchronously (the known-down-holder
+    shape), `mute` shards never answer (the dead-but-marked-up
+    shape the read timeout exists for)."""
+
+    def __init__(self, *a, **k):
+        super().__init__(*a, **k)
+        self.down: set[int] = set()
+        self.mute: set[int] = set()
+        self.read_bytes = 0
+        self.read_reqs: list[tuple[int, int, int]] = []
+
+    def sub_read(self, shard, oid, off, length, on_done):
+        if shard in self.mute:
+            return                      # reply never arrives
+        if shard in self.down:
+            on_done(shard, None)
+            return
+        self.read_bytes += length
+        self.read_reqs.append((shard, off, length))
+        super().sub_read(shard, oid, off, length, on_done)
+
+
+def _backend(plugin, profile, chunk=1024, queue=None, **kw):
+    codec = REG.factory(plugin, {k: str(v) for k, v in profile.items()})
+    k = codec.get_data_chunk_count()
+    store = MemStore()
+    store.mount()
+    shards = InstrumentedShards(store, pg_t(1, 0),
+                                codec.get_chunk_count())
+    be = ECBackend(codec, StripeInfo(k * chunk, chunk), shards,
+                   launch_queue=queue, **kw)
+    return be, shards, store
+
+
+def _write(be, name, payload, ver):
+    acked = []
+    txn = PGTransaction()
+    txn.write(hobject_t(pool=1, name=name), 0, payload)
+    be.submit_transaction(txn, eversion_t(1, ver),
+                          lambda: acked.append(1))
+    assert acked, f"write {name} not acked"
+    return hobject_t(pool=1, name=name)
+
+
+# -- launch queue: recovery decode + clay repair kinds ----------------------
+
+def test_queue_decode_coalesces_across_pgs():
+    """Two PGs' recovery decodes with the same (codec, erasures)
+    signature share ONE decode_chunks launch; per-submission demux is
+    bit-identical to a private decode."""
+    q = ECLaunchQueue(window_us=1e6)
+    try:
+        p1 = REG.factory("jax", {"k": "4", "m": "2",
+                                 "technique": "cauchy"})
+        p2 = REG.factory("jax", {"k": "4", "m": "2",
+                                 "technique": "cauchy"})
+        rng = np.random.default_rng(3)
+        fulls, denses = [], []
+        for p, w in ((p1, 512), (p2, 256)):
+            d = rng.integers(0, 256, (4, w), dtype=np.uint8)
+            full = np.concatenate([d, np.asarray(p.encode_chunks(d))])
+            dense = full.copy()
+            dense[1] = 0
+            dense[5] = 0
+            fulls.append(full)
+            denses.append(dense)
+        t1 = q.submit_decode(p1, denses[0], [1, 5], owner=1)
+        t2 = q.submit_decode(p2, denses[1], [1, 5], owner=2)
+        r1, r2 = np.asarray(t1.result()), np.asarray(t2.result())
+        for r, full in ((r1, fulls[0]), (r2, fulls[1])):
+            np.testing.assert_array_equal(r[1], full[1])
+            np.testing.assert_array_equal(r[5], full[5])
+        st = q.status()
+        assert st["decode_launches"] == 1
+        assert st["cross_pg_launches"] == 1
+        assert st["launches"] == 1
+    finally:
+        q.close()
+
+
+def test_queue_decode_different_erasures_never_cobatch():
+    """Erasure patterns are part of the coalescing key: mixed patterns
+    through one decode_chunks call would rebuild the wrong rows."""
+    q = ECLaunchQueue(window_us=1e6)
+    try:
+        p = REG.factory("jax", {"k": "4", "m": "2",
+                                "technique": "cauchy"})
+        rng = np.random.default_rng(4)
+        d = rng.integers(0, 256, (4, 256), dtype=np.uint8)
+        full = np.concatenate([d, np.asarray(p.encode_chunks(d))])
+        da = full.copy()
+        da[0] = 0
+        db = full.copy()
+        db[3] = 0
+        ta = q.submit_decode(p, da, [0], owner=1)
+        tb = q.submit_decode(p, db, [3], owner=1)
+        np.testing.assert_array_equal(np.asarray(ta.result())[0],
+                                      full[0])
+        np.testing.assert_array_equal(np.asarray(tb.result())[3],
+                                      full[3])
+        assert q.status()["decode_launches"] == 2
+    finally:
+        q.close()
+
+
+def test_queue_clay_repair_coalesces_on_plan_signature():
+    q = ECLaunchQueue(window_us=1e6)
+    try:
+        clay = REG.factory("clay", {"k": "4", "m": "2", "d": "5"})
+        n, sub, ss = 6, clay.get_sub_chunk_count(), 32
+        rng = np.random.default_rng(5)
+        lost = 1
+        plan = ClayRepairPlan.build(clay, lost)
+        planes = clay.repair_planes(lost)
+        tickets, refs = [], []
+        for i in range(2):
+            payload = rng.integers(0, 256, 4 * sub * ss,
+                                   dtype=np.uint8).tobytes()
+            enc = clay.encode(set(range(n)), payload)
+            helpers = {ch: np.asarray(enc[ch]).reshape(sub, ss)[planes]
+                       for ch in plan.helper_ids}
+            rows = clay.repair_rows(lost, helpers)
+            tickets.append(q.submit_clay_repair(plan, rows, owner=i))
+            refs.append(np.asarray(enc[lost]))
+        for t, ref in zip(tickets, refs):
+            np.testing.assert_array_equal(
+                np.asarray(t.result()).reshape(-1), ref)
+        st = q.status()
+        assert st["repair_launches"] == 1
+        assert st["cross_pg_launches"] == 1
+    finally:
+        q.close()
+
+
+# -- reconstruct-on-read + osd_ec_read_timeout ------------------------------
+
+def test_reconstruct_on_read_via_batched_decode():
+    """A degraded data shard fails the read fan-out synchronously; the
+    read fans to parity immediately and rebuilds through the launch
+    queue's decode path — counted in ec_reconstruct_reads, no 30s
+    stall anywhere."""
+    q = ECLaunchQueue(window_us=500.0)
+    try:
+        be, shards, _ = _backend("jax", {"k": 8, "m": 3,
+                                         "technique": "cauchy"},
+                                 queue=q, read_timeout=5.0)
+        rng = np.random.default_rng(7)
+        oids = {}
+        for i in range(3):
+            p = rng.integers(0, 256, 8 * 1024 * 2, dtype=np.uint8)
+            oids[_write(be, f"o{i}", p, i + 1)] = p
+        shards.down = {2}
+        t0 = time.perf_counter()
+        for oid, p in oids.items():
+            np.testing.assert_array_equal(be.read(oid), p)
+        dt = time.perf_counter() - t0
+        assert dt < 4.0, f"degraded reads stalled {dt:.1f}s"
+        d = be.perf.dump()
+        assert d["ec_reconstruct_reads"] == 3
+        assert d["ec_reconstruct_read_bytes"] > 0
+        assert d["ec_read_timeouts"] == 0     # down != timed out
+        assert q.status()["decode_launches"] >= 1
+    finally:
+        q.close()
+
+
+def test_read_timeout_conf_and_counter():
+    """A shard that never answers (dead-but-marked-up) binds the read
+    to osd_ec_read_timeout — conf'd, counted — and the read still
+    completes from parity."""
+    be, shards, _ = _backend("jax", {"k": 4, "m": 2,
+                                     "technique": "cauchy"},
+                             read_timeout=0.3)
+    rng = np.random.default_rng(8)
+    p = rng.integers(0, 256, 4 * 1024, dtype=np.uint8)
+    oid = _write(be, "t0", p, 1)
+    shards.mute = {1}
+    t0 = time.perf_counter()
+    np.testing.assert_array_equal(be.read(oid), p)
+    dt = time.perf_counter() - t0
+    assert 0.25 <= dt < 2.0, dt
+    assert be.perf.dump()["ec_read_timeouts"] == 1
+    assert be.perf.dump()["ec_reconstruct_reads"] == 1
+
+
+def test_partial_degraded_read_offsets():
+    """Reconstruct-on-read serves sub-object ranges too (offset/length
+    slicing over the rebuilt stripe run)."""
+    be, shards, _ = _backend("jax", {"k": 4, "m": 2,
+                                     "technique": "cauchy"})
+    rng = np.random.default_rng(9)
+    p = rng.integers(0, 256, 4 * 1024 * 3, dtype=np.uint8)
+    oid = _write(be, "p0", p, 1)
+    shards.down = {0, 3}
+    for off, ln in ((0, 100), (4096, 4096), (5000, 2500),
+                    (len(p) - 7, 7)):
+        np.testing.assert_array_equal(be.read(oid, off, ln),
+                                      p[off:off + ln])
+    assert be.perf.dump()["ec_reconstruct_reads"] == 4
+
+
+# -- CLAY plane-read recovery ------------------------------------------------
+
+def _clay_backend(k, m, d, chunk=1024, **kw):
+    return _backend("clay", {"k": k, "m": m, "d": d}, chunk=chunk,
+                    **kw)
+
+
+def _kill_shard(store, shards, oid, s):
+    goid = shard_oid(oid, s)
+    orig = store.read(shards.cids[s], goid).copy()
+    t = Transaction()
+    t.remove(goid)
+    store.queue_transactions(shards.cids[s], [t])
+    return orig
+
+
+def test_clay_recovery_reads_only_repair_planes():
+    """Single-shard recovery of a CLAY pool reads exactly the repair
+    planes of the d helpers (1/q of each helper chunk) — asserted on
+    the wire bytes, not just the counter — and rebuilds bit-exact via
+    the batched plan."""
+    be, shards, store = _clay_backend(4, 2, 5)
+    codec = be.ec_impl
+    rng = np.random.default_rng(11)
+    oids, origs = [], {}
+    for i in range(3):
+        p = rng.integers(0, 256, 4 * 1024, dtype=np.uint8)
+        oids.append(_write(be, f"c{i}", p, i + 1))
+    for oid in oids:
+        origs[oid] = _kill_shard(store, shards, oid, 2)
+    shards.read_bytes = 0
+    shards.read_reqs = []
+    pushed = {}
+    res = be.recover_shards_batch(
+        [(oid, [2]) for oid in oids],
+        lambda o: (lambda s, data, h, o=o:
+                   pushed.setdefault(o.name, {}).__setitem__(s, data)))
+    assert all(e is None for e in res.values()), res
+    for oid in oids:
+        np.testing.assert_array_equal(pushed[oid.name][2], origs[oid])
+    sub = codec.get_sub_chunk_count()
+    q = codec.q
+    P = len(codec.repair_planes(2))
+    sub_size = 1024 // sub
+    expect = len(oids) * codec.d * P * sub_size
+    # data-plane reads only (stat/hinfo probes are metadata): the read
+    # fan-out must total d helpers x 1/q of each chunk per object
+    assert shards.read_bytes == expect, (shards.read_bytes, expect)
+    assert shards.read_bytes < len(oids) * 4 * 1024  # < k-shard reads
+    st = be.repair_status()
+    assert st["clay_repairs"] == 3
+    assert st["clay_repair_launches"] == 1      # one batched launch
+    assert st["helper_bytes_read"] == expect
+    assert st["reconstructed_bytes"] == len(oids) * 1024
+    assert P == sub // q
+
+
+def test_clay_recovery_falls_back_on_helper_failure():
+    """A dead helper breaks the plane-read set: recovery falls back to
+    the full-read decode path and still rebuilds bit-exact (counted in
+    ec_clay_repair_fallbacks)."""
+    be, shards, store = _clay_backend(4, 2, 5, read_timeout=2.0)
+    rng = np.random.default_rng(12)
+    p = rng.integers(0, 256, 4 * 1024, dtype=np.uint8)
+    oid = _write(be, "f0", p, 1)
+    orig = _kill_shard(store, shards, oid, 2)
+    shards.down = {4}        # a helper (parity shard) is down too
+    pushed = {}
+    res = be.recover_shards_batch(
+        [(oid, [2])],
+        lambda o: (lambda s, data, h:
+                   pushed.setdefault(s, data)))
+    assert res[oid] is None, res
+    np.testing.assert_array_equal(pushed[2], orig)
+    st = be.repair_status()
+    assert st["clay_repair_fallbacks"] == 1
+    assert st["clay_repairs"] == 0
+
+
+def test_clay_multi_shard_loss_uses_full_decode():
+    """Losing more than one shard is outside the single-failure repair
+    construction: the full decode path serves it."""
+    be, shards, store = _clay_backend(4, 2, 5)
+    rng = np.random.default_rng(13)
+    p = rng.integers(0, 256, 4 * 1024, dtype=np.uint8)
+    oid = _write(be, "m0", p, 1)
+    o1 = _kill_shard(store, shards, oid, 1)
+    o4 = _kill_shard(store, shards, oid, 4)
+    pushed = {}
+    res = be.recover_shards_batch(
+        [(oid, [1, 4])],
+        lambda o: (lambda s, data, h: pushed.setdefault(s, data)))
+    assert res[oid] is None, res
+    np.testing.assert_array_equal(pushed[1], o1)
+    np.testing.assert_array_equal(pushed[4], o4)
+    assert be.repair_status()["clay_repairs"] == 0
+
+
+def test_clay_mesh_batch_matches_host(mesh_service):
+    """The mesh collective CLAY repair (clay_repair_batch on the CPU
+    4x2 virtual mesh — the interpret/dry-run plane) is bit-equal to
+    the host plane-solver."""
+    clay = REG.factory("clay", {"k": "8", "m": "3", "d": "10"})
+    n, sub, ss = 11, clay.get_sub_chunk_count(), 16
+    rng = np.random.default_rng(14)
+    lost = 2
+    plan = ClayRepairPlan.build(clay, lost)
+    planes = clay.repair_planes(lost)
+    dcodec = mesh_service.acquire(8, 3, technique="cauchy")
+    rows_list, refs = [], []
+    for i in range(3):
+        payload = rng.integers(0, 256, 8 * sub * ss,
+                               dtype=np.uint8).tobytes()
+        enc = clay.encode(set(range(n)), payload)
+        helpers = {ch: np.asarray(enc[ch]).reshape(sub, ss)[planes]
+                   for ch in plan.helper_ids}
+        rows_list.append(clay.repair_rows(lost, helpers))
+        refs.append(np.asarray(enc[lost]).reshape(sub, ss))
+    outs = dcodec.clay_repair_batch(plan, rows_list)
+    for out, ref, rows in zip(outs, refs, rows_list):
+        np.testing.assert_array_equal(np.asarray(out), ref)
+        np.testing.assert_array_equal(plan.apply_host(rows), ref)
+
+
+# -- prioritized recovery: the mClock recovery class end to end -------------
+
+def test_recovery_rides_mclock_recovery_class():
+    """Background rebuild units dequeue under the scheduler's
+    `recovery` class (phase-served counters + perf counter), the
+    repair-bandwidth throttle brakes pushes, and a degraded-object
+    client read completes promptly while the rebuild is throttled —
+    the priority inversion the subsystem exists to prevent."""
+    from ceph_tpu.tools.vstart import Cluster
+    rng = np.random.default_rng(15)
+    with Cluster(n_osds=4, heartbeat_interval=1.0,
+                 conf={"osd_op_queue": "mclock",
+                       "osd_ec_read_timeout": 5.0,
+                       "osd_recovery_max_bytes_per_sec": 4096,
+                       "osd_recovery_sleep": 0.05}) as c:
+        client = c.client()
+        client.set_ec_profile("rep21", {
+            "plugin": "jax", "k": "2", "m": "1",
+            "technique": "cauchy", "stripe_unit": "1024"})
+        client.create_pool("reppool", "erasure",
+                           erasure_code_profile="rep21", pg_num=4)
+        io = client.open_ioctx("reppool")
+        payloads = {}
+        for i in range(6):
+            p = rng.integers(0, 256, 4096, dtype=np.uint8).tobytes()
+            io.write_full(f"r{i}", p)
+            payloads[f"r{i}"] = p
+        # pick a victim holding a DATA shard of some object's PG, and
+        # remember one object it serves
+        osdmap = c.osds[0].osdmap
+        from ceph_tpu.crush.hash import crush_hash32
+        pool_id = osdmap.pool_id("reppool") \
+            if hasattr(osdmap, "pool_id") else \
+            [pid for pid, pl in osdmap.pools.items()
+             if pl.name == "reppool"][0]
+        pgnum = osdmap.pools[pool_id].pg_num
+        victim, probe_obj = None, None
+        for name in payloads:
+            seed = crush_hash32(name) % pgnum
+            _, acting, _, primary = osdmap.pg_to_up_acting_osds(
+                pg_t(pool_id, seed))
+            if len(acting) >= 2 and acting[1] != primary:
+                victim, probe_obj = acting[1], name
+                break
+        assert victim is not None
+        c.kill_osd(victim)
+        c.mark_osd_down(victim)
+        # degraded read completes promptly while rebuild is throttled
+        t0 = time.perf_counter()
+        got = io.read(probe_obj, len(payloads[probe_obj]))
+        dt = time.perf_counter() - t0
+        assert got == payloads[probe_obj]
+        assert dt < 5.0, f"degraded read took {dt:.1f}s"
+        # reconstruct-on-read provenance on some primary
+        def sum_ec(key):
+            tot = 0
+            for osd in c.osds:
+                if osd is None:
+                    continue
+                for cname, counters in osd.cct.perf.dump().items():
+                    if cname.startswith("ec.") and \
+                            isinstance(counters, dict):
+                        tot += int(counters.get(key, 0) or 0)
+            return tot
+        assert sum_ec("ec_reconstruct_reads") >= 1
+        # the rebuild units ride the recovery class: dequeue-phase
+        # stats + the mclock perf counter both show it
+        deadline = time.time() + 30
+        served = 0
+        while time.time() < deadline:
+            served = sum(
+                osd.op_wq.dump()["classes"]
+                .get("recovery", {}).get("dequeued", 0)
+                for osd in c.osds
+                if osd is not None and osd.op_wq is not None)
+            if served:
+                break
+            time.sleep(0.5)
+        assert served >= 1, "no rebuild unit dequeued as recovery"
+        queued = sum(
+            int(osd.cct.perf.dump()
+                .get(f"osd.{osd.osd_id}", {})
+                .get("recovery_queued_ops", 0) or 0)
+            for osd in c.osds if osd is not None)
+        assert queued >= 1
+        # repair status asok surfaces the ledger + scheduler row
+        st = None
+        for osd in c.osds:
+            if osd is None:
+                continue
+            s = osd._asok_repair_status({})
+            assert "recovery" in s and "pgs" in s
+            if s["scheduler_recovery_class"] and \
+                    s["scheduler_recovery_class"]["dequeued"]:
+                st = s
+        assert st is not None
+        assert st["recovery"]["throttle"]["max_bytes_per_sec"] == 4096
+        # lift the throttle, heal, and verify zero acked loss
+        for osd in c.osds:
+            if osd is not None:
+                osd.cct.conf.set("osd_recovery_max_bytes_per_sec", 0)
+                osd.cct.conf.set("osd_recovery_sleep", 0.0)
+        c.revive_osd(victim)
+        c.wait_active_clean(timeout=120)
+        for name, p in payloads.items():
+            assert io.read(name, len(p)) == p, name

@@ -415,13 +415,26 @@ def test_mclock_cluster_serves_ops_and_counts_classes():
                 osd.op_wq.dump()["classes"]
         # internal background classes can't be claimed from the wire:
         # qos="recovery" must ride the client class, not consume the
-        # recovery reservation/limit or distort its accounting
+        # recovery reservation/limit or distort its accounting.  The
+        # class itself DOES serve real work now (background rebuild
+        # units route through it, docs/REPAIR.md), so wait for
+        # recovery quiescence, snapshot its dequeue count, and assert
+        # the impostor ops moved CLIENT dequeues, not recovery's.
+        c.wait_active_clean(timeout=60)
+
+        def recovery_dequeued() -> int:
+            return sum(osd.op_wq.dump()["classes"]
+                       .get("recovery", {}).get("dequeued", 0)
+                       for osd in c.osds)
+        before = recovery_dequeued()
         impostor = client.open_ioctx("mcl")
         impostor.set_qos_class("recovery")
         impostor.write_full("imp", b"i" * 512)
         assert impostor.read("imp", 512) == b"i" * 512
-        assert sum(osd.op_wq.dump()["classes"]["recovery"]["dequeued"]
-                   for osd in c.osds) == 0
+        assert recovery_dequeued() == before
+        for osd in c.osds:
+            assert not osd.op_wq.wire_class_ok("recovery")
+            assert not osd.op_wq.wire_class_ok("scrub")
         served = {"client": 0, "tenant_a": 0}
         for osd in c.osds:
             d = osd.op_wq.dump()
