@@ -98,6 +98,20 @@ register_options([
            "seconds between peer pings", min=0.05),
     Option("osd_heartbeat_grace", float, 4.0,
            "missed-ping multiplier before reporting failure", min=1.0),
+    Option("osd_heartbeat_min_peers", int, 10,
+           "target heartbeat peer count (reference "
+           "osd_heartbeat_min_peers): above this many up OSDs, each "
+           "daemon pings only its ring neighbors by id instead of the "
+           "full O(N^2) mesh — every OSD stays watched by ~this many "
+           "reporters, which is what the mon's failure quorum needs",
+           min=2),
+    Option("osd_pg_stat_keepalive", float, 3.0,
+           "re-send cadence for an UNCHANGED MPGStats report: a "
+           "changed report still sends every osd_pg_stat_interval "
+           "tick, but steady-state identical reports only refresh "
+           "the mon's freshness window at this slower pace (must sit "
+           "well inside the mon's 10 s PG_STAT_FRESH horizon)",
+           min=0.1, max=8.0),
     Option("osd_pool_default_pg_num", int, 8, "default pg count", min=1),
     Option("osd_op_queue", str, "wpq", "op scheduler",
            enum_values=("wpq", "mclock")),

@@ -14,7 +14,7 @@ import time
 
 from ..msg import Messenger
 from ..msg import messages as M
-from ..osd.osd_map import OSDMap
+from ..osd.osd_map import OSDMap, apply_inc_chain
 
 
 class MgrModule:
@@ -116,6 +116,19 @@ class MgrDaemon:
             newmap = OSDMap.from_json(msg.map_json)
             if newmap.epoch >= self.osdmap.epoch:
                 self.osdmap = newmap
+            self.map_event.set()
+        elif isinstance(msg, M.MOSDMapInc):
+            # incremental publish / keepalive (same contract as the
+            # OSD/objecter appliers; gap -> full re-request)
+            m = apply_inc_chain(self.osdmap, msg.incs)
+            if m is None or (not msg.incs and
+                             msg.epoch > self.osdmap.epoch):
+                try:
+                    self.mon_conn.send_message(M.MMonGetMap())
+                except Exception:  # noqa: BLE001 - mon electing
+                    pass
+                return
+            self.osdmap = m
             self.map_event.set()
         elif isinstance(msg, M.MMonCommandAck):
             with self._lock:

@@ -35,11 +35,14 @@ import errno
 import threading
 import time
 
+import json
+
 from ..auth.keyring import Keyring
+from ..common.perf_counters import PerfCountersBuilder
 from ..ec import ErasureCodeError, ErasureCodePluginRegistry, Profile
 from ..msg import Messenger
 from ..msg import messages as M
-from ..osd.osd_map import OSDMap
+from ..osd.osd_map import Incremental, OSDMap
 from ..osd.types import PoolType, pg_t
 from .paxos import ElectionLogic, Paxos
 from .store import MonitorStore
@@ -77,9 +80,22 @@ FWD_TID_BASE = 1 << 40
 
 
 class Monitor:
+    # committed epoch deltas kept for incremental publishes: a
+    # subscriber whose epoch fell further behind than this gets a full
+    # map (reference mon_max_osdmap_epochs bounding send_incremental)
+    OSDMAP_INC_RING = 512
+    # burst-coalescing window for fire-and-forget maintenance
+    # mutations (boots, failure mark-downs): everything that lands
+    # within one window commits as ONE map epoch — a 64-OSD cold start
+    # is a handful of epochs instead of 64 (docs/ARCHITECTURE.md "Map
+    # distribution").  Well under every liveness timeout that waits on
+    # the resulting map (boot wait 10 s, heartbeat grace 4 s).
+    MAP_BATCH_WINDOW = 0.05
+
     def __init__(self, addr: tuple[str, int] = ("127.0.0.1", 0),
                  failure_quorum: int = 2, auth=None, secure: bool = False,
-                 data_dir: str | None = None):
+                 data_dir: str | None = None,
+                 asok_path: str | None = None):
         self.store = MonitorStore(data_dir)
         self.osdmap = OSDMap()
         self.osdmap.ec_profiles["default"] = dict(DEFAULT_EC_PROFILE)
@@ -103,7 +119,49 @@ class Monitor:
         # Leader-local: a failover pauses an unfinished walk until the
         # operator re-issues `osd drain` (documented).
         self._draining: dict[int, float] = {}
-        self._subscribers: list = []
+        # map subscribers: conn -> the osdmap epoch we believe it has
+        # (reference OSDMonitor's osd_epochs / session subscriptions).
+        # Updated optimistically on every send and authoritatively by
+        # each MMonGetMap's have_epoch — so a publish ships only the
+        # delta since the last send, and a current daemon's heartbeat
+        # keepalive ships ~nothing.
+        self._subscribers: dict[object, int] = {}
+        # ring of committed epoch deltas: epoch -> Incremental wire
+        # JSON (with its `prev` link; a paxos catch-up commit may span
+        # several epochs in one delta)
+        self._inc_ring: dict[int, dict] = {}
+        # (epoch, bytes) of the last serialized full payload — so
+        # keepalive accounting doesn't re-serialize the map it exists
+        # to avoid serializing
+        self._full_size_cache: tuple[int, int] = (-1, 0)
+        # maintenance-mutation batching (MAP_BATCH_WINDOW)
+        self._batch_dirty = False
+        self._batch_timer: threading.Timer | None = None
+        # map-distribution observability (`osdmap status` asok + the
+        # cluster_bench --scale gates)
+        self.perf = (
+            PerfCountersBuilder("mon")
+            .add_u64_counter("map_epochs", "osdmap epochs committed")
+            .add_u64_counter("map_full_sends", "full-map payloads sent")
+            .add_u64_counter("map_inc_sends",
+                             "incremental chains sent")
+            .add_u64_counter("map_keepalive_sends",
+                             "empty keepalive acks sent (subscriber "
+                             "already current)")
+            .add_u64_counter("map_full_bytes",
+                             "payload bytes of full-map sends")
+            .add_u64_counter("map_inc_bytes",
+                             "payload bytes of incremental sends")
+            .add_u64_counter("map_full_equiv_bytes",
+                             "bytes the same sends would have cost "
+                             "under full-map publish (the baseline "
+                             "the --scale bench gates against)")
+            .add_u64_counter("map_batched_mutations",
+                             "maintenance mutations coalesced through "
+                             "the batch window")
+            .add_time_avg("map_commit",
+                          "wall-clock per paxos value commit")
+            .create_perf_counters())
         self.auth = auth       # auth.CephxAuth with keyring (AuthMonitor)
         # PaxosService state beyond the OSDMap (reference AuthMonitor /
         # ConfigMonitor / MDSMonitor / MgrMonitor)
@@ -133,6 +191,19 @@ class Monitor:
         self.paxos.role = "leader"
         self.paxos.leader = 0
         self.paxos.quorum = [0]
+        # out-of-band introspection (reference `ceph daemon mon.X ...`)
+        self.asok = None
+        if asok_path:
+            from ..common.admin_socket import AdminSocket
+            self.asok = AdminSocket(asok_path)
+            for prefix in ("osdmap status", "osdmap_status"):
+                self.asok.register_command(
+                    prefix, lambda cmd: self.map_stats())
+            self.asok.register_command(
+                "perf dump",
+                lambda cmd: {self.perf.name: self.perf.dump()})
+            self.asok.register_command(
+                "mon_status", lambda cmd: self.quorum_status())
 
     # -- the replicated multi-service value ---------------------------------
 
@@ -217,6 +288,7 @@ class Monitor:
         # restore the last committed state (an uncommitted local
         # mutation must not leak) and go back to the polls
         with self.lock:
+            self._batch_dirty = False   # batched mutations roll back too
             self._adopt_value(self._committed_json, force=True)
         if len(self.mon_addrs) > 1:
             self.election.start()
@@ -225,9 +297,22 @@ class Monitor:
         """A paxos value committed: persist, adopt, publish (every
         quorum mon).  The store write comes FIRST — a committed value
         the cluster acted on must survive this mon's restart
-        (MonitorDBStore contract)."""
+        (MonitorDBStore contract).  The committed-to-committed osdmap
+        delta lands in the incremental ring here, so EVERY quorum mon
+        (not just the leader) can serve delta chains; a restarted mon
+        starts with an empty ring and serves fulls until it refills."""
         self.store.save_committed(value)
         with self.lock:
+            old_om = self._committed_json.get("osdmap")
+            new_om = value.get("osdmap")
+            if old_om and new_om and \
+                    new_om.get("epoch", 0) > old_om.get("epoch", 0):
+                inc = Incremental.diff(old_om, new_om)
+                self._inc_ring[inc.epoch] = inc.to_json()
+                while len(self._inc_ring) > self.OSDMAP_INC_RING:
+                    del self._inc_ring[min(self._inc_ring)]
+                self.perf.inc("map_epochs",
+                              new_om["epoch"] - old_om["epoch"])
             self._adopt_value(value)
             self._committed_json = value
         self._publish()
@@ -286,8 +371,14 @@ class Monitor:
 
     def shutdown(self) -> None:
         self._stop.set()
+        with self.lock:
+            if self._batch_timer is not None:
+                self._batch_timer.cancel()
+                self._batch_timer = None
         with self.paxos.lock:
             self.paxos.role = "down"   # wait_for_leader must skip us
+        if self.asok is not None:
+            self.asok.shutdown()
         self.messenger.shutdown()
         self.store.close()
 
@@ -298,9 +389,53 @@ class Monitor:
         the mutation is rolled back (quorum-loss path)."""
         with self.lock:
             self.paxos_version += 1
+            if self._batch_dirty:
+                # pending batched osdmap mutations ride this value —
+                # and they MUST carry an epoch bump: map content never
+                # changes under an unchanged epoch (the incremental/
+                # keepalive machinery keys entirely off it), and
+                # non-osdmap command paths (config/auth/fs/mgr) reach
+                # here without bumping.  An osdmap command path that
+                # already bumped just spends one extra epoch number.
+                self.osdmap.bump_epoch()
+                self._batch_dirty = False
             value = self._current_value()
-        ok = self.paxos.propose(value)
+        with self.perf.time("map_commit"):
+            ok = self.paxos.propose(value)
         return ok
+
+    def _commit_batched(self) -> None:
+        """Batched commit for fire-and-forget maintenance mutations
+        (boots, failure mark-downs): the mutation is already applied
+        to the local map; everything arriving within MAP_BATCH_WINDOW
+        commits as ONE epoch + ONE publish instead of one each — the
+        difference between O(burst) and O(1) epochs when 64 OSDs boot
+        or a host's worth of OSDs is reported down at once."""
+        with self.lock:
+            self._batch_dirty = True
+            self.perf.inc("map_batched_mutations")
+            if self._batch_timer is None:
+                t = threading.Timer(self.MAP_BATCH_WINDOW,
+                                    self._flush_batch)
+                t.daemon = True
+                self._batch_timer = t
+                t.start()
+
+    def _flush_batch(self) -> None:
+        # the propose stays INSIDE self.lock like every synchronous
+        # command path: proposing with only the paxos proposal_lock
+        # held would reverse the mon.lock -> proposal_lock order those
+        # paths establish (lockdep-caught deadlock with _apply_commit
+        # re-acquiring mon.lock on the commit callback)
+        with self.lock:
+            self._batch_timer = None
+            if not self._batch_dirty or not self.is_leader:
+                # an interleaved synchronous command already committed
+                # the batch (or leadership moved: reporters re-send)
+                self._batch_dirty = False
+                return
+            # _propose_current bumps the epoch for the dirty batch
+            self._propose_current()
 
     def _map_payload(self) -> dict:
         """The MMonMap body: the committed osdmap plus the central
@@ -313,13 +448,119 @@ class Monitor:
 
     def _publish(self) -> None:
         """Push the committed map to every subscriber (reference OSDMap
-        epoch share; subscribers are daemons and clients)."""
-        j = self._map_payload()
-        for conn in list(self._subscribers):
+        epoch share; subscribers are daemons and clients) — as the
+        delta since each subscriber's tracked epoch, a full map only
+        when its epoch fell off the incremental ring (or it never had
+        one)."""
+        with self.lock:
+            subs = list(self._subscribers.items())
+        for conn, have in subs:
             try:
-                conn.send_message(M.MMonMap(j))
+                self._send_map_update(conn, have)
             except Exception:  # noqa: BLE001
-                self._subscribers.remove(conn)
+                with self.lock:
+                    self._subscribers.pop(conn, None)
+
+    def _committed_epoch(self) -> int:
+        """The osdmap epoch of the COMMITTED value — what map sends
+        actually serve.  (The live map may be mid-mutation ahead of it
+        while a propose is in flight; serving decisions keyed on the
+        live epoch could overtrack a subscriber past an epoch it never
+        received.)"""
+        return self._committed_json.get("osdmap", {}).get("epoch", 0)
+
+    def _full_payload_size(self) -> int:
+        """Serialized size of the current full-map payload, cached per
+        epoch: the full-publish-equivalent accounting must not itself
+        pay the serialization keepalives exist to avoid."""
+        with self.lock:
+            epoch = self._committed_epoch()
+            if self._full_size_cache[0] == epoch:
+                return self._full_size_cache[1]
+            size = len(json.dumps(self._map_payload()))
+            self._full_size_cache = (epoch, size)
+            return size
+
+    def _inc_chain(self, have: int, epoch: int) -> list | None:
+        """The ring's delta chain covering (have, epoch], oldest
+        first, or None when the ring cannot reach `have` exactly (gap
+        -> caller sends a full)."""
+        if have <= 0 or have >= epoch:
+            return None
+        chain: list = []
+        e = epoch
+        with self.lock:
+            while e > have:
+                inc = self._inc_ring.get(e)
+                if inc is None:
+                    return None
+                chain.append(inc)
+                e = inc["prev"]
+        if e != have:
+            return None     # a catch-up delta jumped past `have`
+        chain.reverse()
+        return chain
+
+    def _send_map_update(self, conn, have: int) -> None:
+        """One subscriber's map update: keepalive ack when current,
+        delta chain when the ring covers it, full map otherwise
+        (reference OSDMonitor::send_incremental).  Tracks the epoch
+        optimistically; the subscriber's next have_epoch corrects."""
+        with self.lock:
+            epoch = self._committed_epoch()
+            config = self._committed_json.get("config", {})
+        if have >= epoch > 0:
+            conn.send_message(M.MOSDMapInc(epoch=epoch, config=config))
+            self.perf.inc("map_keepalive_sends")
+            self.perf.inc("map_full_equiv_bytes",
+                          self._full_payload_size())
+            return
+        chain = self._inc_chain(have, epoch)
+        if chain is not None:
+            msg = M.MOSDMapInc(epoch=epoch, incs=chain, config=config)
+            conn.send_message(msg)
+            self.perf.inc("map_inc_sends")
+            self.perf.inc("map_inc_bytes", len(msg.data_segment()))
+        else:
+            conn.send_message(M.MMonMap(self._map_payload()))
+            self.perf.inc("map_full_sends")
+            self.perf.inc("map_full_bytes", self._full_payload_size())
+        self.perf.inc("map_full_equiv_bytes", self._full_payload_size())
+        with self.lock:
+            if conn in self._subscribers:
+                self._subscribers[conn] = epoch
+
+    def map_stats(self) -> dict:
+        """Map-distribution ledger (the `osdmap status` asok payload
+        and the --scale bench's gate source)."""
+        with self.lock:
+            ring = sorted(self._inc_ring)
+            n_subs = len(self._subscribers)
+            epoch = self.osdmap.epoch
+        d = self.perf.dump()
+        actual = d["map_full_bytes"] + d["map_inc_bytes"]
+        commit = d["map_commit"]
+        return {
+            "epoch": epoch,
+            "subscribers": n_subs,
+            "ring": {"len": len(ring),
+                     "from": ring[0] if ring else None,
+                     "to": ring[-1] if ring else None},
+            "epochs_committed": d["map_epochs"],
+            "sends": {"full": d["map_full_sends"],
+                      "inc": d["map_inc_sends"],
+                      "keepalive": d["map_keepalive_sends"]},
+            "bytes": {"full": d["map_full_bytes"],
+                      "inc": d["map_inc_bytes"],
+                      "shipped": actual,
+                      "full_equiv": d["map_full_equiv_bytes"]},
+            "bytes_saved_ratio": round(
+                d["map_full_equiv_bytes"] / actual, 2) if actual
+            else None,
+            "batched_mutations": d["map_batched_mutations"],
+            "commit": {"count": commit["avgcount"],
+                       "avg_ms": round(commit["avgtime"] * 1e3, 3)},
+        }
 
     def _leader_conn(self):
         return self.messenger.connect(self.mon_addrs[self.paxos.leader])
@@ -359,15 +600,22 @@ class Monitor:
                                   uncommitted=msg.uncommitted,
                                   epoch=msg.epoch)
         elif isinstance(msg, M.MMonGetMap):
+            # have_epoch is the subscriber's authoritative state — it
+            # overrides our optimistic tracking (and a 0 from an older
+            # sender or a gap-recovering daemon forces a full map)
+            have = getattr(msg, "have_epoch", 0)
             with self.lock:
-                if conn not in self._subscribers:
-                    self._subscribers.append(conn)
+                self._subscribers[conn] = have
             # lease reads only: a mon outside the quorum (partitioned,
             # electing) must not serve a possibly-stale map — silence
             # makes daemons/clients hunt to a live mon (reference
             # Paxos::is_lease_valid gating on reads)
             if self._lease_ok():
-                conn.send_message(M.MMonMap(self._map_payload()))
+                try:
+                    self._send_map_update(conn, have)
+                except Exception:  # noqa: BLE001 - dead conn
+                    with self.lock:
+                        self._subscribers.pop(conn, None)
         elif isinstance(msg, M.MOSDBoot):
             if self.is_leader:
                 self._handle_boot(msg)
@@ -485,8 +733,9 @@ class Monitor:
                                     addr=msg.addr)
             self.osdmap.set_osd_up(msg.osd_id, msg.addr)
             self._failure_reports.pop(msg.osd_id, None)
-            self.osdmap.bump_epoch()
-            self._propose_current()
+        # fire-and-forget mutation: a cold-start boot storm commits as
+        # one epoch per batch window, not one per OSD
+        self._commit_batched()
 
     def _handle_failure(self, msg: M.MOSDFailure) -> None:
         with self.lock:
@@ -499,8 +748,13 @@ class Monitor:
             if len(reports) >= need:
                 self.osdmap.set_osd_down(msg.failed)
                 self._failure_reports.pop(msg.failed, None)
-                self.osdmap.bump_epoch()
-                self._propose_current()
+                marked = True
+            else:
+                marked = False
+        if marked:
+            # a host's worth of failure reports arriving in a burst
+            # coalesces into one mark-down epoch
+            self._commit_batched()
 
     def _handle_slow_op_report(self, msg: M.MOSDSlowOpReport) -> None:
         """An OSD's tracker latched (or cleared) slow ops (reference:

@@ -405,17 +405,27 @@ class MOSDPing(Message):
 
 @register_message
 class MMonGetMap(Message):
+    """Map subscription / refresh request.  `have_epoch` is the
+    subscriber's current osdmap epoch (reference: the `start` epoch in
+    MMonSubscribe's sub_osdmap): 0 means "no map, send a full"; a
+    current epoch turns the request into a ~free keepalive ack, and
+    anything in the mon's incremental ring gets a delta chain instead
+    of the full payload (docs/ARCHITECTURE.md "Map distribution")."""
+
     type_id = 4
 
-    def __init__(self, what: str = "osdmap"):
+    def __init__(self, what: str = "osdmap", have_epoch: int = 0):
         super().__init__()
         self.what = what
+        self.have_epoch = have_epoch
 
     def to_meta(self):
-        return {"what": self.what}
+        return {"what": self.what, "have": self.have_epoch}
 
     def decode_wire(self, meta, data):
         self.what = meta["what"]
+        # absent on messages from an older sender: 0 = full map
+        self.have_epoch = meta.get("have", 0)
 
 
 @register_message
@@ -436,6 +446,40 @@ class MMonMap(Message):
 
     def decode_wire(self, meta, data):
         self.map_json = json.loads(data.decode()) if data else {}
+
+
+@register_message
+class MOSDMapInc(Message):
+    """Incremental osdmap range (reference MOSDMap carrying
+    OSDMap::Incremental epochs): `incs` is a contiguous chain of
+    committed epoch deltas (osd_map.Incremental wire JSON, oldest
+    first) the subscriber applies on top of its current map; an EMPTY
+    chain with `epoch` equal to the subscriber's map is the keepalive
+    ack a current daemon's MMonGetMap(have_epoch=) heartbeat earns —
+    bytes instead of a full-map serialization.  The mon's central
+    config sections ride every send like they do on MMonMap."""
+
+    type_id = 6
+
+    def __init__(self, epoch: int = 0, incs: list | None = None,
+                 config: dict | None = None):
+        super().__init__()
+        self.epoch = epoch          # the epoch the chain ends at
+        self.incs = incs or []
+        self.config = config or {}
+
+    def to_meta(self):
+        return {"epoch": self.epoch}
+
+    def data_segment(self):
+        return json.dumps({"incs": self.incs,
+                           "config": self.config}).encode()
+
+    def decode_wire(self, meta, data):
+        self.epoch = meta["epoch"]
+        body = json.loads(data.decode()) if data else {}
+        self.incs = body.get("incs", [])
+        self.config = body.get("config", {})
 
 
 @register_message

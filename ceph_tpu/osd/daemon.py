@@ -33,7 +33,7 @@ from ..crush.hash import crush_hash32
 from ..ec import ErasureCodeError, ErasureCodePluginRegistry, Profile
 from ..msg import Messenger
 from ..msg import messages as M
-from ..osd.osd_map import OSDMap
+from ..osd.osd_map import OSDMap, apply_inc_chain
 from ..store import MemStore
 from ..store.object_store import ObjectStore, Transaction
 from .ec_backend import ECBackend, ShardBackend
@@ -573,6 +573,10 @@ class OSDDaemon:
         self._hb_thread: threading.Thread | None = None
         self._hb_last_seen: dict[int, float] = {}
         self._hb_first_ping: dict[int, float] = {}
+        # MPGStats dedup (last report sent + when): unchanged reports
+        # re-send only at the osd_pg_stat_keepalive cadence
+        self._pgstats_last_sent: dict | None = None
+        self._pgstats_last_time = 0.0
 
         self.messenger = Messenger(f"osd.{osd_id}", auth=auth,
                                    secure=secure)
@@ -689,6 +693,8 @@ class OSDDaemon:
                     return
             if isinstance(msg, M.MMonMap):
                 self._handle_map(msg)
+            elif isinstance(msg, M.MOSDMapInc):
+                self._handle_map_inc(msg)
             elif isinstance(msg, M.MOSDOp):
                 # op tracking starts at messenger dispatch: adopt the
                 # client's trace context — same span, the op continues
@@ -834,7 +840,41 @@ class OSDDaemon:
         # already have (a pure `config set` doesn't bump the osdmap)
         if "config" in msg.map_json:
             self._apply_mon_config(msg.map_json["config"] or {})
-        newmap = OSDMap.from_json(msg.map_json)
+        self._adopt_map(OSDMap.from_json(msg.map_json))
+
+    def _handle_map_inc(self, msg: M.MOSDMapInc) -> None:
+        """Incremental map range or keepalive ack (reference the OSD's
+        handling of MOSDMap incremental epochs): apply the committed
+        delta chain on top of our map — bit-equal to full-map adoption
+        — and fall back to an explicit full-map request on any epoch
+        gap (we slept past the mon's incremental ring, or the mon's
+        optimistic tracking overshot us)."""
+        self._last_map_time = time.time()
+        # config is authoritative on EVERY send (an emptied config_db
+        # must clear the mon layer, exactly like the MMonMap path)
+        self._apply_mon_config(msg.config or {})
+        if not msg.incs:
+            # keepalive: the mon believes we are current.  If it acks
+            # an epoch AHEAD of us its tracking overshot (a send we
+            # never got) — recover with a full request.
+            if msg.epoch > self.osdmap.epoch:
+                self._request_full_map()
+            else:
+                self.map_event.set()
+            return
+        m = apply_inc_chain(self.osdmap, msg.incs)
+        if m is None:               # gap -> explicit full re-request
+            self._request_full_map()
+            return
+        self._adopt_map(m)
+
+    def _request_full_map(self) -> None:
+        try:     # have_epoch=0: the mon must answer with a full map
+            self.mon_conn.send_message(M.MMonGetMap())
+        except Exception:  # noqa: BLE001 - mon hunting handles it
+            pass
+
+    def _adopt_map(self, newmap: OSDMap) -> None:
         if newmap.epoch <= self.osdmap.epoch and self.osdmap.epoch:
             self.map_event.set()
             return
@@ -1736,10 +1776,20 @@ class OSDDaemon:
                         pgid, spg, oid, goid, ancestors, up_osds):
                     all_ok = False
                     continue
-            data = self.store.read(self._cid(spg), goid)
-            attrs = self.store.getattrs(self._cid(spg), goid)
-            omap = self.store.omap_get(self._cid(spg), goid)
-            omap_hdr = self.store.omap_get_header(self._cid(spg), goid)
+            try:
+                data = self.store.read(self._cid(spg), goid)
+                attrs = self.store.getattrs(self._cid(spg), goid)
+                omap = self.store.omap_get(self._cid(spg), goid)
+                omap_hdr = self.store.omap_get_header(
+                    self._cid(spg), goid)
+            except KeyError:
+                # a concurrent split/merge sweep moved the object out
+                # of this collection between the stat above and the
+                # read — it is someone else's to recover now; keep the
+                # pass alive (a KeyError here used to kill the whole
+                # recovery thread mid-pass) and let the retry converge
+                all_ok = False
+                continue
             for osd in acting:
                 if osd == self.osd_id or not self.osdmap.is_up(osd):
                     continue
@@ -3639,6 +3689,17 @@ class OSDDaemon:
             "pools": pools,
         }
 
+    def _pgstats_should_send(self, rep: dict, now: float) -> bool:
+        """A CHANGED report sends immediately (the mon's gates need
+        fresh truth); an unchanged one only re-sends at the slower
+        osd_pg_stat_keepalive cadence to refresh the mon's freshness
+        window — steady state is O(cluster / keepalive) instead of
+        O(cluster / tick) mon-bound report traffic."""
+        if rep != self._pgstats_last_sent:
+            return True
+        return now - self._pgstats_last_time >= \
+            float(self.cct.conf.get("osd_pg_stat_keepalive"))
+
     def _pgstats_loop(self) -> None:
         conf = self.cct.conf
         while not self._hb_stop.wait(
@@ -3648,21 +3709,49 @@ class OSDDaemon:
                 self.perf.set("pg_degraded", rep["degraded_pgs"])
                 self.perf.set("pg_misplaced", rep["misplaced"])
                 self.perf.set("pg_unfound", rep["unfound"])
-                self.mon_conn.send_message(
-                    M.MPGStats(self.osd_id, rep))
+                now = time.time()
+                if self._pgstats_should_send(rep, now):
+                    self.mon_conn.send_message(
+                        M.MPGStats(self.osd_id, rep))
+                    self._pgstats_last_sent = rep
+                    self._pgstats_last_time = now
             except Exception:  # noqa: BLE001 - mon electing/shutdown
                 pass
 
     # -- heartbeats (reference OSD::handle_osd_ping / failure_queue) --------
+
+    def _heartbeat_peers(self) -> list[int]:
+        """Bounded heartbeat peer subset (reference OSD::maybe_update_
+        heartbeat_peers + osd_heartbeat_min_peers): ring neighbors by
+        OSD id.  Small clusters keep the full mesh; above the target
+        count each OSD pings only ~osd_heartbeat_min_peers neighbors,
+        and — because ring selection is symmetric — remains WATCHED by
+        about as many, so the mon's failure-reporter quorum still
+        trips without the O(N^2)-per-tick ping mesh."""
+        import bisect
+        peers = sorted(o.id for o in self.osdmap.osds.values()
+                       if o.up and o.id != self.osd_id)
+        want = max(2, int(self.cct.conf.get("osd_heartbeat_min_peers")))
+        if len(peers) <= want:
+            return peers
+        i = bisect.bisect_left(peers, self.osd_id)
+        half = (want + 1) // 2
+        sel = {peers[(i + k) % len(peers)] for k in range(half)}
+        sel |= {peers[(i - 1 - k) % len(peers)] for k in range(half)}
+        return sorted(sel)
 
     def _heartbeat_loop(self) -> None:
         while not self._hb_stop.wait(self.heartbeat_interval):
             now = time.time()
             # mon keepalive + hunting: no map traffic for too long means
             # our mon may be dead — rotate to the next one and
-            # re-announce (reference MonClient::tick hunting)
+            # re-announce (reference MonClient::tick hunting).  The
+            # keepalive carries our epoch, so a current daemon's tick
+            # earns a ~zero-byte ack instead of a full-map payload
+            # (counted in the mon's map_keepalive_sends).
             try:
-                self.mon_conn.send_message(M.MMonGetMap())
+                self.mon_conn.send_message(
+                    M.MMonGetMap(have_epoch=self.osdmap.epoch))
                 stale = max(2.0, 4 * self.heartbeat_interval)
                 if len(self.mon_addrs) > 1 and \
                         now - self._last_map_time > stale:
@@ -3671,13 +3760,15 @@ class OSDDaemon:
                     self.mon_conn = self.messenger.connect(
                         self.mon_addrs[self._mon_idx])
                     self._last_map_time = now
-                    self.mon_conn.send_message(M.MMonGetMap())
+                    self.mon_conn.send_message(
+                        M.MMonGetMap(have_epoch=self.osdmap.epoch))
                     self.mon_conn.send_message(
                         M.MOSDBoot(self.osd_id, self.addr))
             except Exception:  # noqa: BLE001
                 pass
-            peers = [o for o in self.osdmap.osds.values()
-                     if o.up and o.id != self.osd_id]
+            peers = [self.osdmap.osds[oid]
+                     for oid in self._heartbeat_peers()
+                     if oid in self.osdmap.osds]
             for o in peers:
                 try:
                     # lossy: a dead peer must not accumulate a replay
@@ -3695,7 +3786,11 @@ class OSDDaemon:
                 self._hb_first_ping.setdefault(o.id, now)
                 last = self._hb_last_seen.get(o.id,
                                               self._hb_first_ping[o.id])
-                grace = self.heartbeat_interval * 4
+                # osd_heartbeat_grace was declared but never read —
+                # the multiplier was hardcoded at its default of 4;
+                # loaded many-daemon boxes need it tunable
+                grace = self.heartbeat_interval * \
+                    float(self.cct.conf.get("osd_heartbeat_grace"))
                 if now - last > grace:
                     self.mon_conn.send_message(M.MOSDFailure(
                         self.osd_id, o.id, self.osdmap.epoch))

@@ -9,9 +9,17 @@ then applies up/down filtering and overrides).
 
 Incremental maps: `Incremental` records deltas; `apply_incremental`
 advances the epoch.  (The mon is the sole author; everyone else applies.)
+The delta is produced by structural diff of the committed wire JSON
+(`Incremental.diff`) rather than by mutation recording — the reference's
+`OSDMap::Incremental` is likewise a new_*/old_* delta encoding, and the
+diff construction makes `apply_incremental(full_{e-1}) == full_e`
+bit-equal BY CONSTRUCTION for every mutator, present and future, instead
+of relying on each mutation site to remember to record itself.
 """
 
 from __future__ import annotations
+
+import json
 
 from dataclasses import dataclass, field, replace
 
@@ -287,11 +295,37 @@ class OSDMap:
         self._pg_cache.clear()
         return self.epoch
 
+    # -- incremental adoption (subscriber side) -----------------------------
+
+    def canonical(self) -> str:
+        """Order-independent canonical serialization — the bit-equality
+        yardstick: two maps are the same state iff their canonical
+        strings are equal (wire JSON list ordering is insertion-order
+        on the mon and id-order after an incremental rebuild; no query
+        depends on it)."""
+        return json.dumps(map_json_keyed(self.to_json()), sort_keys=True)
+
+    def apply_incremental(self, inc: "Incremental") -> "OSDMap":
+        """Advance this map by one committed delta, returning the NEW
+        map (the adoption paths replace their map wholesale, like the
+        full-map path).  Raises ValueError on an epoch gap — the caller
+        falls back to an explicit full-map re-request."""
+        if inc.prev != self.epoch:
+            raise ValueError(
+                f"incremental {inc.prev}->{inc.epoch} does not apply "
+                f"to epoch {self.epoch} (gap)")
+        keyed = map_json_keyed(self.to_json())
+        inc.patch(keyed)
+        return OSDMap.from_json(keyed_to_map_json(keyed))
+
     # -- wire form (mon -> everyone; reference OSDMap::encode) --------------
 
     def to_json(self) -> dict:
         from ..crush.map import Rule, Step
         crush = self.crush.map
+        # every mutable container is COPIED: a to_json snapshot (the
+        # mon's committed value, the incremental diff base) must not
+        # change underneath when the live map mutates in place
         return {
             "epoch": self.epoch,
             "osds": [[o.id, o.up, o.in_, o.weight, list(o.addr or ())]
@@ -302,17 +336,19 @@ class OSDMap:
                        list(p.removed_snaps), p.pg_autoscale_mode,
                        p.pg_num_max]
                       for p in self.pools.values()],
-            "pg_temp": [[pg.pool, pg.seed, osds]
+            "pg_temp": [[pg.pool, pg.seed, list(osds)]
                         for pg, osds in self.pg_temp.items()],
             "pg_upmap_items": [
                 [pg.pool, pg.seed, [list(p) for p in pairs]]
                 for pg, pairs in self.pg_upmap_items.items()],
-            "ec_profiles": self.ec_profiles,
-            "blacklist": self.blacklist,
+            "ec_profiles": {name: dict(p)
+                            for name, p in self.ec_profiles.items()},
+            "blacklist": dict(self.blacklist),
             "crush": {
                 "devices": [[d.id, d.weight, d.device_class]
                             for d in crush.devices.values()],
-                "buckets": [[b.id, b.name, b.type_name, b.items, b.weights]
+                "buckets": [[b.id, b.name, b.type_name, list(b.items),
+                             list(b.weights)]
                             for b in crush.buckets.values()],
                 "rules": [[r.id, r.name, r.mode,
                            [[s.op, s.num, s.type_name, s.mode, s.item]
@@ -368,3 +404,147 @@ class OSDMap:
         m.crush._next_bucket_id = cj["next_bucket_id"]
         m.crush._next_rule_id = cj["next_rule_id"]
         return m
+
+
+# -- incremental maps (reference OSDMap::Incremental + the MOSDMap
+#    incremental ranges OSDMonitor::send_incremental ships) -----------------
+#
+# The wire JSON's sections re-keyed as dicts so a delta is a set of
+# dict set/del operations and map equality is order-independent.
+
+_KEYED_SECTIONS = ("osds", "pools", "pg_temp", "pg_upmap_items",
+                   "ec_profiles", "blacklist", "crush_devices",
+                   "crush_buckets", "crush_rules")
+_SCALAR_KEYS = ("next_bucket_id", "next_rule_id")
+
+
+def map_json_keyed(j: dict) -> dict:
+    """Canonical keyed form of a full-map wire JSON (extra keys such
+    as the piggybacked central config are dropped — they are not map
+    state)."""
+    crush = j.get("crush", {})
+    return {
+        "epoch": j["epoch"],
+        "osds": {str(rec[0]): list(rec) for rec in j.get("osds", [])},
+        "pools": {str(rec[0]): list(rec) for rec in j.get("pools", [])},
+        "pg_temp": {f"{pool}.{seed}": [pool, seed, list(osds)]
+                    for pool, seed, osds in j.get("pg_temp", [])},
+        "pg_upmap_items": {
+            f"{pool}.{seed}": [pool, seed,
+                               [list(p) for p in pairs]]
+            for pool, seed, pairs in j.get("pg_upmap_items", [])},
+        "ec_profiles": {name: dict(p)
+                        for name, p in j.get("ec_profiles", {}).items()},
+        "blacklist": dict(j.get("blacklist", {})),
+        "crush_devices": {str(rec[0]): list(rec)
+                          for rec in crush.get("devices", [])},
+        "crush_buckets": {str(rec[0]): list(rec)
+                          for rec in crush.get("buckets", [])},
+        "crush_rules": {str(rec[0]): list(rec)
+                        for rec in crush.get("rules", [])},
+        "next_bucket_id": crush.get("next_bucket_id", -1),
+        "next_rule_id": crush.get("next_rule_id", 0),
+    }
+
+
+def keyed_to_map_json(keyed: dict) -> dict:
+    """Rebuild a from_json-consumable full-map JSON from the keyed
+    form (sections come out id-ordered; nothing reads the order)."""
+    def by_id(sec: str) -> list:
+        return [keyed[sec][k]
+                for k in sorted(keyed[sec], key=lambda s: int(s))]
+
+    def by_pg(sec: str) -> list:
+        return [keyed[sec][k] for k in sorted(
+            keyed[sec], key=lambda s: tuple(map(int, s.split("."))))]
+
+    return {
+        "epoch": keyed["epoch"],
+        "osds": by_id("osds"),
+        "pools": by_id("pools"),
+        "pg_temp": by_pg("pg_temp"),
+        "pg_upmap_items": by_pg("pg_upmap_items"),
+        "ec_profiles": keyed["ec_profiles"],
+        "blacklist": keyed["blacklist"],
+        "crush": {
+            "devices": by_id("crush_devices"),
+            "buckets": by_id("crush_buckets"),
+            "rules": by_id("crush_rules"),
+            "next_bucket_id": keyed["next_bucket_id"],
+            "next_rule_id": keyed["next_rule_id"],
+        },
+    }
+
+
+def apply_inc_chain(osdmap: OSDMap, incs: list) -> OSDMap | None:
+    """Apply a published delta chain (Incremental wire JSONs, oldest
+    first) on top of `osdmap`: already-applied epochs are skipped
+    (duplicate delivery), and None means an epoch GAP — the caller
+    must fall back to an explicit full-map request.  The one applier
+    shared by OSD, objecter, and mgr."""
+    m = osdmap
+    try:
+        for j in incs:
+            inc = Incremental.from_json(j)
+            if inc.epoch <= m.epoch:
+                continue
+            m = m.apply_incremental(inc)
+    except ValueError:
+        return None
+    return m
+
+
+@dataclass
+class Incremental:
+    """One committed epoch's delta: apply on top of epoch `prev` to
+    reach epoch `epoch`.  Sections carry full replacement records for
+    changed/added keys and a removal list — the shape of the
+    reference's new_*/old_* maps in OSDMap::Incremental."""
+    epoch: int
+    prev: int
+    sets: dict = field(default_factory=dict)   # section -> {key: record}
+    dels: dict = field(default_factory=dict)   # section -> [keys]
+
+    @classmethod
+    def diff(cls, old_j: dict, new_j: dict) -> "Incremental":
+        """Structural diff of two full-map wire JSONs (old -> new)."""
+        ok, nk = map_json_keyed(old_j), map_json_keyed(new_j)
+        sets: dict = {}
+        dels: dict = {}
+        for sec in _KEYED_SECTIONS:
+            o, n = ok[sec], nk[sec]
+            changed = {k: v for k, v in n.items() if o.get(k) != v}
+            gone = sorted(k for k in o if k not in n)
+            if changed:
+                sets[sec] = changed
+            if gone:
+                dels[sec] = gone
+        scalars = {k: nk[k] for k in _SCALAR_KEYS if ok[k] != nk[k]}
+        if scalars:
+            sets["_scalars"] = scalars
+        return cls(epoch=nk["epoch"], prev=ok["epoch"],
+                   sets=sets, dels=dels)
+
+    def patch(self, keyed: dict) -> None:
+        """Apply in place onto a keyed full-map form."""
+        for sec, keys in self.dels.items():
+            d = keyed.get(sec)
+            if d is not None:
+                for k in keys:
+                    d.pop(k, None)
+        for sec, vals in self.sets.items():
+            if sec == "_scalars":
+                keyed.update(vals)
+            else:
+                keyed.setdefault(sec, {}).update(vals)
+        keyed["epoch"] = self.epoch
+
+    def to_json(self) -> dict:
+        return {"epoch": self.epoch, "prev": self.prev,
+                "set": self.sets, "del": self.dels}
+
+    @classmethod
+    def from_json(cls, j: dict) -> "Incremental":
+        return cls(epoch=j["epoch"], prev=j["prev"],
+                   sets=dict(j.get("set", {})),
+                   dels=dict(j.get("del", {})))

@@ -18,7 +18,7 @@ import time
 from ..common.tracked_op import OpTracker, TraceContext
 from ..msg import Messenger
 from ..msg import messages as M
-from ..osd.osd_map import OSDMap
+from ..osd.osd_map import OSDMap, apply_inc_chain
 from ..osd.types import hobject_t, spg_t
 
 
@@ -134,6 +134,21 @@ class Objecter:
                 self.osdmap = newmap
             self._map_nudge_pending = False
             self.map_event.set()
+        elif isinstance(msg, M.MOSDMapInc):
+            # incremental publish / keepalive ack: apply the delta
+            # chain like the OSD does; a gap (or a keepalive claiming
+            # an epoch we never got) re-requests a full map
+            m = apply_inc_chain(self.osdmap, msg.incs)
+            if m is None or (not msg.incs and
+                             msg.epoch > self.osdmap.epoch):
+                try:
+                    self.mon_conn.send_message(M.MMonGetMap())
+                except Exception:  # noqa: BLE001 - mon electing
+                    pass
+                return
+            self.osdmap = m
+            self._map_nudge_pending = False
+            self.map_event.set()
         elif isinstance(msg, M.MOSDOpReply):
             with self._lock:
                 w = self._waiters.pop(msg.tid, None)
@@ -165,11 +180,16 @@ class Objecter:
     # -- map plumbing -------------------------------------------------------
 
     def refresh_map(self, timeout: float = 5.0) -> None:
+        # carry our epoch: a current map earns a keepalive ack, a
+        # stale one an incremental chain — not a full payload per
+        # refresh (docs/ARCHITECTURE.md "Map distribution")
         self.map_event.clear()
-        self.mon_conn.send_message(M.MMonGetMap())
+        self.mon_conn.send_message(
+            M.MMonGetMap(have_epoch=self.osdmap.epoch))
         if not self.map_event.wait(timeout):
             self._rotate_mon()
-            self.mon_conn.send_message(M.MMonGetMap())
+            self.mon_conn.send_message(
+                M.MMonGetMap(have_epoch=self.osdmap.epoch))
             self.map_event.wait(timeout)
 
     def _calc_target(self, pool_id: int, name: str
@@ -267,7 +287,8 @@ class Objecter:
                     # not multiply into a burst of mon requests.
                     self._map_nudge_pending = True
                     try:
-                        self.mon_conn.send_message(M.MMonGetMap())
+                        self.mon_conn.send_message(M.MMonGetMap(
+                            have_epoch=self.osdmap.epoch))
                     except Exception:  # noqa: BLE001 - mon electing
                         pass
                 if reply.result == -errno.EAGAIN:
@@ -406,7 +427,12 @@ class Objecter:
         rotates the session to the next one (reference MonClient
         hunting + command resend on session reset)."""
         deadline = time.time() + timeout
-        attempt_timeout = min(3.0, timeout)
+        # the attempt window scales with the caller's budget: a SLOW
+        # (not dead) mon whose ack RT exceeds a fixed 3 s window would
+        # never land an ack — every resend starts a new tid, and the
+        # resend storm itself adds mon load.  Short budgets keep the
+        # snappy 3 s hunt; long budgets wait the mon out.
+        attempt_timeout = min(max(3.0, timeout / 3.0), timeout)
         while True:
             with self._lock:
                 self._tid += 1

@@ -32,6 +32,12 @@ commands this build's mon implements:
       # `mesh status` = the multichip plane state (docs/MULTICHIP.md);
       # `repair status` = recovery backlog/throttle + per-PG repair
       # ledger (docs/REPAIR.md)
+  python -m ceph_tpu.tools.ceph_cli daemon /path/to/mon.0.asok \
+      osdmap status
+      # mon map-distribution ledger: full/incremental/keepalive sends,
+      # bytes shipped vs the full-publish equivalent, incremental ring
+      # span, batched mutations (docs/ARCHITECTURE.md "Map
+      # distribution")
 """
 
 from __future__ import annotations
@@ -67,7 +73,7 @@ def daemon_command(argv: list[str]) -> int:
     # prefix.  Parity-based folding alone cannot reach the three-word
     # `launch queue status`, hence the head-driven loop.
     heads = ("perf", "config", "log", "mesh", "launch", "launch queue",
-             "repair")
+             "repair", "osdmap")
     while extra and prefix in heads:
         prefix = f"{prefix} {extra[0]}"
         extra = extra[1:]

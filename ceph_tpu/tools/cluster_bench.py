@@ -13,6 +13,17 @@ Matrix: replicated x3, EC k=2 m=1, EC k=8 m=3 (the reference's
 canonical profile) — each on MemStore; EC additionally with the
 dynamic batch window on vs off (tpu_batch_window_ms) to quantify the
 cross-transaction batching the TPU pipeline exists for.
+
+`--scale [N]` (default 64) is the CONTROL-PLANE row instead: stand up
+the largest thread-topology cluster the box allows, churn map epochs
+via split + merge + drain + kill/revive UNDER write load, and gate
+  - map bytes shipped per epoch vs the full-publish equivalent
+    (>= SCALE_MAP_RATIO_MIN, default 10x — the incremental-publish
+    claim, docs/ARCHITECTURE.md "Map distribution"),
+  - heartbeat keepalives counted (a current daemon's tick is ~free),
+  - incremental-applied maps bit-equal to the mon's on every daemon,
+  - time-to-active-clean after the churn with ZERO acked-write loss.
+One BENCH-comparable JSON line; rc != 0 on any gate failure.
 """
 
 from __future__ import annotations
@@ -206,7 +217,28 @@ def main(argv=None) -> int:
                     help="multi-process topology (ProcCluster): each "
                          "daemon its own interpreter — cluster numbers "
                          "measure the system, not one GIL")
+    ap.add_argument("--scale", nargs="?", type=int, const=64,
+                    default=None, metavar="N",
+                    help="control-plane scale row instead of the I/O "
+                         "matrix: N-OSD cluster (default 64), epoch "
+                         "churn under load, incremental-map + "
+                         "active-clean + zero-loss gates, rc!=0 on "
+                         "failure")
+    ap.add_argument("--heartbeat", type=float, default=2.0,
+                    help="heartbeat interval for the --scale cluster "
+                         "(failure detection + mon keepalive cadence)")
+    ap.add_argument("--hb-peers", type=int, default=6,
+                    help="osd_heartbeat_min_peers for the --scale "
+                         "cluster (ring-subset ping fan-out)")
+    ap.add_argument("--hb-grace", type=float, default=10.0,
+                    help="osd_heartbeat_grace for the --scale cluster "
+                         "(missed-ping multiplier; generous so python "
+                         "thread scheduling jitter on a small box "
+                         "doesn't flap daemons down)")
     args = ap.parse_args(argv)
+
+    if args.scale is not None:
+        return _main_scale(args)
 
     if args.mesh is not None:
         # CPU hosts need the virtual devices BEFORE jax initializes
@@ -260,6 +292,217 @@ def main(argv=None) -> int:
                 for osd in c.osds
                 for st in getattr(osd, "pgs", {}).values())
             print(json.dumps({"config": name, **counters}), flush=True)
+    return 0
+
+
+def _main_scale(args) -> int:
+    """The ROADMAP-item-5 scale row: where does the control plane
+    actually stop scaling?  Epoch churn (split, merge, drain walk,
+    kill/revive) on the biggest thread-topology cluster the box
+    allows, write load running THROUGH the churn, and the map
+    distribution ledger gated against the full-publish baseline."""
+    import os
+    import queue as _q
+
+    from ..osdc.objecter import TimedOut
+    from ..rados.client import RadosError
+    from .vstart import Cluster
+
+    n = args.scale
+    min_ratio = float(os.environ.get("SCALE_MAP_RATIO_MIN", "10"))
+    clean_timeout = float(os.environ.get("SCALE_CLEAN_TIMEOUT_S",
+                                         "180"))
+    rng = np.random.default_rng(7)
+    payload = rng.integers(0, 256, args.size, dtype=np.uint8).tobytes()
+    row: dict = {"metric": "cluster_scale", "osds": n,
+                 "obj_size": args.size}
+    fail: list[str] = []
+    t0 = time.time()
+    with Cluster(n_osds=n, heartbeat_interval=args.heartbeat,
+                 boot_parallel=True,
+                 conf={"osd_heartbeat_min_peers": args.hb_peers,
+                       "osd_heartbeat_grace": args.hb_grace}) as c:
+        row["boot_s"] = round(time.time() - t0, 2)
+        client = None
+        for _ in range(5):      # map RT right after a big boot can
+            try:                # exceed the client's 10 s start budget
+                client = c.client()
+                break
+            except TimedOut:
+                time.sleep(1.0)
+        if client is None:
+            client = c.client()
+
+        def mcmd(cmd: dict, budget: float = 180.0) -> dict:
+            """Mon command with a generous single-attempt window: with
+            N daemons + recovery threads sharing this interpreter, an
+            ack can starve well past the client's default 3 s attempt
+            (and a blind resend of a landed create answers EEXIST);
+            EBUSY/EAGAIN (interleave guard, stats refresh) retry."""
+            deadline = time.time() + budget
+            while True:
+                try:
+                    r, out = client.objecter.mon_command(
+                        cmd, timeout=min(60.0, budget))
+                except TimedOut:
+                    r, out = -1, {"error": "mon command timeout"}
+                if r == 0 or -r == 17:        # ok / EEXIST on resend
+                    return out
+                if time.time() > deadline:
+                    raise RuntimeError(f"{cmd.get('prefix')}: {out}")
+                time.sleep(0.5)
+
+        mcmd({"prefix": "osd pool create", "name": "scale",
+              "type": "replicated", "size": 3, "pg_num": 32})
+        io = client.open_ioctx("scale")
+        acked: dict[str, bool] = {}
+        acked_q: _q.Queue = _q.Queue()
+        stop_writing = threading.Event()
+
+        def writer(t: int) -> None:
+            i = 0
+            while not stop_writing.is_set():
+                name = f"s_{t}_{i}"
+                try:
+                    # short per-op budget: a write racing a killed
+                    # primary must fail fast and move on, not pin the
+                    # churn phase on a 30 s default timeout
+                    reply = client.objecter.op_submit(
+                        io.pool_id, name,
+                        [["writefull", len(payload)]], payload,
+                        timeout=5.0, attempts=2)
+                    if reply.result == 0:
+                        acked_q.put(name)
+                except Exception:  # noqa: BLE001 - churn makes every
+                    pass           # failure shape expected here
+                i += 1
+
+        # lighter write load at high N: the point is load DURING
+        # churn, not peak IOPS — at 64 in-process daemons the GIL is
+        # the scarce resource
+        n_writers = 2 if n >= 32 else min(args.threads, 4)
+        writers = [threading.Thread(target=writer, args=(t,),
+                                    daemon=True)
+                   for t in range(n_writers)]
+        for t in writers:
+            t.start()
+        time.sleep(max(1.0, args.seconds / 2))
+
+        # split/merge churn rides its own pool: the measured axis is
+        # CONTROL-PLANE fan-out (epochs, sweeps, re-peering on every
+        # daemon), not data migration — resizing the loaded pool at
+        # 64 OSDs additionally triggers O(PGs x OSDs) recovery wide
+        # scans that swamp a small box for minutes (tier-1's
+        # pg_split/pg_merge thrash suites own that axis); drain +
+        # kill/revive below still remap the LOADED pool
+        mcmd({"prefix": "osd pool create", "name": "churn",
+              "type": "replicated", "size": 3, "pg_num": 8})
+
+        def pool_set(val: int, budget: float = 180.0) -> None:
+            mcmd({"prefix": "osd pool set", "pool": "churn",
+                  "var": "pg_num", "val": val}, budget)
+
+        churn_t0 = time.time()
+        epoch0 = c.mon.osdmap.epoch
+        pool_set(16)                       # split under load
+        time.sleep(1.0)
+        pool_set(8)                        # merge back (interleave-
+        # guarded: retries until split pushes settle)
+        # drain walk: one committed epoch per weight step
+        mcmd({"prefix": "osd drain", "id": n - 1, "step": 0.5})
+        deadline = time.time() + 60
+        while c.mon.osdmap.osds[n - 1].weight > 0 and \
+                time.time() < deadline:
+            time.sleep(0.2)
+        mcmd({"prefix": "osd reweight", "id": n - 1, "weight": 1.0})
+        # kill/revive: heartbeat failure reports mark them down (a
+        # burst the mon coalesces), revival re-boots them
+        victims = [n // 2, n // 2 + 1]
+        for v in victims:
+            c.kill_osd(v)
+        # detection takes heartbeat * grace on the watching peers
+        deadline = time.time() + \
+            max(30, 3 * args.heartbeat * args.hb_grace)
+        while any(c.mon.osdmap.is_up(v) for v in victims) and \
+                time.time() < deadline:
+            time.sleep(0.2)
+        down_ok = not any(c.mon.osdmap.is_up(v) for v in victims)
+        if not down_ok:
+            fail.append("failure detection never marked victims down")
+        for v in victims:
+            c.revive_osd(v)
+        time.sleep(max(1.0, args.seconds / 2))
+        stop_writing.set()
+        for t in writers:
+            t.join(timeout=30)
+        while not acked_q.empty():
+            acked[acked_q.get()] = True
+        row["churn_s"] = round(time.time() - churn_t0, 2)
+        row["epochs_churned"] = c.mon.osdmap.epoch - epoch0
+
+        clean_t0 = time.time()
+        try:
+            c.wait_active_clean(timeout=clean_timeout)
+            row["time_to_active_clean_s"] = round(
+                time.time() - clean_t0, 2)
+        except TimeoutError as e:
+            row["time_to_active_clean_s"] = None
+            fail.append(f"not active+clean: {e}")
+
+        # zero acked loss: every acked write reads back intact
+        lost = 0
+        for name in acked:
+            try:
+                if io.read(name, len(payload)) != payload:
+                    lost += 1
+            except (TimedOut, RadosError):
+                lost += 1
+        row["acked_objects"] = len(acked)
+        row["lost_objects"] = lost
+        if not acked:
+            fail.append("no write ever acked")
+        if lost:
+            fail.append(f"{lost}/{len(acked)} acked objects lost")
+
+        # bit-equality: incremental adoption converged every daemon to
+        # the mon's exact committed state
+        mon_can = c.mon.osdmap.canonical()
+        diverged = [osd.osd_id for osd in c.osds
+                    if osd is not None and
+                    osd.osdmap.canonical() != mon_can]
+        row["maps_bit_equal"] = not diverged
+        if diverged:
+            fail.append(f"osd maps diverged from mon: {diverged}")
+
+        # the map-distribution ledger + its gates
+        st = c.mon.map_stats()
+        epochs = max(1, st["epochs_committed"])
+        shipped = st["bytes"]["shipped"]
+        row["map_epochs"] = st["epochs_committed"]
+        row["map_fulls"] = st["sends"]["full"]
+        row["map_incrementals"] = st["sends"]["inc"]
+        row["map_keepalives"] = st["sends"]["keepalive"]
+        row["map_bytes_shipped"] = shipped
+        row["map_bytes_per_epoch"] = round(shipped / epochs, 1)
+        row["map_full_equiv_bytes"] = st["bytes"]["full_equiv"]
+        row["map_bytes_ratio"] = st["bytes_saved_ratio"]
+        row["map_batched_mutations"] = st["batched_mutations"]
+        row["mon_commit_ms_avg"] = st["commit"]["avg_ms"]
+        if (st["bytes_saved_ratio"] or 0) < min_ratio:
+            fail.append(f"map bytes ratio {st['bytes_saved_ratio']} "
+                        f"< {min_ratio} (incremental publish not "
+                        f"saving vs full-publish baseline)")
+        if st["sends"]["keepalive"] <= 0:
+            fail.append("no heartbeat keepalive was served (have_"
+                        "epoch path dead: every tick pulls a map)")
+    row["ok"] = not fail
+    if fail:
+        row["failures"] = fail
+    print(json.dumps(row), flush=True)
+    if fail:
+        print(f"# cluster_bench --scale FAILED: {fail}",
+              file=sys.stderr)
+        return 1
     return 0
 
 

@@ -32,7 +32,8 @@ class Cluster:
                  data_dir: str | None = None, n_mons: int = 1,
                  auth: str = "none", secure: bool = False,
                  conf: dict | None = None,
-                 mesh_devices: str | None = None):
+                 mesh_devices: str | None = None,
+                 boot_parallel: bool = False):
         self.conf = dict(conf or {})   # applied to every OSD pre-boot
         # multichip deployment mode (docs/MULTICHIP.md): every OSD in
         # this (one-host) cluster shares the process-wide MeshService,
@@ -66,7 +67,9 @@ class Cluster:
         self.mons = [Monitor(failure_quorum=failure_quorum,
                              auth=mon_auths[i], secure=secure,
                              data_dir=(f"{data_dir}/mon.{i}"
-                                       if data_dir else None))
+                                       if data_dir else None),
+                             asok_path=(f"{asok_dir}/mon.{i}.asok"
+                                        if asok_dir else None))
                      for i in range(n_mons)]
         self.mon_addrs = [m.addr for m in self.mons]
         if n_mons > 1:
@@ -75,6 +78,13 @@ class Cluster:
         self.mon = self.mons[0]   # convenience alias (rank 0)
         self.osds: list[OSDDaemon] = []
         self.n_osds = n_osds
+        # concurrent boots (the scale topology): all MOSDBoots land in
+        # the mon's batch window and commit as a couple of epochs
+        # instead of one epoch + full publish round per OSD — the
+        # difference between O(N) and O(N^2) cold-start control-plane
+        # work.  Sequential remains the default (tests that reason
+        # about per-boot epochs keep their semantics).
+        self.boot_parallel = boot_parallel
         self.heartbeat_interval = heartbeat_interval
         self.asok_dir = asok_dir
         self.objectstore = objectstore
@@ -106,8 +116,17 @@ class Cluster:
                             conf={**self.conf,
                                   **self.osd_conf.get(i, {})})
             self.osds.append(osd)
-        for osd in self.osds:
-            osd.boot()
+        if self.boot_parallel:
+            import threading
+            ts = [threading.Thread(target=osd.boot, daemon=True)
+                  for osd in self.osds]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        else:
+            for osd in self.osds:
+                osd.boot()
         return self
 
     def set_osd_conf(self, osd_id: int, key: str, value) -> None:

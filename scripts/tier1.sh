@@ -50,6 +50,18 @@ if [ "$rc" -eq 0 ]; then
   timeout -k 10 300 env JAX_PLATFORMS=cpu python -m ceph_tpu.tools.load_harness \
     --scenario degraded-read --osds 12 --objects 5 --size 16384 || rc=$?
 fi
+# Control-plane scale gate (ISSUE 14, docs/ARCHITECTURE.md "Map
+# distribution"): a bounded 16-OSD scale row for the 2-core box — epoch
+# churn (split + merge + drain walk + kill/revive) under write load,
+# gating map bytes shipped per epoch >= 10x under the full-publish
+# equivalent (incremental publishes + have_epoch keepalives), bit-equal
+# incremental-applied maps on every daemon, time-to-active-clean, and
+# zero acked-write loss.  The full >= 64-OSD row is
+# `cluster_bench --scale` (default 64) for a box with cores to spare.
+if [ "$rc" -eq 0 ]; then
+  timeout -k 10 420 env JAX_PLATFORMS=cpu python -m ceph_tpu.tools.cluster_bench \
+    --scale 16 --seconds 2 --size 16384 || rc=$?
+fi
 # Fused-kernel variant gate (ISSUE 11, docs/FUSED_CRC.md): every
 # shipped (extract, combine) variant of the fused parity+crc kernel —
 # planar/packed/wide extraction through the XLA log-fold AND the

@@ -1,0 +1,410 @@
+"""Incremental osdmap distribution (ISSUE 14, docs/ARCHITECTURE.md
+"Map distribution").
+
+The contract under test: the mon publishes committed epoch DELTAS
+(osd_map.Incremental over MOSDMapInc) with per-subscriber epoch
+tracking and `have_epoch` keepalives, and incremental adoption is
+bit-equal to full-map adoption at EVERY epoch of a
+split->merge->drain->kill/revive churn; a subscriber that slept past
+the mon's incremental ring recovers with an explicit full map, and an
+old-style subscriber (no have_epoch on the wire) always gets a full —
+the mixed-version fallback.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+from ceph_tpu.mon.monitor import Monitor
+from ceph_tpu.msg import messages as M
+from ceph_tpu.osd.osd_map import Incremental, OSDMap
+
+
+class FakeConn:
+    """Collects messages like a subscriber connection."""
+
+    def __init__(self):
+        self.msgs = []
+
+    def send_message(self, msg):
+        self.msgs.append(wire_roundtrip(msg))
+
+
+def wire_roundtrip(msg):
+    """Encode/decode through the Message wire surface so the test sees
+    exactly what a real peer would."""
+    fresh = type(msg).__new__(type(msg))
+    M.Message.__init__(fresh)
+    data = msg.data_segment() if hasattr(msg, "data_segment") else b""
+    fresh.decode_wire(json.loads(json.dumps(msg.to_meta())), data)
+    return fresh
+
+
+def replay(m: OSDMap, msgs, start: int = 0) -> OSDMap:
+    """Client-side adoption of a publish stream: fulls adopted by
+    epoch, incremental chains applied in order (duplicates skipped)."""
+    for msg in msgs[start:]:
+        if isinstance(msg, M.MMonMap):
+            nm = OSDMap.from_json(msg.map_json)
+            if nm.epoch >= m.epoch:
+                m = nm
+        elif isinstance(msg, M.MOSDMapInc):
+            for j in msg.incs:
+                inc = Incremental.from_json(j)
+                if inc.epoch <= m.epoch:
+                    continue
+                m = m.apply_incremental(inc)
+    return m
+
+
+@pytest.fixture()
+def mon():
+    mon = Monitor()
+    yield mon
+    mon.shutdown()
+
+
+def _settle(mon, timeout: float = 5.0) -> None:
+    """Wait until every batched mutation is committed (live epoch ==
+    committed epoch and nothing pending in the batch window)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        with mon.lock:
+            settled = not mon._batch_dirty and \
+                mon._batch_timer is None and \
+                mon.osdmap.epoch == mon._committed_epoch()
+        if settled:
+            return
+        time.sleep(0.02)
+    raise TimeoutError("batched mutations never committed")
+
+
+def _boot(mon, n: int) -> None:
+    for i in range(n):
+        mon._handle_boot(M.MOSDBoot(i, ("127.0.0.1", 7000 + i)))
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if all(mon.osdmap.is_up(i) for i in range(n)):
+            _settle(mon)
+            return
+        time.sleep(0.02)
+    raise TimeoutError("boot batch never committed")
+
+
+def _stats_fresh(mon, n: int) -> None:
+    for i in range(n):
+        mon._handle_pg_stats(M.MPGStats(i, {"pools": {}}))
+
+
+def test_incremental_roundtrip_pure_map():
+    """OSDMap-level: every mutator's diff applies bit-equal, through a
+    JSON wire roundtrip of the Incremental itself."""
+    from ceph_tpu.osd.types import PoolType, pg_t
+    m = OSDMap()
+    shadow = OSDMap.from_json(m.to_json())
+    old_j = m.to_json()
+
+    def step(mut):
+        nonlocal old_j, shadow
+        mut(m)
+        m.bump_epoch()
+        new_j = m.to_json()
+        inc = Incremental.from_json(json.loads(json.dumps(
+            Incremental.diff(old_j, new_j).to_json())))
+        shadow = shadow.apply_incremental(inc)
+        assert shadow.canonical() == m.canonical()
+        old_j = new_j
+
+    step(lambda m: [m.add_osd(i, f"host{i}") for i in range(6)])
+    step(lambda m: [m.set_osd_up(i, ("127.0.0.1", 7000 + i))
+                    for i in range(6)])
+    step(lambda m: m.create_pool(
+        "p", PoolType.REPLICATED, 3, 8,
+        m.crush.add_simple_rule("r", "default", "host", 3)))
+    step(lambda m: m.set_pool_pg_num(1, 16))       # split
+    step(lambda m: m.set_pool_pg_num(1, 8))        # merge
+    step(lambda m: m.set_osd_weight(3, 0.5))       # drain step
+    step(lambda m: m.pg_temp.__setitem__(pg_t(1, 2), [0, 1, 2]))
+    step(lambda m: m.pg_upmap_items.__setitem__(pg_t(1, 3), [(0, 4)]))
+    step(lambda m: m.set_osd_down(2))              # kill
+    step(lambda m: m.set_osd_up(2))                # revive
+    step(lambda m: m.blacklist.__setitem__("client.x", 1.5))
+    step(lambda m: m.ec_profiles.__setitem__("x", {"k": "4"}))
+    step(lambda m: m.remove_osd(5))
+    # gap refusal: a non-contiguous delta must raise, not mis-apply
+    bad = Incremental.diff(old_j, old_j)
+    bad.prev = 999
+    bad.epoch = 1000
+    with pytest.raises(ValueError):
+        shadow.apply_incremental(bad)
+
+
+def test_incremental_vs_full_equivalence_per_epoch(mon):
+    """Replay a split->merge->drain->kill/revive churn BOTH ways at
+    every epoch: the incremental subscriber's map must be bit-equal to
+    a freshly-served full map after each committed step."""
+    sub = FakeConn()
+    mon._dispatch(sub, M.MMonGetMap())
+    m = OSDMap.from_json(sub.msgs[0].map_json)
+    seen = 1
+
+    def check():
+        nonlocal m, seen
+        _settle(mon)
+        m = replay(m, sub.msgs, seen)
+        seen = len(sub.msgs)
+        probe = FakeConn()
+        mon._dispatch(probe, M.MMonGetMap())       # have=0 -> full
+        full = OSDMap.from_json(probe.msgs[0].map_json)
+        assert m.canonical() == full.canonical()
+        assert m.epoch == full.epoch
+
+    _boot(mon, 6)
+    check()
+    r, out = mon.handle_command(
+        {"prefix": "osd pool create", "name": "p",
+         "type": "replicated", "size": 3, "pg_num": 16})
+    assert r == 0, out
+    check()
+    r, out = mon.handle_command(
+        {"prefix": "osd pool set", "pool": "p", "var": "pg_num",
+         "val": 32})                               # split
+    assert r == 0, out
+    check()
+    _stats_fresh(mon, 6)
+    r, out = mon.handle_command(
+        {"prefix": "osd pool set", "pool": "p", "var": "pg_num",
+         "val": 16})                               # merge
+    assert r == 0, out
+    check()
+    for w in (0.75, 0.5, 0.25, 0.0, 1.0):          # drain walk
+        r, out = mon.handle_command(
+            {"prefix": "osd reweight", "id": 4, "weight": w})
+        assert r == 0, out
+        check()
+    r, out = mon.handle_command({"prefix": "osd down", "id": 5})
+    assert r == 0, out
+    check()
+    mon._handle_boot(M.MOSDBoot(5, ("127.0.0.1", 7005)))  # revive
+    deadline = time.time() + 5
+    while not mon.osdmap.is_up(5) and time.time() < deadline:
+        time.sleep(0.02)
+    check()
+    # the churn after the subscriber HAD a map must have been all
+    # deltas (the initial subscription and the first commit while it
+    # was still tracked at epoch 0 are legitimately full)
+    fulls = sum(isinstance(x, M.MMonMap) for x in sub.msgs)
+    incs = sum(1 for x in sub.msgs
+               if isinstance(x, M.MOSDMapInc) and x.incs)
+    assert fulls <= 2, f"churn pulled {fulls} full maps"
+    assert incs >= 9
+
+
+def test_keepalive_is_cheap_and_counted(mon):
+    _boot(mon, 4)
+    sub = FakeConn()
+    mon._dispatch(sub, M.MMonGetMap())
+    epoch = mon.osdmap.epoch
+    before = mon.perf.dump()
+    n0 = len(sub.msgs)
+    for _ in range(5):
+        mon._dispatch(sub, M.MMonGetMap(have_epoch=epoch))
+    after = mon.perf.dump()
+    kas = sub.msgs[n0:]
+    assert len(kas) == 5
+    assert all(isinstance(k, M.MOSDMapInc) and not k.incs
+               for k in kas)
+    assert after["map_keepalive_sends"] - \
+        before["map_keepalive_sends"] == 5
+    # ~free: no full serialization, payload is config-only
+    assert after["map_full_sends"] == before["map_full_sends"]
+    assert all(len(k.data_segment()) < 256 for k in kas)
+
+
+def test_gap_recovery_serves_full(mon):
+    """A subscriber asleep past the incremental ring gets a full map,
+    never a broken chain."""
+    _boot(mon, 4)
+    sub = FakeConn()
+    mon._dispatch(sub, M.MMonGetMap())
+    stale_epoch = mon.osdmap.epoch
+    for w in (0.9, 0.8, 0.7, 0.6, 0.5, 1.0):
+        r, out = mon.handle_command(
+            {"prefix": "osd reweight", "id": 1, "weight": w})
+        assert r == 0, out
+    with mon.lock:
+        mon._inc_ring.clear()                      # ring rolled over
+    probe = FakeConn()
+    mon._dispatch(probe, M.MMonGetMap(have_epoch=stale_epoch))
+    assert isinstance(probe.msgs[0], M.MMonMap)
+    got = OSDMap.from_json(probe.msgs[0].map_json)
+    assert got.canonical() == mon.osdmap.canonical()
+
+
+def test_mixed_version_fallback_always_full(mon):
+    """A getmap whose wire meta has NO `have` key (an older sender)
+    decodes as have_epoch=0 and is always answered with a full map —
+    the mon can always serve a full."""
+    _boot(mon, 4)
+    raw = M.MMonGetMap.__new__(M.MMonGetMap)
+    M.Message.__init__(raw)
+    raw.decode_wire({"what": "osdmap"}, b"")       # pre-have_epoch meta
+    assert raw.have_epoch == 0
+    probe = FakeConn()
+    mon._dispatch(probe, raw)
+    assert isinstance(probe.msgs[0], M.MMonMap)
+
+
+def test_boot_burst_batches_epochs(mon):
+    """A 16-OSD cold-start boot storm commits a handful of epochs, not
+    one per OSD (MAP_BATCH_WINDOW coalescing)."""
+    e0 = mon.osdmap.epoch
+    _boot(mon, 16)
+    assert all(mon.osdmap.is_up(i) for i in range(16))
+    assert mon.osdmap.epoch - e0 <= 4, \
+        f"boot burst cost {mon.osdmap.epoch - e0} epochs"
+
+
+def test_failure_burst_batches_epochs(mon):
+    """A host's worth of failure reports marks every victim down in a
+    coalesced epoch or two."""
+    _boot(mon, 12)
+    e0 = mon.osdmap.epoch
+    for victim in (2, 3, 4, 5):
+        for reporter in (0, 1):
+            mon._handle_failure(M.MOSDFailure(reporter, victim, e0))
+    deadline = time.time() + 5
+    while any(mon.osdmap.is_up(v) for v in (2, 3, 4, 5)) and \
+            time.time() < deadline:
+        time.sleep(0.02)
+    assert not any(mon.osdmap.is_up(v) for v in (2, 3, 4, 5))
+    time.sleep(2 * Monitor.MAP_BATCH_WINDOW)
+    assert mon.osdmap.epoch - e0 <= 3, \
+        f"failure burst cost {mon.osdmap.epoch - e0} epochs"
+
+
+def test_interleaved_command_still_bumps_for_batch(mon):
+    """A NON-osdmap command (config set) landing inside the batch
+    window carries the pending batched mutations — and MUST bump the
+    osdmap epoch for them: map content changing under an unchanged
+    epoch would leave every current subscriber keepalive-acked and
+    permanently unaware of the mark-down."""
+    _boot(mon, 6)
+    sub = FakeConn()
+    mon._dispatch(sub, M.MMonGetMap())
+    m = OSDMap.from_json(sub.msgs[0].map_json)
+    assert m.is_up(3)
+    e0 = mon.osdmap.epoch
+    # failure quorum trips -> mark-down applied, commit batched
+    for reporter in (0, 1):
+        mon._handle_failure(M.MOSDFailure(reporter, 3, e0))
+    assert not mon.osdmap.is_up(3)
+    # a config-only command commits INSIDE the window (it never bumps
+    # the osdmap epoch on its own)
+    r, out = mon.handle_command(
+        {"prefix": "config set", "section": "osd",
+         "name": "osd_scrub_auto", "value": "false"})
+    assert r == 0, out
+    _settle(mon)
+    assert mon.osdmap.epoch > e0, \
+        "batched mark-down committed without an epoch bump"
+    m = replay(m, sub.msgs, 1)
+    assert not m.is_up(3), "subscriber never learned the mark-down"
+    assert m.canonical() == mon.osdmap.canonical()
+
+
+def test_heartbeat_peer_subset(mon):
+    """Above osd_heartbeat_min_peers up OSDs the ping set is a bounded
+    ring neighborhood; below it, the full mesh — and ring symmetry
+    keeps every OSD watched by enough reporters for the mon's failure
+    quorum."""
+    from ceph_tpu.osd.daemon import OSDDaemon
+    osd = OSDDaemon(7, mon.addr)
+    try:
+        for i in range(40):
+            osd.osdmap.add_osd(i, f"host{i}")
+            osd.osdmap.set_osd_up(i, ("127.0.0.1", 7000 + i))
+        want = int(osd.cct.conf.get("osd_heartbeat_min_peers"))
+        peers = osd._heartbeat_peers()
+        assert 7 not in peers
+        assert len(peers) <= want + 1
+        assert len(peers) >= want - 1
+        # neighbors by id around osd.7
+        assert 6 in peers and 8 in peers
+        # coverage: every OSD is selected by >= 2 watchers under the
+        # same rule (what the failure-reporter quorum needs)
+        watch_count = {i: 0 for i in range(40)}
+        for i in range(40):
+            osd.osd_id = i
+            for p in osd._heartbeat_peers():
+                watch_count[p] += 1
+        osd.osd_id = 7
+        assert min(watch_count.values()) >= 2
+        # small cluster: full mesh unchanged
+        for i in range(12, 40):
+            osd.osdmap.set_osd_down(i)
+        small = [o.id for o in osd.osdmap.osds.values()
+                 if o.up and o.id != 7]
+        if len(small) <= want:
+            assert osd._heartbeat_peers() == sorted(small)
+    finally:
+        osd.shutdown()
+
+
+def test_pgstats_dedup(mon):
+    """Unchanged MPGStats reports re-send only at the keepalive
+    cadence; any change sends immediately."""
+    from ceph_tpu.osd.daemon import OSDDaemon
+    osd = OSDDaemon(0, mon.addr)
+    try:
+        rep = {"degraded_pgs": 0, "misplaced": 0, "unfound": 0,
+               "recovering": 0, "epoch": 3, "pools": {}}
+        now = time.time()
+        assert osd._pgstats_should_send(rep, now)   # first: changed
+        osd._pgstats_last_sent = dict(rep)
+        osd._pgstats_last_time = now
+        assert not osd._pgstats_should_send(dict(rep), now + 0.5)
+        # a change sends immediately
+        changed = {**rep, "degraded_pgs": 1}
+        assert osd._pgstats_should_send(changed, now + 0.5)
+        # staleness keepalive refreshes the mon's freshness window
+        keep = float(osd.cct.conf.get("osd_pg_stat_keepalive"))
+        assert osd._pgstats_should_send(dict(rep), now + keep + 0.1)
+    finally:
+        osd.shutdown()
+
+
+def test_cluster_incremental_end_to_end():
+    """Live 4-OSD cluster with heartbeats: churn commits ride deltas,
+    keepalives are served, and every daemon's incremental-applied map
+    is bit-equal to the mon's committed state."""
+    import numpy as np
+
+    from ceph_tpu.tools.vstart import Cluster
+    with Cluster(n_osds=4, heartbeat_interval=0.25) as c:
+        client = c.client()
+        client.create_pool("p", "replicated", size=3, pg_num=8)
+        io = client.open_ioctx("p")
+        payload = np.random.default_rng(3).integers(
+            0, 256, 4096, dtype=np.uint8).tobytes()
+        for i in range(4):
+            io.write_full(f"o{i}", payload)
+        for w in (0.5, 1.0):
+            r, out = client.mon_command(
+                {"prefix": "osd reweight", "id": 1, "weight": w})
+            assert r == 0, out
+        c.wait_active_clean(timeout=60)
+        time.sleep(0.6)                 # a few heartbeat keepalives
+        for i in range(4):
+            assert io.read(f"o{i}", 4096) == payload
+        mon_can = c.mon.osdmap.canonical()
+        for osd in c.osds:
+            assert osd.osdmap.canonical() == mon_can
+        st = c.mon.map_stats()
+        assert st["sends"]["inc"] >= 2
+        assert st["sends"]["keepalive"] >= 1
+        # steady state: full maps only for first subscriptions
+        assert st["sends"]["full"] <= st["subscribers"] + 2
