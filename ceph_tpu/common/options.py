@@ -220,6 +220,31 @@ register_options([
            "input-byte cap per super-batch launch (the occupancy "
            "denominator of the launch-queue counters); reaching it "
            "launches immediately", min=1 << 16),
+    # device-plane flight recorder (docs/TRACING.md "Device plane")
+    Option("osd_ec_profiler", bool, True,
+           "record every device launch (fused/plain encode, decode, "
+           "CLAY repair, scrub CRC) in the per-host launch ledger "
+           "with compile attribution; off = the null fast path "
+           "(ops/profiler.py)"),
+    Option("osd_ec_profiler_ring", int, 256,
+           "completed launch records kept in the flight-recorder "
+           "ring (the `launch profile` asok tail)", Level.DEV,
+           min=1, flags=("startup",)),
+    Option("osd_ec_compile_stall_s", float, 0.25,
+           "a first-seen jit bucket whose submit wall time exceeds "
+           "this counts as a compile stall (ec_compile_stalls, "
+           "slow-op first_compile blame, COMPILE_STORM events)",
+           min=0.0),
+    Option("osd_ec_compile_storm_budget_s", float, 5.0,
+           "compile seconds inside the storm window above which the "
+           "mon raises the COMPILE_STORM health warning", min=0.0),
+    Option("osd_ec_compile_storm_window_s", float, 60.0,
+           "sliding window for the COMPILE_STORM compile-seconds "
+           "budget", min=1.0),
+    Option("osd_ec_inject_compile_stall", float, 0.0,
+           "fault injection: sleep this long inside the submit of "
+           "every FIRST-seen jit bucket (a synthetic compile stall "
+           "for the smoke/health gates)", Level.DEV, min=0.0),
     # multichip mesh scale-out (docs/MULTICHIP.md)
     Option("osd_ec_use_mesh", bool, False,
            "acquire the per-host MeshService multichip data plane for "

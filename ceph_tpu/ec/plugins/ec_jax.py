@@ -95,6 +95,10 @@ class ErasureCodeJax(ErasureCode):
     def get_alignment(self) -> int:
         return 64
 
+    # flight-recorder hint (ops/profiler.py): encode/decode run jitted
+    # XLA programs, so a first-seen launch shape IS a compile
+    jit_backed = True
+
     def codec_signature(self) -> tuple:
         """Coalescing key for the per-host launch queue
         (parallel/launch_queue.py): two instances with equal
@@ -249,6 +253,22 @@ class ErasureCodeJax(ErasureCode):
             wb=point["wb"] if point else None,
             extract=point["extract"] if point else "planar",
             combine=point["combine"] if point else "xla")
+
+    def launch_bucket(self, handle) -> str:
+        """Flight-recorder jit-bucket key of one submit handle
+        (ops/profiler.py): the axes XLA/Mosaic actually key their
+        caches on — kernel path, the autotuned (tile, wb) operating
+        point, and the pow2-padded (width, run-count) launch shape —
+        so the compile ledger's first-seen detection matches real
+        compiles instead of guessing from raw widths."""
+        from ...parallel.launch_queue import _extents_bucket
+        base = _extents_bucket(handle)
+        point = self._fused_point
+        if point and self._use_w32:
+            return (f"{base}:t{point.get('tile')}"
+                    f":wb{point.get('wb')}"
+                    f":{point.get('extract')}.{point.get('combine')}")
+        return base
 
     def encode_extents_with_crc_finalize(self, handle):
         """Completion half: blocks on one submit handle's device work

@@ -1703,6 +1703,35 @@ class Monitor:
                     f"{r.get('unfound', 0)} unfound"
                     for o, r in affected],
             }
+        # COMPILE_STORM: device-plane compile seconds (first-seen jit
+        # buckets, ops/profiler.py) exceeded the conf'd budget inside
+        # the storm window on some host — the known "compile stall
+        # flaps OSDs / stalls launch queues" failure mode surfaced as
+        # a health check instead of folklore.  Each report names its
+        # worst bucket so the operator sees WHAT compiled, not just
+        # that something did.  Budget rides the report (the OSD's
+        # conf'd osd_ec_compile_storm_budget_s): the mon needs no
+        # config of its own and mixed-conf clusters warn per-host.
+        storms = [(o, r["compile"]) for o, r in pg_stats.items()
+                  if isinstance(r.get("compile"), dict)
+                  and r["compile"].get("compile_s", 0.0)
+                  > r["compile"].get("budget_s", float("inf"))]
+        if storms:
+            total_s = round(sum(c["compile_s"] for _o, c in storms), 2)
+            daemons = ", ".join(f"osd.{o}" for o, _c in sorted(storms))
+            checks["COMPILE_STORM"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{total_s}s of device-plane compiles in "
+                           f"the last "
+                           f"{storms[0][1].get('window_s')}s window, "
+                           f"hosts [{daemons}] over budget",
+                "detail": [
+                    f"osd.{o}: {c['compile_s']}s compiled "
+                    f"(budget {c['budget_s']}s, "
+                    f"{c.get('stalls', 0)} stalls), worst bucket "
+                    f"{c.get('worst_bucket')} ({c.get('worst_s')}s)"
+                    for o, c in sorted(storms)],
+            }
         status = "HEALTH_WARN" if checks else "HEALTH_OK"
         return 0, {"status": status, "checks": checks}
 

@@ -416,6 +416,15 @@ class OSDDaemon:
             .add_gauge("pg_misplaced",
                        "objects with split/merge pushes pending")
             .add_gauge("pg_unfound", "objects latched unfound")
+            # heartbeat tick-lag detector (the compile-stall flap
+            # evidence PR 8's note asked for): how late the last
+            # heartbeat tick ran vs osd_heartbeat_interval
+            .add_gauge("hb_tick_lag",
+                       "seconds the last heartbeat tick ran past "
+                       "its osd_heartbeat_interval schedule")
+            .add_u64_counter("hb_tick_lag_events",
+                             "heartbeat ticks delayed a full extra "
+                             "interval or more past schedule (logged)")
             .create_perf_counters())
         # request tracing (reference TrackedOp/OpTracker, docs/
         # TRACING.md): always-on per-op event timelines + per-stage
@@ -439,6 +448,37 @@ class OSDDaemon:
                 _tconf.get("osd_op_complaint_time"))
         for _opt in ("osd_enable_op_tracker", "osd_op_complaint_time"):
             _tconf.add_observer(_opt, _apply_track)
+        # device-plane flight recorder (ops/profiler.py, docs/
+        # TRACING.md "Device plane"): the HOST singleton — its perf
+        # set (lat_launch_* histograms, ec_compile_stalls) registers
+        # into exactly ONE daemon's collection per host (the launch-
+        # queue rule: re-exporting a shared singleton from every
+        # daemon would make sum-across-daemons read n_daemons x the
+        # truth), and the same daemon ships the windowed compile
+        # report monward for COMPILE_STORM
+        from ..ops.profiler import DeviceProfiler
+        self._profiler = DeviceProfiler.host_instance()
+        self._profiler_reporter = False
+        if not getattr(self._profiler, "_perf_registered", False):
+            self._profiler._perf_registered = True
+            self._profiler_reporter = True
+            self.cct.perf.add(self._profiler.perf)
+            self._profiler.set_ring_size(
+                int(_tconf.get("osd_ec_profiler_ring")))
+
+        def _apply_prof(_k=None, _v=None):
+            p = self._profiler
+            p.enabled = bool(_tconf.get("osd_ec_profiler"))
+            p.stall_s = float(_tconf.get("osd_ec_compile_stall_s"))
+            p.storm_window_s = float(
+                _tconf.get("osd_ec_compile_storm_window_s"))
+            p.inject_stall_s = float(
+                _tconf.get("osd_ec_inject_compile_stall") or 0.0)
+        _apply_prof()
+        for _opt in ("osd_ec_profiler", "osd_ec_compile_stall_s",
+                     "osd_ec_compile_storm_window_s",
+                     "osd_ec_inject_compile_stall"):
+            _tconf.add_observer(_opt, _apply_prof)
         if self.cct.asok is not None:
             self.cct.asok.register_command(
                 "status", lambda cmd: {
@@ -473,6 +513,16 @@ class OSDDaemon:
                 "repair status", self._asok_repair_status)
             self.cct.asok.register_command(
                 "repair_status", self._asok_repair_status)
+            # device-plane flight recorder (docs/TRACING.md "Device
+            # plane"); both spellings like mesh/launch-queue
+            self.cct.asok.register_command(
+                "launch profile", self._asok_launch_profile)
+            self.cct.asok.register_command(
+                "launch_profile", self._asok_launch_profile)
+            self.cct.asok.register_command(
+                "compile ledger", self._asok_compile_ledger)
+            self.cct.asok.register_command(
+                "compile_ledger", self._asok_compile_ledger)
         self.store = store or MemStore()
         self.store.mount()
         self._raw_tid = 1 << 32   # raw-RPC tids, disjoint from backends'
@@ -573,6 +623,12 @@ class OSDDaemon:
         self._hb_thread: threading.Thread | None = None
         self._hb_last_seen: dict[int, float] = {}
         self._hb_first_ping: dict[int, float] = {}
+        # tick-lag detector state: when the previous heartbeat tick
+        # STARTED (perf_counter) — a tick that starts much later than
+        # interval after its predecessor means the loop was starved
+        # (first-bucket XLA compile holding the GIL, load) and peers
+        # may be about to report us down
+        self._hb_last_tick: float | None = None
         # MPGStats dedup (last report sent + when): unchanged reports
         # re-send only at the osd_pg_stat_keepalive cadence
         self._pgstats_last_sent: dict | None = None
@@ -3497,6 +3553,29 @@ class OSDDaemon:
             "pgs": pgs,
         }
 
+    def _asok_launch_profile(self, cmd: dict) -> dict:
+        """`ceph daemon osd.N.asok launch profile`: the host flight
+        recorder's launch ledger — aggregates, lat_launch_* percentile
+        summaries, and the bounded ring of recent launches (each with
+        launch id, jit bucket, runs/bytes/pg-mix, queue-wait, submit
+        and device times, and the contributing ops' trace ids)."""
+        out = self._profiler.profile(
+            last=int(cmd["last"]) if "last" in cmd else None)
+        out["osd"] = self.osd_id
+        out["host_perf_owner"] = self._profiler_reporter
+        return out
+
+    def _asok_compile_ledger(self, cmd: dict) -> dict:
+        """`ceph daemon osd.N.asok compile ledger`: per-host compile
+        attribution — every first-seen jit bucket with first-hit vs
+        steady-state submit times (the difference is the compile),
+        stall counts, and the COMPILE_STORM window summary."""
+        out = self._profiler.compile_ledger()
+        out["osd"] = self.osd_id
+        out["storm_budget_s"] = float(self.cct.conf.get(
+            "osd_ec_compile_storm_budget_s"))
+        return out
+
     def _asok_mesh_status(self, cmd: dict) -> dict:
         """`ceph daemon osd.N.asok mesh status`: the host service's
         mesh + per-PG plane state (active / fallen-back / config
@@ -3680,7 +3759,7 @@ class OSDDaemon:
             pools[pid]["push_seeds"] = sorted(seeds)[:128]
         for pg, n in unfound.items():
             pool_rec(pg.pool)["unfound"] += n
-        return {
+        rep = {
             "degraded_pgs": len(needing),
             "misplaced": len(pushes),
             "unfound": sum(unfound.values()),
@@ -3688,6 +3767,24 @@ class OSDDaemon:
             "epoch": self.osdmap.epoch,
             "pools": pools,
         }
+        # compile attribution monward (COMPILE_STORM, mon/monitor.py):
+        # only the host profiler's perf-owner daemon reports — the
+        # recorder is a HOST singleton, and every co-hosted daemon
+        # re-reporting it would make the mon's sum read n_daemons x
+        # the real compile seconds (the launch-queue perf rule)
+        if self._profiler_reporter and self._profiler.enabled:
+            w = self._profiler.compile_report()
+            if w["events"]:
+                rep["compile"] = {
+                    "window_s": w["window_s"],
+                    "compile_s": w["compile_s"],
+                    "stalls": w["stalls"],
+                    "worst_bucket": w["worst_bucket"],
+                    "worst_s": w["worst_s"],
+                    "budget_s": float(self.cct.conf.get(
+                        "osd_ec_compile_storm_budget_s")),
+                }
+        return rep
 
     def _pgstats_should_send(self, rep: dict, now: float) -> bool:
         """A CHANGED report sends immediately (the mon's gates need
@@ -3740,8 +3837,36 @@ class OSDDaemon:
         sel |= {peers[(i - 1 - k) % len(peers)] for k in range(half)}
         return sorted(sel)
 
+    def _note_hb_tick_lag(self, now_mono: float) -> float:
+        """Tick-lag detector (the compile-stall flap evidence PR 8's
+        note asked for): seconds this tick started past its
+        osd_heartbeat_interval schedule.  Sets the hb_tick_lag gauge
+        every tick; a tick a full extra interval late counts in
+        hb_tick_lag_events and logs — so when heartbeat grace trips,
+        `perf dump` + the log say whether the DAEMON was starved
+        (compile stall, GIL, load) rather than the peer dead."""
+        last, self._hb_last_tick = self._hb_last_tick, now_mono
+        if last is None:
+            return 0.0
+        lag = (now_mono - last) - self.heartbeat_interval
+        self.perf.set("hb_tick_lag", round(max(0.0, lag), 6))
+        # the inter-tick gap legitimately includes the previous
+        # body's work (pings, mon RPC), so the event/log threshold
+        # is a FULL extra interval — the ping cadence effectively
+        # halved, eating real margin out of peers' grace windows —
+        # not the half-interval a busy healthy body routinely costs
+        if lag >= self.heartbeat_interval:
+            self.perf.inc("hb_tick_lag_events")
+            self.cct.dout(
+                "osd", 1,
+                f"heartbeat tick delayed {lag:.3f}s past "
+                f"osd_heartbeat_interval={self.heartbeat_interval}s "
+                f"(loop starved: first-bucket compile / load?)")
+        return lag
+
     def _heartbeat_loop(self) -> None:
         while not self._hb_stop.wait(self.heartbeat_interval):
+            self._note_hb_tick_lag(time.perf_counter())
             now = time.time()
             # mon keepalive + hunting: no map traffic for too long means
             # our mon may be dead — rotate to the next one and

@@ -47,6 +47,7 @@ import numpy as np
 
 from ..common.tracked_op import NULL_TRACKED
 from ..ec.interface import ErasureCodeError, ErasureCodeInterface
+from ..ops.profiler import device_profiler
 from ..store.object_store import ObjectStore, Transaction
 from . import ec_transaction as ect
 from . import ec_util
@@ -221,6 +222,11 @@ class _Drain:
     plain_handle: tuple | None        # ("mesh"|"plugin"|"np", handle)
     plain_cols: dict[int, int]        # work index -> column offset
     t_assemble: float = 0.0
+    # flight-recorder records of DIRECT (non-queue) launches; queue
+    # launches are recorded by the queue itself and stitched back
+    # through the ticket's launch_id (ops/profiler.py)
+    prof_fused: object | None = None
+    prof_plain: object | None = None
 
 
 def _build_ec_perf(name: str):
@@ -901,6 +907,14 @@ class ECBackend:
         fused_set = set(fused_idx)
         drain.kinds = ["fused" if i in fused_set else "plain"
                        for i in range(len(work))]
+        # flight recorder (ops/profiler.py): direct launches record
+        # here; queue submissions carry the ops' trace ids so the
+        # queue's super-batch record can name its contributors
+        from ..parallel.launch_queue import (_codec_label,
+                                             _extents_bucket)
+        prof = device_profiler()
+        traces = tuple(op.top.trace.trace_id for op in ready
+                       if op.top.is_tracked) if prof.enabled else ()
         try:
             if fused_idx:
                 drain.fused_pos = {wi: p
@@ -913,13 +927,28 @@ class ECBackend:
                     # waits for the launch (completion half)
                     drain.fused_handle = \
                         self._launch_queue.submit_extents(
-                            self.ec_impl, fused_runs, owner=id(self))
+                            self.ec_impl, fused_runs, owner=id(self),
+                            traces=traces)
                     if self.perf:
                         self.perf.inc("ec_host_queue_drains")
                 else:
+                    rec = prof.begin(
+                        "fused_encode", codec=_codec_label(self.ec_impl),
+                        runs=len(fused_runs),
+                        nbytes=sum(r.size for r in fused_runs),
+                        traces=traces)
                     drain.fused_handle = \
                         self.ec_impl.encode_extents_with_crc_submit(
                             fused_runs)
+                    prof.submitted(
+                        rec,
+                        self.ec_impl.launch_bucket(drain.fused_handle)
+                        if hasattr(self.ec_impl, "launch_bucket")
+                        else _extents_bucket(drain.fused_handle),
+                        path=drain.fused_handle.get("path")
+                        if isinstance(drain.fused_handle, dict)
+                        else None)
+                    drain.prof_fused = rec
                     # kernel-path provenance (ISSUE 11): which fused
                     # kernel served this drain — hier_acc/hier_lsub
                     # are the overlapped Pallas family, anything else
@@ -940,6 +969,9 @@ class ECBackend:
                 big = np.concatenate(plain_runs, axis=1) \
                     if len(plain_runs) > 1 else plain_runs[0]
                 if self.mesh_codec is not None:
+                    rec = prof.begin(
+                        "mesh_encode", codec=_codec_label(self.ec_impl),
+                        nbytes=int(big.size), traces=traces)
                     try:
                         drain.plain_handle = (
                             "mesh",
@@ -950,21 +982,42 @@ class ECBackend:
                         # plane — the mesh never wedges the queue
                         self._disable_mesh(e)
                         raise
+                    prof.submitted(rec, f"mesh:x:w{big.shape[1]}",
+                                   path="mesh")
+                    drain.prof_plain = rec
                     if self.perf:
                         self.perf.inc("ec_mesh_drains")
                 elif self._launch_queue is not None:
                     drain.plain_handle = (
                         "queue", self._launch_queue.submit_chunks(
-                            self.ec_impl, big, owner=id(self)))
+                            self.ec_impl, big, owner=id(self),
+                            traces=traces))
                     if self.perf and not fused_idx:
                         self.perf.inc("ec_host_queue_drains")
                 elif hasattr(self.ec_impl, "encode_chunks_submit"):
-                    drain.plain_handle = (
-                        "plugin", self.ec_impl.encode_chunks_submit(big))
+                    rec = prof.begin(
+                        "plain_encode", codec=_codec_label(self.ec_impl),
+                        nbytes=int(big.size), traces=traces)
+                    h = self.ec_impl.encode_chunks_submit(big)
+                    drain.plain_handle = ("plugin", h)
+                    prof.submitted(rec, f"c:{h[0]}:w{big.shape[1]}",
+                                   path=str(h[0]))
+                    drain.prof_plain = rec
                 else:
-                    # host-synchronous CPU plugins: nothing to defer
+                    # host-synchronous CPU plugins: nothing to defer —
+                    # the whole launch is the submit; device time 0
+                    rec = prof.begin(
+                        "plain_encode", codec=_codec_label(self.ec_impl),
+                        nbytes=int(big.size), traces=traces)
                     drain.plain_handle = (
                         "np", np.asarray(self.ec_impl.encode_chunks(big)))
+                    # jit=False: a pure-CPU encode has no compiled
+                    # program — its wall must not read as a "compile"
+                    prof.submitted(rec, f"c:np:w{big.shape[1]}",
+                                   path="np",
+                                   jit=getattr(self.ec_impl,
+                                               "jit_backed", False))
+                    prof.materialized(rec, 0.0)
         except Exception:
             # withdraw any queue submissions this drain already made:
             # the owning ops are about to abort, and an orphaned
@@ -1043,6 +1096,7 @@ class ECBackend:
     def _complete_drain(self, drain: _Drain) -> None:
         import time as _time
         t0 = _time.perf_counter()
+        prof = device_profiler()
         try:
             try:
                 fh = drain.fused_handle
@@ -1056,11 +1110,15 @@ class ECBackend:
                     fused_res = fh.result()
                     self._note_fused_path(fh.path)
                 else:
+                    t_f = _time.perf_counter()
                     fused_res = \
                         self.ec_impl.encode_extents_with_crc_finalize(fh)
+                    prof.materialized(drain.prof_fused,
+                                      _time.perf_counter() - t_f)
                 plain_par = None
                 if drain.plain_handle is not None:
                     kind, h = drain.plain_handle
+                    t_p = _time.perf_counter()
                     if kind == "queue":
                         plain_par = np.asarray(h.result())
                     elif kind == "mesh":
@@ -1073,8 +1131,12 @@ class ECBackend:
                             raise RuntimeError(self.mesh_error or
                                                "mesh plane disabled")
                         plain_par = mc.encode_flat_finalize(h)
+                        prof.materialized(drain.prof_plain,
+                                          _time.perf_counter() - t_p)
                     elif kind == "plugin":
                         plain_par = self.ec_impl.encode_chunks_finalize(h)
+                        prof.materialized(drain.prof_plain,
+                                          _time.perf_counter() - t_p)
                     else:
                         plain_par = h
             except Exception as e:  # noqa: BLE001 — device/encode failure
@@ -1102,8 +1164,31 @@ class ECBackend:
                 return
             device_dt = _time.perf_counter() - t0
             worked = {id(op) for op, _, _, _ in drain.work}
+            # trace stitching (ops/profiler.py): the launch ids that
+            # served this drain land as events on every contributing
+            # op's timeline — and a first-compile that stalled past
+            # the threshold lands FIRST, so slow-op blame (largest
+            # gap ends at the event) names the bucket that compiled
+            # instead of a bare "ec_encode_materialize"
+            stitches = []
+            for src in (fh, drain.plain_handle[1]
+                        if drain.plain_handle is not None else None):
+                if getattr(src, "is_launch_ticket", False) and \
+                        src.launch_id is not None:
+                    stitches.append((src.launch_id, src.bucket,
+                                     src.compiled, src.compile_s))
+            for rec in (drain.prof_fused, drain.prof_plain):
+                if rec is not None:
+                    stitches.append((rec.launch_id, rec.bucket,
+                                     rec.compiled, rec.compile_s))
+            stall_s = prof.stall_s
             for op in drain.ops:
                 if id(op) in worked:
+                    for lid, bucket, compiled, comp_s in stitches:
+                        if compiled and comp_s >= stall_s:
+                            op.top.mark_event(
+                                f"first_compile({bucket})")
+                        op.top.mark_event(f"launch({lid})")
                     op.top.mark_event("ec_encode_materialize")
             encoded_by_op: dict[int, dict] = {id(op): {}
                                               for op in drain.ops}

@@ -29,6 +29,7 @@ MemStore PG (tests) and a messenger-backed PG (daemon asok command).
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -225,8 +226,22 @@ def _verify_chunk(metas: list[_ObjMeta],
     nbytes = sum(r.size for r in rows)
     seeds = [0xFFFFFFFF] * len(rows)
     if use_device:
+        from ..common.util import next_pow2
         from ..ops import crc32c_linear as cl
+        from ..ops.profiler import device_profiler
+        # flight recorder: the deep-scrub CRC launch is a device
+        # launch like any encode — ledgered with an (approximate:
+        # pow2 of rows/bytes, the jit axes) bucket key
+        prof = device_profiler()
+        rec = prof.begin("scrub_crc", codec="crc32c_rows",
+                         runs=len(rows), nbytes=nbytes)
         got = cl.crc32c_rows_device(rows, seeds)
+        # synchronous call: the submit clock (begin -> here) covers
+        # dispatch + compile + execution; device_s stays 0 so the
+        # wall is counted ONCE (lat_launch_submit), not twice
+        prof.submitted(rec, f"s:crc:n{next_pow2(len(rows))}"
+                            f":w{next_pow2(nbytes)}", path="device")
+        prof.materialized(rec, 0.0)
         # honest attribution: only full SCRUB_BLOCK bodies ride the
         # device launch; sub-block tails (and rows shorter than one
         # block) fold on host inside crc32c_rows_device

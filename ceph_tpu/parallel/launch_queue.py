@@ -60,6 +60,34 @@ import time
 import numpy as np
 
 from ..common.util import next_pow2
+from ..ops.profiler import device_profiler
+
+
+def _codec_label(plugin) -> str:
+    """Short human codec tag for the flight recorder (the full
+    codec_signature carries raw matrix bytes — ledger rows want
+    'JaxCodec:k8m3', not a kilobyte of generator matrix)."""
+    try:
+        return (f"{type(plugin).__name__}:"
+                f"k{plugin.get_data_chunk_count()}"
+                f"m{plugin.get_coding_chunk_count()}")
+    except Exception:  # noqa: BLE001 — plans/odd plugins
+        return type(plugin).__name__
+
+
+def _extents_bucket(handle) -> str:
+    """Jit-bucket key of a fused-extents submit handle: the (path,
+    padded width, bucketed run count) triple the pow2 launch-shape
+    bucketing (ops/bitsliced.py) collapses XLA's cache key to.  Best
+    effort — an opaque plugin handle degrades to its path alone."""
+    if isinstance(handle, dict):
+        if "split" in handle:
+            return "+".join(_extents_bucket(h)
+                            for _idx, h in handle["split"])
+        w = handle.get("big_width")
+        nr = next_pow2(max(1, len(handle.get("meta", ()))))
+        return f"x:{handle.get('path')}:w{w}:r{nr}"
+    return "x:opaque"
 
 
 def codec_signature(plugin) -> tuple:
@@ -114,9 +142,10 @@ class _Sub:
     run).  `extra` carries kind-specific launch arguments (the decode
     erasure list)."""
     __slots__ = ("ticket", "plugin", "runs", "n_runs", "width",
-                 "nbytes", "t_submit", "owner", "extra")
+                 "nbytes", "t_submit", "owner", "extra", "traces")
 
-    def __init__(self, ticket, plugin, runs, owner, extra=None):
+    def __init__(self, ticket, plugin, runs, owner, extra=None,
+                 traces=()):
         self.ticket = ticket
         self.plugin = plugin
         self.runs = runs
@@ -126,6 +155,10 @@ class _Sub:
         self.t_submit = time.perf_counter()
         self.owner = owner
         self.extra = extra
+        # trace ids of the ops whose bytes ride this submission
+        # (PR 4 stitching: the flight recorder's LaunchRecord carries
+        # them so a slow-op's blame can name its launch and vice versa)
+        self.traces = traces
 
 
 class _Batch:
@@ -152,6 +185,11 @@ class _Batch:
         self.combined = None        # (plugin, handle)
         self.per_sub = None         # [(sub, handle | None)]
         self.path = None
+        # flight-recorder state (ops/profiler.py): queue wait of the
+        # oldest submission (set at pop) and the in-flight record the
+        # finalizer closes with the device time
+        self.queue_wait = 0.0
+        self.prof_rec = None
 
 
 class LaunchTicket:
@@ -173,6 +211,13 @@ class LaunchTicket:
         self._done = False
         self.path: str | None = None
         self.cancelled = False
+        # flight-recorder stitching (ops/profiler.py): filled at
+        # launch so the owning backend can put the launch id (and a
+        # first-compile blame event) on its ops' timelines
+        self.launch_id: int | None = None
+        self.bucket: str | None = None
+        self.compiled = False
+        self.compile_s = 0.0
 
     @property
     def launched(self) -> bool:
@@ -305,25 +350,27 @@ class ECLaunchQueue:
     # -- submission ----------------------------------------------------------
 
     def submit_extents(self, plugin, runs: list[np.ndarray],
-                       owner=None) -> LaunchTicket:
+                       owner=None, traces=()) -> LaunchTicket:
         """Queue a drain's fused append runs (each (k, Wi) uint8) for
         a coalesced `encode_extents_with_crc_submit` launch;
         `result()` yields the per-run (parity, l, tail, body) tuples
-        in this submission's run order."""
+        in this submission's run order.  traces: the contributing
+        ops' trace ids (flight-recorder stitching)."""
         return self._submit("x", plugin, [
             np.ascontiguousarray(r, dtype=np.uint8) for r in runs],
-            owner)
+            owner, traces=traces)
 
     def submit_chunks(self, plugin, chunks: np.ndarray,
-                      owner=None) -> LaunchTicket:
+                      owner=None, traces=()) -> LaunchTicket:
         """Queue a drain's concatenated plain (k, W) run for a
         coalesced parity-only launch; `result()` yields this
         submission's (m, W) parity columns."""
         return self._submit("c", plugin, [
-            np.ascontiguousarray(chunks, dtype=np.uint8)], owner)
+            np.ascontiguousarray(chunks, dtype=np.uint8)], owner,
+            traces=traces)
 
     def submit_decode(self, plugin, dense: np.ndarray, erasures,
-                      owner=None) -> LaunchTicket:
+                      owner=None, traces=()) -> LaunchTicket:
         """Queue one recovery/reconstruct decode: `dense` is the
         (k+m, W) array with zeros in the erased rows.  Submissions
         sharing (codec, erasure pattern) coalesce into one
@@ -335,10 +382,10 @@ class ECLaunchQueue:
         return self._submit(
             "d", plugin,
             [np.ascontiguousarray(dense, dtype=np.uint8)], owner,
-            key_suffix=(erasures,), extra=erasures)
+            key_suffix=(erasures,), extra=erasures, traces=traces)
 
     def submit_clay_repair(self, plan, rows: np.ndarray,
-                           owner=None) -> LaunchTicket:
+                           owner=None, traces=()) -> LaunchTicket:
         """Queue one CLAY repair-plan apply: `rows` are the stacked
         helper repair-plane symbols (d*P, W) of ONE object (or a
         backend's own concatenation of several).  Submissions sharing
@@ -348,16 +395,18 @@ class ECLaunchQueue:
         submission's (sub_chunks, W) rebuilt columns."""
         return self._submit(
             "r", plan, [np.ascontiguousarray(rows, dtype=np.uint8)],
-            owner, key_suffix=())
+            owner, key_suffix=(), traces=traces)
 
     def _submit(self, kind: str, plugin, runs, owner,
-                key_suffix: tuple = (), extra=None) -> LaunchTicket:
+                key_suffix: tuple = (), extra=None,
+                traces=()) -> LaunchTicket:
         if kind == "r":
             key = (kind,) + tuple(plugin.signature)
         else:
             key = (kind,) + codec_signature(plugin) + key_suffix
         ticket = LaunchTicket(self, kind, key)
-        sub = _Sub(ticket, plugin, runs, owner, extra=extra)
+        sub = _Sub(ticket, plugin, runs, owner, extra=extra,
+                   traces=traces)
         batch = None
         with self._lock:
             self._pending.setdefault(key, []).append(sub)
@@ -466,6 +515,9 @@ class ECLaunchQueue:
             s.ticket._batch = batch
             if self.perf:
                 self.perf.hinc("lat_ec_batch_wait", now - s.t_submit)
+        # the launch ledger records the OLDEST submission's wait (the
+        # batching cost an op actually paid, not the average)
+        batch.queue_wait = now - min(s.t_submit for s in subs)
         nbytes = sum(s.nbytes for s in subs)
         nruns = sum(s.n_runs for s in subs)
         owners = {s.owner for s in subs}
@@ -516,6 +568,20 @@ class ECLaunchQueue:
             return
         subs = batch.subs
         kind = batch.kind
+        # flight recorder (ops/profiler.py): one LaunchRecord per
+        # super-batch, begun before the device submit so its clock
+        # covers the dispatch (and a first-bucket compile)
+        prof = device_profiler()
+        rec = prof.begin(
+            {"x": "fused_encode", "c": "plain_encode",
+             "d": "decode", "r": "clay_repair"}.get(kind, kind),
+            codec=_codec_label(subs[0].plugin),
+            runs=sum(s.n_runs for s in subs),
+            nbytes=sum(s.nbytes for s in subs),
+            pg_mix=len({s.owner for s in subs}),
+            traces=[t for s in subs for t in s.traces],
+            queue_wait_s=batch.queue_wait)
+        bucket = None
         try:
             plugin = subs[0].plugin
             if kind == "x":
@@ -523,6 +589,11 @@ class ECLaunchQueue:
                 handle = plugin.encode_extents_with_crc_submit(all_runs)
                 batch.path = handle.get("path") \
                     if isinstance(handle, dict) else None
+                # plugins that know their real jit-key axes (the jax
+                # plugin's autotuned operating point) refine the bucket
+                bucket = plugin.launch_bucket(handle) \
+                    if hasattr(plugin, "launch_bucket") \
+                    else _extents_bucket(handle)
             elif kind == "r":
                 # CLAY repair plan: one batched GF matmul for every
                 # co-submitted object (plugin slot holds the shared
@@ -530,6 +601,8 @@ class ECLaunchQueue:
                 bigs = [s.runs[0] for s in subs]
                 big = np.concatenate(bigs, axis=1) if len(bigs) > 1 \
                     else bigs[0]
+                sig = abs(hash(tuple(plugin.signature))) & 0xFFFFFF
+                bucket = f"r:{sig:x}:w{big.shape[1]}"
                 handle = ("np", np.asarray(plugin.apply(big)))
             elif kind == "d":
                 # recovery/reconstruct decode: erasure patterns match
@@ -547,6 +620,8 @@ class ECLaunchQueue:
                         big = np.concatenate(
                             [big, np.zeros((big.shape[0], w2 - w),
                                            dtype=np.uint8)], axis=1)
+                era = "".join(str(e) for e in subs[0].extra)
+                bucket = f"d:e{era}:w{big.shape[1]}"
                 handle = ("np", np.asarray(plugin.decode_chunks(
                     big, list(subs[0].extra))))
             else:
@@ -574,7 +649,31 @@ class ECLaunchQueue:
                     # encode for the whole super-batch (fewer, larger
                     # host matmuls — the CPU analog of occupancy)
                     handle = ("np", np.asarray(plugin.encode_chunks(big)))
+                bucket = f"c:{handle[0]}:w{big.shape[1]}"
             batch.combined = (plugin, handle)
+            # host-synchronous launches (pure-CPU plugin encode/
+            # decode: handle kind "np" on a plugin without a jitted
+            # backend) carry no compiled program — their submit wall
+            # must not enter the compile ledger (jit=False); the jax
+            # plugin and ClayRepairPlan declare jit_backed, and a
+            # device submit handle ("h") is jitted by construction
+            jit = (kind == "x"
+                   or (isinstance(handle, tuple) and handle[0] == "h")
+                   or getattr(plugin, "jit_backed", False))
+            prof.submitted(rec, bucket, path=batch.path or
+                           (handle[0] if isinstance(handle, tuple)
+                            else None), jit=jit)
+            batch.prof_rec = rec
+            if rec is not None:
+                # stitching: the owning backends put these on their
+                # ops' timelines (launch id event + first-compile
+                # blame) at completion
+                for s in subs:
+                    t = s.ticket
+                    t.launch_id = rec.launch_id
+                    t.bucket = rec.bucket
+                    t.compiled = rec.compiled
+                    t.compile_s = rec.compile_s
         except Exception:  # noqa: BLE001 — containment retry
             # a poison submission must fail only its owner: launch
             # each submission on its OWN plugin, recording per-ticket
@@ -632,6 +731,7 @@ class ECLaunchQueue:
         with batch.lock:
             if batch.finalized:
                 return
+            t_mat = time.perf_counter()
             try:
                 if batch.per_sub is not None:
                     for sub, handle in batch.per_sub:
@@ -675,6 +775,10 @@ class ECLaunchQueue:
                         sub.ticket._done = True
             finally:
                 batch.finalized = True
+                # ledger: submit -> materialize is the device time
+                # (the first finalizer blocks on the futures here)
+                device_profiler().materialized(
+                    batch.prof_rec, time.perf_counter() - t_mat)
 
     def _finalize_sub(self, kind: str, sub: _Sub, handle) -> None:
         if kind == "x":

@@ -44,6 +44,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..common.util import concat_columns, split_columns
 from ..ec import gf
 from ..ops import bitsliced
+from ..ops.profiler import device_profiler
 
 LANE = bitsliced.LANE
 
@@ -293,7 +294,25 @@ class DistributedStripeCodec:
         widths = [a.shape[1] for a in avail_list]
         big = np.concatenate(avail_list, axis=1) \
             if len(avail_list) > 1 else avail_list[0]
-        out = self.decode_flat(big, survivors, targets)
+        survivors = tuple(survivors)
+        targets = tuple(targets)
+        if len(survivors) != self.k:
+            raise ValueError(f"need exactly k={self.k} survivors")
+        # flight recorder (ops/profiler.py): one record per batched
+        # repair collective, submit/finalize split preserved
+        import time as _time
+        prof = device_profiler()
+        rec = prof.begin("mesh_decode",
+                         codec=f"mesh:k{self.k}m{self.m}",
+                         runs=len(avail_list), nbytes=int(big.size))
+        mats = self._decode_bitmats(survivors, targets)
+        handle = self._apply_flat_submit(mats, big, len(targets))
+        tgt = "".join(str(t) for t in targets)
+        prof.submitted(rec, f"mesh:d{tgt}:w{big.shape[1]}",
+                       path="mesh")
+        t0 = _time.perf_counter()
+        out = self._apply_flat_finalize(handle)
+        prof.materialized(rec, _time.perf_counter() - t0)
         res = []
         col = 0
         for w in widths:
@@ -333,7 +352,18 @@ class DistributedStripeCodec:
             big = np.concatenate(
                 [big, np.zeros((pad, big.shape[1]), dtype=np.uint8)],
                 axis=0)
-        out = self._apply_flat(mats, big, plan.out_rows)
+        import time as _time
+        prof = device_profiler()
+        rec = prof.begin("mesh_clay_repair",
+                         codec=f"mesh:k{self.k}m{self.m}",
+                         runs=len(rows_list), nbytes=int(big.size))
+        handle = self._apply_flat_submit(mats, big, plan.out_rows)
+        sig = abs(hash(plan.signature)) & 0xFFFFFF
+        prof.submitted(rec, f"mesh:r{sig:x}:w{big.shape[1]}",
+                       path="mesh")
+        t0 = _time.perf_counter()
+        out = self._apply_flat_finalize(handle)
+        prof.materialized(rec, _time.perf_counter() - t0)
         return split_columns(out, widths)
 
     def decode(self, stripes_avail, survivors, targets):
@@ -398,6 +428,10 @@ class ClayRepairPlan:
                    lost_chunk, helpers)
 
     # -- host oracle ---------------------------------------------------------
+
+    # flight-recorder hint (ops/profiler.py): apply() runs the jitted
+    # XLA bitmatmul, so a first-seen width IS a compile
+    jit_backed = True
 
     def apply_host(self, rows: np.ndarray) -> np.ndarray:
         """(in_rows, W) helper rows -> (out_rows, W) rebuilt sub-chunk

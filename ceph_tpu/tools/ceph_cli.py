@@ -27,11 +27,14 @@ commands this build's mon implements:
       set PROFILE [CLASS:RES,WGT,LIM;...]   # rides central config to OSDs
   python -m ceph_tpu.tools.ceph_cli daemon /path/to/osd.N.asok \
       {dump_latencies | dump_mclock | perf dump | mesh status |
-       repair status | ...}
+       repair status | launch profile | compile ledger | ...}
       # local asok, no mon needed (reference `ceph daemon`);
       # `mesh status` = the multichip plane state (docs/MULTICHIP.md);
       # `repair status` = recovery backlog/throttle + per-PG repair
-      # ledger (docs/REPAIR.md)
+      # ledger (docs/REPAIR.md);
+      # `launch profile` = the device-plane flight recorder's launch
+      # ledger, `compile ledger` = per-host jit-bucket compile
+      # attribution (docs/TRACING.md "Device plane")
   python -m ceph_tpu.tools.ceph_cli daemon /path/to/mon.0.asok \
       osdmap status
       # mon map-distribution ledger: full/incremental/keepalive sends,
@@ -73,7 +76,7 @@ def daemon_command(argv: list[str]) -> int:
     # prefix.  Parity-based folding alone cannot reach the three-word
     # `launch queue status`, hence the head-driven loop.
     heads = ("perf", "config", "log", "mesh", "launch", "launch queue",
-             "repair", "osdmap")
+             "repair", "osdmap", "compile")
     while extra and prefix in heads:
         prefix = f"{prefix} {extra[0]}"
         extra = extra[1:]

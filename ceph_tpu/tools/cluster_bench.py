@@ -192,6 +192,11 @@ def _bench_row(c, client, args, name, profile, window,
            "threads": args.threads, "obj_size": args.size,
            "batch_window_ms": window,
            "mesh": _row_mesh(c, args, profile), **res, **extra}
+    # device-plane provenance (ISSUE 15): EC rows embed the host
+    # flight recorder's summary so a rate move is attributable to
+    # compiles / launch occupancy without re-running with an asok
+    from ..ops.profiler import device_profiler
+    row["launch_ledger"] = device_profiler().bench_summary()
     print(json.dumps(row), flush=True)
     return row
 
@@ -495,6 +500,10 @@ def _main_scale(args) -> int:
         if st["sends"]["keepalive"] <= 0:
             fail.append("no heartbeat keepalive was served (have_"
                         "epoch path dead: every tick pulls a map)")
+        # device-plane provenance (ISSUE 15): the scale row carries
+        # the host launch/compile ledger like every bench row
+        from ..ops.profiler import device_profiler
+        row["launch_ledger"] = device_profiler().bench_summary()
     row["ok"] = not fail
     if fail:
         row["failures"] = fail

@@ -58,7 +58,14 @@ def merge_stage_histograms(perf_dumps) -> dict[str, list]:
     dump` payloads (all histograms share the same le axis, so the
     cumulative columns add): {stage: [[le, cum], ..., ['+Inf', n]]}.
     Accepts the exact dict `perf dump` returns — works in-process
-    (osd.cct.perf.dump()) and over the asok alike."""
+    (osd.cct.perf.dump()) and over the asok alike.
+
+    Beyond the OpTracker's op-timeline stages this also sweeps up the
+    device-plane series on the same DEFAULT_LAT_BUCKETS axis: the
+    host launch queue's `ec_batch_wait` and the flight recorder's
+    `launch_submit` / `launch_device` / `launch_queue_wait`
+    (ops/profiler.py) — so per-stage blame decomposes a write's tail
+    BELOW the host boundary (queue wait vs device time vs compile)."""
     merged: dict[str, list] = {}
     for dump in perf_dumps:
         for counters in dump.values():
@@ -796,6 +803,10 @@ def run_degraded_read_storm(n_osds: int = 12, objects: int = 6,
                 elif cname == f"osd.{osd.osd_id}":
                     recovery_q += int(counters.get(
                         "recovery_queued_ops", 0) or 0)
+        # per-stage blame incl. the device-plane series (ec_batch_wait
+        # from the host queue, launch_device/launch_submit from the
+        # flight recorder) — the row carries its own explanation
+        stages = cluster_stage_quantiles(c)
         summary = lat.summary()
     row = {
         "metric": "harness_degraded_read",
@@ -810,6 +821,7 @@ def run_degraded_read_storm(n_osds: int = 12, objects: int = 6,
         "repair_helper_bytes": helper,
         "repair_reconstructed_bytes": rebuilt,
         "recovery_queued_ops": recovery_q,
+        "stages": stages,
         "duration_s": round(time.perf_counter() - t_start, 1),
     }
     errors = summary.get("errors", 0) or 0

@@ -16,6 +16,16 @@ set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu pyt
 # must be present and positive) and the deterministic virtual-time QoS
 # isolation experiment (qos_isolation_ratio <= QOS_ISOLATION_MAX,
 # default 2.0, with the FIFO contrast required to sit ABOVE the bound).
+# ISSUE 15 flight-recorder guards ride here too (docs/TRACING.md
+# "Device plane"): the launch_ledger block must show >=1 launch with
+# runs/launch + queue-wait/device-time percentiles and >=1 first-seen
+# compile bucket; profiler on-vs-off overhead <= PROF_OVERHEAD_MAX_PCT
+# (2%) + noise; and an injected compile stall on a live 4-OSD cluster
+# must raise COMPILE_STORM at the mon and a slow op blamed on
+# first_compile(<bucket>) with the launch id on its timeline
+# (check_compile_storm_smoke).  The `launch profile`/`compile ledger`
+# asok round-trip + ceph_cli folds run in the pytest tier above
+# (tests/test_profiler.py::test_cluster_asok_roundtrip_and_stage_blame).
 if [ "$rc" -eq 0 ]; then
   timeout -k 10 300 env JAX_PLATFORMS=cpu python bench.py --smoke || rc=$?
 fi

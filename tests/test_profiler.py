@@ -1,0 +1,361 @@
+"""Device-plane flight recorder tests (ISSUE 15, docs/TRACING.md
+"Device plane"): the launch ledger, compile attribution, trace
+stitching, the heartbeat tick-lag detector, and the asok/exporter
+surfaces.
+
+What must hold: every device launch lands in the bounded ring with a
+monotonic id; the off path records nothing; a bucket's FIRST submit is
+attributed as its compile while warm relaunches refine the steady
+state; launch ids (and first-compile blame) stitch onto the
+contributing ops' PR 4 timelines through a depth-2 pipelined batch;
+`lat_launch_*` percentiles reach the exporter; `launch profile` /
+`compile ledger` round-trip over a live 4-OSD cluster's asok unquoted;
+and an injected heartbeat-loop stall shows up as tick lag instead of
+staying folklore.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.common.tracked_op import OpTracker
+from ceph_tpu.ec import ErasureCodePluginRegistry
+from ceph_tpu.ops.profiler import DeviceProfiler, device_profiler
+from ceph_tpu.osd.ec_backend import ECBackend, LocalShardBackend
+from ceph_tpu.osd.ec_transaction import PGTransaction
+from ceph_tpu.osd.ec_util import StripeInfo
+from ceph_tpu.osd.types import eversion_t, hobject_t, pg_t
+from ceph_tpu.parallel.launch_queue import ECLaunchQueue
+from ceph_tpu.store import MemStore
+
+REG = ErasureCodePluginRegistry.instance()
+
+
+def oid(name):
+    return hobject_t(pool=1, name=name)
+
+
+def make_backend(pg, queue, plugin="jax", k=2, m=1, chunk=64):
+    prof = {"k": str(k), "m": str(m)}
+    if plugin == "jax":
+        prof["technique"] = "cauchy"
+    codec = REG.factory(plugin, prof)
+    store = MemStore()
+    store.mount()
+    shards = LocalShardBackend(store, pg_t(1, pg), k + m)
+    return ECBackend(codec, StripeInfo(k * chunk, chunk), shards,
+                     launch_queue=queue, perf_name=f"ec.1.{pg}")
+
+
+# -- ledger core -------------------------------------------------------------
+
+def test_launch_ring_eviction_and_monotonic_ids():
+    p = DeviceProfiler(ring_size=4)
+    for i in range(10):
+        rec = p.begin("fused_encode", runs=2, nbytes=100)
+        p.submitted(rec, f"x:test:w{64 * (i % 3)}")
+        p.materialized(rec, 0.001)
+    prof = p.profile()
+    assert prof["launches"] == 10
+    assert len(prof["recent"]) == 4          # ring evicted to maxlen
+    ids = [r["launch_id"] for r in prof["recent"]]
+    assert ids == sorted(ids) and len(set(ids)) == 4
+    assert ids[-1] == 10
+    assert prof["runs_per_launch"] == 2.0
+
+
+def test_profiler_off_null_fast_path():
+    """Disabled: begin() returns None after one attribute check and
+    the other entry points no-op — including through a real backend
+    write (no records, no histograms touched)."""
+    p = DeviceProfiler(enabled=False)
+    assert p.begin("fused_encode") is None
+    p.submitted(None, "x:whatever")          # must not throw
+    p.materialized(None, 1.0)
+    assert p.profile()["launches"] == 0
+    assert p.compile_ledger()["distinct_buckets"] == 0
+
+    DeviceProfiler.reset_host()
+    host = device_profiler()
+    host.enabled = False
+    try:
+        q = ECLaunchQueue(window_us=0.0)
+        be = make_backend(0, q)
+        txn = PGTransaction()
+        txn.write(oid("off0"), 0, np.arange(400, dtype=np.uint8) % 251)
+        done = []
+        be.submit_transaction(txn, eversion_t(1, 1),
+                              lambda: done.append(1))
+        q.close()
+        assert done
+        assert host.profile()["launches"] == 0
+        assert host.compile_ledger()["distinct_buckets"] == 0
+    finally:
+        DeviceProfiler.reset_host()
+
+
+def test_compile_first_bucket_vs_warm_relaunch():
+    """First hit of a bucket is the compile (flagged, upper-bound
+    estimate = its submit wall); a warm relaunch establishes the
+    steady minimum and the ledger refines compile_s to the delta —
+    never negative, never re-flagging the warm hit."""
+    p = DeviceProfiler(stall_s=0.05)
+    r1 = p.begin("fused_encode")
+    time.sleep(0.08)                          # "the compile"
+    p.submitted(r1, "x:test:w1024")
+    p.materialized(r1, 0.0)
+    assert r1.compiled and r1.compile_s >= 0.08
+    assert p.compile_stalls == 1              # over stall_s
+
+    r2 = p.begin("fused_encode")
+    p.submitted(r2, "x:test:w1024")           # warm: ~instant
+    p.materialized(r2, 0.0)
+    assert not r2.compiled and r2.compile_s == 0.0
+    assert p.compile_stalls == 1              # warm hit never counts
+
+    led = p.compile_ledger()
+    [row] = led["buckets"]
+    assert row["count"] == 2
+    assert row["steady_s"] is not None
+    assert 0.0 <= row["compile_s"] <= row["first_s"]
+    assert row["compile_s"] >= 0.07           # first - tiny steady
+    assert led["total_compile_s"] == row["compile_s"]
+
+
+def test_injected_stall_feeds_storm_window():
+    """osd_ec_inject_compile_stall's profiler knob: a first-seen
+    bucket's submit sleeps, the event lands in the storm window, a
+    warm relaunch does not."""
+    p = DeviceProfiler(stall_s=0.02, storm_window_s=30.0)
+    p.inject_stall_s = 0.06
+    for _ in range(2):                        # first + warm
+        r = p.begin("decode")
+        p.submitted(r, "d:e2:w4096")
+        p.materialized(r, 0.0)
+    w = p.compile_report()
+    assert w["events"] == 1
+    assert w["compile_s"] >= 0.05
+    assert w["stalls"] == 1
+    assert w["worst_bucket"] == "d:e2:w4096"
+    # window ages out
+    assert p.compile_report(window_s=0.0)["events"] == 0
+
+
+# -- trace stitching ---------------------------------------------------------
+
+def test_trace_stitching_depth2_pipelined_batch():
+    """Depth-2 pipelined writes through the launch queue: every
+    contributing op's PR 4 timeline carries the launch(<id>) event of
+    the super-batch that served it, the first-compiled launch
+    additionally blames first_compile(<bucket>), and the ledger
+    record carries the ops' trace ids back."""
+    DeviceProfiler.reset_host()
+    host = device_profiler()
+    host.stall_s = 0.0          # every first bucket marks the blame
+    tracker = OpTracker(complaint_time=30.0)
+    try:
+        q = ECLaunchQueue(window_us=60_000_000.0)
+        be = make_backend(0, q)
+        rng = np.random.default_rng(7)
+        tops, done = [], []
+        with be.pipeline():
+            for i in range(4):
+                txn = PGTransaction()
+                txn.write(oid(f"st{i}"), 0,
+                          rng.integers(0, 256, 512, dtype=np.uint8))
+                top = tracker.create("osd_op", f"st{i}")
+                tops.append(top)
+                be.submit_transaction(
+                    txn, eversion_t(1, i + 1),
+                    lambda t=top: (done.append(1),
+                                   tracker.unregister(t, 0)),
+                    top=top)
+        q.close()
+        assert len(done) == 4
+        lids_per_op = []
+        compiles = []
+        for top in tops:
+            names = [n for _ts, n in top.events]
+            lids = [n for n in names if n.startswith("launch(")]
+            assert lids, f"no launch event on {names}"
+            lids_per_op.append(lids)
+            compiles += [n for n in names
+                         if n.startswith("first_compile(")]
+        # the first super-batch compiled its bucket: some op blames it
+        assert compiles, "no first_compile event on any timeline"
+        assert "(" in compiles[0] and compiles[0].endswith(")")
+        # the ledger records carry the ops' trace ids back
+        recs = host.profile()["recent"]
+        traced = {t for r in recs for t in r["traces"]}
+        assert {top.trace.trace_id for top in tops} <= traced
+        # and the launch ids on the timelines exist in the ledger
+        rec_ids = {r["launch_id"] for r in recs}
+        for lids in lids_per_op:
+            for ev in lids:
+                assert int(ev[len("launch("):-1]) in rec_ids
+    finally:
+        DeviceProfiler.reset_host()
+
+
+# -- exporter ----------------------------------------------------------------
+
+def test_exporter_emits_lat_launch_percentile_gauges():
+    import tempfile
+
+    from ceph_tpu.common.context import CephContext
+    from ceph_tpu.tools.metrics_exporter import collect
+    with tempfile.TemporaryDirectory() as d:
+        cct = CephContext("osd.0", f"{d}/osd.0.asok")
+        try:
+            p = DeviceProfiler()
+            cct.perf.add(p.perf)
+            for v in (0.001, 0.004, 0.02):
+                rec = p.begin("fused_encode", queue_wait_s=v / 2)
+                p.submitted(rec, f"x:test:w{v}")
+                p.materialized(rec, v)
+            text = collect(d)
+        finally:
+            cct.shutdown()
+    for series in ("lat_launch_device", "lat_launch_submit",
+                   "lat_launch_queue_wait"):
+        assert f"ceph_tpu_{series}_bucket" in text
+        line = next((ln for ln in text.splitlines()
+                     if ln.startswith(f"ceph_tpu_{series}_p99{{")),
+                    None)
+        assert line is not None, f"missing {series} p99 gauge"
+    assert ("ceph_tpu_ec_compile_stalls" in text)
+
+
+# -- deployment: asok round-trip + stage blame -------------------------------
+
+def test_cluster_asok_roundtrip_and_stage_blame(tmp_path):
+    """Live 4-OSD cluster: `launch profile` and `compile ledger`
+    round-trip over the asok — including the ceph_cli daemon-mode
+    unquoted folds — the host profiler's perf set registers into
+    exactly ONE daemon's collection, and the merged per-stage blame
+    (load_harness) now decomposes below the host boundary
+    (ec_batch_wait + launch_device stages)."""
+    from ceph_tpu.tools import ceph_cli
+    from ceph_tpu.tools.load_harness import cluster_stage_quantiles
+    from ceph_tpu.tools.vstart import Cluster
+    ECLaunchQueue.reset_host()
+    DeviceProfiler.reset_host()
+    try:
+        with Cluster(n_osds=4, asok_dir=str(tmp_path)) as c:
+            client = c.client()
+            client.set_ec_profile("fr21", {
+                "plugin": "jax", "k": "2", "m": "1",
+                "technique": "cauchy", "stripe_unit": "1024"})
+            client.create_pool("frpool", "erasure",
+                               erasure_code_profile="fr21", pg_num=4)
+            io = client.open_ioctx("frpool")
+            for i in range(6):
+                io.write_full(f"fr{i}", bytes([i + 1]) * 3000)
+            host = device_profiler()
+            assert host.profile()["launches"] >= 1
+            assert host.compile_ledger()["distinct_buckets"] >= 1
+            # exactly one daemon owns the host perf set
+            owners = [osd for osd in c.osds
+                      if "device_profiler" in osd.cct.perf.dump()]
+            assert len(owners) == 1
+            # asok handlers on EVERY daemon serve the host truth
+            prof = c.osds[1]._asok_launch_profile({})
+            assert prof["launches"] >= 1
+            assert prof["recent"][-1]["launch_id"] >= 1
+            led = c.osds[2]._asok_compile_ledger({})
+            assert led["distinct_buckets"] >= 1
+            assert led["storm_budget_s"] > 0
+            # ceph_cli daemon mode folds both two-word prefixes
+            for words in (["launch", "profile"], ["compile", "ledger"]):
+                rc = ceph_cli.daemon_command(
+                    [c.osds[0].cct.asok.path] + words)
+                assert rc == 0, words
+            # per-stage blame reaches below the host boundary
+            stages = cluster_stage_quantiles(c)
+            assert "ec_batch_wait" in stages
+            assert "launch_device" in stages
+            assert stages["launch_device"]["count"] >= 1
+    finally:
+        ECLaunchQueue.reset_host()
+        DeviceProfiler.reset_host()
+
+
+# -- heartbeat tick lag ------------------------------------------------------
+
+def test_hb_tick_lag_detector_with_injected_stall():
+    """A stalled heartbeat loop (the compile-stall flap shape) must
+    surface as hb_tick_lag gauge + counted/logged late ticks instead
+    of only as a peer-reported failure."""
+    from ceph_tpu.tools.vstart import Cluster
+    with Cluster(n_osds=2, heartbeat_interval=0.05) as c:
+        osd = c.osds[0]
+        real_peers = osd._heartbeat_peers
+
+        def stalled_peers():
+            time.sleep(0.4)              # the injected stall
+            return real_peers()
+        osd._heartbeat_peers = stalled_peers
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            d = osd.perf.dump()
+            if d.get("hb_tick_lag_events", 0) >= 1:
+                break
+            time.sleep(0.05)
+        d = osd.perf.dump()
+        assert d.get("hb_tick_lag_events", 0) >= 1
+        assert d.get("hb_tick_lag", 0.0) > 0.2
+        ring = "\n".join(str(e) for e in osd.cct.log.ring.recent())
+        assert "heartbeat tick delayed" in ring
+
+
+def test_hb_tick_lag_unit():
+    """The detector math, no cluster: a tick landing one interval
+    late reports ~one interval of lag; an on-time tick reports ~0."""
+    from ceph_tpu.tools.vstart import Cluster
+    with Cluster(n_osds=2, heartbeat_interval=1.0) as c:
+        osd = c.osds[1]
+        osd._hb_last_tick = None
+        assert osd._note_hb_tick_lag(100.0) == 0.0     # first tick
+        lag = osd._note_hb_tick_lag(102.0)             # 1s late
+        assert lag == pytest.approx(1.0)
+        assert osd.perf.dump()["hb_tick_lag_events"] >= 1
+        lag = osd._note_hb_tick_lag(103.0)             # on time
+        assert lag == pytest.approx(0.0)
+        assert osd.perf.dump()["hb_tick_lag"] == 0.0
+
+
+# -- COMPILE_STORM health (mon unit) ----------------------------------------
+
+def test_compile_storm_health_check(tmp_path):
+    """The mon's health check: a fresh pgstats report whose windowed
+    compile seconds exceed its shipped budget raises COMPILE_STORM
+    naming the daemon and worst bucket; under budget stays quiet.
+    (The injected end-to-end variant — profiler -> pgstats -> health
+    — is bench.py --smoke's check_compile_storm_smoke.)"""
+    from ceph_tpu.tools.vstart import Cluster
+    with Cluster(n_osds=2) as c:
+        mon = c.mon
+        base = {"degraded_pgs": 0, "misplaced": 0, "unfound": 0,
+                "recovering": 0, "epoch": 1, "pools": {},
+                "ts": time.time()}
+        with mon.lock:
+            mon.pg_stat_reports[0] = dict(
+                base, compile={"window_s": 60.0, "compile_s": 7.5,
+                               "stalls": 3, "budget_s": 5.0,
+                               "worst_bucket": "x:hier_acc:w65536:r4",
+                               "worst_s": 4.2})
+        _rc, health = mon.handle_command({"prefix": "health"})
+        storm = health["checks"].get("COMPILE_STORM")
+        assert storm is not None
+        assert "osd.0" in storm["summary"]
+        assert "x:hier_acc:w65536:r4" in storm["detail"][0]
+        assert health["status"] == "HEALTH_WARN"
+        # under budget: no storm
+        with mon.lock:
+            mon.pg_stat_reports[0] = dict(
+                base, compile={"window_s": 60.0, "compile_s": 1.0,
+                               "stalls": 0, "budget_s": 5.0,
+                               "worst_bucket": None, "worst_s": 0.0})
+        _rc, health = mon.handle_command({"prefix": "health"})
+        assert "COMPILE_STORM" not in health["checks"]
