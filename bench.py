@@ -1161,6 +1161,37 @@ def check_compile_storm_smoke(out: dict) -> str | None:
         DeviceProfiler.reset_host()
 
 
+def smoke_prewarm() -> dict:
+    """Prewarm the smoke gates' jit buckets before any measurement
+    (ISSUE 16: the 64pg-frac and profiler-overhead wander the PR-14/15
+    bounded retries papered over was first-pass compile time landing
+    inside the measured window).  Persistent compile cache on (the
+    default dir, or CEPH_TPU_COMPILE_CACHE for hermetic CI), then the
+    boot prewarm plan for the geometry the sweep gates use."""
+    from ceph_tpu.ec.interface import Profile
+    from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+    from ceph_tpu.ops import compile_cache, prewarm
+    compile_cache.enable()
+    status = {"enabled": compile_cache.enabled()}
+    try:
+        codec = ErasureCodePluginRegistry.instance().factory(
+            "jax", Profile({"plugin": "jax", "k": "8", "m": "3"}))
+        plan = prewarm.PrewarmPlan(codec, budget_s=float(
+            os.environ.get("EC_SMOKE_PREWARM_BUDGET_S", "20")))
+        st = plan.run()
+        status.update({k: st[k] for k in
+                       ("done", "compiles", "cache_hits", "truncated",
+                        "total_s")})
+        print(f"# smoke prewarm: {st['done']} buckets, "
+              f"{st['compiles']} compiles, {st['cache_hits']} cache "
+              f"hits, {st['total_s']}s", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — prewarm never fails smoke
+        status["error"] = repr(e)
+        print(f"# smoke prewarm failed (continuing cold): {e!r}",
+              file=sys.stderr)
+    return status
+
+
 def run_smoke() -> int:
     """CPU-mode smoke for tier-1 (scripts/tier1.sh): tiny sizes, runs
     the full end-to-end benches, and asserts the published JSON keys
@@ -1169,7 +1200,9 @@ def run_smoke() -> int:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from ceph_tpu.utils.platform import ensure_usable_backend
     ensure_usable_backend(prefer_cpu=True)
+    prewarm_status = smoke_prewarm()
     out = bench_end_to_end(on_tpu=False, passes=1, spacing=0.0)
+    out["ec_smoke_prewarm"] = prewarm_status
     out["metric"] = "ec_write_pipeline_smoke"
     fused_why = check_fused_kernel_smoke(out)   # fills ec_fused_path
     clay_why = check_clay_repair_smoke(out)     # fills clay_* keys
@@ -1243,7 +1276,12 @@ def run_smoke() -> int:
     # failing single shot earns fresh interleaved A/Bs — a REAL
     # recorder regression (an alloc or lock per op, a sync) fails
     # every attempt
-    pretries = int(os.environ.get("PROF_OVERHEAD_RETRIES", "2"))
+    # demoted workaround (ISSUE 16): with the gates prewarmed these
+    # retries should never fire — each use is recorded in the row and
+    # called out after the gates, so residual wander stays VISIBLE
+    # instead of silently absorbed
+    pretries_max = int(os.environ.get("PROF_OVERHEAD_RETRIES", "2"))
+    pretries = pretries_max
     while (povh is None or povh > pthresh + pnoise) and pretries > 0:
         pretries -= 1
         print(f"# profiler overhead {povh}% > "
@@ -1252,6 +1290,7 @@ def run_smoke() -> int:
         povh, pnoise = measure_profiler_overhead()
         out["ec_write_profiler_overhead_pct"] = povh
         out["ec_write_profiler_noise_pct"] = pnoise
+    out["ec_prof_overhead_retries_used"] = pretries_max - pretries
     if povh is None or povh > pthresh + pnoise:
         print(f"# smoke FAILED: profiler overhead {povh}% > "
               f"{pthresh + pnoise:.2f}% ({pthresh}% threshold + "
@@ -1276,7 +1315,8 @@ def run_smoke() -> int:
     # failing single-shot earns up to EC_64PG_RETRIES fresh sweeps —
     # the gate passes on the best showing, a REAL pass-through
     # regression fails every attempt
-    retries = int(os.environ.get("EC_64PG_RETRIES", "2"))
+    retries_max = int(os.environ.get("EC_64PG_RETRIES", "2"))
+    retries = retries_max
     while (not isinstance(frac, (int, float)) or frac < pg_min) \
             and retries > 0:
         retries -= 1
@@ -1302,6 +1342,21 @@ def run_smoke() -> int:
             out["ec_host_queue_occupancy_pct"] = \
                 sweep["occupancy_pct"]
             out["ec_64pg_retried"] = True
+    out["ec_64pg_retries_used"] = retries_max - retries
+    retried = (out["ec_64pg_retries_used"]
+               + out["ec_prof_overhead_retries_used"])
+    if retried:
+        # demoted workaround (ISSUE 16): the retry fired DESPITE the
+        # prewarmed first pass — loud and machine-readable, because
+        # with compiles out of the window a retry now means real
+        # wander (box load, a recorder regression), not a cold jit
+        # bucket
+        print(f"# NOTE: smoke gates needed {retried} retr"
+              f"{'y' if retried == 1 else 'ies'} with prewarmed "
+              f"first pass (64pg={out['ec_64pg_retries_used']}, "
+              f"prof_overhead={out['ec_prof_overhead_retries_used']})"
+              f" — wander persisted past the compile fix",
+              file=sys.stderr)
     if out.get("ec_64pg_retried"):
         # the row already printed before the gates: publish ONE
         # corrected row with the best retry's figures

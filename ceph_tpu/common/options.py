@@ -245,6 +245,32 @@ register_options([
            "fault injection: sleep this long inside the submit of "
            "every FIRST-seen jit bucket (a synthetic compile stall "
            "for the smoke/health gates)", Level.DEV, min=0.0),
+    # compile lifecycle: persistent cache + boot prewarm
+    # (docs/PIPELINE.md "Compile lifecycle")
+    Option("osd_ec_compile_cache", bool, True,
+           "persist every XLA/Mosaic compile to disk "
+           "(ops/compile_cache.py): a restarted daemon re-traces its "
+           "jit buckets but never re-compiles them; hits surface in "
+           "the compile ledger as fast first-launches, not stalls",
+           flags=("startup",)),
+    Option("osd_ec_compile_cache_dir", str, "",
+           "persistent compile cache directory; empty = "
+           "~/.cache/ceph_tpu/xla beside the autotune v2 cache "
+           "(CEPH_TPU_COMPILE_CACHE also honored).  One directory per "
+           "host — the first daemon to enable it wins",
+           flags=("startup",)),
+    Option("osd_ec_prewarm", bool, False,
+           "compile the expected jit-bucket set at OSD boot BEFORE "
+           "the daemon reports up (ops/prewarm.py): pow2 fused-drain "
+           "widths x run counts at the autotuned point, plain-encode "
+           "and single-loss decode shapes.  Off by default to keep "
+           "unit-test boots cheap; benches and tier-1 churn gates "
+           "turn it on", flags=("startup",)),
+    Option("osd_ec_prewarm_budget_s", float, 8.0,
+           "wall-clock cap on the boot-time prewarm pass; on cutoff "
+           "the plan is marked truncated and the daemon boots with "
+           "whatever was warmed (prewarm is an optimization, never a "
+           "boot dependency)", min=0.0, flags=("startup",)),
     # multichip mesh scale-out (docs/MULTICHIP.md)
     Option("osd_ec_use_mesh", bool, False,
            "acquire the per-host MeshService multichip data plane for "

@@ -333,6 +333,129 @@ class ErasureCodeJax(ErasureCode):
         crcs = self.fold_extent_crcs(l, tail_bytes, seeds, body_bytes)
         return np.asarray(parity), crcs
 
+    # -- AOT lowering (boot-time prewarm, ops/prewarm.py) -------------------
+    #
+    # The headline kernels get jax.jit(...).lower().compile() paths so a
+    # steady-state launch of a prewarmed shape dispatches the compiled
+    # executable directly — no trace-time, ever (the jitted path still
+    # retraces on the first call per process even when the persistent
+    # cache serves the compile).  Shapes here MUST mirror the dispatch
+    # sites in ops/bitsliced.py exactly (same pow2/lane padding), which
+    # is why each method reproduces the corresponding wrapper's padding
+    # arithmetic rather than guessing.  All three are best-effort: a
+    # backend that can't lower the shape returns False and the jitted
+    # path serves it.
+
+    def _aot_spec(self, shape, dtype):
+        import jax
+        return jax.ShapeDtypeStruct(tuple(shape), np.dtype(dtype))
+
+    def aot_compile_encode(self, width: int) -> bool:
+        """AOT-lower the plain (no-crc) encode at byte width `width` —
+        the gf_bitmatmul / gf_bitmatmul_w32 dispatch shapes."""
+        bs = _ops()
+        if not self._use_w32:
+            w = width + (-width % bs.LANE)
+            return bs.aot_compile(
+                "mm_xla", bs.gf_bitmatmul_xla,
+                (self._enc_bitmat, self._aot_spec((self.k, w), np.uint8)),
+                {"r": self.m})
+        w = (width + (-width % 4)) // 4            # packed word count
+        wlane = w + (-w % bs.LANE)
+        return bs.aot_compile(
+            "mm_w32", bs.gf_bitmatmul_pallas_w32,
+            (self._enc_bitmat32,
+             self._aot_spec((self.k, wlane), np.int32)),
+            {"r": self.m, "tile": 4 * bs._pick_wt(wlane)})
+
+    def aot_compile_decode(self, width: int, n_erased: int = 1) -> bool:
+        """AOT-lower the flat decode at byte width `width` for
+        `n_erased` lost shards.  The executable is keyed by the decode
+        bitmatrix SHAPE, which depends only on n_erased — one AOT
+        compile covers every erasure pattern of that cardinality."""
+        bs = _ops()
+        n = self.get_chunk_count()
+        e = max(1, min(n_erased, self.m))
+        # representative pattern: last e shards lost (shape-equivalent
+        # to any other pattern of e losses)
+        erased = tuple(range(n - e, n))
+        survivors = tuple(i for i in range(n) if i not in erased)[:self.k]
+        _, bitmat = self._decode_plan(survivors, erased)
+        if not self._use_w32:
+            w = width + (-width % bs.LANE)
+            return bs.aot_compile(
+                "mm_xla", bs.gf_bitmatmul_xla,
+                (bitmat, self._aot_spec((self.k, w), np.uint8)),
+                {"r": e})
+        w = (width + (-width % 4)) // 4
+        wlane = w + (-w % bs.LANE)
+        return bs.aot_compile(
+            "mm_w32", bs.gf_bitmatmul_pallas_w32,
+            (bitmat, self._aot_spec((self.k, wlane), np.int32)),
+            {"r": e, "tile": 4 * bs._pick_wt(wlane)})
+
+    def aot_compile_fused(self, widths: list[int]) -> bool:
+        """AOT-lower the fused parity+crc launch for a drain whose runs
+        have the given byte widths, at this codec's operating point —
+        the gf_encode_extents_with_crc_submit dispatch shapes (tile
+        padding, pow2 tile-count bucketing, pow2 run-count bucketing
+        all reproduced)."""
+        import jax
+        import jax.numpy as jnp
+        bs = _ops()
+        from ...common.util import next_pow2
+        from ...ops import crc32c_linear as cl
+        k, m = self.k, self.m
+        if not self._use_w32:                      # CPU: force_xla path
+            tile = bs.FUSED_TILE
+            nt = next_pow2(sum(-(-w // tile) for w in widths))
+            cmat = jnp.asarray(cl.crc_tile_matrix(tile))
+            return bs.aot_compile(
+                "fused_xla", bs.gf_encode_with_crc_xla,
+                (self._enc_bitmat, cmat,
+                 self._aot_spec((k, nt * tile), np.uint8)),
+                {"m": m, "tile": tile})
+        point = self.fused_point()
+        tile_hier = point["tile"] or bs.FUSED_TILE_HIER
+        wb = point["wb"] or bs.FUSED_WB
+        extract = point["extract"]
+        donate = jax.default_backend() != "cpu"
+        hier = min(widths) >= tile_hier
+        tile = tile_hier if hier else bs.FUSED_TILE
+        ntiles_run = [-(-w // tile) for w in widths]
+        ntiles_total = sum(ntiles_run)
+        nt2 = next_pow2(ntiles_total)
+        pad_tiles = nt2 - ntiles_total
+        words = self._aot_spec((k, nt2 * tile // 4), np.int32)
+        cmat_sub = jnp.asarray(cl.crc_tile_matrix_w32(wb))
+        if hier and point["combine"] == "kernel":
+            if pad_tiles:
+                ntiles_run = ntiles_run + [pad_tiles]
+            nruns_acc = next_pow2(len(ntiles_run))
+            ntiles_run += [0] * (nruns_acc - len(ntiles_run))
+            run_map, first_map, adv, comb = bs._acc_launch_args(
+                ntiles_run, tile, wb)
+            acc_fn = bs._hier_acc_donate if donate else bs._hier_acc
+            return bs.aot_compile(
+                "hier_acc_donate" if donate else "hier_acc", acc_fn,
+                (self._enc_bitmat32, cmat_sub, adv, comb, run_map,
+                 first_map, words),
+                {"m": m, "tile": tile, "wb": wb, "nruns": nruns_acc,
+                 "interpret": False, "extract": extract})
+        if hier:
+            hier_fn = bs._fused_hier_lsub_donate if donate \
+                else bs._fused_hier_lsub
+            return bs.aot_compile(
+                "hier_lsub_donate" if donate else "hier_lsub", hier_fn,
+                (self._enc_bitmat32, cmat_sub, words),
+                {"m": m, "tile": tile, "wb": wb, "interpret": False,
+                 "extract": extract})
+        cmat32 = jnp.asarray(cl.crc_tile_matrix_w32(tile // 4))
+        return bs.aot_compile(
+            "fused_w32", bs.gf_encode_with_crc_pallas_w32,
+            (self._enc_bitmat32, cmat32, words),
+            {"m": m, "interpret": False})
+
     # -- decode -------------------------------------------------------------
 
     def _decode_plan(self, survivors: tuple[int, ...],

@@ -29,6 +29,7 @@ fallback, which materializes the 8x unpacked bit-planes in HBM).
 from __future__ import annotations
 
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -364,8 +365,9 @@ def gf_bitmatmul_w32(bitmat32: jnp.ndarray, words: jnp.ndarray, r: int
     wpad = -w % LANE
     if wpad:
         words = jnp.pad(words, ((0, 0), (0, wpad)))
-    out = gf_bitmatmul_pallas_w32(bitmat32, words, r,
-                                  tile=4 * _pick_wt(w + wpad))
+    out = _aot_dispatch("mm_w32", gf_bitmatmul_pallas_w32,
+                        (bitmat32, words),
+                        {"r": r, "tile": 4 * _pick_wt(w + wpad)})
     return out[:, :w] if wpad else out
 
 
@@ -1005,8 +1007,9 @@ def gf_encode_extents_with_crc_submit(bitmat, bitmat32, runs, m: int,
     lbits_devs = None
     if force_xla:
         cmat = jnp.asarray(cl.crc_tile_matrix(tile))
-        parity_dev, crc_bits = gf_encode_with_crc_xla(
-            bitmat, cmat, jnp.asarray(big), m)
+        parity_dev, crc_bits = _aot_dispatch(
+            "fused_xla", gf_encode_with_crc_xla,
+            (bitmat, cmat, jnp.asarray(big)), {"m": m, "tile": tile})
         lb_all = jnp.transpose(crc_bits, (1, 0, 2))    # (r, ntiles, 32)
         block_bytes = tile
         path = "xla"
@@ -1039,10 +1042,13 @@ def gf_encode_extents_with_crc_submit(bitmat, bitmat32, runs, m: int,
         run_map, first_map, adv, comb = _acc_launch_args(
             ntiles_run, tile, wb)
         acc_fn = _hier_acc_donate if donate else _hier_acc
-        parity_dev, lb = acc_fn(
-            bitmat32, cmat_sub, adv, comb, run_map, first_map,
-            jnp.asarray(words), m, tile, wb,
-            nruns_acc, interpret, extract)             # (nruns, r, 32)
+        parity_dev, lb = _aot_dispatch(
+            "hier_acc_donate" if donate else "hier_acc", acc_fn,
+            (bitmat32, cmat_sub, adv, comb, run_map, first_map,
+             jnp.asarray(words)),
+            {"m": m, "tile": tile, "wb": wb, "nruns": nruns_acc,
+             "interpret": interpret,
+             "extract": extract})                      # (nruns, r, 32)
         lbits_devs = [lb[i] for i in range(len(runs))]
         block_bytes = 4 * wb
         w32_out = True
@@ -1051,9 +1057,11 @@ def gf_encode_extents_with_crc_submit(bitmat, bitmat32, runs, m: int,
         cmat_sub = jnp.asarray(cl.crc_tile_matrix_w32(wb))
         words = big.view("<u4").view(np.int32)
         hier_fn = _fused_hier_lsub_donate if donate else _fused_hier_lsub
-        parity_dev, lb_all = hier_fn(
-            bitmat32, cmat_sub, jnp.asarray(words), m, tile, wb,
-            interpret, extract)                        # (r, nsub, 32)
+        parity_dev, lb_all = _aot_dispatch(
+            "hier_lsub_donate" if donate else "hier_lsub", hier_fn,
+            (bitmat32, cmat_sub, jnp.asarray(words)),
+            {"m": m, "tile": tile, "wb": wb, "interpret": interpret,
+             "extract": extract})                      # (r, nsub, 32)
         block_bytes = 4 * wb
         w32_out = True
         path = "hier_lsub"
@@ -1061,8 +1069,10 @@ def gf_encode_extents_with_crc_submit(bitmat, bitmat32, runs, m: int,
         wt = tile // 4
         cmat32 = jnp.asarray(cl.crc_tile_matrix_w32(wt))
         words = big.view("<u4").view(np.int32)
-        parity_dev, crc_flat = gf_encode_with_crc_pallas_w32(
-            bitmat32, cmat32, jnp.asarray(words), m, interpret=interpret)
+        parity_dev, crc_flat = _aot_dispatch(
+            "fused_w32", gf_encode_with_crc_pallas_w32,
+            (bitmat32, cmat32, jnp.asarray(words)),
+            {"m": m, "interpret": interpret})
         lb_all = jnp.transpose(
             crc_flat.reshape(ntiles_total, rows, 32)[:, :r_tot],
             (1, 0, 2))                                 # (r, ntiles, 32)
@@ -1170,8 +1180,97 @@ def gf_bitmatmul(bitmat: jnp.ndarray, chunks: jnp.ndarray, r: int,
     if npad:
         chunks = jnp.pad(chunks, ((0, 0), (0, npad)))
     if use_xla:
-        out = gf_bitmatmul_xla(bitmat, chunks, r)
+        out = _aot_dispatch("mm_xla", gf_bitmatmul_xla,
+                            (bitmat, chunks), {"r": r})
     else:
         out = gf_bitmatmul_pallas(bitmat, chunks, r,
                                   tile=_pick_tile(n + npad))
     return out[:, :n] if npad else out
+
+
+# ----------------------------------------------------------------------------
+# AOT lowering: headline kernels compiled ahead of time
+# ----------------------------------------------------------------------------
+# The compile-stall fix's third leg (with the persistent compile cache
+# and the boot-time prewarm plan): the headline entry points — the
+# fused hier-acc encode+crc point, the plain/flat encode, and the flat
+# decode — get jax.jit(...).lower().compile() executables built BEFORE
+# any data exists, keyed by (entry name, input avals, static args).
+# The dispatch sites below consult this registry first, so a
+# steady-state launch of an AOT-covered shape calls the compiled
+# executable directly and never touches jit dispatch (no trace-time,
+# ever); uncovered shapes fall through to the jitted path unchanged.
+# With the persistent cache enabled, an AOT lower+compile also lands
+# the executable on disk — a restarted daemon's aot_compile() of the
+# same shape is a cache read, not a compile.
+
+_AOT_LOCK = threading.Lock()
+_AOT: dict[tuple, object] = {}
+_AOT_STATS = {"compiles": 0, "calls": 0, "errors": 0, "compile_s": 0.0}
+
+
+def _aot_key(name: str, args, statics: dict) -> tuple:
+    return (name,
+            tuple((tuple(a.shape), str(np.dtype(a.dtype)))
+                  for a in args),
+            tuple(sorted(statics.items())))
+
+
+def aot_compile(name: str, jitted, args, statics: dict) -> bool:
+    """Lower+compile one jitted entry at the given arg shapes (arrays
+    or ShapeDtypeStructs — only shape/dtype are read) and register the
+    executable under (name, avals, statics).  Idempotent; returns
+    whether the executable is (now) registered.  Failures degrade to
+    the jitted path and are counted, never raised — AOT is an
+    optimization, not a correctness dependency."""
+    key = _aot_key(name, args, statics)
+    with _AOT_LOCK:
+        if key in _AOT:
+            return True
+    import time as _time
+    avals = tuple(jax.ShapeDtypeStruct(tuple(a.shape),
+                                       np.dtype(a.dtype))
+                  for a in args)
+    t0 = _time.perf_counter()
+    try:
+        exe = jitted.lower(*avals, **statics).compile()
+    except Exception:  # noqa: BLE001 — unsupported backend/shape
+        _AOT_STATS["errors"] += 1
+        return False
+    with _AOT_LOCK:
+        _AOT.setdefault(key, exe)
+        _AOT_STATS["compiles"] += 1
+        _AOT_STATS["compile_s"] += _time.perf_counter() - t0
+    return True
+
+
+def _aot_dispatch(name: str, jitted, args, statics: dict):
+    """Call the AOT executable registered for (name, arg shapes,
+    statics) when one exists, else the jitted path.  A call-time
+    mismatch (dtype drift, backend change) drops the stale executable
+    and falls back — one failed call, never a wedged path."""
+    exe = _AOT.get(_aot_key(name, args, statics))
+    if exe is not None:
+        try:
+            out = exe(*args)
+            _AOT_STATS["calls"] += 1
+            return out
+        except Exception:  # noqa: BLE001 — stale/mismatched executable
+            _AOT_STATS["errors"] += 1
+            with _AOT_LOCK:
+                _AOT.pop(_aot_key(name, args, statics), None)
+    return jitted(*args, **statics)
+
+
+def aot_stats() -> dict:
+    with _AOT_LOCK:
+        out = dict(_AOT_STATS)
+        out["executables"] = len(_AOT)
+    return out
+
+
+def aot_reset_for_tests() -> None:
+    with _AOT_LOCK:
+        _AOT.clear()
+        _AOT_STATS.update(
+            {"compiles": 0, "calls": 0, "errors": 0, "compile_s": 0.0})

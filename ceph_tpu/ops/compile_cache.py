@@ -1,0 +1,157 @@
+"""Persistent XLA compile cache: one compile per host LIFETIME.
+
+PR 15's flight recorder made compile stalls visible; this module (with
+ops/prewarm.py) removes them.  JAX's persistent compilation cache
+serializes every compiled executable to disk keyed by the (HLO,
+compile options, backend) fingerprint, so a RESTARTED daemon re-traces
+its jit buckets but never re-compiles them — the multi-second XLA/
+Mosaic compile that used to flap heartbeats on every revive becomes a
+millisecond disk read.  The cache directory sits alongside the
+autotune v2 cache under ~/.cache/ceph_tpu/ and is configured through
+`osd_ec_compile_cache_dir` (conf, not env-only; the CEPH_TPU_* env
+layer of common/options.py reaches it anyway).
+
+Hit/miss attribution rides jax.monitoring: the backend records a
+'/jax/compilation_cache/cache_hits' event every time a compile is
+served from disk.  This module keeps a process-global hit counter;
+the flight recorder (ops/profiler.py) snapshots it around each
+first-seen submit, so a persistent-cache hit records as a fast
+first-launch with `cache_hit: true` in the launch ledger — NOT as a
+compile stall (before this PR the two were indistinguishable).
+
+Everything degrades gracefully: a jax without the persistent cache
+knobs, an unwritable directory, or a backend that never emits the
+monitoring events leaves the module disabled and every query cheap
+(`enabled()` one bool, `hit_count()` one int).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from pathlib import Path
+
+_lock = threading.Lock()
+_enabled = False
+_dir: str | None = None
+_error: str | None = None
+_listener_ok = False
+# process-global persistent-cache hit counter (bumped by the
+# jax.monitoring listener; int reads are atomic under the GIL, so the
+# profiler's per-launch snapshots never take _lock)
+_hits = 0
+
+
+def default_cache_dir() -> Path:
+    """~/.cache/ceph_tpu/xla — beside the autotune v2 cache
+    (ops/autotune._cache_path), honoring the same style of env
+    override for hermetic CI."""
+    env = os.environ.get("CEPH_TPU_COMPILE_CACHE")
+    if env:
+        return Path(env)
+    return Path.home() / ".cache" / "ceph_tpu" / "xla"
+
+
+def _on_event(event: str, **kw) -> None:
+    global _hits
+    if "compilation_cache" in event and "hit" in event:
+        _hits += 1
+
+
+def enable(cache_dir: str | os.PathLike | None = None) -> bool:
+    """Point jax's persistent compilation cache at `cache_dir`
+    (default: default_cache_dir()) and register the hit listener.
+    Idempotent per process — the first caller's directory wins (one
+    cache per host, like the mesh shape); returns whether the cache is
+    live.  Must run before the first jit COMPILE to cover it, but is
+    safe (and still effective for later compiles) at any point."""
+    global _enabled, _dir, _error, _listener_ok
+    with _lock:
+        if _enabled:
+            return True
+        path = Path(cache_dir) if cache_dir else default_cache_dir()
+        try:
+            path.mkdir(parents=True, exist_ok=True)
+        except OSError as e:
+            _error = f"mkdir {path}: {e}"
+            return False
+        try:
+            import jax
+            jax.config.update("jax_compilation_cache_dir", str(path))
+            # jax memoizes "is the cache in use" at the process's FIRST
+            # compile (compilation_cache._cache_checked); if anything
+            # compiled before enable() — a test, an import-time trace —
+            # that latch reads "disabled" forever.  Drop it so the next
+            # compile re-evaluates against the directory just set.
+            try:
+                from jax._src import compilation_cache as _jcc
+                _jcc.reset_cache()
+            except Exception:  # noqa: BLE001 — private API; best-effort
+                pass
+            # daemon workloads are many SMALL programs: cache every
+            # compile regardless of size or compile time (the defaults
+            # skip sub-second compiles — exactly the ones whose sum
+            # makes a revive storm)
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", -1)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0)
+        except Exception as e:  # noqa: BLE001 — old jax / no knob
+            _error = f"jax persistent cache unavailable: {e!r}"
+            return False
+        try:
+            from jax._src import monitoring
+            monitoring.register_event_listener(_on_event)
+            _listener_ok = True
+        except Exception:  # noqa: BLE001 — hit attribution degrades,
+            _listener_ok = False       # the cache itself still works
+        _enabled = True
+        _dir = str(path)
+        _error = None
+        return True
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def cache_dir() -> str | None:
+    return _dir
+
+
+def hit_count() -> int:
+    """Process-global persistent-cache hits (monotonic; the profiler
+    deltas it around each submit for per-launch attribution)."""
+    return _hits
+
+
+def status() -> dict:
+    """The `prewarm status` / `compile ledger` asok block."""
+    out = {
+        "enabled": _enabled,
+        "dir": _dir,
+        "hits": _hits,
+        "hit_listener": _listener_ok,
+    }
+    if _error:
+        out["error"] = _error
+    if _enabled and _dir:
+        try:
+            files = [f for f in Path(_dir).iterdir() if f.is_file()]
+            out["entries"] = len(files)
+            out["bytes"] = sum(f.stat().st_size for f in files)
+        except OSError:
+            pass
+    return out
+
+
+def reset_for_tests() -> None:
+    """Tests only: forget the enabled state so a test can re-point the
+    cache at its own tmpdir.  jax's own config keeps the LAST enabled
+    directory until the next enable() — callers pair this with
+    jax.clear_caches() when simulating a daemon restart."""
+    global _enabled, _dir, _error
+    with _lock:
+        _enabled = False
+        _dir = None
+        _error = None

@@ -56,6 +56,9 @@ import collections
 import threading
 import time
 
+from .compile_cache import hit_count as _cc_hits
+from .compile_cache import status as _cc_status
+
 
 def _build_prof_perf(name: str = "device_profiler"):
     from ..common.perf_counters import PerfCountersBuilder
@@ -68,7 +71,17 @@ def _build_prof_perf(name: str = "device_profiler"):
                              "input bytes carried by recorded launches")
             .add_u64_counter("ec_compile_stalls",
                              "first-seen jit buckets whose submit "
-                             "exceeded osd_ec_compile_stall_s")
+                             "exceeded osd_ec_compile_stall_s "
+                             "(persistent-cache hits excluded)")
+            .add_u64_counter("ec_compile_cache_hits",
+                             "first-seen jit buckets served from the "
+                             "persistent compile cache at runtime")
+            .add_u64_counter("ec_prewarm_compiles",
+                             "jit buckets compiled by the boot-time "
+                             "prewarm plan (cold cache)")
+            .add_u64_counter("ec_prewarm_cache_hits",
+                             "prewarm-plan buckets served from the "
+                             "persistent compile cache")
             .add_histogram("lat_launch_submit",
                            "launch dispatch wall time (includes the "
                            "compile on a bucket's first hit)")
@@ -76,6 +89,8 @@ def _build_prof_perf(name: str = "device_profiler"):
                            "submit -> materialize device time")
             .add_histogram("lat_launch_queue_wait",
                            "host-queue batching wait before launch")
+            .add_histogram("lat_prewarm",
+                           "per-bucket boot-time prewarm compile wall")
             .create_perf_counters())
 
 
@@ -85,7 +100,7 @@ class LaunchRecord:
     __slots__ = ("launch_id", "kind", "codec", "bucket", "path",
                  "runs", "nbytes", "pg_mix", "traces", "queue_wait_s",
                  "submit_s", "device_s", "compiled", "compile_s",
-                 "ts", "_t0")
+                 "cache_hit", "ts", "_t0", "_cc0")
 
     def __init__(self, launch_id: int, kind: str, codec: str,
                  runs: int, nbytes: int, pg_mix: int, traces,
@@ -105,8 +120,15 @@ class LaunchRecord:
         self.device_s = 0.0
         self.compiled = False
         self.compile_s = 0.0
+        # a FIRST launch of this bucket whose compile was served by
+        # the persistent compile cache (or whose bucket was prewarmed
+        # at boot): fast by construction, excluded from stall counting
+        self.cache_hit = False
         self.ts = time.time()
         self._t0 = time.perf_counter()
+        # persistent-cache hit counter at record start: submitted()
+        # deltas it to attribute a disk-served compile to THIS launch
+        self._cc0 = _cc_hits()
 
     def to_dict(self) -> dict:
         return {
@@ -124,6 +146,7 @@ class LaunchRecord:
             "device_ms": round(self.device_s * 1e3, 3),
             "compiled": self.compiled,
             "compile_s": round(self.compile_s, 4),
+            "cache_hit": self.cache_hit,
             "ts": self.ts,
         }
 
@@ -161,6 +184,14 @@ class DeviceProfiler:
         self.launched_runs = 0
         self.launched_bytes = 0
         self.compile_stalls = 0
+        # first-seen buckets whose compile came off the persistent
+        # compile cache at runtime (the revive-storm success metric)
+        self.cache_hits = 0
+        # boot-time prewarm tallies (ops/prewarm.py feeds these through
+        # note_prewarm; the `prewarm status` asok reads them back)
+        self.prewarm_compiles = 0
+        self.prewarm_cache_hits = 0
+        self.prewarm_s = 0.0
         self.created_at = time.time()
 
     # -- host singleton ------------------------------------------------------
@@ -236,31 +267,55 @@ class DeviceProfiler:
         rec.submit_s = now - rec._t0
         rec.bucket = bucket
         rec.path = path
+        # persistent compile cache (ops/compile_cache.py): the hit
+        # counter advancing during THIS submit means the first-seen
+        # compile was served from disk — a fast first launch, never a
+        # stall.  Best-effort under concurrency (a racing launch's hit
+        # could land in this window), but misattribution only ever
+        # downgrades a stall into a hit on a host where the cache IS
+        # serving compiles — the semantics the ledger wants
+        cache_hit = _cc_hits() > rec._cc0
         stalled = False
+        hit = False
         with self._lock:
             ent = self._buckets.get(bucket)
             if ent is None:
                 self._buckets[bucket] = {
                     "count": 1, "first_s": rec.submit_s,
-                    "steady_min_s": None, "first_ts": rec.ts}
+                    "steady_min_s": None, "first_ts": rec.ts,
+                    "cache_hit": cache_hit}
                 rec.compiled = True
                 # upper-bound estimate until a warm relaunch
                 # establishes the bucket's steady state (the ledger
                 # dump refines it; the record keeps the first-hit view)
                 rec.compile_s = rec.submit_s
-                self._compile_events.append(
-                    (time.time(), bucket, rec.submit_s))
-                if rec.submit_s >= self.stall_s:
-                    self.compile_stalls += 1
-                    stalled = True
+                rec.cache_hit = cache_hit
+                if cache_hit:
+                    # excluded from the stall counter AND the
+                    # COMPILE_STORM window: a disk-served compile is
+                    # the fix working, not a storm brewing
+                    self.cache_hits += 1
+                    hit = True
+                else:
+                    self._compile_events.append(
+                        (time.time(), bucket, rec.submit_s))
+                    if rec.submit_s >= self.stall_s:
+                        self.compile_stalls += 1
+                        stalled = True
             else:
                 ent["count"] += 1
+                if ent.get("prewarmed") and ent["count"] == 1:
+                    # first RUNTIME launch of a boot-prewarmed bucket:
+                    # the ledger shows it as a cache hit, not a compile
+                    rec.cache_hit = True
                 sm = ent["steady_min_s"]
                 ent["steady_min_s"] = rec.submit_s if sm is None \
                     else min(sm, rec.submit_s)
         if self.perf:
             if stalled:
                 self.perf.inc("ec_compile_stalls")
+            if hit:
+                self.perf.inc("ec_compile_cache_hits")
             self.perf.hinc("lat_launch_submit", rec.submit_s)
             self.perf.hinc("lat_launch_queue_wait", rec.queue_wait_s)
 
@@ -282,6 +337,43 @@ class DeviceProfiler:
             self.perf.inc("ec_launch_bytes", rec.nbytes)
             self.perf.hinc("lat_launch_device", device_s)
 
+    def note_prewarm(self, bucket: str, warm_s: float,
+                     cache_hit: bool) -> None:
+        """Record one boot-time prewarm compile (ops/prewarm.py): the
+        bucket enters the ledger PRE-SEEDED — the first runtime launch
+        of a prewarmed bucket is not first-seen, so it pays no compile,
+        trips no stall/injection, and records as a cache hit.  Prewarm
+        compiles never enter the COMPILE_STORM window: they happen
+        before the daemon reports up, by design."""
+        with self._lock:
+            if bucket not in self._buckets:
+                self._buckets[bucket] = {
+                    "count": 0, "first_s": warm_s,
+                    "steady_min_s": None, "first_ts": time.time(),
+                    "prewarmed": True, "cache_hit": cache_hit}
+            if cache_hit:
+                self.prewarm_cache_hits += 1
+            else:
+                self.prewarm_compiles += 1
+            self.prewarm_s += warm_s
+        if self.perf:
+            self.perf.inc("ec_prewarm_cache_hits" if cache_hit
+                          else "ec_prewarm_compiles")
+            self.perf.hinc("lat_prewarm", warm_s)
+
+    def prewarm_summary(self) -> dict:
+        """The prewarm tallies block (`prewarm status` asok /
+        compile-ledger provenance)."""
+        with self._lock:
+            prewarmed = sum(1 for e in self._buckets.values()
+                            if e.get("prewarmed"))
+            return {
+                "compiles": self.prewarm_compiles,
+                "cache_hits": self.prewarm_cache_hits,
+                "buckets": prewarmed,
+                "total_s": round(self.prewarm_s, 3),
+            }
+
     # -- compile ledger ------------------------------------------------------
 
     def _bucket_rows(self) -> list[dict]:
@@ -300,6 +392,8 @@ class DeviceProfiler:
                 if steady is not None else None,
                 "compile_s": round(compile_s, 4),
                 "first_ts": e["first_ts"],
+                "prewarmed": bool(e.get("prewarmed")),
+                "cache_hit": bool(e.get("cache_hit")),
             })
         rows.sort(key=lambda r: -r["compile_s"])
         return rows
@@ -318,6 +412,9 @@ class DeviceProfiler:
             "max_compile_s": round(
                 max((r["compile_s"] for r in rows), default=0.0), 4),
             "compile_stalls": self.compile_stalls,
+            "compile_cache_hits": self.cache_hits,
+            "prewarm": self.prewarm_summary(),
+            "persistent_cache": _cc_status(),
             "window": self.compile_report(),
         }
 
@@ -392,6 +489,9 @@ class DeviceProfiler:
             "compile_s_total": round(
                 sum(r["compile_s"] for r in rows), 3),
             "compile_stalls": self.compile_stalls,
+            "compile_cache_hits": self.cache_hits,
+            "prewarm_compiles": self.prewarm_compiles,
+            "prewarm_cache_hits": self.prewarm_cache_hits,
             "device_ms_p50": q("lat_launch_device", 0.5),
             "device_ms_p99": q("lat_launch_device", 0.99),
             "queue_wait_ms_p50": q("lat_launch_queue_wait", 0.5),
@@ -419,6 +519,10 @@ class DeviceProfiler:
             self.launched_runs = 0
             self.launched_bytes = 0
             self.compile_stalls = 0
+            self.cache_hits = 0
+            self.prewarm_compiles = 0
+            self.prewarm_cache_hits = 0
+            self.prewarm_s = 0.0
 
 
 def device_profiler() -> DeviceProfiler:

@@ -465,6 +465,18 @@ class OSDDaemon:
             self.cct.perf.add(self._profiler.perf)
             self._profiler.set_ring_size(
                 int(_tconf.get("osd_ec_profiler_ring")))
+        # persistent XLA compile cache (ops/compile_cache.py, docs/
+        # PIPELINE.md "Compile lifecycle"): point jax at the on-disk
+        # cache BEFORE any jit compile this daemon triggers — a
+        # restarted daemon re-traces but never re-compiles.  One
+        # directory per host (first enabler wins, like the profiler
+        # perf owner); failures leave the cache off, never fail boot
+        self._prewarm_status: dict | None = None
+        if bool(_tconf.get("osd_ec_compile_cache")):
+            from ..ops import compile_cache
+            compile_cache.enable(
+                str(_tconf.get("osd_ec_compile_cache_dir") or "")
+                or None)
 
         def _apply_prof(_k=None, _v=None):
             p = self._profiler
@@ -523,6 +535,12 @@ class OSDDaemon:
                 "compile ledger", self._asok_compile_ledger)
             self.cct.asok.register_command(
                 "compile_ledger", self._asok_compile_ledger)
+            # boot-time prewarm state (ops/prewarm.py); both
+            # spellings like mesh/launch-queue
+            self.cct.asok.register_command(
+                "prewarm status", self._asok_prewarm_status)
+            self.cct.asok.register_command(
+                "prewarm_status", self._asok_prewarm_status)
         self.store = store or MemStore()
         self.store.mount()
         self._raw_tid = 1 << 32   # raw-RPC tids, disjoint from backends'
@@ -690,6 +708,7 @@ class OSDDaemon:
 
     def boot(self, timeout: float = 10.0) -> None:
         """reference OSD::init + MOSDBoot."""
+        self._maybe_prewarm()
         self.mon_conn.send_message(M.MMonGetMap())
         self.mon_conn.send_message(M.MOSDBoot(self.osd_id, self.addr))
         deadline = time.time() + timeout
@@ -3563,6 +3582,46 @@ class OSDDaemon:
             last=int(cmd["last"]) if "last" in cmd else None)
         out["osd"] = self.osd_id
         out["host_perf_owner"] = self._profiler_reporter
+        return out
+
+    def _maybe_prewarm(self) -> None:
+        """Boot-time jit-bucket prewarm (ops/prewarm.py, conf
+        osd_ec_prewarm): compile the expected bucket set BEFORE
+        MOSDBoot, so the daemon never reports `up` with cold jit
+        caches.  Process-level: the first in-process daemon to boot
+        warms for the host (the caches are process-global); later
+        booters reuse its status.  Never fails the boot."""
+        if not bool(self.cct.conf.get("osd_ec_prewarm")):
+            return
+        try:
+            from ..ec.interface import Profile
+            from ..ec.registry import ErasureCodePluginRegistry
+            from ..ops import prewarm
+            prof = Profile(dict(
+                kv.split("=", 1) for kv in str(self.cct.conf.get(
+                    "osd_pool_default_erasure_code_profile")).split()
+                if "=" in kv))
+            codec = ErasureCodePluginRegistry.instance().factory(
+                prof.get("plugin", "jax") or "jax", prof)
+            self._prewarm_status = prewarm.run_once(
+                codec, profiler=self._profiler,
+                budget_s=float(self.cct.conf.get(
+                    "osd_ec_prewarm_budget_s")))
+        except Exception as e:  # noqa: BLE001 — never a boot dependency
+            self._prewarm_status = {"error": repr(e)}
+
+    def _asok_prewarm_status(self, cmd: dict) -> dict:
+        """`ceph daemon osd.N.asok prewarm status`: the boot prewarm
+        pass's plan/coverage/budget outcome plus the host-level
+        prewarm tallies and persistent-cache state."""
+        from ..ops import compile_cache, prewarm
+        out = {
+            "osd": self.osd_id,
+            "enabled": bool(self.cct.conf.get("osd_ec_prewarm")),
+            "boot": self._prewarm_status or prewarm.last_status(),
+            "host": self._profiler.prewarm_summary(),
+            "persistent_cache": compile_cache.status(),
+        }
         return out
 
     def _asok_compile_ledger(self, cmd: dict) -> dict:

@@ -48,6 +48,7 @@ import numpy as np
 from ..common.tracked_op import NULL_TRACKED
 from ..ec.interface import ErasureCodeError, ErasureCodeInterface
 from ..ops.profiler import device_profiler
+from ..parallel.launch_queue import DECODE_MAX_LAUNCH_W
 from ..store.object_store import ObjectStore, Transaction
 from . import ec_transaction as ect
 from . import ec_util
@@ -1176,16 +1177,20 @@ class ECBackend:
                 if getattr(src, "is_launch_ticket", False) and \
                         src.launch_id is not None:
                     stitches.append((src.launch_id, src.bucket,
-                                     src.compiled, src.compile_s))
+                                     src.compiled, src.compile_s,
+                                     src.cache_hit))
             for rec in (drain.prof_fused, drain.prof_plain):
                 if rec is not None:
                     stitches.append((rec.launch_id, rec.bucket,
-                                     rec.compiled, rec.compile_s))
+                                     rec.compiled, rec.compile_s,
+                                     rec.cache_hit))
             stall_s = prof.stall_s
             for op in drain.ops:
                 if id(op) in worked:
-                    for lid, bucket, compiled, comp_s in stitches:
-                        if compiled and comp_s >= stall_s:
+                    for lid, bucket, compiled, comp_s, c_hit in stitches:
+                        # a persistent-cache hit is a fast first-launch,
+                        # not a stall — it never takes the compile blame
+                        if compiled and not c_hit and comp_s >= stall_s:
                             op.top.mark_event(
                                 f"first_compile({bucket})")
                         op.top.mark_event(f"launch({lid})")
@@ -1617,6 +1622,15 @@ class ECBackend:
     # once fan-out would, while still collapsing to one launch per
     # geometry group within each slice
     RECOVER_BATCH_MAX = 64
+    # max concatenated byte width of one grouped recovery decode
+    # launch (single source: parallel/launch_queue, which enforces the
+    # same cap on cross-PG coalescing): with the queue's pow2 padding
+    # this bounds the decode jit-bucket universe to {pow2 <= cap} x
+    # {cardinality <= m} — small enough for the boot prewarm
+    # (ops/prewarm.py) to cover exactly, so a recovery storm never
+    # mints a first-seen bucket.  A single object's chunk wider than
+    # the cap still launches alone (an object's chunk is atomic).
+    DECODE_MAX_LAUNCH_W = DECODE_MAX_LAUNCH_W
 
     def recover_shards_batch(
             self, items: list[tuple[hobject_t, list[int]]],
@@ -1907,25 +1921,44 @@ class ECBackend:
                 # recovery decodes coalesce with OTHER PGs' repairs
                 # (and share occupancy accounting with writes) instead
                 # of issuing a private launch
-                widths = [st["chunk_len"] for st in sts]
-                big = np.zeros((self.n, sum(widths)), dtype=np.uint8)
-                col = 0
-                for st, w in zip(sts, widths):
-                    for s, d in st["have"].items():
-                        big[s, col:col + w] = d
-                    col += w
-                if self._launch_queue is not None:
-                    dec = np.asarray(self._launch_queue.submit_decode(
-                        self.ec_impl, big, list(erasures),
-                        owner=id(self)).result())
-                else:
-                    dec = self.ec_impl.decode_chunks(big,
-                                                     list(erasures))
-                col = 0
-                for st, w in zip(sts, widths):
-                    rebuilt_per_st.append(
-                        {s: dec[s, col:col + w] for s in targets})
-                    col += w
+                # width-capped slices (DECODE_MAX_LAUNCH_W): the
+                # concatenated width, pow2-padded by the queue, stays
+                # inside the prewarm-enumerable bucket set instead of
+                # growing with the storm's queue depth
+                slices: list[list[dict]] = []
+                cur: list[dict] = []
+                cur_w = 0
+                for st in sts:
+                    w = st["chunk_len"]
+                    if cur and cur_w + w > self.DECODE_MAX_LAUNCH_W:
+                        slices.append(cur)
+                        cur, cur_w = [], 0
+                    cur.append(st)
+                    cur_w += w
+                if cur:
+                    slices.append(cur)
+                for chunk_sts in slices:
+                    widths = [st["chunk_len"] for st in chunk_sts]
+                    big = np.zeros((self.n, sum(widths)),
+                                   dtype=np.uint8)
+                    col = 0
+                    for st, w in zip(chunk_sts, widths):
+                        for s, d in st["have"].items():
+                            big[s, col:col + w] = d
+                        col += w
+                    if self._launch_queue is not None:
+                        dec = np.asarray(
+                            self._launch_queue.submit_decode(
+                                self.ec_impl, big, list(erasures),
+                                owner=id(self)).result())
+                    else:
+                        dec = self.ec_impl.decode_chunks(
+                            big, list(erasures))
+                    col = 0
+                    for st, w in zip(chunk_sts, widths):
+                        rebuilt_per_st.append(
+                            {s: dec[s, col:col + w] for s in targets})
+                        col += w
             else:
                 for st in sts:
                     dense = np.zeros((self.n, st["chunk_len"]),

@@ -62,6 +62,15 @@ import numpy as np
 from ..common.util import next_pow2
 from ..ops.profiler import device_profiler
 
+# max summed input width of one coalesced decode launch.  Decode
+# launches are pow2-padded (see _do_launch), so together with this cap
+# the decode jit-bucket universe is {pow2 width <= cap} x {erasure
+# cardinality <= m} — finite, and exactly enumerable by the boot
+# prewarm (ops/prewarm.py).  A single submission wider than the cap
+# still launches alone (a recovery group's chunk is atomic); its width
+# follows the object geometry, which prewarm covers separately.
+DECODE_MAX_LAUNCH_W = 65536
+
 
 def _codec_label(plugin) -> str:
     """Short human codec tag for the flight recorder (the full
@@ -218,6 +227,7 @@ class LaunchTicket:
         self.bucket: str | None = None
         self.compiled = False
         self.compile_s = 0.0
+        self.cache_hit = False
 
     @property
     def launched(self) -> bool:
@@ -407,7 +417,7 @@ class ECLaunchQueue:
         ticket = LaunchTicket(self, kind, key)
         sub = _Sub(ticket, plugin, runs, owner, extra=extra,
                    traces=traces)
-        batch = None
+        batches: list[_Batch] = []
         with self._lock:
             self._pending.setdefault(key, []).append(sub)
             nb = self._pending_bytes.get(key, 0) + sub.nbytes
@@ -415,10 +425,10 @@ class ECLaunchQueue:
             if nb >= self.max_bytes or self.window_us <= 0:
                 # occupancy cap reached (or batching disabled): launch
                 # this key's super-batch immediately
-                batch = self._pop_batch_locked(key)
+                batches = self._pop_batches_locked(key)
             else:
                 self._arm_window_locked()
-        if batch is not None:
+        for batch in batches:
             self._do_launch(batch)
         return ticket
 
@@ -477,9 +487,9 @@ class ECLaunchQueue:
                 if delay > 0:
                     self._cv.wait(delay)
                     continue
-                batches = [self._pop_batch_locked(k)
-                           for k in list(self._pending)
-                           if self._pending.get(k)]
+                batches = [b for k in list(self._pending)
+                           if self._pending.get(k)
+                           for b in self._pop_batches_locked(k)]
                 self._deadline = None
             for batch in batches:
                 self._do_launch(batch)
@@ -490,25 +500,46 @@ class ECLaunchQueue:
         fires, and the idle-flush hook."""
         with self._lock:
             keys = [key] if key is not None else list(self._pending)
-            batches = [self._pop_batch_locked(k) for k in keys
-                       if self._pending.get(k)]
+            batches = [b for k in keys if self._pending.get(k)
+                       for b in self._pop_batches_locked(k)]
         for batch in batches:
             self._do_launch(batch)
 
     # -- launch --------------------------------------------------------------
 
-    def _pop_batch_locked(self, key: tuple) -> _Batch:
+    def _pop_batches_locked(self, key: tuple) -> "list[_Batch]":
         """Under self._lock: claim a key's pending submissions as one
-        batch, bind every ticket to it (so a racing result() waits on
-        the batch instead of re-flushing an empty key), and account
-        the launch.  The device submit itself happens OUTSIDE the
-        queue lock in _do_launch — a multi-second first-bucket compile
-        (or a CPU plugin's synchronous encode) must stall only this
-        batch, not every PG's submit path on the host."""
+        or more batches, binding every ticket to one (so a racing
+        result() waits on its batch instead of re-flushing an empty
+        key).  Decode keys split at DECODE_MAX_LAUNCH_W of summed
+        input width: with the pow2 padding in _do_launch this keeps
+        every decode launch inside the prewarm-enumerable bucket set
+        ({pow2 <= cap} x cardinality) no matter how many PGs' repair
+        slices coalesce in one window.  The device submit itself
+        happens OUTSIDE the queue lock in _do_launch — a multi-second
+        first-bucket compile (or a CPU plugin's synchronous encode)
+        must stall only its batch, not every PG's submit path."""
         subs = self._pending.pop(key)
         self._pending_bytes.pop(key, None)
         if not self._pending:
             self._deadline = None
+        if key[0] != "d":
+            groups = [subs]
+        else:
+            groups, cur, cur_w = [], [], 0
+            for s in subs:
+                w = int(s.runs[0].shape[1])
+                if cur and cur_w + w > DECODE_MAX_LAUNCH_W:
+                    groups.append(cur)
+                    cur, cur_w = [], 0
+                cur.append(s)
+                cur_w += w
+            if cur:
+                groups.append(cur)
+        return [self._make_batch_locked(key, g) for g in groups]
+
+    def _make_batch_locked(self, key: tuple,
+                           subs: "list[_Sub]") -> _Batch:
         batch = _Batch(key[0], subs)
         now = time.perf_counter()
         for s in subs:
@@ -613,13 +644,19 @@ class ECLaunchQueue:
                 bigs = [s.runs[0] for s in subs]
                 big = np.concatenate(bigs, axis=1) if len(bigs) > 1 \
                     else bigs[0]
-                if len(bigs) > 1:
-                    w = big.shape[1]
-                    w2 = next_pow2(w)
-                    if w2 != w:
-                        big = np.concatenate(
-                            [big, np.zeros((big.shape[0], w2 - w),
-                                           dtype=np.uint8)], axis=1)
+                # launch-shape bucketing, UNCONDITIONAL: a solo sub
+                # can carry an arbitrary width (a recovery group's
+                # concatenated chunks, a non-pow2 chunk_len), and an
+                # unpadded width mints a fresh jit bucket no boot
+                # prewarm can enumerate.  Pow2 padding bounds the
+                # decode bucket universe; the finalize demux slices
+                # each sub's real width, so pad columns are never read.
+                w = big.shape[1]
+                w2 = next_pow2(w)
+                if w2 != w:
+                    big = np.concatenate(
+                        [big, np.zeros((big.shape[0], w2 - w),
+                                       dtype=np.uint8)], axis=1)
                 era = "".join(str(e) for e in subs[0].extra)
                 bucket = f"d:e{era}:w{big.shape[1]}"
                 handle = ("np", np.asarray(plugin.decode_chunks(
@@ -674,6 +711,7 @@ class ECLaunchQueue:
                     t.bucket = rec.bucket
                     t.compiled = rec.compiled
                     t.compile_s = rec.compile_s
+                    t.cache_hit = rec.cache_hit
         except Exception:  # noqa: BLE001 — containment retry
             # a poison submission must fail only its owner: launch
             # each submission on its OWN plugin, recording per-ticket

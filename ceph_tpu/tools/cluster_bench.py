@@ -240,6 +240,14 @@ def main(argv=None) -> int:
                          "(missed-ping multiplier; generous so python "
                          "thread scheduling jitter on a small box "
                          "doesn't flap daemons down)")
+    ap.add_argument("--prewarm", action="store_true",
+                    help="--scale only: boot with the jit-bucket "
+                         "prewarm + persistent compile cache "
+                         "(CEPH_TPU_COMPILE_CACHE for a hermetic "
+                         "dir), drive an EC pool through the churn "
+                         "with compile-stall injection armed, and "
+                         "gate ec_compile_stalls == 0 / no "
+                         "COMPILE_STORM (ISSUE 16)")
     args = ap.parse_args(argv)
 
     if args.scale is not None:
@@ -322,11 +330,22 @@ def _main_scale(args) -> int:
     row: dict = {"metric": "cluster_scale", "osds": n,
                  "obj_size": args.size}
     fail: list[str] = []
+    conf = {"osd_heartbeat_min_peers": args.hb_peers,
+            "osd_heartbeat_grace": args.hb_grace}
+    prewarm_ec = bool(getattr(args, "prewarm", False)) and n >= 12
+    if prewarm_ec:
+        # ISSUE 16 churn gate: boot prewarmed (persistent cache dir
+        # from CEPH_TPU_COMPILE_CACHE when hermetic CI points one),
+        # and ARM the compile-stall injection — any EC launch on a
+        # bucket the prewarm failed to cover sleeps 0.5 s in its
+        # submit and fails the zero-stall gate below.  Deterministic:
+        # with full coverage the injection can never fire.
+        conf.update({"osd_ec_prewarm": True,
+                     "osd_ec_prewarm_budget_s": 60.0,
+                     "osd_ec_inject_compile_stall": 0.5})
     t0 = time.time()
     with Cluster(n_osds=n, heartbeat_interval=args.heartbeat,
-                 boot_parallel=True,
-                 conf={"osd_heartbeat_min_peers": args.hb_peers,
-                       "osd_heartbeat_grace": args.hb_grace}) as c:
+                 boot_parallel=True, conf=conf) as c:
         row["boot_s"] = round(time.time() - t0, 2)
         client = None
         for _ in range(5):      # map RT right after a big boot can
@@ -363,6 +382,26 @@ def _main_scale(args) -> int:
         acked: dict[str, bool] = {}
         acked_q: _q.Queue = _q.Queue()
         stop_writing = threading.Event()
+        ec_io = None
+        ec_payload = b""
+        ec_acked_q: _q.Queue = _q.Queue()
+        if prewarm_ec:
+            # EC churn lane (ISSUE 16): k=8,m=3 writes at the default
+            # profile's prewarmed geometry (32 KiB objects -> 4 KiB
+            # chunk columns) ride THROUGH the kill/revive below, so
+            # the zero-stall gate covers encode, degraded decode, and
+            # post-revive recovery launches
+            mcmd({"prefix": "osd erasure-code-profile set",
+                  "name": "scale_ec",
+                  "profile": {"plugin": "jax", "technique": "cauchy",
+                              "k": "8", "m": "3",
+                              "stripe_unit": "1024"}})
+            mcmd({"prefix": "osd pool create", "name": "scaleec",
+                  "type": "erasure",
+                  "erasure_code_profile": "scale_ec", "pg_num": 4})
+            ec_io = client.open_ioctx("scaleec")
+            ec_payload = rng.integers(0, 256, 32768,
+                                      dtype=np.uint8).tobytes()
 
         def writer(t: int) -> None:
             i = 0
@@ -382,6 +421,37 @@ def _main_scale(args) -> int:
                     pass           # failure shape expected here
                 i += 1
 
+        # the EC lane pauses across the remap windows (ec_gate: drain
+        # walk through kill/revive): a write in flight when its shard
+        # holders re-peer can wedge the EC pipeline or leave a partial
+        # object past the clean-wait (sub-write acks are not resent on
+        # re-peer — a known reduction, docs/PIPELINE.md) and that
+        # liveness axis is not what this gate measures.  Writes BEFORE
+        # the remaps cover the cold-boot buckets, writes AFTER the
+        # revives are the acceptance point (warm first launches on a
+        # revived daemon); the replicated lane keeps load through the
+        # windows themselves.
+        ec_gate = threading.Event()
+        ec_gate.set()
+
+        def ec_writer(t: int) -> None:
+            i = 0
+            while not stop_writing.is_set():
+                if not ec_gate.is_set():
+                    time.sleep(0.1)
+                    continue
+                name = f"ec_{t}_{i}"
+                try:
+                    reply = client.objecter.op_submit(
+                        ec_io.pool_id, name,
+                        [["writefull", len(ec_payload)]], ec_payload,
+                        timeout=5.0, attempts=2)
+                    if reply.result == 0:
+                        ec_acked_q.put(name)
+                except Exception:  # noqa: BLE001 - churn failures
+                    pass           # expected, like the replicated lane
+                i += 1
+
         # lighter write load at high N: the point is load DURING
         # churn, not peak IOPS — at 64 in-process daemons the GIL is
         # the scarce resource
@@ -389,6 +459,10 @@ def _main_scale(args) -> int:
         writers = [threading.Thread(target=writer, args=(t,),
                                     daemon=True)
                    for t in range(n_writers)]
+        if ec_io is not None:
+            writers += [threading.Thread(target=ec_writer, args=(t,),
+                                         daemon=True)
+                        for t in range(2)]
         for t in writers:
             t.start()
         time.sleep(max(1.0, args.seconds / 2))
@@ -413,6 +487,17 @@ def _main_scale(args) -> int:
         time.sleep(1.0)
         pool_set(8)                        # merge back (interleave-
         # guarded: retries until split pushes settle)
+        # drain, reweight, and kill/revive below all remap the EC
+        # pool's acting sets — close the gate across ALL of them, not
+        # just the kills: a k=8,m=3 write in flight across ANY remap
+        # can strand sub-writes (acks are not resent on re-peer) into
+        # a partial object recovery can neither rebuild (> m shards
+        # short) nor latch unfound, wedging active+clean.  The
+        # split/merge above only resizes the "churn" pool, so the EC
+        # lane keeps writing through it.
+        if ec_io is not None:
+            ec_gate.clear()
+            time.sleep(2.0)     # let in-flight EC ops resolve first
         # drain walk: one committed epoch per weight step
         mcmd({"prefix": "osd drain", "id": n - 1, "step": 0.5})
         deadline = time.time() + 60
@@ -436,6 +521,14 @@ def _main_scale(args) -> int:
             fail.append("failure detection never marked victims down")
         for v in victims:
             c.revive_osd(v)
+        if ec_io is not None:
+            # resume once the map shows the revived daemons up: the
+            # post-revive EC writes are the warm-first-launch check
+            deadline = time.time() + 30
+            while not all(c.mon.osdmap.is_up(v) for v in victims) \
+                    and time.time() < deadline:
+                time.sleep(0.2)
+            ec_gate.set()
         time.sleep(max(1.0, args.seconds / 2))
         stop_writing.set()
         for t in writers:
@@ -504,6 +597,41 @@ def _main_scale(args) -> int:
         # the host launch/compile ledger like every bench row
         from ..ops.profiler import device_profiler
         row["launch_ledger"] = device_profiler().bench_summary()
+        if prewarm_ec:
+            # ISSUE 16 gates: with the boot prewarm + persistent
+            # cache, the armed stall injection must never have fired
+            # (zero compile stalls), the mon must never have raised
+            # COMPILE_STORM, and the EC lane must actually have
+            # written through the churn
+            ec_acked = 0
+            while not ec_acked_q.empty():
+                ec_acked_q.get()
+                ec_acked += 1
+            prof = device_profiler()
+            ledger = row["launch_ledger"]
+            row["ec_acked_objects"] = ec_acked
+            row["prewarm"] = prof.prewarm_summary()
+            row["ec_compile_stalls"] = ledger.get("compile_stalls", 0)
+            _rc, health = c.mon.handle_command({"prefix": "health"})
+            storm = (health.get("checks") or {}).get("COMPILE_STORM")
+            row["compile_storm"] = storm is not None
+            if not ec_acked:
+                fail.append("prewarm churn lane: no EC write acked")
+            if row["prewarm"].get("buckets", 0) <= 0:
+                fail.append("prewarm ran no buckets (boot hook dead)")
+            if row["ec_compile_stalls"]:
+                cold = [r["bucket"] for r in
+                        prof.compile_ledger()["buckets"]
+                        if r.get("count") and not r.get("prewarmed")
+                        and not r.get("cache_hit")]
+                row["cold_buckets"] = cold
+                fail.append(
+                    f"{row['ec_compile_stalls']} compile stalls with "
+                    f"prewarm on (runtime launches hit cold buckets "
+                    f"the boot prewarm should have covered: {cold})")
+            if storm is not None:
+                fail.append(f"COMPILE_STORM with prewarm on: "
+                            f"{storm.get('summary')}")
     row["ok"] = not fail
     if fail:
         row["failures"] = fail

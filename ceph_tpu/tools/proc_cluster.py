@@ -84,7 +84,22 @@ class ProcCluster:
                  failure_quorum: int = 2,
                  conf: dict | None = None,
                  boot_timeout: float = 120.0,
-                 mesh_devices: str | None = None):
+                 mesh_devices: str | None = None,
+                 prewarm: bool = False,
+                 compile_cache_dir: str | None = None):
+        # compile lifecycle (docs/PIPELINE.md): in the process
+        # topology EVERY OSD process prewarms its own interpreter's
+        # jit caches, so the shared persistent compile cache does the
+        # cross-process heavy lifting (first booter compiles to disk,
+        # the rest read).  compile_cache_dir points it at a private
+        # dir for hermetic CI.
+        if prewarm or compile_cache_dir is not None:
+            conf = dict(conf or {})
+            if prewarm:
+                conf.setdefault("osd_ec_prewarm", True)
+            if compile_cache_dir is not None:
+                conf.setdefault("osd_ec_compile_cache_dir",
+                                str(compile_cache_dir))
         self.n_osds = n_osds
         self.n_mons = n_mons
         self.objectstore = objectstore

@@ -72,6 +72,22 @@ if [ "$rc" -eq 0 ]; then
   timeout -k 10 420 env JAX_PLATFORMS=cpu python -m ceph_tpu.tools.cluster_bench \
     --scale 16 --seconds 2 --size 16384 || rc=$?
 fi
+# Compile-stall kill gate (ISSUE 16, docs/PIPELINE.md "Compile
+# lifecycle"): a prewarmed 16-OSD churn row with the stall injection
+# ARMED and the persistent compile cache pointed at a throwaway dir —
+# EC writes must ack through kill/revive churn with ec_compile_stalls
+# == 0 and no COMPILE_STORM (any bucket the boot-time PrewarmPlan
+# missed trips the injected stall and fails the row).  The
+# prewarm-plan exactness + persistent-cache round-trip + budget-cutoff
+# + kill/revive unit scenarios run in the pytest tier above
+# (tests/test_prewarm.py).
+if [ "$rc" -eq 0 ]; then
+  _cc_dir=$(mktemp -d) && \
+  timeout -k 10 540 env JAX_PLATFORMS=cpu CEPH_TPU_COMPILE_CACHE="$_cc_dir" \
+    python -m ceph_tpu.tools.cluster_bench \
+    --scale 16 --prewarm --seconds 2 --size 16384 || rc=$?
+  rm -rf "$_cc_dir"
+fi
 # Fused-kernel variant gate (ISSUE 11, docs/FUSED_CRC.md): every
 # shipped (extract, combine) variant of the fused parity+crc kernel —
 # planar/packed/wide extraction through the XLA log-fold AND the
