@@ -285,6 +285,37 @@ register_options([
            "daemon to configure it wins", flags=("startup",)),
 ])
 
+# rgw bucket index sharding / dynamic resharding / quota admission
+# (rgw/bucket_index.py, rgw/reshard.py, rgw/store.py); reference
+# option names match src/common/options/rgw.yaml.in where one exists
+register_options([
+    Option("rgw_bucket_index_shards", int, 1,
+           "index shard count for newly created buckets (reference "
+           "rgw_override_bucket_index_max_shards); 1 keeps the legacy "
+           "single directory object layout", min=1),
+    Option("rgw_max_objs_per_shard", int, 100_000,
+           "dynamic-reshard trigger: when a bucket's entry count "
+           "exceeds shards*this, the reshard sweep scales the shard "
+           "count to the next power of two that brings the per-shard "
+           "load back under it", min=1),
+    Option("rgw_reshard_max_shards", int, 64,
+           "ceiling on automatic reshard targets (manual 'bucket "
+           "reshard' may still exceed it)", min=1),
+    Option("rgw_reshard_grace_s", float, 0.25,
+           "dwell in the dual-write state before copying begins: "
+           "writers that read the bucket meta just before the reshard "
+           "marker landed finish their single-layout writes inside "
+           "this window, so the copier's old-shard pages see them",
+           min=0.0),
+    Option("rgw_reshard_batch", int, 512,
+           "entries per dir_merge page while copying a shard (one "
+           "atomic class call each)", min=1),
+    Option("rgw_quota_reservation_ttl_s", float, 30.0,
+           "lifetime of a cls_user quota reservation; a writer that "
+           "died between reserve and release stops counting against "
+           "its user's quota after this", min=0.0),
+])
+
 
 class Config:
     """Layered md_config_t equivalent with change observers."""

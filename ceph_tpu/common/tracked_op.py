@@ -384,7 +384,11 @@ class OpTracker:
                      "trace_id": t.trace.trace_id,
                      "age": round(t.age(now) if t.completed_at is None
                                   else t.duration(), 3),
-                     "blamed_stage": t.blamed_stage}
+                     "blamed_stage": t.blamed_stage,
+                     # op owner (the PG primary) when known: the mon
+                     # names IT in the SLOW_OPS daemons list, so a
+                     # replica's sub-op report blames the right daemon
+                     "primary": t.info.get("primary")}
                     for t in slow[:10]],
         }
 

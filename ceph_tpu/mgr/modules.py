@@ -11,6 +11,8 @@ live PG splits).
 
 from __future__ import annotations
 
+import threading
+
 from ..crush.map import CRUSH_ITEM_NONE
 from ..osd.types import pg_t
 from .daemon import MgrModule
@@ -372,6 +374,56 @@ class DashboardModule(MgrModule):
         self.httpd.server_close()
 
 
+class RgwReshardModule(MgrModule):
+    """Dynamic bucket-index resharding driver (reference
+    pybind/mgr's rgw support + RGWReshard's background processor).
+
+    RGW stores register themselves at gateway construction (class
+    registry — the in-process clusters this build runs host mgr and
+    radosgw in one interpreter); each tick sweeps every attached
+    store: resume reshards interrupted by a daemon kill, autoscale
+    buckets whose per-shard entry count exceeds
+    rgw_max_objs_per_shard.  Sweeps are cheap when nothing is over
+    threshold (one dir_count per shard per bucket)."""
+
+    name = "rgw_reshard"
+    run_interval = 5.0
+
+    _stores: list = []          # class-level: shared across daemons
+    _reg_lock = threading.Lock()
+
+    @classmethod
+    def attach(cls, store) -> None:
+        with cls._reg_lock:
+            if store not in cls._stores:
+                cls._stores.append(store)
+
+    @classmethod
+    def detach(cls, store) -> None:
+        with cls._reg_lock:
+            if store in cls._stores:
+                cls._stores.remove(store)
+
+    def tick(self) -> None:
+        with self._reg_lock:
+            stores = list(self._stores)
+        msgs: list[str] = []
+        for store in stores:
+            try:
+                stats = store.reshard_sweep()
+            except Exception as e:  # noqa: BLE001 - degraded cluster
+                msgs.append(f"reshard sweep failed: {e}")
+                continue
+            n = stats.get("resumed", 0) + stats.get("started", 0)
+            if n:
+                msgs.append(f"resharded {n} bucket(s)")
+        self.mgr.set_health(self.name,
+                            "HEALTH_WARN" if any(
+                                "failed" in m for m in msgs)
+                            else "HEALTH_OK", msgs)
+
+
 DEFAULT_MODULES = [HealthModule, BalancerModule, PgAutoscalerModule,
-                   TelemetryModule, DeviceHealthModule]
+                   TelemetryModule, DeviceHealthModule,
+                   RgwReshardModule]
 

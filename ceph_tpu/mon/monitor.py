@@ -1662,7 +1662,19 @@ class Monitor:
         if total:
             oldest = max((r.get("oldest_age", 0.0)
                           for r in reports.values()), default=0.0)
-            daemons = ", ".join(f"osd.{o}" for o in sorted(reports))
+            # name the op OWNERS (each op row carries its PG primary):
+            # a replica's sub-op report must blame the primary whose
+            # op is stuck, not the reporting daemon — reports lacking
+            # attribution fall back to the reporter
+            owners: set[int] = set()
+            for o, r in reports.items():
+                ops = r.get("ops", [])
+                if not ops:
+                    owners.add(o)
+                for op in ops:
+                    p = op.get("primary")
+                    owners.add(o if p is None else p)
+            daemons = ", ".join(f"osd.{o}" for o in sorted(owners))
             checks["SLOW_OPS"] = {
                 "severity": "HEALTH_WARN",
                 "summary": f"{total} slow ops, oldest one blocked "

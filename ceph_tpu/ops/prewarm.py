@@ -48,6 +48,12 @@ from . import compile_cache
 _guard_lock = threading.Lock()
 _ran = False
 _last_status: dict | None = None
+# buckets whose XLA program has already been built in THIS process:
+# jax's in-memory executable cache emits no persistent-cache hit
+# event on reuse, so without this ledger an entry sharing an
+# already-built program (same pow2 bucket from another entry, or a
+# warm in-process re-run) would count as a compile it never paid
+_warmed_buckets: set[str] = set()
 
 
 class PrewarmPlan:
@@ -231,12 +237,20 @@ class PrewarmPlan:
                 st["skipped"] += 1
                 continue
             warm_s = time.perf_counter() - te
-            cache_hit = compile_cache.hit_count() > hits0
             buckets = self._buckets_of(entry, handle)
+            # a disk-cache hit event OR every covered bucket already
+            # built in-process means no XLA compile happened — the
+            # in-memory program reuse path emits no event, so it must
+            # be inferred from the warmed-bucket ledger or `compiles`
+            # over-reports on warm boots
+            cache_hit = compile_cache.hit_count() > hits0 or (
+                bool(buckets) and
+                all(b in _warmed_buckets for b in buckets))
             for b in buckets:
                 if self.profiler is not None:
                     self.profiler.note_prewarm(b, warm_s, cache_hit)
                 st["buckets"].append(b)
+                _warmed_buckets.add(b)
             st["done"] += 1
             if cache_hit:
                 st["cache_hits"] += 1
@@ -277,3 +291,6 @@ def reset_for_tests() -> None:
     with _guard_lock:
         _ran = False
         _last_status = None
+        # a simulated restart clears jax's in-memory executables, so
+        # the in-process warmed ledger must reset with it
+        _warmed_buckets.clear()

@@ -784,6 +784,11 @@ class OSDDaemon:
                     top.mark_event("msgr_dispatch",
                                    getattr(msg, "recv_stamp", None))
                     top.set_info("pg", str(msg.pgid.pgid))
+                    # the op's primary IS this OSD (client ops land on
+                    # the primary): slow-op reports carry it so the
+                    # mon's SLOW_OPS summary blames the op owner even
+                    # when a replica's sub-op report arrives first
+                    top.set_info("primary", self.osd_id)
                 else:
                     top = NULL_TRACKED
                 msg.top = top
@@ -822,6 +827,16 @@ class OSDDaemon:
                         "ec_sub_write", f"{msg.pgid} tid={msg.tid}",
                         TraceContext.from_wire(msg.trace))
                     stop.set_info("pg", str(msg.pgid.pgid))
+                    # a sub-op belongs to the PG's primary: the mon
+                    # attributes SLOW_OPS to the op's owner, not to
+                    # whichever replica happened to report first
+                    try:
+                        stop.set_info(
+                            "primary",
+                            self.osdmap.pg_to_up_acting_osds(
+                                msg.pgid.pgid)[3])
+                    except Exception:  # noqa: BLE001 - stale/gap map
+                        pass
                 else:
                     stop = NULL_TRACKED
                 try:
@@ -1022,8 +1037,7 @@ class OSDDaemon:
                 shards = getattr(state.backend, "shards", None) or \
                     getattr(state.backend, "replicas", None)
                 if hasattr(shards, "acting"):
-                    if list(acting) != list(shards.acting) and \
-                            state.kind == "ec":
+                    if list(acting) != list(shards.acting):
                         state.needs_peer = True
                     shards.acting = list(acting)
                     if state.kind != "ec":
@@ -1408,17 +1422,23 @@ class OSDDaemon:
     def _remote_read_full(self, osd: int, spg: spg_t, oid: hobject_t,
                           timeout: float = 3.0,
                           unreachable: set | None = None,
-                          want_omap: bool = False):
+                          want_omap: bool = False,
+                          stat_only: bool = False):
         if self._hb_stop.is_set():
             return None
         """(data, attrs) — plus (omap, omap_header) when want_omap —
         of a shard object on a specific OSD, or None.  The backfill
         copy path: a moved shard is fetched from its old holder
-        verbatim instead of being re-decoded."""
+        verbatim instead of being re-decoded.  stat_only skips the
+        data read (data comes back None): attrs and omap ride the
+        stat reply, which is all a version probe needs."""
         if osd == self.osd_id:
             goid = ghobject_t(oid, shard=spg.shard)
             try:
-                data = self.store.read(self._cid(spg), goid)
+                data = None if stat_only else \
+                    self.store.read(self._cid(spg), goid)
+                if stat_only:
+                    self.store.stat(self._cid(spg), goid)
                 attrs = self.store.getattrs(self._cid(spg), goid)
                 if want_omap:
                     omap = self.store.omap_get(self._cid(spg), goid)
@@ -1427,8 +1447,9 @@ class OSDDaemon:
             except KeyError:
                 return None
             if want_omap:
-                return np.asarray(data), attrs, omap, hdr
-            return np.asarray(data), attrs
+                return (data if data is None else np.asarray(data),
+                        attrs, omap, hdr)
+            return (data if data is None else np.asarray(data), attrs)
         with self.pg_lock:
             self._raw_tid += 1
             tid = self._raw_tid
@@ -1449,7 +1470,9 @@ class OSDDaemon:
         stat = box["msg"]
         if stat.result != 0 or stat.size < 0:
             return None
-        if stat.size == 0:
+        if stat_only:
+            data = None
+        elif stat.size == 0:
             data = np.empty(0, dtype=np.uint8)
         else:
             with self.pg_lock:
@@ -1768,10 +1791,29 @@ class OSDDaemon:
                           traceback.format_exc())
             return False
 
+    @staticmethod
+    def _obj_ver(attrs) -> tuple[int, int]:
+        """Decode a replicated object's "_v" stamp to (epoch, version);
+        unstamped legacy copies sort lowest (ties keep the local copy,
+        i.e. pre-stamp behavior)."""
+        v = (attrs or {}).get("_v")
+        if v is None:
+            return (0, 0)
+        try:
+            if isinstance(v, np.ndarray):
+                v = v.tobytes()
+            elif isinstance(v, str):
+                v = v.encode()
+            e, _, n = bytes(v).partition(b".")
+            return (int(e), int(n))
+        except (ValueError, TypeError):
+            return (0, 0)
+
     def _recover_replicated_pg(self, pgid: pg_t,
                                acting: list[int],
                                prevmap=None,
-                               unreachable: set | None = None) -> None:
+                               unreachable: set | None = None,
+                               force: bool = False) -> None:
         from ..store.object_store import Transaction
         pool = self.osdmap.pools.get(pgid.pool)
         prevmap = prevmap if prevmap is not None else self.prev_osdmap
@@ -1783,7 +1825,7 @@ class OSDDaemon:
             try:
                 _, prev_acting, _, _ = \
                     prevmap.pg_to_up_acting_osds(pgid)
-                if not fresh_child and \
+                if not force and not fresh_child and \
                         list(prev_acting) == list(acting) and \
                         pgid not in self._pgs_needing_recovery and \
                         all(self.osdmap.is_up(o) for o in acting):
@@ -1835,36 +1877,92 @@ class OSDDaemon:
                      if crush_hash32(h.key or h.name) % pool.pg_num ==
                      pgid.seed}
         all_ok = True
+        peers = [o for o in acting
+                 if o != self.osd_id and self.osdmap.is_up(o) and
+                 o not in unreachable]
         for oid in names:
             if self._hb_stop.is_set():
                 return
             goid = ghobject_t(oid, shard=NO_SHARD)
-            have_local = True
+            local = None
             try:
-                self.store.stat(self._cid(spg), goid)
+                local = (self.store.read(self._cid(spg), goid),
+                         self.store.getattrs(self._cid(spg), goid),
+                         self.store.omap_get(self._cid(spg), goid),
+                         self.store.omap_get_header(self._cid(spg),
+                                                    goid))
             except KeyError:
-                have_local = False
-            if not have_local:
-                # pull from any holder — another replica, or (post
-                # split) a pre-split holder's child/ancestor collection
+                pass
+            # the primary's OWN copy is not authoritative across an
+            # interval change: a revived ex-primary holds stale data
+            # while the interim primary holds acked writes.  Compare
+            # the per-object "_v" stamp (epoch-first, so interim
+            # writes beat a dead primary's last epoch) across every
+            # acting holder — stat-only probes, the stamp rides the
+            # attrs — and adopt the winner BEFORE pushing; pushing
+            # blind used to roll acked overwrites back.
+            best = local
+            best_ver = self._obj_ver(local[1]) if local else None
+            best_osd = None
+            for osd in peers:
+                if osd in unreachable:   # grown mid-pass: one
+                    continue             # timeout, not one per object
+                got = self._remote_read_full(osd, spg, oid,
+                                             want_omap=True,
+                                             stat_only=True,
+                                             unreachable=unreachable)
+                if got is None:
+                    continue
+                ver = self._obj_ver(got[1])
+                if best is None or ver > best_ver:
+                    best, best_ver, best_osd = got, ver, osd
+            if best_osd is not None:
+                # a peer wins: fetch its data (probe carried none)
+                full = self._remote_read_full(best_osd, spg, oid,
+                                              want_omap=True,
+                                              unreachable=unreachable)
+                # the winner vanished between probe and read (moved
+                # by a split sweep, or its holder died): fall back to
+                # the local copy rather than dropping the object
+                best = full if full is not None else local
+            if best is None:
+                # on no acting holder — pull from a pre-split
+                # holder's child/ancestor collection
                 if not self._pull_replicated_object(
                         pgid, spg, oid, goid, ancestors, up_osds):
                     all_ok = False
                     continue
-            try:
-                data = self.store.read(self._cid(spg), goid)
-                attrs = self.store.getattrs(self._cid(spg), goid)
-                omap = self.store.omap_get(self._cid(spg), goid)
-                omap_hdr = self.store.omap_get_header(
-                    self._cid(spg), goid)
-            except KeyError:
-                # a concurrent split/merge sweep moved the object out
-                # of this collection between the stat above and the
-                # read — it is someone else's to recover now; keep the
-                # pass alive (a KeyError here used to kill the whole
-                # recovery thread mid-pass) and let the retry converge
-                all_ok = False
-                continue
+                try:
+                    best = (self.store.read(self._cid(spg), goid),
+                            self.store.getattrs(self._cid(spg), goid),
+                            self.store.omap_get(self._cid(spg), goid),
+                            self.store.omap_get_header(
+                                self._cid(spg), goid))
+                except KeyError:
+                    # a concurrent split/merge sweep moved the object
+                    # out of this collection — someone else's to
+                    # recover now; keep the pass alive and let the
+                    # retry converge
+                    all_ok = False
+                    continue
+            elif best is not local:
+                # a peer holds a newer copy: adopt it locally
+                # (remove-then-rewrite so stale longer data or stale
+                # omap keys cannot survive underneath)
+                data, attrs, omap, omap_hdr = best
+                txn = Transaction()
+                txn.remove(goid)
+                txn.touch(goid)
+                if np.asarray(data).size:
+                    txn.write(goid, 0, np.asarray(data))
+                if attrs:
+                    txn.setattrs(goid, attrs)
+                if omap:
+                    txn.omap_setkeys(goid, omap)
+                if omap_hdr:
+                    txn.omap_setheader(goid, omap_hdr)
+                self.apply_shard_txn(spg, txn)
+            data, attrs, omap, omap_hdr = best
             for osd in acting:
                 if osd == self.osd_id or not self.osdmap.is_up(osd):
                     continue
@@ -1885,6 +1983,23 @@ class OSDDaemon:
             self._pgs_needing_recovery.discard(pgid)
         else:
             self._pgs_needing_recovery.add(pgid)
+
+    def _reconcile_replicated_pg(self, pgid: pg_t,
+                                 state: PGState) -> bool:
+        """Replicated analog of _peer_pg: before a fresh primary
+        serves its first op, reconcile every object with the acting
+        set so a revived stale ex-primary cannot serve (or RMW over)
+        data older than an interim primary's acked writes.  Returns
+        True when the PG is consistent enough to serve."""
+        _, acting, _, _ = self.osdmap.pg_to_up_acting_osds(pgid)
+        try:
+            self._recover_replicated_pg(pgid, list(acting), force=True)
+        except Exception as e:  # noqa: BLE001
+            self.cct.dout("osd", 1,
+                          f"replicated reconcile of {pgid} failed: "
+                          f"{e!r}")
+            return False
+        return pgid not in self._pgs_needing_recovery
 
     def _pull_replicated_object(self, pgid: pg_t, spg: spg_t,
                                 oid: hobject_t, goid: ghobject_t,
@@ -2495,18 +2610,24 @@ class OSDDaemon:
                     replicas = MessengerReplicaBackend(self, pgid, acting)
                     backend = ReplicatedBackend(replicas)
                     state = PGState(backend, "replicated")
-                    state.needs_peer = False  # log peering is EC-scoped
                 self.pgs[pgid] = state
         # Peer outside pg_lock: the shard-log RPCs must not stall every
         # other PG's dispatch (reference peering happens in its own
-        # state machine, ops wait on Active).
-        if state.kind == "ec" and state.needs_peer:
+        # state machine, ops wait on Active).  EC PGs reconcile shard
+        # logs; replicated PGs reconcile object versions — without it a
+        # revived stale ex-primary serves (and RMWs over) data older
+        # than the interim primary's acked writes before background
+        # recovery gets to the PG.
+        if state.needs_peer:
             with state.peer_lock:
                 if state.needs_peer:
                     # incomplete peering (a live shard didn't answer)
                     # keeps needs_peer set: the next op retries until
                     # every live shard's log has been reconciled
-                    state.needs_peer = not self._peer_pg(pgid, state)
+                    ok = self._peer_pg(pgid, state) \
+                        if state.kind == "ec" else \
+                        self._reconcile_replicated_pg(pgid, state)
+                    state.needs_peer = not ok
             if state.needs_peer:
                 # Never serve ops from an unpeered PG: a partial view
                 # could miss acked writes held by the silent shard.

@@ -81,7 +81,8 @@ class ReplicatedBackend:
     def _whole_oid(oid: hobject_t) -> ghobject_t:
         return ghobject_t(oid, shard=NO_SHARD)
 
-    def _to_store_txn(self, txn: PGTransaction) -> Transaction:
+    def _to_store_txn(self, txn: PGTransaction,
+                      version: eversion_t | None = None) -> Transaction:
         t = Transaction()
         for oid, op in txn.ops.items():
             goid = self._whole_oid(oid)
@@ -96,6 +97,15 @@ class ReplicatedBackend:
             if op.truncate_to is not None:
                 t.truncate(goid, op.truncate_to)
             sets = {k: v for k, v in op.attrs.items() if v is not None}
+            if version is not None:
+                # per-object version stamp (the reference's
+                # object_info_t user_version in attr "_"): recovery
+                # compares these across holders to find the
+                # authoritative copy — epoch-first ordering makes an
+                # interim primary's acked writes beat a revived
+                # ex-primary's stale data
+                sets["_v"] = \
+                    f"{version.epoch}.{version.version}".encode()
             if sets:
                 t.setattrs(goid, sets)
             for k in (k for k, v in op.attrs.items() if v is None):
@@ -122,7 +132,7 @@ class ReplicatedBackend:
 
     def submit_transaction(self, txn: PGTransaction, version: eversion_t,
                            on_commit: Callable[[], None]) -> None:
-        store_txn = self._to_store_txn(txn)
+        store_txn = self._to_store_txn(txn, version)
         with self.lock:
             for oid, op in txn.ops.items():
                 self.log.add(LogEntry(

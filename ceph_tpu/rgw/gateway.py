@@ -413,8 +413,10 @@ class _Handler(BaseHTTPRequestHandler):
                     raise RGWError(409, "BucketAlreadyExists", bucket)
                 self._reply(200)    # idempotent re-create by owner:
                 return              # keep versioning/acl meta intact
+            shards = self.headers.get("x-rgw-index-shards")
             st.create_bucket(bucket, owner=self._identity,
-                             acl=self._requested_acl())
+                             acl=self._requested_acl(),
+                             shards=int(shards) if shards else None)
             self._reply(200)
         elif self.command == "DELETE":
             self._require_bucket_owner(bucket)
@@ -773,10 +775,27 @@ class S3Gateway:
     def __init__(self, client, addr: tuple[str, int] = ("127.0.0.1", 0),
                  creds: dict[str, str] | None = None,
                  ec_profile: str | None = None,
-                 lc_interval: float = 60.0, modlog: bool = False):
+                 lc_interval: float = 60.0, modlog: bool = False,
+                 asok_path: str | None = None):
         # modlog=True for a multisite source zone (rgw/sync.py)
         self.store = RGWStore(client, ec_profile=ec_profile,
                               modlog=modlog)
+        # reshard maintenance registry: mgr's rgw_reshard module
+        # drives sweeps on every attached store (in-process clusters)
+        from ..mgr.modules import RgwReshardModule
+        RgwReshardModule.attach(self.store)
+        self.asok = None
+        if asok_path:
+            from ..common.admin_socket import AdminSocket
+            self.asok = AdminSocket(asok_path)
+            self.asok.register_command("bucket reshard status",
+                                       self._asok_reshard_status)
+            self.asok.register_command("bucket reshard start",
+                                       self._asok_reshard_start)
+            self.asok.register_command("bucket limit check",
+                                       self._asok_limit_check)
+            self.asok.register_command("bucket stats",
+                                       self._asok_bucket_stats)
         self.creds = creds          # access_key -> secret; None = open
         from .swift import SwiftFrontend
         self.swift = SwiftFrontend(self.store, creds)
@@ -799,13 +818,51 @@ class S3Gateway:
                 except Exception:  # noqa: BLE001 - worker must survive
                     import traceback
                     traceback.print_exc()
+                try:
+                    # same cadence: resume interrupted reshards and
+                    # autoscale over-full bucket indexes (the mgr's
+                    # rgw_reshard module covers clusters where the
+                    # gateway died mid-reshard)
+                    self.store.reshard_sweep()
+                except Exception:  # noqa: BLE001 - worker must survive
+                    import traceback
+                    traceback.print_exc()
 
         self._lc_thread = threading.Thread(
             target=_lc_loop, daemon=True, name="rgw-lc")
         self._lc_thread.start()
 
+    # -- asok surface (ceph daemon ASOK bucket ...; reference
+    #    radosgw-admin bucket reshard / bucket limit check) ---------------
+
+    def _asok_reshard_status(self, cmd: dict) -> dict:
+        try:
+            return self.store.reshard_status(cmd["bucket"])
+        except (RGWError, KeyError) as e:
+            return {"error": str(e)}
+
+    def _asok_reshard_start(self, cmd: dict) -> dict:
+        try:
+            return self.store.reshard_bucket(cmd["bucket"],
+                                             int(cmd["shards"]))
+        except (RGWError, KeyError, ValueError) as e:
+            return {"error": str(e)}
+
+    def _asok_limit_check(self, _cmd: dict) -> dict:
+        return {"buckets": self.store.bucket_limit_check()}
+
+    def _asok_bucket_stats(self, cmd: dict) -> dict:
+        try:
+            return self.store.bucket_stats(cmd["bucket"])
+        except (RGWError, KeyError) as e:
+            return {"error": str(e)}
+
     def shutdown(self) -> None:
         self._lc_stop.set()
+        from ..mgr.modules import RgwReshardModule
+        RgwReshardModule.detach(self.store)
+        if self.asok is not None:
+            self.asok.shutdown()
         self.httpd.shutdown()
         self.httpd.server_close()
 

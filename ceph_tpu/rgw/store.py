@@ -6,8 +6,11 @@ fidelity the S3 surface needs:
 - bucket registry: a directory object ("buckets") in the meta pool,
   maintained by the rgw object class (atomic server-side updates —
   reference cls_rgw + the RGWRados bucket metadata handlers)
-- per-bucket index: one directory object ("index.<bucket>") in the
-  meta pool (reference bucket index shards; one shard here)
+- per-bucket index: hash-sharded directory objects in the meta pool
+  (reference bucket index shards, cls_rgw).  Routing, layout and the
+  merge-sorted listing cursor live in rgw/bucket_index.py; online
+  dynamic resharding in rgw/reshard.py.  Buckets created without a
+  shard count keep the legacy single object ("index.<bucket>").
 - object data: one rados object per S3 object in the data pool, named
   with a length-prefixed bucket separator so keys may contain any
   character (reference rgw_obj raw-object naming)
@@ -29,10 +32,12 @@ from __future__ import annotations
 import errno
 import hashlib
 import json
-import threading
 import time
 
+from ..common.options import SCHEMA
 from ..rados.client import RadosError
+from .bucket_index import BucketIndex
+from .reshard import Resharder
 
 META_POOL = ".rgw.meta"
 DATA_POOL = ".rgw.data"
@@ -93,14 +98,24 @@ class RGWStore:
         # drops the first's field
         import threading as _threading
         self._bmeta_lock = _threading.Lock()
-        # quota admission is check-then-act over the user header;
-        # concurrent puts by one user must serialize the check AND
-        # count each other's admitted-but-not-yet-accounted growth, or
-        # N racing puts could each pass the gate and overshoot
-        # max_bytes/max_objects N-fold (mirrors _bmeta_lock)
-        self._quota_mu = _threading.Lock()
-        self._quota_locks: dict[str, _threading.Lock] = {}
-        self._quota_pending: dict[str, list[int]] = {}  # [objs, bytes]
+        # every index/versions plane access routes through the shard
+        # layer (shard selection, dual-write during reshard, merged
+        # listing); quota admission is a cls_user reservation — no
+        # process-local pending pot survives here (see _quota_gate)
+        self.index = BucketIndex(self)
+        self.resharder = Resharder(self)
+        # continuation-cursor cache: a paginated listing re-entered
+        # via its resume token continues the live merged cursor
+        # (buffered shard pages intact) instead of re-seeking every
+        # shard — without it each page pays one dir_list per shard,
+        # so page latency grows with shard count.  Keyed by the full
+        # request shape + token; invalidated on any index mutation
+        # through this store and on layout (reshard) change, so a
+        # reused cursor can never show state older than this
+        # gateway's own acked writes.
+        from collections import OrderedDict as _OD
+        self._cursor_cache: dict = _OD()
+        self._cursor_mu = _threading.Lock()
 
     def _ensure_pools(self, ec_profile, pg_num) -> None:
         for name, kind in ((META_POOL, "replicated"),
@@ -116,6 +131,27 @@ class RGWStore:
             except RadosError as e:
                 if e.errno != errno.EEXIST:
                     raise
+
+    def _stash_cursor(self, key: tuple, lay, mcur) -> None:
+        with self._cursor_mu:
+            self._cursor_cache[key] = ((lay.shards, lay.gen), mcur)
+            self._cursor_cache.move_to_end(key)
+            while len(self._cursor_cache) > 32:
+                self._cursor_cache.popitem(last=False)
+
+    def _take_cursor(self, key: tuple, lay):
+        """Pop a stashed cursor if its layout still matches (a reshard
+        cutover between pages orphans old-gen cursors)."""
+        with self._cursor_mu:
+            ent = self._cursor_cache.pop(key, None)
+        if ent is not None and ent[0] == (lay.shards, lay.gen):
+            return ent[1]
+        return None
+
+    def _drop_cursors(self, bucket: str) -> None:
+        with self._cursor_mu:
+            for k in [k for k in self._cursor_cache if k[0] == bucket]:
+                del self._cursor_cache[k]
 
     def _cls(self, io, oid: str, method: str, payload: dict | None = None
              ) -> bytes:
@@ -190,65 +226,48 @@ class RGWStore:
                                       "max_bytes": max_bytes}).encode())
 
     def _quota_gate(self, user: str | None, add_objects: int,
-                    add_bytes: int) -> None:
+                    add_bytes: int) -> str | None:
         """Admit-or-403 a write against the owner's quota AND reserve
-        its growth (reference RGWQuotaHandler::check_quota before every
-        put).  The check and the reservation are one atomic step under
-        a per-user lock, and admitted-but-unaccounted growth (the
-        pending pot) counts toward the next admission — so concurrent
-        puts through THIS gateway cannot overshoot max_bytes /
-        max_objects.  Every successful gate must be paired with a
-        `_quota_release` once the op's accounting has landed (or the
-        op failed).
+        its growth (reference RGWQuotaHandler::check_quota before
+        every put).  Check and reservation are ONE atomic cls_user
+        call on the user object — the OSD serializes class calls per
+        object, so racing writers from ANY process or host see each
+        other's live reservations and cannot jointly overshoot
+        max_bytes/max_objects (this closes the process-local pending
+        pot's documented cross-process window).  Returns a reservation
+        token; every successful gate must be paired with a
+        `_quota_release(user, token)` once the op's accounting has
+        landed (or the op failed).  A writer that dies in between
+        stops counting against the quota after
+        rgw_quota_reservation_ttl_s.
 
-        Residual approximate window, documented deviation: the pending
-        pot is process-local, so concurrent puts through DIFFERENT
-        gateway processes still race the shared totals (the reference
-        has the same eventual-consistency window — rgw quota caches
-        stats per gateway); and between `_user_stats` landing and the
-        release, the growth is briefly counted twice, which can only
-        falsely DENY at the boundary, never falsely admit."""
+        Residual boundary effect: between `_user_stats` landing and
+        the release, growth is briefly counted twice (reservation +
+        totals), which can only falsely DENY at the boundary, never
+        falsely admit."""
         if not user:
-            return
-        with self._quota_mu:
-            lock = self._quota_locks.setdefault(user, threading.Lock())
-        with lock:
-            hdr = self.get_user_header(user)
-            q = hdr.get("quota", {})
-            t = hdr.get("totals", {})
-            with self._quota_mu:
-                pend = self._quota_pending.setdefault(user, [0, 0])
-                pend_obj, pend_bytes = pend
-            if q.get("max_objects", -1) >= 0 and \
-                    t.get("objects", 0) + pend_obj + add_objects > \
-                    q["max_objects"]:
+            return None
+        try:
+            raw = self.meta.execute(
+                self._user_oid(user), "user", "reserve",
+                json.dumps({
+                    "objects": add_objects, "bytes": add_bytes,
+                    "ttl": SCHEMA["rgw_quota_reservation_ttl_s"
+                                  ].default}).encode())
+        except RadosError as e:
+            if e.errno == errno.EDQUOT:
                 raise RGWError(403, "QuotaExceeded",
-                               f"user {user} object quota")
-            if q.get("max_bytes", -1) >= 0 and \
-                    t.get("bytes", 0) + pend_bytes + add_bytes > \
-                    q["max_bytes"]:
-                raise RGWError(403, "QuotaExceeded",
-                               f"user {user} byte quota")
-            with self._quota_mu:
-                pend = self._quota_pending[user]
-                pend[0] += add_objects
-                pend[1] += add_bytes
+                               f"user {user}: {e}") from e
+            raise
+        return json.loads(raw.decode())["token"]
 
-    def _quota_release(self, user: str | None, add_objects: int,
-                       add_bytes: int) -> None:
+    def _quota_release(self, user: str | None,
+                       token: str | None) -> None:
         """Return a gate's reservation (accounting landed or op died)."""
-        if not user:
+        if not user or not token:
             return
-        with self._quota_mu:
-            pend = self._quota_pending.get(user)
-            if pend is not None:
-                pend[0] -= add_objects
-                pend[1] -= add_bytes
-                if pend == [0, 0]:
-                    # drained: don't retain a pot per user ever seen
-                    # (the per-user Lock stays — pruning it could hand
-                    # two racing reservers different lock objects)
-                    del self._quota_pending[user]
+        self.meta.execute(self._user_oid(user), "user", "release",
+                          json.dumps({"token": token}).encode())
 
     def _usage(self, user: str | None, op: str, bucket: str,
                key: str | None, nbytes: int) -> None:
@@ -291,18 +310,31 @@ class RGWStore:
     # -- buckets -------------------------------------------------------------
 
     def create_bucket(self, bucket: str, owner: str | None = None,
-                      acl: str = "private") -> None:
+                      acl: str = "private",
+                      shards: int | None = None) -> None:
+        """`shards` picks the index shard count (None = the
+        rgw_bucket_index_shards default).  shards == 1 keeps the
+        legacy single-object layout; > 1 creates a hash-sharded index
+        at generation 1 (generation 0 is the legacy spelling)."""
         if not bucket or "/" in bucket:
             raise RGWError(400, "InvalidBucketName", bucket)
+        if shards is None:
+            shards = SCHEMA["rgw_bucket_index_shards"].default
+        shards = int(shards)
+        if shards < 1:
+            raise RGWError(400, "InvalidArgument",
+                           f"shard count {shards}")
         meta: dict = {"created": time.time()}
         if owner is not None:
             meta["owner"] = owner
         if acl != "private":
             meta["acl"] = acl
+        if shards > 1:
+            meta["index"] = {"shards": shards, "gen": 1}
         self._modlog("sync_bucket", bucket)
         self._cls(self.meta, BUCKETS_OBJ, "dir_add", {
             "key": bucket, "meta": meta})
-        self._cls(self.meta, f"index.{bucket}", "dir_init")
+        self.index.init(bucket, shards, 1 if shards > 1 else 0)
         self._modlog("sync_bucket", bucket)     # post-success
 
     def set_bucket_acl(self, bucket: str, acl: str) -> None:
@@ -345,8 +377,7 @@ class RGWStore:
             raise RGWError(404, "NoSuchKey", key)
         cur["acl"] = acl
         self._modlog("sync", bucket, key)
-        self._cls(self.meta, f"index.{bucket}", "dir_add", {
-            "key": key, "meta": cur})
+        self.index.add(bucket, "index", key, cur)
         self._modlog("sync", bucket, key)       # post-success
 
     # -- lifecycle (reference rgw_lc.h: per-bucket rules evaluated by
@@ -471,7 +502,7 @@ class RGWStore:
 
     def delete_bucket(self, bucket: str) -> None:
         self._require_bucket(bucket)
-        count = int(self._cls(self.meta, f"index.{bucket}", "dir_count"))
+        count = self.index.count(bucket)
         if count:
             raise RGWError(409, "BucketNotEmpty", bucket)
         # in-flight multipart uploads also block deletion (S3
@@ -485,18 +516,18 @@ class RGWStore:
             raise RGWError(409, "BucketNotEmpty",
                            f"{bucket}: object versions remain")
         self._modlog("sync_bucket", bucket)
-        owner = (self._bucket_meta(bucket) or {}).get("owner")
+        bmeta = self._bucket_meta(bucket) or {}
+        owner = bmeta.get("owner")
         if owner:
             self.meta.execute(self._user_oid(owner), "user",
                               "rm_bucket",
                               json.dumps({"bucket": bucket}).encode())
         self._cls(self.meta, BUCKETS_OBJ, "dir_rm", {"key": bucket})
-        for obj in (f"index.{bucket}", f"uploads.{bucket}",
-                    f"versions.{bucket}"):
-            try:
-                self.meta.remove(obj)
-            except RadosError:
-                pass
+        self.index.remove_all(bucket, bmeta=bmeta)
+        try:
+            self.meta.remove(f"uploads.{bucket}")
+        except RadosError:
+            pass
         self._modlog("sync_bucket", bucket)     # post-success
 
     def list_buckets(self) -> list[tuple[str, dict]]:
@@ -554,43 +585,39 @@ class RGWStore:
         return f"{inv:016x}.{os.urandom(6).hex()}"
 
     def _archive_version(self, bucket: str, key: str, meta: dict,
-                         version_id: str) -> None:
-        """Record one immutable version row (newest sorts first)."""
-        self._cls(self.meta, f"versions.{bucket}", "dir_add", {
-            "key": f"{key}\x00{version_id}",
-            "meta": {**meta, "version_id": version_id}})
+                         version_id: str,
+                         bmeta: dict | None = None) -> None:
+        """Record one immutable version row (newest sorts first).
+        Version rows shard by PARENT key (all versions of a key
+        colocate), so per-key order survives sharding."""
+        self.index.add(bucket, "versions", f"{key}\x00{version_id}",
+                       {**meta, "version_id": version_id},
+                       route=key, bmeta=bmeta)
 
     def list_versions(self, bucket: str, prefix: str = "",
                       max_keys: int = 1000) -> list[dict]:
         """Version rows up to max_keys, newest-first per key; the
-        newest row of each key is marked latest.  PAGINATES the
-        underlying index — a truncated page silently presented as
-        complete would let version deletion drop live index entries."""
+        newest row of each key is marked latest.  The merged cursor
+        PAGINATES every underlying shard — a truncated page silently
+        presented as complete would let version deletion drop live
+        index entries — and yields rows in global row-key order
+        (= key asc, newest version first within a key, because the
+        inverted-timestamp version ids sort newest-first and a key's
+        rows all live in one shard)."""
         self._require_bucket(bucket)
-        rows = []
+        cur = self.index.cursor(bucket, "versions", prefix=prefix,
+                                page=min(max_keys, 1000) + 1)
+        rows: list[dict] = []
         latest_seen: set[str] = set()
-        marker = ""
         while len(rows) < max_keys:
-            try:
-                out = json.loads(self._cls(
-                    self.meta, f"versions.{bucket}", "dir_list",
-                    {"prefix": prefix, "marker": marker,
-                     "max": min(max_keys, 1000)}).decode())
-            except RadosError as e:
-                self._not_found(e)
-                return rows
-            if not out["entries"]:
+            ent = cur.next()
+            if ent is None:
                 break
-            for k, m in out["entries"]:
-                key = k.split("\x00", 1)[0]
-                rows.append({"key": key, **m,
-                             "is_latest": key not in latest_seen})
-                latest_seen.add(key)
-                if len(rows) >= max_keys:
-                    return rows
-                marker = k
-            if not out["truncated"]:
-                break
+            k, m = ent
+            key = k.split("\x00", 1)[0]
+            rows.append({"key": key, **m,
+                         "is_latest": key not in latest_seen})
+            latest_seen.add(key)
         return rows
 
     def _versions_of_key(self, bucket: str, key: str) -> list[dict]:
@@ -598,26 +625,28 @@ class RGWStore:
         return self.list_versions(bucket, prefix=f"{key}\x00",
                                   max_keys=100000)
 
-    def _current_meta(self, bucket: str, key: str) -> dict | None:
+    def _current_meta(self, bucket: str, key: str,
+                      bmeta: dict | None = None) -> dict | None:
         try:
-            raw = self._cls(self.meta, f"index.{bucket}", "dir_get",
-                            {"key": key})
+            raw = self.index.get(bucket, "index", key, bmeta=bmeta)
         except RadosError as e:
             self._not_found(e)
             return None
         return json.loads(raw.decode())
 
-    def _archive_null_version(self, bucket: str, key: str) -> None:
+    def _archive_null_version(self, bucket: str, key: str,
+                              bmeta: dict | None = None) -> None:
         """An object written BEFORE versioning was enabled has no
         version row; S3 makes it the "null" version.  Archive its
         existing meta (data stays at _data_oid / its multipart parts —
         the row records where) so enabling versioning never orphans or
         destroys pre-existing data."""
-        cur = self._current_meta(bucket, key)
+        cur = self._current_meta(bucket, key, bmeta=bmeta)
         if cur is None or cur.get("version_id"):
             return              # absent, or already versioned
         self._archive_version(bucket, key,
-                              {**cur, "null_data": True}, "null")
+                              {**cur, "null_data": True}, "null",
+                              bmeta=bmeta)
 
     def put_object(self, bucket: str, key: str, body: bytes,
                    extra: dict | None = None) -> str:
@@ -630,7 +659,7 @@ class RGWStore:
         if bmeta is None:
             raise RGWError(404, "NoSuchBucket", bucket)
         owner = (extra or {}).get("owner") or bmeta.get("owner")
-        cur = self._current_meta(bucket, key)
+        cur = self._current_meta(bucket, key, bmeta=bmeta)
         cur_owner = (cur or {}).get("owner") or bmeta.get("owner")
         same = (cur is None or cur_owner == owner)
         # quota admits the NEW owner's growth; a same-owner overwrite
@@ -638,7 +667,7 @@ class RGWStore:
         q_obj = (0 if cur else 1) if same else 1
         q_bytes = (len(body) - (cur or {}).get("size", 0)) \
             if same else len(body)
-        self._quota_gate(owner, q_obj, q_bytes)
+        token = self._quota_gate(owner, q_obj, q_bytes)
         try:
             etag = hashlib.md5(body).hexdigest()
             self._modlog("sync", bucket, key)
@@ -649,9 +678,11 @@ class RGWStore:
                         "mtime": time.time(), **(extra or {})}
                 self.data.write_full(_version_oid(bucket, vid, key),
                                      body)
-                self._archive_version(bucket, key, meta, vid)
-                self._cls(self.meta, f"index.{bucket}", "dir_add", {
-                    "key": key, "meta": {**meta, "version_id": vid}})
+                self._archive_version(bucket, key, meta, vid,
+                                      bmeta=bmeta)
+                self.index.add(bucket, "index", key,
+                               {**meta, "version_id": vid},
+                               bmeta=bmeta)
                 self._account_overwrite(bucket, key, cur, cur_owner,
                                         owner, len(body))
                 self._publish(bucket, key, "s3:ObjectCreated:Put",
@@ -664,14 +695,13 @@ class RGWStore:
             meta = {"size": len(body), "etag": etag,
                     "mtime": time.time(), **(extra or {})}
             self.data.write_full(_data_oid(bucket, key), body)
-            self._cls(self.meta, f"index.{bucket}", "dir_add", {
-                "key": key, "meta": meta})
+            self.index.add(bucket, "index", key, meta, bmeta=bmeta)
             if suspended:
                 # Suspended bucket: S3 says the PUT replaces the null
                 # version — (re)write the null row to match the bytes
                 self._archive_version(bucket, key,
                                       {**meta, "null_data": True},
-                                      "null")
+                                      "null", bmeta=bmeta)
             for m in reap:
                 self._reap_manifest(bucket, m)
             self._account_overwrite(bucket, key, cur, cur_owner, owner,
@@ -683,14 +713,14 @@ class RGWStore:
         finally:
             # accounting has landed (or the op died): the reservation
             # hands back to the shared totals
-            self._quota_release(owner, q_obj, q_bytes)
+            self._quota_release(owner, token)
 
     def get_object_version(self, bucket: str, key: str,
                            version_id: str) -> tuple[bytes, dict]:
         self._require_bucket(bucket)
         try:
-            raw = self._cls(self.meta, f"versions.{bucket}", "dir_get",
-                            {"key": f"{key}\x00{version_id}"})
+            raw = self.index.get(bucket, "versions",
+                                 f"{key}\x00{version_id}", route=key)
         except RadosError as e:
             self._not_found(e)
             raise RGWError(404, "NoSuchVersion", version_id) from e
@@ -725,11 +755,12 @@ class RGWStore:
         if vmeta is None:
             raise RGWError(404, "NoSuchVersion", version_id)
         bmeta = self._bucket_meta(bucket) or {}
-        pre_cur = self._current_meta(bucket, key)
+        pre_cur = self._current_meta(bucket, key, bmeta=bmeta)
         self._modlog("sync", bucket, key)
         try:
-            self._cls(self.meta, f"versions.{bucket}", "dir_rm",
-                      {"key": f"{key}\x00{version_id}"})
+            self.index.rm(bucket, "versions",
+                          f"{key}\x00{version_id}", route=key,
+                          bmeta=bmeta)
         except RadosError as e:
             self._not_found(e)
             raise RGWError(404, "NoSuchVersion", version_id) from e
@@ -748,7 +779,7 @@ class RGWStore:
                 self.data.remove(_version_oid(bucket, version_id, key))
             except RadosError:
                 pass
-        cur = self._current_meta(bucket, key)
+        cur = self._current_meta(bucket, key, bmeta=bmeta)
         cur_vid = cur.get("version_id") if cur is not None else None
         null_is_current = (cur is not None and cur_vid is None and
                            version_id == "null")
@@ -765,21 +796,19 @@ class RGWStore:
                     # restoring the null version restores the plain
                     # unversioned entry (data at _data_oid / manifest)
                     drop |= {"version_id", "null_data"}
-                self._cls(self.meta, f"index.{bucket}", "dir_add", {
-                    "key": key, "meta": {
-                        k: v for k, v in nxt.items()
-                        if k not in drop}})
+                self.index.add(bucket, "index", key,
+                               {k: v for k, v in nxt.items()
+                                if k not in drop}, bmeta=bmeta)
             else:
                 try:
-                    self._cls(self.meta, f"index.{bucket}", "dir_rm",
-                              {"key": key})
+                    self.index.rm(bucket, "index", key, bmeta=bmeta)
                 except RadosError as e:
                     self._not_found(e)
         # CURRENT-view accounting: deleting the current version (or
         # promoting a different-size predecessor) changes the index
         # view the user stats track — without this, version surgery
         # permanently leaks quota
-        post_cur = self._current_meta(bucket, key)
+        post_cur = self._current_meta(bucket, key, bmeta=bmeta)
         if (pre_cur is None) != (post_cur is None) or (
                 pre_cur is not None and post_cur is not None and
                 (pre_cur.get("size"), pre_cur.get("owner")) !=
@@ -800,8 +829,8 @@ class RGWStore:
     def _version_row(self, bucket: str, key: str,
                      version_id: str) -> dict | None:
         try:
-            raw = self._cls(self.meta, f"versions.{bucket}", "dir_get",
-                            {"key": f"{key}\x00{version_id}"})
+            raw = self.index.get(bucket, "versions",
+                                 f"{key}\x00{version_id}", route=key)
         except RadosError as e:
             self._not_found(e)
             return None
@@ -843,8 +872,7 @@ class RGWStore:
     def head_object(self, bucket: str, key: str) -> dict:
         self._require_bucket(bucket)
         try:
-            raw = self._cls(self.meta, f"index.{bucket}", "dir_get",
-                            {"key": key})
+            raw = self.index.get(bucket, "index", key)
         except RadosError as e:
             self._not_found(e)
             raise RGWError(404, "NoSuchKey", key) from e
@@ -878,7 +906,7 @@ class RGWStore:
         bmeta = self._bucket_meta(bucket)
         if bmeta is None:
             raise RGWError(404, "NoSuchBucket", bucket)
-        cur = self._current_meta(bucket, key)
+        cur = self._current_meta(bucket, key, bmeta=bmeta)
         if cur is None and bmeta.get("versioning") != "Enabled":
             # validate BEFORE logging (both plain and Suspended paths
             # 404 on an absent key): a failed op must not feed the
@@ -889,14 +917,13 @@ class RGWStore:
         if bmeta.get("versioning") == "Enabled":
             # versioned delete = insert a delete marker as the new
             # current; nothing is destroyed (reference delete markers)
-            self._archive_null_version(bucket, key)
+            self._archive_null_version(bucket, key, bmeta=bmeta)
             vid = self._new_version_id()
             meta = {"size": 0, "etag": "", "mtime": time.time(),
                     "delete_marker": True}
-            self._archive_version(bucket, key, meta, vid)
+            self._archive_version(bucket, key, meta, vid, bmeta=bmeta)
             try:
-                self._cls(self.meta, f"index.{bucket}", "dir_rm",
-                          {"key": key})
+                self.index.rm(bucket, "index", key, bmeta=bmeta)
             except RadosError as e:
                 self._not_found(e)
             if cur is not None:
@@ -913,8 +940,7 @@ class RGWStore:
         reap = self._displaced_manifests(bucket, key, suspended,
                                          cur=cur)
         try:
-            self._cls(self.meta, f"index.{bucket}", "dir_rm",
-                      {"key": key})
+            self.index.rm(bucket, "index", key, bmeta=bmeta)
         except RadosError as e:
             self._not_found(e)
             raise RGWError(404, "NoSuchKey", key) from e
@@ -930,7 +956,7 @@ class RGWStore:
             # data is destroyed; version_id'd rows survive untouched)
             self._archive_version(bucket, key, {
                 "size": 0, "etag": "", "mtime": time.time(),
-                "delete_marker": True}, "null")
+                "delete_marker": True}, "null", bmeta=bmeta)
         for m in reap:
             self._reap_manifest(bucket, m)
         try:
@@ -1043,12 +1069,12 @@ class RGWStore:
             total += meta["size"]
         bmeta = self._bucket_meta(bucket) or {}
         owner = (extra or {}).get("owner") or bmeta.get("owner")
-        cur = self._current_meta(bucket, key)
+        cur = self._current_meta(bucket, key, bmeta=bmeta)
         cur_owner = (cur or {}).get("owner") or bmeta.get("owner")
         same = (cur is None or cur_owner == owner)
         q_obj = (0 if cur else 1) if same else 1
         q_bytes = (total - (cur or {}).get("size", 0)) if same else total
-        self._quota_gate(owner, q_obj, q_bytes)
+        token = self._quota_gate(owner, q_obj, q_bytes)
         try:
             self._modlog("sync", bucket, key)   # validated: will mutate
             etag = f"{hashlib.md5(md5cat).hexdigest()}-{len(parts)}"
@@ -1063,23 +1089,24 @@ class RGWStore:
                 # overwritten current survives as a version row (its
                 # manifest stays referenced by that row — never reaped
                 # here)
-                self._archive_null_version(bucket, key)
+                self._archive_null_version(bucket, key, bmeta=bmeta)
                 vid = self._new_version_id()
-                self._archive_version(bucket, key, obj_meta, vid)
-                self._cls(self.meta, f"index.{bucket}", "dir_add", {
-                    "key": key,
-                    "meta": {**obj_meta, "version_id": vid}})
+                self._archive_version(bucket, key, obj_meta, vid,
+                                      bmeta=bmeta)
+                self.index.add(bucket, "index", key,
+                               {**obj_meta, "version_id": vid},
+                               bmeta=bmeta)
             else:
                 suspended = bool(bmeta.get("versioning"))
                 reap = self._displaced_manifests(bucket, key, suspended)
-                self._cls(self.meta, f"index.{bucket}", "dir_add", {
-                    "key": key, "meta": obj_meta})
+                self.index.add(bucket, "index", key, obj_meta,
+                               bmeta=bmeta)
                 if suspended:
                     # like put_object: the complete replaces the null
                     # version on a Suspended bucket
                     self._archive_version(
                         bucket, key, {**obj_meta, "null_data": True},
-                        "null")
+                        "null", bmeta=bmeta)
                 for m in reap:
                     self._reap_manifest(bucket, m)
             # unreferenced parts (uploaded but not listed)
@@ -1100,7 +1127,7 @@ class RGWStore:
             self._modlog("sync", bucket, key)   # post-success
             return etag
         finally:
-            self._quota_release(owner, q_obj, q_bytes)
+            self._quota_release(owner, token)
 
     def abort_multipart(self, bucket: str, key: str,
                         upload_id: str) -> None:
@@ -1148,61 +1175,117 @@ class RGWStore:
         key+"\\0" past an emitted key, or the prefix successor past a
         rolled-up folder — so folders cost one index probe each (not a
         walk of every key underneath) and progress is guaranteed for
-        ANY legal key bytes (no sentinel-collision livelock)."""
+        ANY legal key bytes (no sentinel-collision livelock).
+
+        Sharded buckets list through the merged cursor: one bounded
+        page per shard in flight, entries in global key order — the
+        truncation invariant (never present a truncated page as
+        complete) holds per shard and merged, because `truncated` is
+        literally "the cursor still holds an entry".  A truncated
+        page stashes its live cursor under the returned resume token;
+        the follow-up request continues it (buffered shard pages
+        intact) instead of paying one re-seek dir_list per shard."""
         self._require_bucket(bucket)
+        page = min(max_keys, 1000) + 1
+        lay = self.index.read_layout(bucket)
+        ckey = (bucket, prefix, marker, delimiter, page)
+        mcur = self._take_cursor((*ckey, resume), lay) if resume \
+            else None
+        if mcur is None:
+            mcur = self.index.cursor(bucket, "index", prefix=prefix,
+                                     marker=marker, resume=resume,
+                                     page=page, lay=lay)
         if not delimiter:
-            out = json.loads(self._cls(
-                self.meta, f"index.{bucket}", "dir_list",
-                {"prefix": prefix, "marker": marker, "from": resume,
-                 "max": max_keys}).decode())
-            entries = [(k, m) for k, m in out["entries"]]
+            entries: list[tuple[str, dict]] = []
+            while len(entries) < max_keys:
+                ent = mcur.next()
+                if ent is None:
+                    break
+                entries.append((ent[0], ent[1]))
             nm = entries[-1][0] + "\x00" if entries else ""
-            return entries, [], out["truncated"], nm
+            trunc = mcur.peek() is not None
+            if trunc and nm:
+                self._stash_cursor((*ckey, nm), lay, mcur)
+            return entries, [], trunc, nm
         contents: list[tuple[str, dict]] = []
         prefixes: list[str] = []
         cur = resume
-        truncated = False
-        exhausted = False
-        while not exhausted and \
-                len(contents) + len(prefixes) < max_keys:
-            out = json.loads(self._cls(
-                self.meta, f"index.{bucket}", "dir_list",
-                {"prefix": prefix, "marker": marker, "from": cur,
-                 "max": max_keys}).decode())
-            if not out["entries"]:
+        while True:
+            if len(contents) + len(prefixes) >= max_keys:
+                # page budget reached: truncated iff anything remains
+                # at/after the resume point (the old max:1 probe is
+                # now just a peek at the merged stream)
+                trunc = mcur.peek() is not None
+                if trunc and cur:
+                    self._stash_cursor((*ckey, cur), lay, mcur)
+                return contents, prefixes, trunc, cur
+            ent = mcur.next()
+            if ent is None:
                 break
-            skip_cp = None     # folder already emitted from this page
-            for k, m in out["entries"]:
-                if skip_cp is not None and k.startswith(skip_cp):
-                    continue   # same folder: already rolled up
-                rest = k[len(prefix):]
-                d = rest.find(delimiter)
-                if d >= 0:
-                    cp = prefix + rest[: d + len(delimiter)]
-                    if len(contents) + len(prefixes) >= max_keys:
-                        return contents, prefixes, True, cur
-                    prefixes.append(cp)
-                    skip_cp = cp
-                    succ = self._prefix_successor(cp)
-                    if succ is None:
-                        exhausted = True   # nothing can sort after
-                        break
-                    cur = succ
-                else:
-                    if len(contents) + len(prefixes) >= max_keys:
-                        return contents, prefixes, True, cur
-                    contents.append((k, m))
-                    cur = k + "\x00"
+            k, m = ent
+            rest = k[len(prefix):]
+            d = rest.find(delimiter)
+            if d >= 0:
+                cp = prefix + rest[: d + len(delimiter)]
+                prefixes.append(cp)
+                succ = self._prefix_successor(cp)
+                if succ is None:
+                    break          # nothing can sort after the folder
+                cur = succ
+                # skip the whole folder in one hop on every shard
+                mcur.seek(succ)
             else:
-                if not out["truncated"]:
-                    break
-                continue
-            break    # inner break (exhausted): stop probing
-        if not exhausted and len(contents) + len(prefixes) >= max_keys:
-            # page budget reached: truncated iff anything remains
-            probe = json.loads(self._cls(
-                self.meta, f"index.{bucket}", "dir_list",
-                {"prefix": prefix, "marker": marker, "from": cur,
-                 "max": 1}).decode())
-            truncated = bool(probe["entries"])
-        return contents, prefixes, truncated, cur
+                contents.append((k, m))
+                cur = k + "\x00"
+        return contents, prefixes, False, cur
+
+    # -- index shard admin (reference radosgw-admin bucket reshard /
+    #    bucket limit check; rgw/reshard.py does the heavy lifting) --------
+
+    def reshard_bucket(self, bucket: str, shards: int) -> dict:
+        """Manual online reshard to `shards` (start dual-write, copy,
+        cut over); returns the post-cutover status."""
+        return self.resharder.reshard(bucket, shards)
+
+    def reshard_status(self, bucket: str) -> dict:
+        return self.resharder.status(bucket)
+
+    def reshard_sweep(self) -> dict:
+        """One autoscale/resume pass (mgr tick, gateway maintenance
+        loop, or tests)."""
+        return self.resharder.sweep()
+
+    def bucket_stats(self, bucket: str) -> dict:
+        """Shard layout + per-shard entry counts + live reshard
+        marker + in-process per-shard op counters."""
+        bmeta = self._bucket_meta(bucket)
+        if bmeta is None:
+            raise RGWError(404, "NoSuchBucket", bucket)
+        lay = self.index.read_layout(bucket, bmeta)
+        fill = self.index.shard_counts(bucket, bmeta=bmeta)
+        return {"bucket": bucket, "shards": lay.shards,
+                "gen": lay.gen, "objects": sum(fill.values()),
+                "shard_fill": fill,
+                "reshard": bmeta.get("reshard"),
+                "perf": self.index.perf_dump(bucket)}
+
+    def bucket_limit_check(self) -> list[dict]:
+        """Per-bucket shard-fill report (reference `radosgw-admin
+        bucket limit check`): objects per shard vs
+        rgw_max_objs_per_shard, with OK / WARN (>50% of the reshard
+        threshold) / OVER status."""
+        max_objs = SCHEMA["rgw_max_objs_per_shard"].default
+        out = []
+        for bucket, bmeta in self.list_buckets():
+            lay = self.index.read_layout(bucket, bmeta)
+            count = self.index.count(bucket, bmeta=bmeta)
+            per_shard = count / max(1, lay.shards)
+            fill = per_shard / max_objs
+            status = ("OVER" if per_shard > max_objs else
+                      "WARN" if fill > 0.5 else "OK")
+            out.append({"bucket": bucket, "shards": lay.shards,
+                        "objects": count,
+                        "objects_per_shard": round(per_shard, 1),
+                        "fill_ratio": round(fill, 4),
+                        "status": status})
+        return out

@@ -76,7 +76,8 @@ def daemon_command(argv: list[str]) -> int:
     # prefix.  Parity-based folding alone cannot reach the three-word
     # `launch queue status`, hence the head-driven loop.
     heads = ("perf", "config", "log", "mesh", "launch", "launch queue",
-             "repair", "osdmap", "compile", "prewarm")
+             "repair", "osdmap", "compile", "prewarm", "bucket",
+             "bucket reshard", "bucket limit")
     while extra and prefix in heads:
         prefix = f"{prefix} {extra[0]}"
         extra = extra[1:]

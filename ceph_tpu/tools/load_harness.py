@@ -21,7 +21,7 @@ rounds can carry p99 trajectories):
   python -m ceph_tpu.tools.load_harness --scenario all --seconds 5
 
 Scenarios: rados | rbd | s3 | qos-sim | qos-sim-recovery |
-qos-cluster | all.  The qos-sim rows run the mClock dequeuer in
+qos-cluster | ec-pg-sweep | degraded-read | s3-shard-sweep | all.  The qos-sim rows run the mClock dequeuer in
 VIRTUAL time (deterministic, no cluster, milliseconds of wall clock)
 — they are the tier-1-gated isolation proof; the cluster scenarios
 exercise the same claim end to end and run under the `slow` marker.
@@ -834,6 +834,313 @@ def run_degraded_read_storm(n_osds: int = 12, objects: int = 6,
     return row
 
 
+# -- sharded bucket index: ingest scaling, bounded listing, reshard --------
+#
+# The bucket-index subsystem's acceptance gate (docs/ARCHITECTURE.md
+# "Bucket index sharding & online resharding").  On this box the win
+# is serialization, not device parallelism: every index mutation
+# read-modify-writes its shard's whole JSON directory doc, so a
+# K-entry bucket pays O(K) serialized bytes per PUT on one shard and
+# O(K/8) on eight.  Leg 1 gates that scaling with the PR 12
+# best-paired-pass rule; leg 2 gates paginated-list p99 bounded and
+# flat vs key count; leg 3 reshards 1->8 under concurrent
+# puts/deletes with an OSD kill/revive through the dual-write window
+# and verifies the surviving key set exactly (zero lost / duplicated
+# / misrouted keys).
+
+def _prefill_index(store, bucket: str, entries: int) -> None:
+    """Blow the bucket's index docs up to `entries` rows via direct
+    dir_merge (one bulk RMW per shard).  The point: a PUT's index
+    cost is the O(doc) RMW the shard count divides, but on an empty
+    bucket the ~ms fixed per-request overhead (socket round trips,
+    data-pool write) swamps it and no shard count can look faster.
+    Prefilled docs restore the production shape — index work
+    dominates — so the sweep measures what sharding actually buys."""
+    lay = store.index.read_layout(bucket)
+    meta = {"size": 0, "etag": "prefill"}
+    byshard: dict = {}
+    for i in range(entries):
+        k = f"f{i:06d}"
+        byshard.setdefault(lay.shard_oid("index", k),
+                           []).append([k, meta])
+    for oid, ents in byshard.items():
+        store.index._cls(oid, "dir_merge", {"entries": ents})
+
+
+def _shard_ingest(store, bucket: str, nshards: int, keys: int,
+                  writers: int, zipf, payload: bytes,
+                  prefill: int = 0) -> float:
+    """Create an nshards-index bucket (no owner: quota admission is
+    out of scope here) and PUT `keys` objects from `writers` threads
+    — key i is fresh except every third op, which re-PUTs a Zipf-hot
+    key (the skewed-overwrite traffic production sees).  Returns
+    keys/sec over the measured puts (prefill excluded)."""
+    store.create_bucket(bucket, shards=nshards)
+    if prefill:
+        _prefill_index(store, bucket, prefill)
+    start = threading.Barrier(writers)
+
+    def work(w: int) -> None:
+        samp = zipf.spawn(w + 1)
+        start.wait()
+        for i in range(w, keys, writers):
+            kid = samp.draw() if i % 3 == 0 else i
+            store.put_object(bucket, f"k{kid:05d}", payload)
+
+    threads = [threading.Thread(target=work, args=(w,))
+               for w in range(writers)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return keys / (time.perf_counter() - t0)
+
+
+def _list_p99(store, bucket: str, page: int, repeats: int) -> float:
+    """p99 (ms) of individual paginated list_objects pages over
+    `repeats` full drains of the bucket."""
+    lat = LatencyRecorder()
+    for _ in range(repeats):
+        resume = ""
+        while True:
+            t0 = time.perf_counter()
+            ents, _cp, trunc, resume = store.list_objects(
+                bucket, max_keys=page, resume=resume)
+            lat.record(time.perf_counter() - t0)
+            if not trunc:
+                break
+    return lat.summary().get("p99_ms") or 0.0
+
+
+def run_s3_shard_sweep(shard_counts=(1, 4, 8), keys: int = 600,
+                       writers: int = 4, passes: int = 3,
+                       list_page: int = 64, zipf_alpha: float = 1.1,
+                       prefill: int = 12000,
+                       min_x: float | None = None,
+                       p99_max_ms: float | None = None,
+                       flat_factor: float | None = None) -> dict:
+    """Gated sharded-bucket-index scenario; env knobs
+    S3_SHARD_SWEEP_MIN_X / S3_LIST_P99_MAX_MS /
+    S3_LIST_P99_FLAT_FACTOR / S3_SHARD_PREFILL."""
+    import os
+
+    from ..rados.client import RadosError
+    from .vstart import Cluster
+    if min_x is None:
+        min_x = float(os.environ.get("S3_SHARD_SWEEP_MIN_X", "2.0"))
+    if p99_max_ms is None:
+        p99_max_ms = float(os.environ.get("S3_LIST_P99_MAX_MS",
+                                          "200.0"))
+    if flat_factor is None:
+        flat_factor = float(os.environ.get("S3_LIST_P99_FLAT_FACTOR",
+                                           "3.0"))
+    prefill = int(os.environ.get("S3_SHARD_PREFILL", str(prefill)))
+    t_start = time.perf_counter()
+    rng = np.random.default_rng(17)
+    payload = rng.integers(0, 256, 64, dtype=np.uint8).tobytes()
+    base = shard_counts[0]
+    top = shard_counts[-1]
+    # measured puts per ingest: enough for a stable rate, few enough
+    # that 4 rounds x len(shard_counts) buckets stay inside a CI
+    # budget — the doc-RMW cost prefill restores is per-put, so the
+    # put count does not change the signal, only the noise floor
+    ingest_keys = max(writers * 25, keys // 4)
+    with Cluster(n_osds=4) as c:
+        from ..rgw.store import RGWStore
+        st = RGWStore(c.client())
+        zipf = ZipfSampler(ingest_keys, zipf_alpha, seed=3)
+
+        # leg 1 — ingest scaling over prefilled buckets (see
+        # _prefill_index: shard count divides the O(doc) index RMW,
+        # which only dominates once docs carry a production-sized
+        # entry count).  Warm pass per count first (pool peering +
+        # the buckets-doc working set), then `passes` measured
+        # sweeps; each fan-out count is gated on its best PAIRED
+        # pass (its rate / the SAME pass's base rate) because the
+        # box's absolute rate wanders ~2x between passes (the
+        # ec-pg-sweep rule, PR 12)
+        rates: dict[int, float] = {}
+        for n in shard_counts:
+            rates[n] = _shard_ingest(st, f"sww{n}", n, ingest_keys,
+                                     writers, zipf, payload,
+                                     prefill=prefill)
+        best_x = {n: 0.0 for n in shard_counts[1:]}
+        for p in range(passes):
+            row = {}
+            for n in shard_counts:
+                rate = _shard_ingest(st, f"swp{p}n{n}", n,
+                                     ingest_keys, writers, zipf,
+                                     payload, prefill=prefill)
+                row[n] = rate
+                rates[n] = max(rates[n], rate)
+            if row[base]:
+                for n in shard_counts[1:]:
+                    best_x[n] = max(best_x[n], row[n] / row[base])
+        ingest_ok = best_x.get(top, 1.0) >= min_x
+
+        # leg 2 — paginated listing: p99 per page bounded, and flat
+        # between a small bucket and one 4x its key count at the
+        # same (top) shard count — what the cls parsed-doc cache and
+        # the gateway continuation-cursor cache buy (without them a
+        # page costs one full-doc parse per shard and the ratio
+        # tracks key count).  Both buckets are dir_merge-prefilled
+        # (listing never touches data objects, only index docs).
+        # Paired per round for the wander reason above; the
+        # denominator floor keeps a microsecond-fast small-bucket
+        # page from failing the ratio on noise.
+        nlarge = st.bucket_stats(f"swp{passes - 1}n{top}")["objects"]
+        nsmall = max(list_page, nlarge // 4)
+        st.create_bucket("swlsmall", shards=top)
+        _prefill_index(st, "swlsmall", nsmall)
+        large_p99 = flat_ratio = float("inf")
+        for _ in range(3):
+            p99_s = _list_p99(st, "swlsmall", list_page, repeats=3)
+            p99_l = _list_p99(st, f"swp{passes - 1}n{top}", list_page,
+                              repeats=3)
+            large_p99 = min(large_p99, p99_l)
+            flat_ratio = min(flat_ratio, p99_l / max(p99_s, 0.5))
+        list_ok = large_p99 <= p99_max_ms and flat_ratio <= flat_factor
+
+        # leg 3 — online reshard 1->top under concurrent writers with
+        # an OSD kill/revive through the dual window.  The marker is
+        # durable, so the killed copy resumes from sweep(); writers
+        # retry through the outage and the final key set must match
+        # the acked history exactly.
+        st.create_bucket("swre", shards=1)
+        npre = keys // 2
+        for i in range(npre):
+            st.put_object("swre", f"pre{i:05d}", payload)
+        expected = {f"pre{i:05d}" for i in range(npre)}
+        acked_put: set[str] = set()
+        acked_del: set[str] = set()
+        uncertain: set[str] = set()
+        write_errors = [0]
+        mu = threading.Lock()
+
+        def attempt(fn, *a, absent_ok: bool = False) -> bool:
+            from ..rgw.store import RGWError
+            for i in range(5):
+                try:
+                    fn(*a)
+                    return True
+                except RGWError as e:
+                    # a delete whose FIRST try timed out ambiguously
+                    # may find the key already gone on retry
+                    if absent_ok and e.status == 404 and i > 0:
+                        return True
+                    return False
+                except Exception:  # noqa: BLE001 — outage window
+                    time.sleep(0.3)
+            return False
+
+        def churn(w: int) -> None:
+            for i in range(keys // 4):
+                k = f"w{w}_{i:04d}"
+                ok_put = attempt(st.put_object, "swre", k, payload)
+                with mu:
+                    (acked_put if ok_put else uncertain).add(k)
+                    if not ok_put:
+                        write_errors[0] += 1
+                if ok_put and i % 3 == 2:
+                    ok_del = attempt(st.delete_object, "swre", k,
+                                     absent_ok=True)
+                    with mu:
+                        (acked_del if ok_del else uncertain).add(k)
+                        if not ok_del:
+                            write_errors[0] += 1
+
+        st.resharder.start("swre", top)
+        churners = [threading.Thread(target=churn, args=(w,))
+                    for w in range(2)]
+        for t in churners:
+            t.start()
+        time.sleep(0.1)
+        victim = 3
+        c.kill_osd(victim)
+        c.mark_osd_down(victim)
+        st.reshard_sweep()          # interrupted mid-copy (or errors)
+        time.sleep(0.4)
+        c.revive_osd(victim)
+        resumed = 0
+        for _ in range(60):
+            sw = st.reshard_sweep()
+            resumed += sw.get("resumed", 0)
+            if not st.reshard_status("swre").get("reshard"):
+                break
+            time.sleep(0.3)
+        for t in churners:
+            t.join()
+        # writers may have raced past the cutover; bounded extra
+        # sweeps drive any still-live marker to a final state before
+        # the audit (a stuck marker fails reshard_ok below)
+        for _ in range(20):
+            if not st.reshard_status("swre").get("reshard"):
+                break
+            st.reshard_sweep()
+            time.sleep(0.3)
+        c.wait_active_clean(timeout=120)
+        expected |= acked_put
+        expected -= acked_del
+        expected -= uncertain
+        listed: list[str] = []
+        resume = ""
+        while True:
+            ents, _cp, trunc, resume = st.list_objects(
+                "swre", max_keys=100, resume=resume)
+            listed.extend(k for k, _m in ents)
+            if not trunc:
+                break
+        got = set(listed) - uncertain
+        misrouted = 0
+        for k in got:
+            try:
+                st.index.get("swre", "index", k)
+            except RadosError:
+                misrouted += 1
+        stat = st.bucket_stats("swre")
+        reshard = {
+            "shards": stat["shards"], "gen": stat["gen"],
+            "resumed_sweeps": resumed,
+            "expected": len(expected), "listed": len(got),
+            "lost": len(expected - got),
+            "extra": len(got - expected),
+            "duplicated": len(listed) - len(set(listed)),
+            "misrouted": misrouted,
+            "uncertain": len(uncertain),
+            "write_errors": write_errors[0],
+        }
+        reshard_ok = (stat["shards"] == top and
+                      not stat["reshard"] and
+                      reshard["lost"] == 0 and
+                      reshard["extra"] == 0 and
+                      reshard["duplicated"] == 0 and
+                      misrouted == 0)
+    return {
+        "metric": "harness_s3_shard_sweep",
+        "shard_counts": list(shard_counts), "keys": keys,
+        "ingest_keys": ingest_keys, "prefill": prefill,
+        "writers": writers,
+        "ingest_keys_per_s": {str(n): round(rates[n], 1)
+                              for n in shard_counts},
+        # speedup_x is each count's best PAIRED pass (vs the same
+        # pass's base rate) — recomputing from ingest_keys_per_s
+        # (best across ALL passes) will not match on a wandering box
+        "speedup_x": {str(n): round(best_x[n], 3)
+                      for n in shard_counts[1:]},
+        "frac_method": "best_paired_pass", "min_x": min_x,
+        "ingest_ok": ingest_ok,
+        "list_p99_ms": round(large_p99, 3),
+        "list_flat_ratio": round(flat_ratio, 3),
+        "list_keys": {"small": nsmall, "large": nlarge},
+        "p99_max_ms": p99_max_ms, "flat_factor": flat_factor,
+        "list_ok": list_ok,
+        "reshard": reshard, "reshard_ok": reshard_ok,
+        "duration_s": round(time.perf_counter() - t_start, 1),
+        "ok": ingest_ok and list_ok and reshard_ok,
+    }
+
+
 def _emit(row: dict) -> None:
     print(json.dumps(row), flush=True)
 
@@ -843,7 +1150,8 @@ def main(argv=None) -> int:
     ap.add_argument("--scenario", default="all",
                     choices=("rados", "rbd", "s3", "qos-sim",
                              "qos-sim-recovery", "qos-cluster",
-                             "ec-pg-sweep", "degraded-read", "all"))
+                             "ec-pg-sweep", "degraded-read",
+                             "s3-shard-sweep", "all"))
     ap.add_argument("--cycles", type=int, default=1,
                     help="degraded-read: kill/revive cycles")
     ap.add_argument("--read-passes", type=int, default=3,
@@ -851,6 +1159,11 @@ def main(argv=None) -> int:
                          "degraded window")
     ap.add_argument("--pg-counts", default="1,8,64",
                     help="ec-pg-sweep: comma-separated PG fan-outs")
+    ap.add_argument("--shard-counts", default="1,4,8",
+                    help="s3-shard-sweep: comma-separated bucket "
+                         "index shard counts (first is the base)")
+    ap.add_argument("--shard-keys", type=int, default=600,
+                    help="s3-shard-sweep: keys ingested per bucket")
     ap.add_argument("--clients", type=int, default=32,
                     help="concurrent client sessions")
     ap.add_argument("--seconds", type=float, default=3.0)
@@ -918,6 +1231,24 @@ def main(argv=None) -> int:
                   f"errors={row.get('read_errors')}, "
                   f"reconstructs={row.get('reconstruct_reads')}, "
                   f"p99={row.get('read_p99_ms')})", file=sys.stderr)
+            rc = 1
+    if "s3-shard-sweep" in scenarios:
+        counts = tuple(int(t) for t in args.shard_counts.split(","))
+        row = run_s3_shard_sweep(shard_counts=counts,
+                                 keys=args.shard_keys)
+        _emit(row)
+        if not row["ok"]:
+            # the sharded-index gate: ingest must scale with shard
+            # count, merged listing must stay bounded/flat, and an
+            # interrupted online reshard must converge losslessly
+            print(f"s3-shard-sweep: gate failed "
+                  f"(ingest_ok={row['ingest_ok']} "
+                  f"speedup={row['speedup_x']}, "
+                  f"list_ok={row['list_ok']} "
+                  f"p99={row['list_p99_ms']}ms "
+                  f"flat={row['list_flat_ratio']}, "
+                  f"reshard_ok={row['reshard_ok']} "
+                  f"{row['reshard']})", file=sys.stderr)
             rc = 1
     if "qos-cluster" in scenarios:
         _emit(run_qos_cluster_tenants(

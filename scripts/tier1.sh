@@ -88,6 +88,19 @@ if [ "$rc" -eq 0 ]; then
     --scale 16 --prewarm --seconds 2 --size 16384 || rc=$?
   rm -rf "$_cc_dir"
 fi
+# Sharded bucket-index gate (ISSUE 17, docs/ARCHITECTURE.md "Bucket
+# index sharding"): dir_merge-prefilled buckets at 1/4/8 index shards
+# — Zipf-skewed concurrent ingest must scale with shard count (best
+# paired pass >= S3_SHARD_SWEEP_MIN_X, default 2x, the PR-12
+# box-wander rule), merged-listing page p99 bounded and flat between
+# a small bucket and 4x its keys at the same shard count, and an
+# online 1->8 reshard under concurrent put/delete churn with an OSD
+# kill/revive through the dual-write window must converge with zero
+# lost/extra/duplicated/misrouted keys.
+if [ "$rc" -eq 0 ]; then
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python -m ceph_tpu.tools.load_harness \
+    --scenario s3-shard-sweep || rc=$?
+fi
 # Fused-kernel variant gate (ISSUE 11, docs/FUSED_CRC.md): every
 # shipped (extract, combine) variant of the fused parity+crc kernel —
 # planar/packed/wide extraction through the XLA log-fold AND the
