@@ -309,7 +309,7 @@ def _pipeline_payloads(nobj: int, objsize: int):
 
 def time_write_pipeline(pipelined: bool, nobj: int, objsize: int,
                         chunk: int, payloads=None,
-                        tracker=None) -> float:
+                        tracker=None, per_op=None) -> float:
     """Wall-clock input bytes/sec of `nobj` object writes through the
     full ECBackend path (plan -> assemble -> fused encode+crc launch ->
     hinfo fold -> per-shard sub-writes on MemStore), every op its own
@@ -318,7 +318,9 @@ def time_write_pipeline(pipelined: bool, nobj: int, objsize: int,
     the next submit — the A/B contrast.  tracker: an OpTracker makes
     every op a TrackedOp with the full stage timeline (the always-on
     daemon configuration; the tracked-vs-untracked delta is the
-    tracking overhead guard, docs/TRACING.md)."""
+    tracking overhead guard, docs/TRACING.md).  per_op: called with
+    the op index before each submit — the ledger-overhead A/B injects
+    the OSD write path's control-plane ledger touches here."""
     import contextlib
     from ceph_tpu.osd.ec_transaction import PGTransaction
     from ceph_tpu.osd.types import eversion_t, hobject_t
@@ -329,6 +331,8 @@ def time_write_pipeline(pipelined: bool, nobj: int, objsize: int,
     t0 = time.perf_counter()
     with ctx:
         for i, payload in enumerate(payloads):
+            if per_op is not None:
+                per_op(i)
             txn = PGTransaction()
             txn.write(hobject_t(pool=1, name=f"pipe{i}"), 0, payload)
             top = tracker.create("osd_op", f"pipe{i}") \
@@ -406,6 +410,57 @@ def measure_profiler_overhead(reps: int = 3) -> tuple[float, float]:
     time_write_pipeline(True, 2, objsize, chunk, payloads[:2])
     on, off, noise = time_profiler_overhead(nobj, objsize, chunk,
                                             payloads, reps=reps)
+    return round((1.0 - on / off) * 100.0, 2), round(noise, 2)
+
+
+def time_ledger_overhead(nobj: int, objsize: int, chunk: int,
+                         payloads, reps: int = 3
+                         ) -> tuple[float, float, float]:
+    """Control-plane ledger on-vs-off A/B on the pipelined write path
+    (ISSUE 19, mirrors time_profiler_overhead): per op the callback
+    replays exactly the ledger touches the OSD write path pays — the
+    enabled gate plus a degraded-ack count every op, a transition and
+    a timed stage at recovery cadence — with the SAME callback wired
+    into both configs so the A/B isolates the ledger's cost, not the
+    callback's.  Returns (on_best, off_best, noise_pct of off)."""
+    from ceph_tpu.osd.pg_ledger import PGLedger
+    from ceph_tpu.osd.types import pg_t
+    led = PGLedger("pg_ledger.bench", ring=64)
+    pgid = pg_t(1, 0)
+
+    def per_op(i: int) -> None:
+        # the daemon's submit-path gate (osd/daemon.py): one enabled
+        # check, then the degraded-ack count
+        if led.enabled:
+            led.degraded_ack(pgid)
+        if i % 8 == 0:
+            # recovery-cadence touches: transition + timed stage
+            led.transition(pgid, "recovering" if i & 8 else "clean")
+            with led.stage(pgid, "scan"):
+                pass
+
+    on, off = [], []
+    for _ in range(reps):
+        led.enabled = False
+        off.append(time_write_pipeline(True, nobj, objsize, chunk,
+                                       payloads, per_op=per_op))
+        led.enabled = True
+        on.append(time_write_pipeline(True, nobj, objsize, chunk,
+                                      payloads, per_op=per_op))
+    noise = (max(off) - min(off)) / max(off) * 100.0
+    return max(on), max(off), noise
+
+
+def measure_ledger_overhead(reps: int = 3) -> tuple[float, float]:
+    """(overhead_pct, noise_pct) of the control-plane ledger at smoke
+    sizes — standalone so the --smoke gate can re-measure on a failing
+    single shot (the same box-wander retry rule as the profiler
+    gate)."""
+    nobj, objsize, chunk = 6, 1 << 16, 1024
+    payloads = _pipeline_payloads(nobj, objsize)
+    time_write_pipeline(True, 2, objsize, chunk, payloads[:2])
+    on, off, noise = time_ledger_overhead(nobj, objsize, chunk,
+                                          payloads, reps=reps)
     return round((1.0 - on / off) * 100.0, 2), round(noise, 2)
 
 
@@ -577,6 +632,14 @@ def bench_end_to_end(on_tpu: bool, passes: int, spacing: float) -> dict:
     out["ec_write_profiler_overhead_pct"] = round(
         (1.0 - p_on / p_off) * 100.0, 2)
     out["ec_write_profiler_noise_pct"] = round(p_noise, 2)
+    # control-plane ledger overhead (ISSUE 19, same gate shape): the
+    # per-PG state ledger rides the OSD write path's degraded-ack
+    # check, so its on-vs-off cost is guarded like the other recorders
+    l_on, l_off, l_noise = time_ledger_overhead(
+        nobj, objsize, chunk, payloads, reps=3)
+    out["ec_write_ledger_overhead_pct"] = round(
+        (1.0 - l_on / l_off) * 100.0, 2)
+    out["ec_write_ledger_noise_pct"] = round(l_noise, 2)
     out["launch_ledger"] = ledger_block()
     return out
 
@@ -1295,6 +1358,30 @@ def run_smoke() -> int:
         print(f"# smoke FAILED: profiler overhead {povh}% > "
               f"{pthresh + pnoise:.2f}% ({pthresh}% threshold + "
               f"{pnoise:.2f}% measured noise, best of retries)",
+              file=sys.stderr)
+        return 1
+    # control-plane ledger overhead gate (ISSUE 19): same shape as
+    # the profiler gate above — threshold + measured noise, bounded
+    # re-measure on a failing single shot, retries-used published
+    lthresh = float(os.environ.get("LEDGER_OVERHEAD_MAX_PCT", "2.0"))
+    lnoise = max(float(out.get("ec_write_ledger_noise_pct") or 0.0),
+                 0.0)
+    lovh = out.get("ec_write_ledger_overhead_pct")
+    lretries_max = int(os.environ.get("LEDGER_OVERHEAD_RETRIES", "2"))
+    lretries = lretries_max
+    while (lovh is None or lovh > lthresh + lnoise) and lretries > 0:
+        lretries -= 1
+        print(f"# ledger overhead {lovh}% > "
+              f"{lthresh + lnoise:.2f}%: re-measuring "
+              f"({lretries} retries left)", file=sys.stderr)
+        lovh, lnoise = measure_ledger_overhead()
+        out["ec_write_ledger_overhead_pct"] = lovh
+        out["ec_write_ledger_noise_pct"] = lnoise
+    out["ec_ledger_overhead_retries_used"] = lretries_max - lretries
+    if lovh is None or lovh > lthresh + lnoise:
+        print(f"# smoke FAILED: pg ledger overhead {lovh}% > "
+              f"{lthresh + lnoise:.2f}% ({lthresh}% threshold + "
+              f"{lnoise:.2f}% measured noise, best of retries)",
               file=sys.stderr)
         return 1
     if storm_why is not None:

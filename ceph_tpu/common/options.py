@@ -245,6 +245,24 @@ register_options([
            "fault injection: sleep this long inside the submit of "
            "every FIRST-seen jit bucket (a synthetic compile stall "
            "for the smoke/health gates)", Level.DEV, min=0.0),
+    # control-plane flight recorder (docs/TRACING.md "Control plane")
+    Option("osd_pg_ledger", bool, True,
+           "record every PG peering/recovery/backfill transition in "
+           "the per-PG state-machine ledger (osd/pg_ledger.py): "
+           "timed stages feed lat_peering_*/lat_recovery_* "
+           "histograms, the `pg ledger` asok, the MPGStats ledger "
+           "block, and cluster_bench's recovery_blame rows; off = "
+           "the null fast path"),
+    Option("osd_pg_ledger_ring", int, 64,
+           "state transitions kept per PG in the control-plane "
+           "ledger ring (the `pg ledger` asok transition tail)",
+           Level.DEV, min=1, flags=("startup",)),
+    Option("osd_stuck_subwrite_s", float, 10.0,
+           "an EC client write whose shard sub-writes have been in "
+           "flight longer than this is surfaced as stuck_subwrite(pg) "
+           "in `repair status` and slow-op blame (the PR 16 known "
+           "reduction: a write wedged across a SIGKILL re-peer must "
+           "be visible, not a silent active+clean stall)", min=0.0),
     # compile lifecycle: persistent cache + boot prewarm
     # (docs/PIPELINE.md "Compile lifecycle")
     Option("osd_ec_compile_cache", bool, True,

@@ -92,6 +92,15 @@ DEFAULT_LAT_BUCKETS = (
     0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
     0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
+# Control-plane axis (peering rounds, recovery passes, mon dispatch
+# under churn): the device-plane buckets top out at 10 s, but a
+# 128-OSD re-peer or a wide backfill scan legitimately runs minutes —
+# a lat_peering_* histogram on the default axis would park every
+# interesting sample in +Inf and the p99 would read "10 s, probably".
+CONTROL_LAT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0)
+
 
 @dataclass
 class _Counter:

@@ -374,6 +374,86 @@ class DashboardModule(MgrModule):
         self.httpd.server_close()
 
 
+class ProgressModule(MgrModule):
+    """Completion fractions for long-running cluster motion (reference
+    pybind/mgr/progress): recovery and backfill events derived from
+    `pg stat` each tick, plus externally-noted events (rgw reshard).
+
+    Event model: while a count (degraded PGs, misplaced objects) is
+    nonzero, the event's BASELINE is the max count seen during the
+    episode and progress = 1 - cur/baseline — monotone even when the
+    count wobbles upward mid-recovery (the baseline rises with it).
+    When the count returns to zero the event is pushed at 1.0, where
+    the mon's linger window keeps it visible to pollers before it
+    retires.  Events live in the LEADER's transient store (`progress
+    update` mon command), so `ceph_cli progress` and the `status`
+    one-liners answer without a mgr round-trip."""
+
+    name = "progress"
+    run_interval = 0.5
+
+    # externally-noted events (class-level so sibling modules can note
+    # without holding a ProgressModule reference)
+    _ext_lock = threading.Lock()
+    _external: dict[str, dict] = {}
+
+    @classmethod
+    def note_event(cls, eid: str, message: str,
+                   progress: float) -> None:
+        with cls._ext_lock:
+            cls._external[eid] = {"message": message,
+                                  "progress": progress}
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self._baseline: dict[str, int] = {}     # episode max count
+        self._started: dict[str, float] = {}    # episode start ts
+        self.events: dict[str, float] = {}      # last pushed fraction
+
+    def _push(self, eid: str, message: str, frac: float) -> None:
+        cmd = {"prefix": "progress update", "id": eid,
+               "message": message, "progress": frac}
+        if eid in self._started:
+            cmd["started_at"] = self._started[eid]
+        r, _out = self.mon_command(cmd)
+        if r == 0:
+            self.events[eid] = frac
+
+    def _track(self, eid: str, what: str, cur: int) -> None:
+        import time as _time
+        if cur <= 0:
+            if eid in self._baseline:
+                # episode over: publish the 1.0, then forget the
+                # episode so the next one starts a fresh baseline
+                self._push(eid, f"{what} (done)", 1.0)
+                del self._baseline[eid]
+                self._started.pop(eid, None)
+                self.events.pop(eid, None)
+            return
+        base = max(self._baseline.get(eid, 0), cur)
+        self._baseline[eid] = base
+        self._started.setdefault(eid, _time.time())
+        frac = 1.0 - cur / base if base else 0.0
+        # monotone within the episode: a shrinking baseline ratio must
+        # never walk a published fraction backwards
+        frac = max(frac, self.events.get(eid, 0.0))
+        self._push(eid, f"{what} ({cur} remaining)", min(frac, 0.999))
+
+    def tick(self) -> None:
+        r, out = self.mon_command({"prefix": "pg stat"})
+        if r == 0:
+            self._track("recovery", "Recovery: degraded PGs",
+                        int(out.get("degraded_pgs", 0)))
+            self._track("backfill", "Backfill: misplaced objects",
+                        int(out.get("misplaced_objects", 0)))
+        with self._ext_lock:
+            ext = dict(self._external)
+            self._external.clear()
+        for eid, ev in ext.items():
+            self._push(eid, ev["message"],
+                       max(0.0, min(1.0, float(ev["progress"]))))
+
+
 class RgwReshardModule(MgrModule):
     """Dynamic bucket-index resharding driver (reference
     pybind/mgr's rgw support + RGWReshard's background processor).
@@ -417,6 +497,10 @@ class RgwReshardModule(MgrModule):
             n = stats.get("resumed", 0) + stats.get("started", 0)
             if n:
                 msgs.append(f"resharded {n} bucket(s)")
+                # surface the reshard in `progress` too (one-shot,
+                # already complete by the time the sweep returns)
+                ProgressModule.note_event(
+                    "rgw-reshard", f"Reshard: {n} bucket(s)", 1.0)
         self.mgr.set_health(self.name,
                             "HEALTH_WARN" if any(
                                 "failed" in m for m in msgs)
@@ -425,5 +509,5 @@ class RgwReshardModule(MgrModule):
 
 DEFAULT_MODULES = [HealthModule, BalancerModule, PgAutoscalerModule,
                    TelemetryModule, DeviceHealthModule,
-                   RgwReshardModule]
+                   ProgressModule, RgwReshardModule]
 

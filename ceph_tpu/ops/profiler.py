@@ -261,8 +261,10 @@ class DeviceProfiler:
             return
         with self._lock:
             first = bucket not in self._buckets
+        injected = False
         if first and self.inject_stall_s > 0:
             time.sleep(self.inject_stall_s)
+            injected = True
         now = time.perf_counter()
         rec.submit_s = now - rec._t0
         rec.bucket = bucket
@@ -273,8 +275,12 @@ class DeviceProfiler:
         # stall.  Best-effort under concurrency (a racing launch's hit
         # could land in this window), but misattribution only ever
         # downgrades a stall into a hit on a host where the cache IS
-        # serving compiles — the semantics the ledger wants
-        cache_hit = _cc_hits() > rec._cc0
+        # serving compiles — the semantics the ledger wants.  An ARMED
+        # injection overrides the downgrade: the launch really did
+        # sleep, and letting a warm disk cache reclassify the simulated
+        # stall as a hit silently greens the storm/blame smokes on any
+        # host that has ever compiled these buckets before.
+        cache_hit = (not injected) and _cc_hits() > rec._cc0
         stalled = False
         hit = False
         with self._lock:

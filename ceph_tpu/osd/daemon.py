@@ -491,6 +491,22 @@ class OSDDaemon:
                      "osd_ec_compile_storm_window_s",
                      "osd_ec_inject_compile_stall"):
             _tconf.add_observer(_opt, _apply_prof)
+        # control-plane flight recorder (osd/pg_ledger.py, docs/
+        # TRACING.md "Control plane"): per-DAEMON, not a host
+        # singleton — peering/recovery is this daemon's own work, so
+        # every daemon registers its own perf set and ships its own
+        # MPGStats ledger block (no profiler-style perf-owner rule)
+        from .pg_ledger import PGLedger
+        self.pg_ledger = PGLedger(
+            name=f"pg_ledger.osd.{osd_id}",
+            ring=int(_tconf.get("osd_pg_ledger_ring")))
+        self.cct.perf.add(self.pg_ledger.perf)
+
+        def _apply_ledger(_k=None, _v=None):
+            self.pg_ledger.enabled = bool(
+                _tconf.get("osd_pg_ledger"))
+        _apply_ledger()
+        _tconf.add_observer("osd_pg_ledger", _apply_ledger)
         if self.cct.asok is not None:
             self.cct.asok.register_command(
                 "status", lambda cmd: {
@@ -541,6 +557,12 @@ class OSDDaemon:
                 "prewarm status", self._asok_prewarm_status)
             self.cct.asok.register_command(
                 "prewarm_status", self._asok_prewarm_status)
+            # control-plane flight recorder (docs/TRACING.md
+            # "Control plane"); both spellings like mesh/launch-queue
+            self.cct.asok.register_command(
+                "pg ledger", self._asok_pg_ledger)
+            self.cct.asok.register_command(
+                "pg_ledger", self._asok_pg_ledger)
         self.store = store or MemStore()
         self.store.mount()
         self._raw_tid = 1 << 32   # raw-RPC tids, disjoint from backends'
@@ -588,6 +610,14 @@ class OSDDaemon:
         # PGs whose last recovery pass failed: the steady-state skip
         # must not strand them until an unrelated acting change
         self._pgs_needing_recovery: set = set()
+        # led PGs serving with a shard slot that has NO live holder
+        # (down-not-out member -> CRUSH_ITEM_NONE hole): everything
+        # recoverable is recovered, but redundancy is below target —
+        # the reference's active+undersized+degraded.  Counted into
+        # MPGStats degraded_pgs (PG_DEGRADED health, mgr progress)
+        # and mirrored as an open pg_ledger degraded window; NOT in
+        # _pgs_needing_recovery, which gates active+clean waits
+        self._pgs_undersized: set = set()
         # recovery passes currently running (quiescence observable for
         # tests/operators: 0 + empty needing-recovery = settled)
         self._recovery_inflight = 0
@@ -1023,6 +1053,10 @@ class OSDDaemon:
                 self._pgs_needing_recovery = {
                     p for p in self._pgs_needing_recovery
                     if not (p.pool == pid and p.seed >= new_n)}
+                for p in [p for p in self._pgs_undersized
+                          if p.pool == pid and p.seed >= new_n]:
+                    self._pgs_undersized.discard(p)
+                    self.pg_ledger.degraded_close(p)
                 for p in [p for p in self._unfound
                           if p.pool == pid and p.seed >= new_n]:
                     self._unfound.pop(p, None)
@@ -1032,6 +1066,8 @@ class OSDDaemon:
                     # pool: parents change content, children are born
                     # or die — rebuild (and re-peer) on next use
                     self.pgs.pop(pgid, None)
+                    self.pg_ledger.transition(pgid, "interval_change",
+                                              epoch=newmap.epoch)
                     continue
                 up, acting, _, primary = newmap.pg_to_up_acting_osds(pgid)
                 shards = getattr(state.backend, "shards", None) or \
@@ -1039,6 +1075,9 @@ class OSDDaemon:
                 if hasattr(shards, "acting"):
                     if list(acting) != list(shards.acting):
                         state.needs_peer = True
+                        self.pg_ledger.transition(
+                            pgid, "interval_change",
+                            epoch=newmap.epoch)
                     shards.acting = list(acting)
                     if state.kind != "ec":
                         # replicated width follows the acting set
@@ -1100,6 +1139,9 @@ class OSDDaemon:
                         continue
                     if primary == self.osd_id:
                         self._pgs_needing_recovery.add(pgid)
+                        self.pg_ledger.transition(
+                            pgid, "needs_recovery",
+                            epoch=newmap.epoch)
         self.map_event.set()
         if self.recovery_enabled and newmap.pools and \
                 newmap.epoch not in self._recovered_epochs:
@@ -1202,10 +1244,17 @@ class OSDDaemon:
         with self.pg_lock:
             self._pgs_needing_recovery = {
                 p for p in self._pgs_needing_recovery if still_ours(p)}
+            gone_undersized = [p for p in self._pgs_undersized
+                               if not still_ours(p)]
+            self._pgs_undersized.difference_update(gone_undersized)
             for p in [p for p in self._unfound
                       if p.pool not in self.osdmap.pools or
                       p.seed >= self.osdmap.pools[p.pool].pg_num]:
                 self._unfound.pop(p, None)
+        for p in gone_undersized:
+            # the window moved with the PG (new primary re-opens its
+            # own); a window left open here would leak the gauge
+            self.pg_ledger.degraded_close(p)
         # peers that time out once in this pass are not probed again:
         # a dead-but-still-up OSD must not cost 3s per object/shard
         unreachable: set[int] = set()
@@ -1365,6 +1414,9 @@ class OSDDaemon:
             return self._list_pg_objects(spg)
         if unreachable is not None and osd in unreachable:
             return []
+        # the O(peers) cost item 4 names: one remote listing RPC per
+        # (shard, candidate holder) per re-peered PG
+        self.pg_ledger.count(spg.pgid, "remote_lists")
         with self.pg_lock:
             self._raw_tid += 1
             tid = self._raw_tid
@@ -1390,15 +1442,22 @@ class OSDDaemon:
         def push(s, data, hinfo):
             # background rebuild pays the repair-bandwidth throttle
             # BEFORE the push so a tiny cap can't be overshot by a
-            # burst of already-decoded shards (docs/REPAIR.md)
-            self._recovery_throttle(int(np.asarray(data).size))
+            # burst of already-decoded shards (docs/REPAIR.md).  The
+            # ledger times the whole throttle gate (not just the
+            # sleep): the blame row's throttle_s is the time pushes
+            # spent in the brake, positive whenever pushes ran
+            with self.pg_ledger.stage(pgid, "throttle"):
+                self._recovery_throttle(int(np.asarray(data).size))
             txn = Transaction()
             goid = shard_oid(oid, s)
             txn.write(goid, 0, data)
             txn.setattrs(goid, recovery_attrs(hinfo, data))
             # count only DELIVERED bytes: a push that times out on a
             # dead peer must not inflate the repair ledger
-            if self._push_shard_txn(acting[s], spg_t(pgid, s), txn):
+            with self.pg_ledger.stage(pgid, "push"):
+                delivered = self._push_shard_txn(acting[s],
+                                                 spg_t(pgid, s), txn)
+            if delivered:
                 self.perf.inc("recovery_pushed_bytes",
                               int(np.asarray(data).size))
         return push
@@ -1541,53 +1600,62 @@ class OSDDaemon:
             return
         up_osds = [o.id for o in self.osdmap.osds.values()
                    if o.up and o.id not in unreachable]
-        names = self._pg_object_names(pgid, acting, range(be.n),
-                                      unreachable=unreachable)
-        if prev_acting:
-            for s, osd in enumerate(prev_acting):
-                if osd != CRUSH_ITEM_NONE and self.osdmap.is_up(osd) \
-                        and osd not in unreachable:
-                    for oj in self._remote_list(
-                            osd, spg_t(pgid, s),
-                            unreachable=unreachable):
-                        names.add(M.hobj_from_json(oj))
-        # wide scan only for shards whose holder changed or is gone —
-        # steady-state shards are already listed from acting above
-        def shard_moved(s: int) -> bool:
-            cur = acting[s] if s < len(acting) else CRUSH_ITEM_NONE
-            if cur == CRUSH_ITEM_NONE or not self.osdmap.is_up(cur):
-                return True
-            if prev_acting is None:
-                return True
-            prev = prev_acting[s] if s < len(prev_acting) \
-                else CRUSH_ITEM_NONE
-            return prev != cur
-        for s in range(be.n):
-            if not shard_moved(s):
-                continue
-            spg = spg_t(pgid, s)
-            known = {acting[s] if s < len(acting) else None,
-                     prev_acting[s] if prev_acting and
-                     s < len(prev_acting) else None}
-            for osd in up_osds:
-                if osd in known:
+        self.pg_ledger.transition(pgid, "recovering",
+                                  epoch=self.osdmap.epoch)
+        with self.pg_ledger.stage(pgid, "scan"):
+            names = self._pg_object_names(pgid, acting, range(be.n),
+                                          unreachable=unreachable)
+            if prev_acting:
+                for s, osd in enumerate(prev_acting):
+                    if osd != CRUSH_ITEM_NONE and \
+                            self.osdmap.is_up(osd) \
+                            and osd not in unreachable:
+                        for oj in self._remote_list(
+                                osd, spg_t(pgid, s),
+                                unreachable=unreachable):
+                            names.add(M.hobj_from_json(oj))
+            # wide scan only for shards whose holder changed or is
+            # gone — steady-state shards are already listed from
+            # acting above
+            def shard_moved(s: int) -> bool:
+                cur = acting[s] if s < len(acting) else CRUSH_ITEM_NONE
+                if cur == CRUSH_ITEM_NONE or \
+                        not self.osdmap.is_up(cur):
+                    return True
+                if prev_acting is None:
+                    return True
+                prev = prev_acting[s] if s < len(prev_acting) \
+                    else CRUSH_ITEM_NONE
+                return prev != cur
+            for s in range(be.n):
+                if not shard_moved(s):
                     continue
-                for oj in self._remote_list(osd, spg, timeout=3.0):
-                    names.add(M.hobj_from_json(oj))
-        # split child / merge parent: objects may still sit in
-        # ANCESTOR collections (split) or dying-CHILD collections
-        # (merge) on holders whose local sweep lags — list those too,
-        # keeping only names the ps-bits rule assigns to this PG
-        ancestors = (self._split_ancestors(pgid) +
-                     self._merge_source_pgs(pgid)) \
-            if prev_acting is None else []
-        names |= self._names_from_ancestors(pgid, ancestors,
-                                            range(be.n), pool.pg_num,
-                                            up_osds, unreachable)
-        if pool.pg_num:
-            names = {h for h in names
-                     if crush_hash32(h.key or h.name) % pool.pg_num ==
-                     pgid.seed}
+                spg = spg_t(pgid, s)
+                known = {acting[s] if s < len(acting) else None,
+                         prev_acting[s] if prev_acting and
+                         s < len(prev_acting) else None}
+                for osd in up_osds:
+                    if osd in known:
+                        continue
+                    for oj in self._remote_list(osd, spg, timeout=3.0):
+                        names.add(M.hobj_from_json(oj))
+            # split child / merge parent: objects may still sit in
+            # ANCESTOR collections (split) or dying-CHILD collections
+            # (merge) on holders whose local sweep lags — list those
+            # too, keeping only names the ps-bits rule assigns to
+            # this PG
+            ancestors = (self._split_ancestors(pgid) +
+                         self._merge_source_pgs(pgid)) \
+                if prev_acting is None else []
+            names |= self._names_from_ancestors(pgid, ancestors,
+                                                range(be.n),
+                                                pool.pg_num,
+                                                up_osds, unreachable)
+            if pool.pg_num:
+                names = {h for h in names
+                         if crush_hash32(h.key or h.name) %
+                         pool.pg_num == pgid.seed}
+        self.pg_ledger.count(pgid, "objects_scanned", len(names))
         all_ok = True
         # decode-needing objects are DEFERRED and rebuilt in one
         # batched pass after the sweep: grouped by recovery geometry,
@@ -1613,13 +1681,42 @@ class OSDDaemon:
                                         decode_queue=decode_queue):
                 all_ok = False
         if decode_queue:
-            if not self._recover_decode_batch(pgid, acting, be,
-                                              decode_queue):
-                all_ok = False
+            with self.pg_ledger.stage(pgid, "decode"):
+                if not self._recover_decode_batch(pgid, acting, be,
+                                                  decode_queue):
+                    all_ok = False
         if all_ok:
             self._pgs_needing_recovery.discard(pgid)
+            self._note_pg_redundancy(pgid, acting, be.n)
         else:
             self._pgs_needing_recovery.add(pgid)
+            self.pg_ledger.transition(pgid, "recovery_deferred",
+                                      epoch=self.osdmap.epoch)
+            self.pg_ledger.degraded_open(pgid)
+
+    def _note_pg_redundancy(self, pgid: pg_t, acting: list[int],
+                            width: int) -> None:
+        """After a clean recovery pass: a shard slot with no live
+        holder (down-not-out member) means the PG serves BELOW full
+        redundancy even though nothing more is recoverable — track it
+        undersized (MPGStats degraded_pgs) with an open degraded
+        window until the map gives the slot a home."""
+        from ..crush.map import CRUSH_ITEM_NONE
+        holes = len(acting) < width or any(
+            o == CRUSH_ITEM_NONE or not self.osdmap.is_up(o)
+            for o in acting)
+        if holes:
+            with self.pg_lock:
+                self._pgs_undersized.add(pgid)
+            self.pg_ledger.transition(pgid, "active_undersized",
+                                      epoch=self.osdmap.epoch)
+            self.pg_ledger.degraded_open(pgid)
+        else:
+            with self.pg_lock:
+                self._pgs_undersized.discard(pgid)
+            self.pg_ledger.transition(pgid, "clean",
+                                      epoch=self.osdmap.epoch)
+            self.pg_ledger.degraded_close(pgid)
 
     def _recover_decode_batch(self, pgid, acting, be,
                               decode_queue: list[tuple]) -> bool:
@@ -1638,6 +1735,7 @@ class OSDDaemon:
         ok = True
         for oid, err in results.items():
             if err is None:
+                self.pg_ledger.count(pgid, "objects_recovered")
                 self.cct.dout("osd", 5,
                               f"recovered {oid.name} of pg {pgid} by "
                               f"batched decode")
@@ -1747,6 +1845,7 @@ class OSDDaemon:
             if not copied:
                 still_missing.append(s)
         if not still_missing:
+            self.pg_ledger.count(pgid, "objects_recovered")
             self.cct.dout("osd", 5,
                           f"backfilled {oid.name} shards {missing} "
                           f"of pg {pgid} by copy")
@@ -1780,6 +1879,7 @@ class OSDDaemon:
             be.recover_shard(
                 oid, still_missing,
                 self._make_recovery_push(pgid, acting, oid))
+            self.pg_ledger.count(pgid, "objects_recovered")
             self.cct.dout("osd", 5,
                           f"recovered {oid.name} shards "
                           f"{still_missing} of pg {pgid} by decode")
@@ -1833,6 +1933,10 @@ class OSDDaemon:
             except Exception:  # noqa: BLE001
                 prev_acting = None
         spg = spg_t(pgid, NO_SHARD)
+        self.pg_ledger.transition(pgid, "recovering",
+                                  epoch=self.osdmap.epoch)
+        scan_timer = self.pg_ledger.stage(pgid, "scan")
+        scan_timer.__enter__()
         names = self._pg_object_names(pgid, acting, [0],
                                       unreachable=unreachable)
         # union over all replicas so a primary that lost data also heals
@@ -1876,6 +1980,8 @@ class OSDDaemon:
             names = {h for h in names
                      if crush_hash32(h.key or h.name) % pool.pg_num ==
                      pgid.seed}
+        scan_timer.__exit__(None, None, None)
+        self.pg_ledger.count(pgid, "objects_scanned", len(names))
         all_ok = True
         peers = [o for o in acting
                  if o != self.osd_id and self.osdmap.is_up(o) and
@@ -1963,6 +2069,7 @@ class OSDDaemon:
                     txn.omap_setheader(goid, omap_hdr)
                 self.apply_shard_txn(spg, txn)
             data, attrs, omap, omap_hdr = best
+            oid_ok = True
             for osd in acting:
                 if osd == self.osd_id or not self.osdmap.is_up(osd):
                     continue
@@ -1977,12 +2084,25 @@ class OSDDaemon:
                     txn.omap_setkeys(goid, omap)
                 if omap_hdr:
                     txn.omap_setheader(goid, omap_hdr)
-                if not self._push_shard_txn(osd, spg, txn):
+                with self.pg_ledger.stage(pgid, "push"):
+                    pushed = self._push_shard_txn(osd, spg, txn)
+                if not pushed:
                     all_ok = False
+                    oid_ok = False
+            if oid_ok:
+                # replicated "recovered" = reconciled: adopted and/or
+                # re-pushed to every live replica without a timeout
+                self.pg_ledger.count(pgid, "objects_recovered")
         if all_ok:
             self._pgs_needing_recovery.discard(pgid)
+            self._note_pg_redundancy(
+                pgid, acting,
+                pool.size if pool is not None else len(acting))
         else:
             self._pgs_needing_recovery.add(pgid)
+            self.pg_ledger.transition(pgid, "recovery_deferred",
+                                      epoch=self.osdmap.epoch)
+            self.pg_ledger.degraded_open(pgid)
 
     def _reconcile_replicated_pg(self, pgid: pg_t,
                                  state: PGState) -> bool:
@@ -2624,10 +2744,19 @@ class OSDDaemon:
                     # incomplete peering (a live shard didn't answer)
                     # keeps needs_peer set: the next op retries until
                     # every live shard's log has been reconciled
-                    ok = self._peer_pg(pgid, state) \
-                        if state.kind == "ec" else \
-                        self._reconcile_replicated_pg(pgid, state)
+                    self.pg_ledger.transition(
+                        pgid,
+                        "peering" if state.kind == "ec"
+                        else "reconcile",
+                        epoch=self.osdmap.epoch)
+                    with self.pg_ledger.stage(pgid, "peering"):
+                        ok = self._peer_pg(pgid, state) \
+                            if state.kind == "ec" else \
+                            self._reconcile_replicated_pg(pgid, state)
                     state.needs_peer = not ok
+                    self.pg_ledger.transition(
+                        pgid, "active" if ok else "peering_incomplete",
+                        epoch=self.osdmap.epoch)
             if state.needs_peer:
                 # Never serve ops from an unpeered PG: a partial view
                 # could miss acked writes held by the silent shard.
@@ -3229,6 +3358,15 @@ class OSDDaemon:
             result = -errno.EAGAIN
         elif result == 0 and txn.ops:
             self.perf.inc("op_w")
+            if self.pg_ledger.enabled:
+                # >= min_size but < size: the write will ack while
+                # some shard has no live home — the degraded-window
+                # ledger counts exactly these acks (docs/TRACING.md
+                # "Control plane")
+                _pool = self.osdmap.pools.get(msg.pgid.pgid.pool)
+                if _pool is not None and \
+                        self._live_shards(state) < _pool.size:
+                    self.pg_ledger.degraded_ack(msg.pgid.pgid)
             if msg.snapc and int(msg.snapc[0]) > 0:
                 # copy-on-write before the mutation lands (reference
                 # PrimaryLogPG::make_writeable)
@@ -3690,8 +3828,67 @@ class OSDDaemon:
                 "decode_launches": qst.get("decode_launches", 0),
                 "repair_launches": qst.get("repair_launches", 0),
             },
+            "stuck_subwrites": self._stuck_subwrites(),
             "pgs": pgs,
         }
+
+    def _stuck_subwrites(self, mark: bool = False) -> list[dict]:
+        """EC client writes whose shard sub-writes have been in
+        flight past osd_stuck_subwrite_s (the PR 16 known reduction:
+        an op wedged across a SIGKILL re-peer used to stall
+        active+clean waits with no trace).  Surfaces each as
+        stuck_subwrite(pg) in `repair status`; with mark=True the
+        event is stamped on the op's timeline ONCE so slow-op blame
+        names it instead of a bare 'waiting after sub_write_sent'."""
+        raw = self.cct.conf.get("osd_stuck_subwrite_s")
+        thresh = 10.0 if raw is None else float(raw)
+        if thresh <= 0:
+            return []
+        now = time.time()
+        out: list[dict] = []
+        with self.pg_lock:
+            ec_pgs = [(pgid, st.backend)
+                      for pgid, st in self.pgs.items()
+                      if st.kind == "ec"]
+        for pgid, be in ec_pgs:
+            with be.lock:
+                waiting = list(be.waiting_commit)
+            for op in waiting:
+                if op.state != "committing" or \
+                        op.pending_commits <= 0:
+                    continue
+                top = op.top
+                age = (now - top.initiated_at) \
+                    if getattr(top, "is_tracked", False) else None
+                if age is None or age < thresh:
+                    continue
+                blame = f"stuck_subwrite({pgid})"
+                if mark and not any(n == blame
+                                    for _, n in top.events):
+                    top.mark_event(blame)
+                out.append({
+                    "pg": str(pgid),
+                    "blame": blame,
+                    "age_s": round(age, 3),
+                    "pending_shards": op.pending_commits,
+                    "version": str(op.version),
+                    "trace_id": top.trace.trace_id
+                    if top.trace is not None else None,
+                })
+        return out
+
+    def _asok_pg_ledger(self, cmd: dict) -> dict:
+        """`ceph daemon osd.N.asok pg ledger` (docs/TRACING.md
+        "Control plane"): the per-PG state-machine ledger — current
+        state + bounded transition ring per PG, peering/recovery
+        stage decomposition, O(peers) scan counters, degraded
+        windows, and the lat_peering_*/lat_recovery_* percentile
+        summaries."""
+        out = self.pg_ledger.dump(
+            last=int(cmd["last"]) if "last" in cmd else 8)
+        out["osd"] = self.osd_id
+        out["pg_state_counts"] = self.pg_ledger.pg_state_counts()
+        return out
 
     def _asok_launch_profile(self, cmd: dict) -> dict:
         """`ceph daemon osd.N.asok launch profile`: the host flight
@@ -3897,6 +4094,11 @@ class OSDDaemon:
                                           "ops": []}))
                         last = 0
                     continue
+                # stamp wedged EC sub-writes (PR 16's known reduction:
+                # a commit lost across a SIGKILL re-peer) onto their
+                # op timelines so blame() names stuck_subwrite(pg)
+                # instead of a generic "waiting after sub_write_sent"
+                self._stuck_subwrites(mark=True)
                 rep = self.op_tracker.slow_op_summary()
                 if rep["count"] or last:
                     self.mon_conn.send_message(
@@ -3916,6 +4118,11 @@ class OSDDaemon:
         per pool and in total."""
         with self.pg_lock:
             needing = list(self._pgs_needing_recovery)
+            # undersized-but-recovered PGs (down-not-out holes) are
+            # degraded too — without them a down OSD whose data all
+            # re-peered is invisible to PG_DEGRADED and mgr progress
+            needing += [p for p in self._pgs_undersized
+                        if p not in self._pgs_needing_recovery]
             pushes = list(self._split_push_pending)
             unfound = {pg: len(objs)
                        for pg, objs in self._unfound.items()}
@@ -3952,6 +4159,13 @@ class OSDDaemon:
         # recorder is a HOST singleton, and every co-hosted daemon
         # re-reporting it would make the mon's sum read n_daemons x
         # the real compile seconds (the launch-queue perf rule)
+        # control-plane ledger block (docs/TRACING.md "Control plane"):
+        # cumulative, coarsely rounded, None while nothing happened —
+        # so steady-state reports stay bit-identical and the
+        # _pgstats_should_send dedup keeps its keepalive cadence
+        lb = self.pg_ledger.pgstats_block()
+        if lb is not None:
+            rep["ledger"] = lb
         if self._profiler_reporter and self._profiler.enabled:
             w = self._profiler.compile_report()
             if w["events"]:

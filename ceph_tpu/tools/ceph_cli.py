@@ -12,6 +12,8 @@ commands this build's mon implements:
   python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd pool set NAME \
       {pg_num N | pg_autoscale_mode on|warn}  # pg_num up = split, down = merge
   python -m ceph_tpu.tools.ceph_cli -m HOST:PORT pg stat      # recovery counts
+  python -m ceph_tpu.tools.ceph_cli -m HOST:PORT progress     # mgr progress
+      # events (recovery/backfill/reshard completion fractions)
   python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd reweight ID W
   python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd drain ID  # weight -> 0
   python -m ceph_tpu.tools.ceph_cli -m HOST:PORT osd ok-to-stop ID
@@ -34,7 +36,10 @@ commands this build's mon implements:
       # ledger (docs/REPAIR.md);
       # `launch profile` = the device-plane flight recorder's launch
       # ledger, `compile ledger` = per-host jit-bucket compile
-      # attribution (docs/TRACING.md "Device plane")
+      # attribution (docs/TRACING.md "Device plane");
+      # `pg ledger` = the control-plane flight recorder: per-PG
+      # state-machine transitions, stage timings, degraded windows
+      # (docs/TRACING.md "Control plane")
   python -m ceph_tpu.tools.ceph_cli daemon /path/to/mon.0.asok \
       osdmap status
       # mon map-distribution ledger: full/incremental/keepalive sends,
@@ -77,7 +82,7 @@ def daemon_command(argv: list[str]) -> int:
     # `launch queue status`, hence the head-driven loop.
     heads = ("perf", "config", "log", "mesh", "launch", "launch queue",
              "repair", "osdmap", "compile", "prewarm", "bucket",
-             "bucket reshard", "bucket limit")
+             "bucket reshard", "bucket limit", "pg")
     while extra and prefix in heads:
         prefix = f"{prefix} {extra[0]}"
         extra = extra[1:]
@@ -153,6 +158,8 @@ def main(argv=None) -> int:
             cmd = {"prefix": "mon stat"}
         elif words == ["pg", "stat"]:
             cmd = {"prefix": "pg stat"}
+        elif words == ["progress"]:
+            cmd = {"prefix": "progress"}
         elif words[:4] == ["osd", "mclock", "profile", "get"]:
             cmd = {"prefix": "osd mclock profile get"}
         elif words[:4] == ["osd", "mclock", "profile", "set"] \

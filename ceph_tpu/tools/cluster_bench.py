@@ -308,6 +308,65 @@ def main(argv=None) -> int:
     return 0
 
 
+BLAME_STAGES = ("peering_s", "scan_s", "decode_s", "push_s",
+                "throttle_s")
+BLAME_COUNTERS = ("remote_lists", "objects_scanned",
+                  "objects_recovered", "transitions")
+
+
+def _ledger_snapshot(cluster) -> dict[int, dict]:
+    """Per-OSD cumulative pg_ledger blame block (osd/pg_ledger)."""
+    snaps: dict[int, dict] = {}
+    for osd in cluster.osds:
+        if osd is None:
+            continue
+        try:
+            snaps[osd.osd_id] = dict(osd.pg_ledger.blame_block())
+        except Exception:  # noqa: BLE001 - daemon mid-shutdown
+            pass
+    return snaps
+
+
+def _recovery_blame(before: dict[int, dict], after: dict[int, dict],
+                    ttac: float | None, window_s: float) -> dict:
+    """time_to_active_clean decomposition from the per-OSD control-
+    plane ledgers (ISSUE 19 payoff gate).  Per stage the value is the
+    MAX across OSDs of the window delta — concurrent recovery overlaps
+    across daemons, so the max approximates the critical path where a
+    sum would count the same wall-second n_osds times.  When the stage
+    total still exceeds ttac (stages overlap within one daemon too:
+    throttle inside push loops, scans while peers push) the stages are
+    folded proportionally onto ttac (`overlap_folded: true`) so the
+    published decomposition reads as shares of the clean wait; the raw
+    per-stage maxima ride along unfolded."""
+    deltas: dict[int, dict] = {}
+    for oid, a in after.items():
+        b = before.get(oid, {})
+        if a.get("transitions", 0) < b.get("transitions", 0):
+            b = {}   # daemon restarted mid-window: fresh ledger
+        deltas[oid] = {k: a.get(k, 0) - b.get(k, 0)
+                       for k in set(a) | set(b)}
+    raw = {k: round(max((d.get(k, 0.0) for d in deltas.values()),
+                        default=0.0), 4)
+           for k in BLAME_STAGES}
+    counters = {k: int(sum(d.get(k, 0) for d in deltas.values()))
+                for k in BLAME_COUNTERS}
+    block: dict = {"stages_raw_s": raw, **counters,
+                   "window_s": round(window_s, 2),
+                   "osds_reporting": len(deltas)}
+    raw_sum = sum(raw.values())
+    if ttac is not None and ttac > 0:
+        folded = raw_sum > ttac
+        scale = (ttac / raw_sum) if folded and raw_sum > 0 else 1.0
+        stages = {k: round(v * scale, 4) for k, v in raw.items()}
+        block.update(stages)
+        block["other_s"] = round(
+            max(0.0, ttac - sum(stages.values())), 4)
+        block["overlap_folded"] = folded
+        block["time_to_active_clean_s"] = ttac
+    return block
+
+
 def _main_scale(args) -> int:
     """The ROADMAP-item-5 scale row: where does the control plane
     actually stop scaling?  Epoch churn (split, merge, drain walk,
@@ -481,8 +540,31 @@ def _main_scale(args) -> int:
             mcmd({"prefix": "osd pool set", "pool": "churn",
                   "var": "pg_num", "val": val}, budget)
 
+        # recovery-blame EC lane (ISSUE 19): a small k=2,m=1 pool
+        # written BEFORE the churn so the kill/revive below has EC
+        # shards to reconstruct — the decode/push stages of the
+        # recovery_blame block need a PG that actually decodes.  The
+        # prewarm row already runs a live EC lane; reuse it there.
+        blame_pool_id = None
+        if not prewarm_ec:
+            mcmd({"prefix": "osd erasure-code-profile set",
+                  "name": "blame_ec",
+                  "profile": {"plugin": "jax", "technique": "cauchy",
+                              "k": "2", "m": "1",
+                              "stripe_unit": "1024"}})
+            mcmd({"prefix": "osd pool create", "name": "blameec",
+                  "type": "erasure",
+                  "erasure_code_profile": "blame_ec", "pg_num": 4})
+            bio = client.open_ioctx("blameec")
+            blame_payload = rng.integers(
+                0, 256, 16384, dtype=np.uint8).tobytes()
+            for i in range(8):
+                bio.write_full(f"blame_{i}", blame_payload)
+            blame_pool_id = bio.pool_id
+
         churn_t0 = time.time()
         epoch0 = c.mon.osdmap.epoch
+        ledger_t0 = _ledger_snapshot(c)
         pool_set(16)                       # split under load
         time.sleep(1.0)
         pool_set(8)                        # merge back (interleave-
@@ -508,6 +590,29 @@ def _main_scale(args) -> int:
         # kill/revive: heartbeat failure reports mark them down (a
         # burst the mon coalesces), revival re-boots them
         victims = [n // 2, n // 2 + 1]
+        # blame lane: make sure at least one victim holds blameec
+        # shards, so its fresh-store revive below forces a real
+        # reconstruct (decode + push) instead of a no-op re-peer
+        wipe_victim = None
+        if blame_pool_id is not None:
+            from ..osd.types import pg_t
+            holders: set[int] = set()
+            for seed in range(4):
+                try:
+                    _, acting, _, _ = c.mon.osdmap.pg_to_up_acting_osds(
+                        pg_t(blame_pool_id, seed))
+                    holders.update(o for o in acting if o >= 0)
+                except Exception:  # noqa: BLE001 - mapping gap
+                    pass
+            hit = [v for v in victims if v in holders]
+            if hit:
+                wipe_victim = hit[0]
+            else:
+                extra = [o for o in sorted(holders)
+                         if o != n - 1 and o not in victims]
+                if extra:
+                    wipe_victim = extra[0]
+                    victims.append(wipe_victim)
         for v in victims:
             c.kill_osd(v)
         # detection takes heartbeat * grace on the watching peers
@@ -520,6 +625,13 @@ def _main_scale(args) -> int:
         if not down_ok:
             fail.append("failure detection never marked victims down")
         for v in victims:
+            if v == wipe_victim and c.objectstore == "memstore":
+                # revive on an EMPTY store (a reimaged disk): MemStore
+                # data normally survives in-process, which would let
+                # re-peering skip reconstruction entirely — the blame
+                # lane needs the decode path to actually run
+                from ..store import create_store
+                c.osds[v].store = create_store(c.objectstore, None)
             c.revive_osd(v)
         if ec_io is not None:
             # resume once the map shows the revived daemons up: the
@@ -546,6 +658,38 @@ def _main_scale(args) -> int:
         except TimeoutError as e:
             row["time_to_active_clean_s"] = None
             fail.append(f"not active+clean: {e}")
+
+        # the ISSUE 19 payoff: decompose the churn's recovery into the
+        # control-plane ledger's stages (window = churn start through
+        # active+clean, so work done while writes still flowed counts)
+        blame = _recovery_blame(
+            ledger_t0, _ledger_snapshot(c),
+            row["time_to_active_clean_s"],
+            time.time() - churn_t0)
+        blame["map_epochs_churned"] = c.mon.osdmap.epoch - epoch0
+        row["recovery_blame"] = blame
+        if not prewarm_ec:
+            # the tier-1 --scale 16 gate (ISSUE 19 satellite): every
+            # stage must have recorded real time and the published
+            # decomposition must account for the clean wait.  The
+            # prewarm row keeps its store across revive (ISSUE 16's
+            # lane), so its decode stage legitimately reads zero —
+            # gate only the plain row.
+            zero = [s for s in BLAME_STAGES
+                    if blame["stages_raw_s"].get(s, 0.0) <= 0.0]
+            if zero:
+                fail.append(f"recovery_blame stages never ran: {zero}")
+            ttac = row["time_to_active_clean_s"]
+            if ttac:
+                total = sum(blame.get(s, 0.0) for s in BLAME_STAGES) \
+                    + blame.get("other_s", 0.0)
+                if abs(total - ttac) > 0.1 * ttac:
+                    fail.append(
+                        f"recovery_blame decomposition {total} "
+                        f"off time_to_active_clean {ttac} by >10%")
+            if blame.get("remote_lists", 0) <= 0:
+                fail.append("recovery_blame saw no remote collection "
+                            "lists (re-peer scan accounting dead)")
 
         # zero acked loss: every acked write reads back intact
         lost = 0

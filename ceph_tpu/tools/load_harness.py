@@ -808,6 +808,25 @@ def run_degraded_read_storm(n_osds: int = 12, objects: int = 6,
         # flight recorder) — the row carries its own explanation
         stages = cluster_stage_quantiles(c)
         summary = lat.summary()
+        # degraded-window ledger summary (ISSUE 19): how long the
+        # windows this storm opened stayed open, and how many client
+        # writes were acked while inside one — summed over daemons
+        deg_windows = deg_acked = deg_open = 0
+        deg_stage_s: dict[str, float] = {}
+        for osd in c.osds:
+            if osd is None:
+                continue
+            try:
+                t = osd.pg_ledger.totals()
+            except Exception:  # noqa: BLE001 - daemon mid-shutdown
+                continue
+            deg_windows += t.get("degraded_windows", 0)
+            deg_acked += t.get("degraded_acked", 0)
+            deg_open += t.get("degraded_open", 0)
+            for k in ("peering_s", "scan_s", "decode_s", "push_s",
+                      "throttle_s"):
+                deg_stage_s[k] = round(
+                    deg_stage_s.get(k, 0.0) + t.get(k, 0.0), 4)
     row = {
         "metric": "harness_degraded_read",
         "osds": n_osds, "objects_acked": len(acked),
@@ -822,6 +841,12 @@ def run_degraded_read_storm(n_osds: int = 12, objects: int = 6,
         "repair_reconstructed_bytes": rebuilt,
         "recovery_queued_ops": recovery_q,
         "stages": stages,
+        "degraded_ledger": {
+            "windows_closed": deg_windows,
+            "windows_open": deg_open,
+            "acked_writes_degraded": deg_acked,
+            "recovery_stage_s": deg_stage_s,
+        },
         "duration_s": round(time.perf_counter() - t_start, 1),
     }
     errors = summary.get("errors", 0) or 0

@@ -115,6 +115,25 @@ def collect(asok_dir: str) -> str:
                 else:
                     emit_type(name, ctype)
                     lines.append(f"{name}{labels} {val}")
+        # per-pool PG state gauges from the control-plane ledger
+        # (ISSUE 19): OSD daemons only — mons/others lack the command,
+        # and a missing surface must not count as a scrape error
+        if daemon.startswith("osd."):
+            try:
+                led = admin_command(path, {"prefix": "pg ledger"},
+                                    timeout=2)
+            except Exception:  # noqa: BLE001 - older daemon
+                led = None
+            counts = (led or {}).get("pg_state_counts")
+            if isinstance(counts, dict):
+                emit_type("ceph_tpu_pg_state", "gauge")
+                for pool, states in sorted(counts.items()):
+                    if not isinstance(states, dict):
+                        continue
+                    for state, n in sorted(states.items()):
+                        lines.append(
+                            f'ceph_tpu_pg_state{{daemon="{daemon}",'
+                            f'pool="{pool}",state="{state}"}} {n}')
     return "\n".join(lines) + "\n"
 
 

@@ -1,0 +1,487 @@
+"""Control-plane flight recorder tests (ISSUE 19, docs/TRACING.md
+"Control plane"): the per-PG state-machine ledger, degraded-window
+bookkeeping, the MPGStats/health/progress aggregation path up to the
+mon and mgr, the mon's command-dispatch instrumentation, and the
+stuck-subwrite blame surface.
+
+What must hold: every transition lands in the bounded per-PG ring
+with a daemon-wide monotonic seq; the off path records nothing; a
+degraded window closes exactly once no matter how many clean passes
+close it redundantly; the MPGStats `ledger` block is cumulative and
+equality-stable (keepalive dedup); PG_DEGRADED health detail says
+since WHEN; the mgr progress module drives a recovery event from
+first degraded report to 1.0 over a live 4-OSD kill/revive; and a
+wedged EC sub-write surfaces as stuck_subwrite(pg) instead of a bare
+'waiting after sub_write_sent'.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.osd.pg_ledger import NULL_STAGE, STAGES, PGLedger
+from ceph_tpu.osd.types import pg_t
+
+
+def _wait(pred, timeout=30.0, step=0.2):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return False
+
+
+# -- ledger core -------------------------------------------------------------
+
+def test_ring_bounded_and_seqs_monotonic():
+    """Transitions across several PGs: each ring evicts to maxlen,
+    seqs are daemon-wide monotonic (one total order over all PGs),
+    and the previous state's duration rides each entry."""
+    led = PGLedger("pg_ledger.t1", ring=4)
+    pgs = [pg_t(1, 0), pg_t(1, 1), pg_t(2, 0)]
+    for i in range(12):
+        led.transition(pgs[i % 3], f"s{i}", epoch=i + 1)
+    d = led.dump(last=None)
+    assert d["enabled"] and d["ring_size"] == 4
+    all_seqs = []
+    for pgid in pgs:
+        trans = d["pgs"][str(pgid)]["transitions"]
+        assert len(trans) == 4                    # ring evicted
+        assert all(t["dur_s"] >= 0.0 for t in trans)
+        all_seqs += [t["seq"] for t in trans]
+    assert len(set(all_seqs)) == len(all_seqs)    # globally unique
+    # per-PG rings are each internally ordered by the global seq
+    for pgid in pgs:
+        seqs = [t["seq"] for t in d["pgs"][str(pgid)]["transitions"]]
+        assert seqs == sorted(seqs)
+    assert max(all_seqs) == 12
+    assert d["totals"]["transitions"] == 12
+    assert led.perf.dump()["pg_transitions"] == 12
+    # epoch of the latest transition sticks to the record
+    assert d["pgs"][str(pgs[0])]["epoch"] == 10
+
+
+def test_disabled_null_path_records_nothing():
+    """enabled=False: every entry point no-ops after one attribute
+    check, stage() hands back the shared null context manager, and
+    the pgstats block stays None."""
+    led = PGLedger("pg_ledger.t2", ring=4)
+    led.enabled = False
+    pg = pg_t(1, 0)
+    led.transition(pg, "peering")
+    led.count(pg, "remote_lists", 5)
+    led.degraded_open(pg)
+    led.degraded_ack(pg)
+    assert led.degraded_close(pg) is False
+    s = led.stage(pg, "scan")
+    assert s is NULL_STAGE
+    with s:
+        pass
+    t = led.totals()
+    assert t["transitions"] == 0 and t["remote_lists"] == 0
+    assert t["degraded_open"] == 0 and t["degraded_acked"] == 0
+    assert led.pgstats_block() is None
+    assert led.perf.dump()["pg_transitions"] == 0
+    assert led.dump()["pgs"] == {}
+
+
+def test_degraded_window_closes_exactly_once():
+    """degraded_ack opens the window; only the FIRST close ends it
+    (clean recovery passes close redundantly every cycle); the open
+    gauge returns to zero and the window duration lands in
+    lat_degraded_window exactly once."""
+    led = PGLedger("pg_ledger.t3")
+    pg = pg_t(3, 1)
+    assert led.degraded_close(pg) is False       # never opened
+    led.degraded_ack(pg)
+    led.degraded_ack(pg)                          # still ONE window
+    t = led.totals()
+    assert t["degraded_open"] == 1
+    assert t["degraded_acked"] == 2
+    assert t["degraded_oldest_since"] is not None
+    assert led.perf.dump()["pg_degraded_open_windows"] == 1
+    assert led.degraded_close(pg) is True
+    for _ in range(3):                            # redundant closes
+        assert led.degraded_close(pg) is False
+    t = led.totals()
+    assert t["degraded_windows"] == 1
+    assert t["degraded_open"] == 0
+    assert t["degraded_oldest_since"] is None
+    d = led.perf.dump()
+    assert d["pg_degraded_open_windows"] == 0
+    assert d["pg_degraded_windows"] == 1
+    assert led.perf.dump_latencies()["lat_degraded_window"][
+        "count"] == 1
+    # a second episode is a fresh window
+    led.degraded_open(pg)
+    assert led.degraded_close(pg) is True
+    assert led.totals()["degraded_windows"] == 2
+
+
+def test_stage_timing_counters_and_blame_block():
+    """The stage context manager accumulates per-PG wall seconds into
+    the right histogram axis (peering -> lat_peering_total, the rest
+    -> lat_recovery_*), counters sum daemon-wide, and blame_block
+    carries the full decomposition cluster_bench diffs."""
+    led = PGLedger("pg_ledger.t4")
+    pg = pg_t(1, 0)
+    for name in STAGES:
+        with led.stage(pg, name):
+            time.sleep(0.002)
+    led.count(pg, "remote_lists", 3)
+    led.count(pg, "objects_scanned", 7)
+    led.count(pg, "objects_recovered", 2)
+    led.transition(pg, "recovering")
+    t = led.totals()
+    for name in STAGES:
+        assert t[f"{name}_s"] > 0.0, name
+    assert t["remote_lists"] == 3 and t["objects_scanned"] == 7
+    lat = led.perf.dump_latencies()
+    assert lat["lat_peering_total"]["count"] == 1
+    for name in ("scan", "decode", "push", "throttle"):
+        assert lat[f"lat_recovery_{name}"]["count"] == 1, name
+    blame = led.blame_block()
+    assert set(blame) == {
+        "peering_s", "scan_s", "decode_s", "push_s", "throttle_s",
+        "remote_lists", "objects_scanned", "objects_recovered",
+        "transitions", "degraded_windows", "degraded_acked"}
+    assert blame["transitions"] == 1
+    assert blame["objects_recovered"] == 2
+
+
+def test_pgstats_block_empty_then_stable():
+    """None while nothing happened (boot reports stay lean), then a
+    cumulative block whose repr is bit-identical between quiescent
+    stat windows — the property the MPGStats keepalive dedup needs."""
+    led = PGLedger("pg_ledger.t5")
+    assert led.pgstats_block() is None
+    pg = pg_t(4, 0)
+    led.transition(pg, "active", epoch=3)
+    b1 = led.pgstats_block()
+    assert b1 is not None and b1["transitions"] == 1
+    assert b1["degraded_oldest_since"] is None
+    assert led.pgstats_block() == b1              # quiescent == stable
+    led.degraded_ack(pg)
+    b2 = led.pgstats_block()
+    assert b2 != b1 and b2["degraded_open"] == 1
+    assert b2["degraded_acked"] == 1
+
+
+def test_pg_state_counts_and_ring_resize():
+    led = PGLedger("pg_ledger.t6", ring=8)
+    led.transition(pg_t(1, 0), "active")
+    led.transition(pg_t(1, 1), "active")
+    led.transition(pg_t(2, 0), "peering")
+    led.degraded_ack(pg_t(1, 1))
+    counts = led.pg_state_counts()
+    assert counts[1]["active"] == 2
+    assert counts[2]["peering"] == 1
+    assert counts[1]["degraded"] == 1             # pseudo-state
+    for _ in range(6):
+        led.transition(pg_t(1, 0), "thrash")
+    led.set_ring_size(2)
+    d = led.dump(last=None)
+    assert all(len(p["transitions"]) <= 2 for p in d["pgs"].values())
+
+
+# -- cluster: transitions, asok, MPGStats, exporter --------------------------
+
+def test_cluster_kill_revive_ledger_and_surfaces(tmp_path):
+    """Live 4-OSD kill/revive: the ledgers record transitions and the
+    O(peers) scan counters, `pg ledger` round-trips over the asok
+    (both the unquoted ceph_cli fold and the underscore spelling),
+    the MPGStats `ledger` block reaches the mon's report store, and
+    the exporter emits per-pool ceph_tpu_pg_state gauges."""
+    from ceph_tpu.tools import ceph_cli
+    from ceph_tpu.tools.metrics_exporter import collect
+    from ceph_tpu.tools.vstart import Cluster
+    with Cluster(n_osds=4, asok_dir=str(tmp_path)) as c:
+        client = c.client()
+        client.set_ec_profile("led21", {
+            "plugin": "jax", "k": "2", "m": "1",
+            "technique": "cauchy", "stripe_unit": "1024"})
+        client.create_pool("ledpool", "erasure",
+                           erasure_code_profile="led21", pg_num=4)
+        io = client.open_ioctx("ledpool")
+        rng = np.random.default_rng(19)
+        for i in range(6):
+            io.write_full(f"led{i}",
+                          rng.integers(0, 256, 3000,
+                                       dtype=np.uint8).tobytes())
+        c.kill_osd(1)
+        c.mark_osd_down(1)
+        assert _wait(lambda: not c.mon.osdmap.is_up(1))
+        # (no writes through the window: with the holder down-not-out
+        # the acting set is short and peering stays incomplete, so
+        # client writes EAGAIN until the revive — the scan counters
+        # below come from the re-peer recovery pass itself)
+        c.revive_osd(1)
+        c.wait_active_clean(timeout=120.0)
+
+        def led_totals():
+            return [o.pg_ledger.totals() for o in c.osds
+                    if o is not None]
+        assert sum(t["transitions"] for t in led_totals()) > 0
+        assert _wait(lambda: sum(t["remote_lists"]
+                                 for t in led_totals()) > 0)
+        assert sum(t["objects_scanned"] for t in led_totals()) > 0
+        # windows opened by the churn all closed by active+clean
+        assert sum(t["degraded_open"] for t in led_totals()) == 0
+
+        # asok handler + both CLI spellings
+        out = c.osds[0]._asok_pg_ledger({})
+        assert out["enabled"] and out["osd"] == 0
+        assert "pg_state_counts" in out and "latencies" in out
+        asok = c.osds[0].cct.asok.path
+        assert ceph_cli.daemon_command([asok, "pg", "ledger"]) == 0
+        assert ceph_cli.daemon_command([asok, "pg_ledger"]) == 0
+
+        # the MPGStats ledger block lands in the mon's report store
+        def mon_has_block():
+            with c.mon.lock:
+                reps = list(c.mon.pg_stat_reports.values())
+            return any(isinstance(r.get("ledger"), dict)
+                       and r["ledger"].get("transitions", 0) > 0
+                       for r in reps)
+        assert _wait(mon_has_block, timeout=30.0)
+
+        # exporter: per-pool PG state gauges from the same ledger
+        text = collect(str(tmp_path))
+        assert "ceph_tpu_pg_state{" in text
+        state_lines = [ln for ln in text.splitlines()
+                       if ln.startswith("ceph_tpu_pg_state{")]
+        assert any('state="active"' in ln or 'state="clean"' in ln
+                   for ln in state_lines)
+
+
+# -- mon: PG_DEGRADED since + dispatch instrumentation ----------------------
+
+def test_health_degraded_since_detail():
+    """The health check's detail rows say since WHEN: a pgstats
+    report carrying the ledger's degraded_oldest_since gets the
+    ', degraded since <stamp> (<age>s ago)' suffix; one without the
+    block keeps the bare row (mixed-version clusters)."""
+    from ceph_tpu.tools.vstart import Cluster
+    with Cluster(n_osds=2) as c:
+        mon = c.mon
+        base = {"degraded_pgs": 2, "misplaced": 0, "unfound": 0,
+                "recovering": 0, "epoch": 1, "pools": {},
+                "ts": time.time()}
+        with mon.lock:
+            mon.pg_stat_reports[0] = dict(
+                base, ledger={"degraded_oldest_since":
+                              time.time() - 42.0})
+        _rc, health = mon.handle_command({"prefix": "health"})
+        deg = health["checks"]["PG_DEGRADED"]
+        assert "degraded since " in deg["detail"][0]
+        assert "s ago)" in deg["detail"][0]
+        with mon.lock:
+            mon.pg_stat_reports[0] = dict(base)   # no ledger block
+        _rc, health = mon.handle_command({"prefix": "health"})
+        assert "degraded since" not in \
+            health["checks"]["PG_DEGRADED"]["detail"][0]
+
+
+def test_mon_dispatch_depth_and_latency_histograms():
+    """Every messenger-dispatched mon command rides the timed wrapper:
+    the total counter and the per-prefix + aggregate dispatch
+    histograms advance, and the depth gauge returns to zero at
+    rest (it only exceeds 1 while dispatch threads queue behind the
+    mon lock)."""
+    from ceph_tpu.tools.vstart import Cluster
+    with Cluster(n_osds=2) as c:
+        client = c.client()
+        before = c.mon.perf.dump().get("mon_commands", 0)
+        for _ in range(3):
+            r, _out = client.mon_command({"prefix": "pg stat"})
+            assert r == 0
+        r, _out = client.mon_command({"prefix": "status"})
+        assert r == 0
+        d = c.mon.perf.dump()
+        assert d["mon_commands"] >= before + 4
+        assert d["mon_dispatch_depth"] == 0       # quiesced
+        lat = c.mon.perf.dump_latencies()
+        assert lat["lat_mon_dispatch"]["count"] >= 4
+        assert lat["lat_mon_dispatch_pg_stat"]["count"] >= 3
+        assert lat["lat_mon_dispatch"]["p99"] >= 0.0
+
+
+# -- mgr progress: recovery event reaches 1.0 --------------------------------
+
+def test_progress_recovery_event_reaches_completion(tmp_path):
+    """The acceptance path: a 4-OSD cluster loses an OSD, the mgr
+    progress module derives a recovery event from `pg stat`, the
+    event's fraction climbs monotonically while the cluster heals,
+    and after active+clean it reaches 1.0 — visible through the
+    `progress` mon command, the `status` one-liners, and ceph_cli."""
+    from ceph_tpu.mgr.daemon import MgrDaemon
+    from ceph_tpu.mgr.modules import ProgressModule
+    from ceph_tpu.tools import ceph_cli
+    from ceph_tpu.tools.vstart import Cluster
+    with Cluster(n_osds=4) as c:
+        client = c.client()
+        client.set_ec_profile("pr21", {
+            "plugin": "jax", "k": "2", "m": "1",
+            "technique": "cauchy", "stripe_unit": "1024"})
+        client.create_pool("prpool", "erasure",
+                           erasure_code_profile="pr21", pg_num=8)
+        io = client.open_ioctx("prpool")
+        rng = np.random.default_rng(7)
+        for i in range(8):
+            io.write_full(f"pr{i}",
+                          rng.integers(0, 256, 3000,
+                                       dtype=np.uint8).tobytes())
+        mgr = MgrDaemon(c.mon_addrs, modules=[ProgressModule])
+        prog = next(m for m in mgr.modules
+                    if isinstance(m, ProgressModule))
+        # drive tick() deterministically (the sampled-thread rule,
+        # test_mgr_modules): the background loop waits run_interval
+        # FIRST, so a huge interval means manual ticks only
+        prog.run_interval = 3600.0
+        mgr.start()
+        try:
+            prog.tick()                            # healthy: no event
+            r, out = client.mon_command({"prefix": "progress"})
+            assert r == 0 and out["events"] == []
+
+            # throttle recovery so the degraded window outlives the
+            # 0.5s MPGStats cadence (tiny objects rebuild in ms)
+            for osd in c.osds:
+                if osd is not None:
+                    osd.cct.conf.set("osd_recovery_sleep", "0.4")
+            c.kill_osd(3)
+            c.mark_osd_down(3)
+
+            def degraded_reported():
+                r, out = client.mon_command({"prefix": "pg stat"})
+                return r == 0 and out["degraded_pgs"] > 0
+            assert _wait(degraded_reported, timeout=30.0)
+            prog.tick()
+            r, out = client.mon_command({"prefix": "progress"})
+            assert r == 0
+            ev = next(e for e in out["events"] if e["id"] == "recovery")
+            assert ev["progress"] < 1.0
+            assert "Recovery" in ev["message"]
+            assert ev["finished_at"] is None
+            first_frac = ev["progress"]
+
+            for osd in c.osds:
+                if osd is not None:
+                    osd.cct.conf.set("osd_recovery_sleep", "0.0")
+            c.revive_osd(3)
+            c.wait_active_clean(timeout=120.0)
+
+            def reaches_one():
+                prog.tick()
+                r, out = client.mon_command({"prefix": "progress"})
+                evs = {e["id"]: e for e in out["events"]}
+                return r == 0 and \
+                    evs.get("recovery", {}).get("progress") == 1.0
+            assert _wait(reaches_one, timeout=60.0, step=0.5)
+            r, out = client.mon_command({"prefix": "progress"})
+            ev = next(e for e in out["events"] if e["id"] == "recovery")
+            assert ev["progress"] >= first_frac    # monotone
+            assert ev["finished_at"] is not None
+            assert any("100.0%" in ln for ln in out["lines"])
+
+            # the status one-liners carry the lingering event
+            r, out = client.mon_command({"prefix": "status"})
+            assert r == 0
+            assert any("Recovery" in ln for ln in out["progress"])
+
+            # and the ceph_cli surface answers end to end
+            host, port = c.mon_addrs[0]
+            assert ceph_cli.main(
+                ["-m", f"{host}:{port}", "progress"]) == 0
+        finally:
+            mgr.shutdown()
+
+
+def test_progress_module_baseline_monotone_unit():
+    """The episodic baseline model, no cluster: a count that wobbles
+    UP mid-episode raises the baseline instead of walking the
+    published fraction backwards, and zero ends the episode at 1.0."""
+    from ceph_tpu.mgr.modules import ProgressModule
+    pushed = []
+
+    class FakeMgr:
+        health = {}
+
+        def mon_command(self, cmd):
+            pushed.append(dict(cmd))
+            return 0, {}
+    prog = ProgressModule(FakeMgr())
+    prog._track("recovery", "Recovery", 10)       # baseline 10
+    prog._track("recovery", "Recovery", 5)        # 0.5
+    prog._track("recovery", "Recovery", 8)        # wobble up: base 10
+    prog._track("recovery", "Recovery", 2)        # 0.8
+    prog._track("recovery", "Recovery", 0)        # done -> 1.0
+    fracs = [p["progress"] for p in pushed]
+    assert fracs == sorted(fracs)                 # monotone
+    assert fracs[-1] == 1.0
+    assert fracs[0] == 0.0 and fracs[2] == 0.5    # wobble held at 0.5
+    assert "done" in pushed[-1]["message"]
+    # episode state cleared: the next episode starts a fresh baseline
+    assert prog._baseline == {} and prog.events == {}
+
+
+# -- stuck EC sub-writes -----------------------------------------------------
+
+def test_stuck_subwrite_blame_surfaces(tmp_path):
+    """A wedged EC client write (committing, pending shard commits,
+    older than osd_stuck_subwrite_s) surfaces as stuck_subwrite(pg)
+    in the scan and `repair status`, and mark=True stamps the blame
+    event on the op's timeline exactly once; threshold 0 disables."""
+    from ceph_tpu.osd.ec_backend import ECOp
+    from ceph_tpu.osd.ec_transaction import PGTransaction
+    from ceph_tpu.osd.types import eversion_t
+    from ceph_tpu.tools.vstart import Cluster
+    with Cluster(n_osds=4, asok_dir=str(tmp_path)) as c:
+        client = c.client()
+        client.set_ec_profile("sw21", {
+            "plugin": "jax", "k": "2", "m": "1",
+            "technique": "cauchy", "stripe_unit": "1024"})
+        client.create_pool("swpool", "erasure",
+                           erasure_code_profile="sw21", pg_num=4)
+        io = client.open_ioctx("swpool")
+        io.write_full("sw0", b"x" * 3000)
+        pgid = c.mon.osdmap.object_to_pg(
+            c.mon.osdmap.lookup_pool("swpool").id, "sw0")
+        _, _, _, primary = c.mon.osdmap.pg_to_up_acting_osds(pgid)
+        osd = c.osds[primary]
+        be = osd._get_pg(pgid).backend
+        top = osd.op_tracker.create("osd_op", "wedged-subwrite")
+        top.initiated_at = time.time() - 60.0     # long past threshold
+        op = ECOp(txn=PGTransaction(), version=eversion_t(9, 999),
+                  on_commit=lambda: None)
+        op.state = "committing"
+        op.pending_commits = 2
+        op.top = top
+        with be.lock:
+            be.waiting_commit.append(op)
+        try:
+            out = osd._stuck_subwrites()
+            assert len(out) == 1
+            assert out[0]["blame"] == f"stuck_subwrite({pgid})"
+            assert out[0]["pending_shards"] == 2
+            assert out[0]["age_s"] >= 50.0
+            # mark stamps the timeline event EXACTLY once
+            osd._stuck_subwrites(mark=True)
+            osd._stuck_subwrites(mark=True)
+            blames = [n for _ts, n in top.events
+                      if n == f"stuck_subwrite({pgid})"]
+            assert len(blames) == 1
+            # the repair-status asok carries the scan
+            rep = osd._asok_repair_status({})
+            assert any(s["blame"] == f"stuck_subwrite({pgid})"
+                       for s in rep["stuck_subwrites"])
+            # threshold 0 disables the scan entirely
+            osd.cct.conf.set("osd_stuck_subwrite_s", "0")
+            assert osd._stuck_subwrites() == []
+        finally:
+            osd.cct.conf.set("osd_stuck_subwrite_s", "10.0")
+            with be.lock:
+                be.waiting_commit.remove(op)
+            osd.op_tracker.unregister(top, 0)
