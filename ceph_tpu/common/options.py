@@ -93,6 +93,40 @@ register_options([
     Option("ms_compress_min_size", int, 4096,
            "only compress frames at least this large (reference "
            "ms_osd_compress_min_size)", min=0),
+    Option("ms_async_op_threads", int, 0,
+           "reactor pool size (reference ms_async_op_threads); 0 = "
+           "auto (max(1, min(4, cpu_count))).  Startup-only: the pool "
+           "is created with the first messenger and pinned loops "
+           "cannot be resized live", min=0, max=64,
+           flags=("startup",)),
+    Option("ms_sync_timeout", float, 30.0,
+           "deadline of the blocking bridge into the reactor "
+           "(Messenger._run_sync; was a hardcoded 30 s); expiries "
+           "count in the wire ledger's msgr_sync_timeouts", min=0.1),
+    # wire-plane flight recorder (docs/TRACING.md "Wire plane")
+    Option("ms_ledger", bool, True,
+           "record per-connection wire accounting, reactor loop-lag "
+           "probes and dispatch-executor wait/run histograms in the "
+           "wire-plane ledger (msg/msgr_ledger.py): feeds the "
+           "`messenger status` / `conn profile` asoks, the MPGStats "
+           "msgr block, the MSGR_REACTOR_LAG health warning and "
+           "cluster_bench's msgr_ledger rows; off = the null fast "
+           "path"),
+    Option("ms_ledger_peers", int, 256,
+           "peers kept per messenger in the bounded per-connection "
+           "table (oldest evicted past the cap)", Level.DEV, min=1),
+    Option("ms_reactor_lag_interval", float, 0.25,
+           "seconds between reactor loop-lag probe fires; a probe "
+           "arriving a FULL extra interval late counts as a lag event "
+           "(the heartbeat tick-lag rule)", min=0.01),
+    Option("ms_reactor_lag_warn_s", float, 1.0,
+           "worst in-window reactor lag above which the mon raises "
+           "the MSGR_REACTOR_LAG health warning (rides the MPGStats "
+           "msgr block, so the mon needs no config)", min=0.0),
+    Option("ms_inject_dispatch_stall", float, 0.0,
+           "fault injection: sleep this long in the messenger send "
+           "path before every wire write — a stalled dispatch for the "
+           "slow-op blame / ledger gates", Level.DEV, min=0.0),
     # osd
     Option("osd_heartbeat_interval", float, 1.0,
            "seconds between peer pings", min=0.05),

@@ -308,13 +308,19 @@ class OpTracker:
         if result is not None:
             top.result = result
         newly_slow = False
-        if self.complaint_time > 0 and not top.slow and \
+        if self.complaint_time > 0 and \
                 top.op_type in COMPLAINT_OP_TYPES and \
                 top.duration() > self.complaint_time:
-            top.slow = True
-            top.slow_since = top.completed_at
+            newly_slow = not top.slow
+            if newly_slow:
+                top.slow = True
+                top.slow_since = top.completed_at
+            # final blame from the COMPLETE timeline: an op the
+            # in-flight scanner latched carries a provisional
+            # "waiting after X" (the stall was still open when it was
+            # scanned) — once the op finishes, the stage that actually
+            # ended the wait (e.g. a late msgr_send(peer)) owns it
             top.blamed_stage = top.blame()
-            newly_slow = True
         with self._lock:
             self._inflight.pop(id(top), None)
             self._history.append(top)

@@ -227,6 +227,19 @@ class Monitor:
                 lambda cmd: {self.perf.name: self.perf.dump()})
             self.asok.register_command(
                 "mon_status", lambda cmd: self.quorum_status())
+            # wire-plane flight recorder (docs/TRACING.md "Wire
+            # plane"); both spellings like the OSD asoks
+            for prefix in ("messenger status", "messenger_status"):
+                self.asok.register_command(
+                    prefix, lambda cmd: dict(
+                        self.messenger.ledger.status(),
+                        daemon=self.messenger.stats.totals()))
+            for prefix in ("conn profile", "conn_profile"):
+                self.asok.register_command(
+                    prefix, lambda cmd: self.messenger.ledger
+                    .conn_profile(
+                        last=int(cmd["last"]) if "last" in cmd
+                        else None))
 
     # -- the replicated multi-service value ---------------------------------
 
@@ -1871,6 +1884,37 @@ class Monitor:
                     f"{c.get('stalls', 0)} stalls), worst bucket "
                     f"{c.get('worst_bucket')} ({c.get('worst_s')}s)"
                     for o, c in sorted(storms)],
+            }
+        # MSGR_REACTOR_LAG: wire-plane reactor starvation (msg/
+        # msgr_ledger.py) — a reactor's loop-lag probe fired late by
+        # more than the reporter's conf'd warn threshold inside its
+        # window.  Same ride-the-report pattern as COMPILE_STORM: the
+        # warn threshold (ms_reactor_lag_warn_s) ships with each
+        # report, so the mon needs no config and mixed-conf clusters
+        # warn per-host.  Names the worst daemon/reactor so "boot RT
+        # >10s" blames a starved loop instead of staying folklore.
+        lags = [(o, r["msgr"]) for o, r in pg_stats.items()
+                if isinstance(r.get("msgr"), dict)
+                and r["msgr"].get("worst_lag_s", 0.0)
+                > r["msgr"].get("warn_s", float("inf"))]
+        if lags:
+            worst_o, worst_m = max(
+                lags, key=lambda t: t[1].get("worst_lag_s", 0.0))
+            daemons = ", ".join(f"osd.{o}" for o, _m in sorted(lags))
+            checks["MSGR_REACTOR_LAG"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"messenger reactor lag up to "
+                           f"{worst_m.get('worst_lag_s')}s (worst "
+                           f"osd.{worst_o} reactor "
+                           f"{worst_m.get('worst_reactor')}), hosts "
+                           f"[{daemons}] over threshold",
+                "detail": [
+                    f"osd.{o}: worst lag {m.get('worst_lag_s')}s on "
+                    f"reactor {m.get('worst_reactor')} "
+                    f"({m.get('lag_events', 0)} lag events in "
+                    f"{m.get('window_s')}s window, warn threshold "
+                    f"{m.get('warn_s')}s)"
+                    for o, m in sorted(lags)],
             }
         status = "HEALTH_WARN" if checks else "HEALTH_OK"
         return 0, {"status": status, "checks": checks}

@@ -44,10 +44,12 @@ import struct
 import threading
 import time
 import uuid
+from concurrent.futures import TimeoutError as _FuturesTimeout
 from typing import Callable
 
 from .message import (CTRL_ACK, CTRL_COMP, CTRL_ENC, CTRL_HELLO, Message,
                       encode_frame)
+from .msgr_ledger import MsgrLedger, msgr_ledger
 
 Dispatcher = Callable[["Connection", Message], None]
 
@@ -292,9 +294,27 @@ class Connection:
         self._closed = False
         self.last_error: str | None = None
         self.peer_entity: str | None = None
+        self._label: str | None = None   # cached ledger peer label
 
     def is_connected(self) -> bool:
         return self.session.writer is not None and not self._closed
+
+    def _peer_label(self) -> str:
+        """Short peer name for ledger rows / trace events: the peer
+        entity with its per-process uuid dropped ('osd.3'), else
+        ip:port.  Cached once the entity is known (it never changes
+        afterwards)."""
+        lab = self._label
+        if lab is None:
+            ent = self.peer_entity
+            if ent:
+                lab = ent.rsplit(".", 1)[0] or ent
+                self._label = lab
+            elif self.peer_addr:
+                lab = f"{self.peer_addr[0]}:{self.peer_addr[1]}"
+            else:
+                lab = "?"
+        return lab
 
     # -- sending (thread-safe entry) ---------------------------------------
 
@@ -303,6 +323,7 @@ class Connection:
 
     async def _send(self, msg: Message) -> None:
         sess = self.session
+        m = self.messenger
         async with sess.send_lock:
             if sess.broken:
                 if not self.can_reconnect:
@@ -320,14 +341,23 @@ class Connection:
                 sess.out_seq = 1            # fresh epoch
                 raw = msg.encode_parts(1)
                 sess.record_out(1, raw)
+            if m.inject_dispatch_stall > 0:
+                # fault injection (conf ms_inject_dispatch_stall): the
+                # assembled frame sits in the send queue while the
+                # reactor "works" — a stalled dispatch's exact shape;
+                # the late msgr_send(peer) stamp inherits the blame
+                await asyncio.sleep(m.inject_dispatch_stall)
             try:
                 if sess.writer is None:
                     if not self.can_reconnect:
                         return  # replayed when the peer reconnects
                     await self._connect()
                     if self.lossless:
-                        return  # _connect's replay already carried raw
+                        # _connect's replay already carried raw
+                        self._note_sent(msg, raw)
+                        return
                 await self._write_raw(raw)
+                self._note_sent(msg, raw)
             except (ConnectionError, OSError, asyncio.TimeoutError,
                     asyncio.IncompleteReadError, ValueError) as e:
                 # IncompleteReadError (EOF mid-HELLO) and ValueError
@@ -336,6 +366,26 @@ class Connection:
                 # sess.unacked with no reconnect scheduled
                 self.last_error = str(e)
                 await self._reconnect()
+
+    def _note_sent(self, msg: Message, raw) -> None:
+        """Wire-plane ledger + trace stitch for one sent frame; one
+        attribute check when the ledger is off."""
+        m = self.messenger
+        if not m.ledger.enabled:
+            return
+        parts = raw if isinstance(raw, tuple) else (raw,)
+        nbytes = 0
+        for p in parts:
+            nbytes += len(p)
+        peer = self._peer_label()
+        m.stats.note_send(peer, type(msg).__name__, nbytes,
+                          len(self.session.unacked))
+        top = getattr(msg, "_top", None)
+        if top is not None and getattr(top, "is_tracked", False):
+            # the interval ENDING here (send-queue + wire write) lands
+            # on the op timeline named by peer, so slow-op blame can
+            # say "5.1 s in the send queue to osd.7"
+            top.mark_event(f"msgr_send({peer})")
 
     async def _write_raw(self, raw: bytes) -> None:
         """Single choke point for outgoing bytes: fault injection hooks
@@ -359,7 +409,15 @@ class Connection:
         parts = raw if isinstance(raw, tuple) else (raw,)
         if sess.comp is not None or (sess.secure and sess.conn_key):
             # compression/encryption wrap the whole frame: join first
-            writer.write(sess.wire_prepare(b"".join(parts)))
+            joined = b"".join(parts)
+            wired = sess.wire_prepare(joined)
+            if m.ledger.enabled:
+                m.stats.note_wrapped(
+                    self._peer_label(), len(wired),
+                    compressed=sess.comp is not None and
+                    len(joined) >= sess.comp_min,
+                    encrypted=bool(sess.secure and sess.conn_key))
+            writer.write(wired)
         else:
             # writev-style: payload buffers go to the transport as-is,
             # never copied into one frame buffer
@@ -447,7 +505,10 @@ class Connection:
             sess.last_acked = 0
             sess.peer_cookie = cookie
         sess.reader, sess.writer = reader, writer
-        for raw in sess.replay_frames(int(meta.get("in_seq", 0))):
+        frames = sess.replay_frames(int(meta.get("in_seq", 0)))
+        if frames and m.ledger.enabled:
+            m.stats.note_replay(self._peer_label(), len(frames))
+        for raw in frames:
             writer.write(sess.wire_prepare(raw))
         await writer.drain()
         self.messenger._spawn_read_loop(self)
@@ -458,6 +519,9 @@ class Connection:
         if not self.lossless or not self.can_reconnect or \
                 self.peer_addr is None or self._closed:
             return
+        m = self.messenger
+        if m.ledger.enabled:
+            m.stats.note_reconnect(self._peer_label())
         for attempt in range(5):
             try:
                 await asyncio.sleep(0.05 * (attempt + 1))
@@ -507,9 +571,23 @@ class Messenger:
     _loop_lock = threading.Lock()
     # pool size (reference ms_async_op_threads): loops beyond the core
     # count only add context switches — measured on a 1-core host,
-    # 4 loops made the 8-way 128 KiB fan-out *slower* (4.8 vs 4.2 ms)
+    # 4 loops made the 8-way 128 KiB fan-out *slower* (4.8 vs 4.2 ms).
+    # The auto default; conf ms_async_op_threads overrides it through
+    # configure_pool() BEFORE the first messenger exists.
     import os as _os
     REACTORS = max(1, min(4, _os.cpu_count() or 1))
+
+    @classmethod
+    def configure_pool(cls, reactors) -> None:
+        """Startup sizing of the reactor pool (conf
+        ms_async_op_threads): applies to the NEXT pool creation — an
+        already-running pool keeps its size (pinned loops cannot be
+        resized live; the reference reads ms_async_op_threads once at
+        start too).  0/None keeps the cpu-count auto size."""
+        if reactors:
+            n = int(reactors)
+            if n > 0:
+                cls.REACTORS = n
 
     def __init__(self, name: str = "client", auth=None,
                  secure: bool = False):
@@ -549,6 +627,17 @@ class Messenger:
         self.inject_delay_max = 0.0
         self.injected_failures = 0
         self._inject_rng = random.Random(0xC3B7)
+        # conf ms_inject_dispatch_stall: sleep this long in the send
+        # path before the wire write (a stalled dispatch for the
+        # slow-op blame gates)
+        self.inject_dispatch_stall = 0.0
+        # blocking-bridge deadline (conf ms_sync_timeout; was a
+        # hardcoded 30 s) — expiries count in msgr_sync_timeouts
+        self.sync_timeout = 30.0
+        # wire-plane flight recorder (msg/msgr_ledger.py): the
+        # process ledger plus this messenger's own counter slice
+        self.ledger = MsgrLedger.host_instance()
+        self.stats = self.ledger.register_messenger(self.entity)
         # pin this messenger to one loop of the pool for its lifetime
         self._loop = self._pick_loop()
 
@@ -582,6 +671,9 @@ class Messenger:
                     t.start()
                     cls._loops.append(loop)
                     cls._loop_threads.append(t)
+                # arm the per-reactor loop-lag probe on the fresh pool
+                # (wire-plane flight recorder, msg/msgr_ledger.py)
+                msgr_ledger().attach_reactors(cls._loops)
             return cls._loops
 
     @classmethod
@@ -602,14 +694,23 @@ class Messenger:
     def submit_dispatch(cls, fn, *args) -> None:
         """dispatch_executor().submit with the exception fence the
         bare Future lacks: a pipeline continuation that raises must
-        surface a traceback, not die unobserved in the Future."""
+        surface a traceback, not die unobserved in the Future.  Queue
+        wait and run time land in the wire-plane ledger's
+        lat_msgr_qwait / lat_msgr_dispatch histograms."""
+        led = msgr_ledger()
+        t_sub = led.dispatch_submit() if led.enabled else None
 
         def run():
+            t_run = led.dispatch_run(t_sub) if t_sub is not None \
+                else None
             try:
                 fn(*args)
             except Exception:  # noqa: BLE001
                 import traceback
                 traceback.print_exc()
+            finally:
+                if t_run is not None:
+                    led.dispatch_done(t_run)
 
         cls.dispatch_executor().submit(run)
 
@@ -646,9 +747,20 @@ class Messenger:
 
         self._run_soon(_all())
 
-    def _run_sync(self, coro, timeout: float = 30.0):
+    def _run_sync(self, coro, timeout: float | None = None):
+        """Blocking bridge into the reactor.  The default deadline is
+        conf ms_sync_timeout (was a hardcoded 30 s); an expiry counts
+        in the ledger (msgr_sync_timeouts) before surfacing — the
+        caller still needs the exception, but the event is no longer
+        invisible."""
         fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
-        return fut.result(timeout)
+        try:
+            return fut.result(self.sync_timeout if timeout is None
+                              else timeout)
+        except _FuturesTimeout:
+            if self.ledger.enabled:
+                self.stats.note_sync_timeout()
+            raise
 
     # -- server side --------------------------------------------------------
 
@@ -766,7 +878,10 @@ class Messenger:
             # must trim nothing or undelivered replies would be lost.
             peer_in = int(meta.get("in_seq", 0)) \
                 if meta.get("peer_cookie") == sess.local_cookie else 0
-            for raw in sess.replay_frames(peer_in):
+            frames = sess.replay_frames(peer_in)
+            if frames and self.ledger.enabled:
+                self.stats.note_replay(conn._peer_label(), len(frames))
+            for raw in frames:
                 writer.write(sess.wire_prepare(raw))
             await writer.drain()
         except (ConnectionError, OSError):
@@ -856,6 +971,11 @@ class Messenger:
                 # Message::recv_stamp set by the messenger): dispatch
                 # latency is attributable even when the executor queues
                 msg.recv_stamp = time.time()
+                if self.ledger.enabled:
+                    self.stats.note_recv(
+                        conn._peer_label(), type(msg).__name__,
+                        Message.HEADER_SIZE + len(meta_raw) +
+                        len(data) + 4)
                 sess.in_seq = seq
                 if self.recv_filter is not None and \
                         self.recv_filter(msg):
@@ -877,9 +997,27 @@ class Messenger:
                             traceback.print_exc()
                     else:
                         # dispatch off-reactor so handlers may send
-                        # synchronously / block on nested RPCs
-                        await asyncio.get_event_loop().run_in_executor(
-                            None, self.dispatcher, conn, msg)
+                        # synchronously / block on nested RPCs; the
+                        # ledger times queue wait + handler run so
+                        # "dispatcher slow" is attributable
+                        led = self.ledger
+                        if led.enabled:
+                            t_sub = led.dispatch_submit()
+
+                            def _timed(d=self.dispatcher, c=conn,
+                                       mm=msg, t=t_sub):
+                                t_run = led.dispatch_run(t)
+                                try:
+                                    d(c, mm)
+                                finally:
+                                    led.dispatch_done(t_run)
+
+                            await asyncio.get_event_loop() \
+                                .run_in_executor(None, _timed)
+                        else:
+                            await asyncio.get_event_loop() \
+                                .run_in_executor(None, self.dispatcher,
+                                                 conn, msg)
                 # Batch acks: piggyback-style — ack when the pipe goes
                 # idle or every 64 frames, not per message (reference
                 # ProtocolV2 acks lazily from the write path too).

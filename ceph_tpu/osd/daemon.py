@@ -76,7 +76,8 @@ class MessengerShardBackend(ShardBackend):
     # -- writes -------------------------------------------------------------
 
     def sub_write(self, shard, txn, on_commit, log_entries=None,
-                  at_version=None, rollforward_to=None, trace=None):
+                  at_version=None, rollforward_to=None, trace=None,
+                  top=None):
         from .pg_log import entry_to_wire
         osd = self._osd_for(shard)
         spg = spg_t(self.pgid, shard)
@@ -100,10 +101,16 @@ class MessengerShardBackend(ShardBackend):
         with self.lock:
             self._pending_writes[tid] = (on_commit, shard)
         conn = self.daemon.conn_to_osd(osd)
-        conn.send_message(M.MOSDECSubOpWrite(
+        m = M.MOSDECSubOpWrite(
             spg, tid, at_version or eversion_t(), txn,
             log_entries=wire_entries, rollforward_to=rollforward_to,
-            trace=trace))
+            trace=trace)
+        if top is not None:
+            # wire-plane trace stitch: the msgr ledger stamps
+            # msgr_send(peer) on the tracked op once the frame is
+            # actually written, so send-queue time is attributable
+            m._top = top
+        conn.send_message(m)
 
     def handle_write_reply(self, msg: M.MOSDECSubOpWriteReply) -> None:
         with self.lock:
@@ -563,6 +570,16 @@ class OSDDaemon:
                 "pg ledger", self._asok_pg_ledger)
             self.cct.asok.register_command(
                 "pg_ledger", self._asok_pg_ledger)
+            # wire-plane flight recorder (docs/TRACING.md "Wire
+            # plane"); both spellings like mesh/launch-queue
+            self.cct.asok.register_command(
+                "messenger status", self._asok_messenger_status)
+            self.cct.asok.register_command(
+                "messenger_status", self._asok_messenger_status)
+            self.cct.asok.register_command(
+                "conn profile", self._asok_conn_profile)
+            self.cct.asok.register_command(
+                "conn_profile", self._asok_conn_profile)
         self.store = store or MemStore()
         self.store.mount()
         self._raw_tid = 1 << 32   # raw-RPC tids, disjoint from backends'
@@ -682,9 +699,29 @@ class OSDDaemon:
         self._pgstats_last_sent: dict | None = None
         self._pgstats_last_time = 0.0
 
+        # reactor pool size is a startup option: the class-level pool
+        # is created by the FIRST messenger on this host, so the knob
+        # must be applied before construction (vstart does the same
+        # for in-process clusters; this covers ProcCluster daemons)
+        Messenger.configure_pool(
+            int(self.cct.conf.get("ms_async_op_threads")))
         self.messenger = Messenger(f"osd.{osd_id}", auth=auth,
                                    secure=secure)
         self.messenger.add_dispatcher(self._dispatch)
+        # wire-plane flight recorder (msg/msgr_ledger.py, docs/
+        # TRACING.md "Wire plane"): per-daemon wire counters always
+        # register (each daemon's own traffic), but the shared
+        # MsgrLedger perf set (reactor lag + dispatch histograms)
+        # follows the profiler's perf-owner rule — the pool is a host
+        # singleton, so exactly ONE daemon per process exports it and
+        # ships the monward lag window on MPGStats
+        self.cct.perf.add(self.messenger.stats.perf)
+        _mled = self.messenger.ledger
+        self._msgr_reporter = False
+        if not getattr(_mled, "_perf_registered", False):
+            _mled._perf_registered = True
+            self._msgr_reporter = True
+            self.cct.perf.add(_mled.perf)
         # fast dispatch (reference ms_fast_dispatch): the EC data-path
         # RPCs run inline on the reactor — their handlers never block
         # on nested RPCs (shard read = store read + async send; the
@@ -711,7 +748,24 @@ class OSDDaemon:
                 str(conf.get("ms_compress")) or None
             self.messenger.compress_min = \
                 int(conf.get("ms_compress_min_size"))
+            self.messenger.inject_dispatch_stall = \
+                float(conf.get("ms_inject_dispatch_stall"))
+            self.messenger.sync_timeout = \
+                float(conf.get("ms_sync_timeout"))
         _apply_inject()
+
+        def _apply_msgr(_k=None, _v=None):
+            led = self.messenger.ledger
+            led.enabled = bool(conf.get("ms_ledger"))
+            led.set_peer_cap(int(conf.get("ms_ledger_peers")))
+            led.probe_interval = float(
+                conf.get("ms_reactor_lag_interval"))
+            led.warn_s = float(conf.get("ms_reactor_lag_warn_s"))
+        _apply_msgr()
+        for _opt in ("ms_ledger", "ms_ledger_peers",
+                     "ms_reactor_lag_interval",
+                     "ms_reactor_lag_warn_s"):
+            conf.add_observer(_opt, _apply_msgr)
         # recovery concurrency cap (reference osd_max_backfills
         # reservations): bounds simultaneous per-object rebuilds
         # across this daemon's recovery threads
@@ -724,7 +778,8 @@ class OSDDaemon:
         for _opt in ("ms_inject_socket_failures",
                      "ms_inject_delay_probability",
                      "ms_inject_delay_max", "ms_compress",
-                     "ms_compress_min_size"):
+                     "ms_compress_min_size",
+                     "ms_inject_dispatch_stall", "ms_sync_timeout"):
             conf.add_observer(_opt, _apply_inject)
         self.addr = self.messenger.bind(addr)
         # one mon or a monmap list (reference MonClient hunting)
@@ -813,6 +868,12 @@ class OSDDaemon:
                         TraceContext.from_wire(msg.trace))
                     top.mark_event("msgr_dispatch",
                                    getattr(msg, "recv_stamp", None))
+                    # wire-plane stitch: the interval from recv_stamp
+                    # (frame off the socket) to here is the messenger
+                    # dispatch-queue wait — blamed on msgr_recv_lag so
+                    # a starved executor names itself on the timeline
+                    if self.messenger.ledger.enabled:
+                        top.mark_event("msgr_recv_lag")
                     top.set_info("pg", str(msg.pgid.pgid))
                     # the op's primary IS this OSD (client ops land on
                     # the primary): slow-op reports carry it so the
@@ -3890,6 +3951,29 @@ class OSDDaemon:
         out["pg_state_counts"] = self.pg_ledger.pg_state_counts()
         return out
 
+    def _asok_messenger_status(self, cmd: dict) -> dict:
+        """`ceph daemon osd.N.asok messenger status` (docs/TRACING.md
+        "Wire plane"): reactor health (per-reactor loop lag, lag
+        events), dispatch-executor depth/high-water and qwait/dispatch
+        latency summaries, plus this daemon's wire totals."""
+        out = self.messenger.ledger.status()
+        out["osd"] = self.osd_id
+        out["host_perf_owner"] = self._msgr_reporter
+        out["reactors_conf"] = int(
+            self.cct.conf.get("ms_async_op_threads")) or None
+        out["daemon"] = self.messenger.stats.totals()
+        return out
+
+    def _asok_conn_profile(self, cmd: dict) -> dict:
+        """`ceph daemon osd.N.asok conn profile`: per-peer wire
+        accounting — msgs/bytes in/out by message type, send-queue
+        high-water, reconnects, replay frames, compress/encrypt
+        bytes — from this daemon's bounded per-peer ring."""
+        out = self.messenger.ledger.conn_profile(
+            last=int(cmd["last"]) if "last" in cmd else None)
+        out["osd"] = self.osd_id
+        return out
+
     def _asok_launch_profile(self, cmd: dict) -> dict:
         """`ceph daemon osd.N.asok launch profile`: the host flight
         recorder's launch ledger — aggregates, lat_launch_* percentile
@@ -4178,6 +4262,15 @@ class OSDDaemon:
                     "budget_s": float(self.cct.conf.get(
                         "osd_ec_compile_storm_budget_s")),
                 }
+        # wire-plane ledger block (MSGR_REACTOR_LAG, mon/monitor.py):
+        # same perf-owner rule as compile — the reactor pool is a host
+        # singleton, so only one co-hosted daemon ships its lag
+        # window; None while the window is empty keeps steady-state
+        # reports bit-identical for the dedup above
+        if self._msgr_reporter:
+            mb = self.messenger.ledger.pgstats_block()
+            if mb is not None:
+                rep["msgr"] = mb
         return rep
 
     def _pgstats_should_send(self, rep: dict, now: float) -> bool:

@@ -67,7 +67,7 @@ class ShardBackend:
                   on_commit: Callable[[int], None],
                   log_entries: list | None = None,
                   at_version=None, rollforward_to=None,
-                  trace: dict | None = None) -> None:
+                  trace: dict | None = None, top=None) -> None:
         """Apply txn on `shard`; log_entries (pg_log.LogEntry) persist
         atomically with it (reference ECSubWrite.log_entries).  trace
         is an optional child TraceContext wire dict — remote
@@ -133,7 +133,10 @@ class LocalShardBackend(ShardBackend):
                            for s in range(n_shards)}
 
     def sub_write(self, shard, txn, on_commit, log_entries=None,
-                  at_version=None, rollforward_to=None, trace=None):
+                  at_version=None, rollforward_to=None, trace=None,
+                  top=None):
+        # top: tracked op for wire-plane trace stitching — local
+        # shards have no wire, so it is accepted and unused here
         slog = self.shard_logs[shard]
         if log_entries and at_version is not None:
             slog.append_to_txn(txn, log_entries, at_version)
@@ -1366,7 +1369,8 @@ class ECBackend:
                                       log_entries=entries,
                                       at_version=op.version,
                                       rollforward_to=rf,
-                                      trace=wire_trace)
+                                      trace=wire_trace,
+                                      top=top if tracked else None)
             except Exception as e:  # noqa: BLE001 — a failed sub-write
                 # must not wedge the in-order commit queue: count the
                 # shard as resolved (failed) so the op drains, carrying

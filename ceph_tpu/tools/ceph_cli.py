@@ -39,7 +39,11 @@ commands this build's mon implements:
       # attribution (docs/TRACING.md "Device plane");
       # `pg ledger` = the control-plane flight recorder: per-PG
       # state-machine transitions, stage timings, degraded windows
-      # (docs/TRACING.md "Control plane")
+      # (docs/TRACING.md "Control plane");
+      # `messenger status` = the wire-plane flight recorder: reactor
+      # loop lag, dispatch-queue depth/wait, wire totals; `conn
+      # profile` = per-peer msgs/bytes by type, reconnects, replay
+      # (docs/TRACING.md "Wire plane")
   python -m ceph_tpu.tools.ceph_cli daemon /path/to/mon.0.asok \
       osdmap status
       # mon map-distribution ledger: full/incremental/keepalive sends,
@@ -82,7 +86,8 @@ def daemon_command(argv: list[str]) -> int:
     # `launch queue status`, hence the head-driven loop.
     heads = ("perf", "config", "log", "mesh", "launch", "launch queue",
              "repair", "osdmap", "compile", "prewarm", "bucket",
-             "bucket reshard", "bucket limit", "pg")
+             "bucket reshard", "bucket limit", "pg", "messenger",
+             "conn")
     while extra and prefix in heads:
         prefix = f"{prefix} {extra[0]}"
         extra = extra[1:]

@@ -741,6 +741,22 @@ def _main_scale(args) -> int:
         # the host launch/compile ledger like every bench row
         from ..ops.profiler import device_profiler
         row["launch_ledger"] = device_profiler().bench_summary()
+        # wire-plane provenance (ISSUE 20): the scale row carries the
+        # messenger ledger beside recovery_blame — reactor lag and
+        # dispatch-queue percentiles, per-peer bytes, reconnects — so
+        # a slow boot-RT ships with its own wire explanation
+        from ..msg.msgr_ledger import msgr_ledger
+        mled = msgr_ledger().bench_summary()
+        row["msgr_ledger"] = mled
+        for k in ("reactor_lag_ms_p50", "reactor_lag_ms_p99",
+                  "qwait_ms_p50", "qwait_ms_p99"):
+            if mled.get(k) is None:
+                fail.append(f"msgr_ledger {k} never populated "
+                            f"(wire-plane recorder dead)")
+        if not mled.get("peer_bytes"):
+            fail.append("msgr_ledger saw no per-peer traffic")
+        if "reconnects" not in mled:
+            fail.append("msgr_ledger reconnects missing")
         if prewarm_ec:
             # ISSUE 16 gates: with the boot prewarm + persistent
             # cache, the armed stall injection must never have fired

@@ -827,6 +827,12 @@ def run_degraded_read_storm(n_osds: int = 12, objects: int = 6,
                       "throttle_s"):
                 deg_stage_s[k] = round(
                     deg_stage_s.get(k, 0.0) + t.get(k, 0.0), 4)
+        # wire-plane ledger (ISSUE 20): the kill/revive storm's
+        # reconnect/replay rounds + reactor-lag/dispatch percentiles —
+        # a degraded window that was really a starved reactor shows up
+        # here instead of staying folklore
+        from ..msg.msgr_ledger import msgr_ledger
+        msgr_row = msgr_ledger().bench_summary()
     row = {
         "metric": "harness_degraded_read",
         "osds": n_osds, "objects_acked": len(acked),
@@ -847,6 +853,7 @@ def run_degraded_read_storm(n_osds: int = 12, objects: int = 6,
             "acked_writes_degraded": deg_acked,
             "recovery_stage_s": deg_stage_s,
         },
+        "msgr_ledger": msgr_row,
         "duration_s": round(time.perf_counter() - t_start, 1),
     }
     errors = summary.get("errors", 0) or 0

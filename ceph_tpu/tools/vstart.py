@@ -66,6 +66,14 @@ class Cluster:
         self.secure = secure
         self.keyring = None
         self.service_key = None
+        # reactor pool sizing (ms_async_op_threads, startup-only): the
+        # class-level pool is created by the FIRST messenger in this
+        # process — the mon's — so the knob must land before Monitor
+        # construction to take effect for the whole cluster
+        if self.conf.get("ms_async_op_threads"):
+            from ..msg.messenger import Messenger
+            Messenger.configure_pool(
+                int(self.conf["ms_async_op_threads"]))
         mon_auths = [None] * n_mons
         if auth == "cephx":
             import os as _os

@@ -76,7 +76,13 @@ fi
 # block (peering/scan/decode/push/throttle all positive, the
 # decomposition within 10% of time_to_active_clean, remote-list scan
 # counts > 0) — asserted inside cluster_bench's fail list, so a dead
-# control-plane ledger fails the row right here.
+# control-plane ledger fails the row right here.  ISSUE 20 rides it
+# too: the row must embed a `msgr_ledger` block beside recovery_blame
+# with reactor-lag and dispatch-qwait p50/p99 populated, per-peer
+# bytes non-empty, and the reconnect counter present — asserted in the
+# same fail list, so a dead wire-plane recorder fails the row here.
+# The msgr on-vs-off overhead gate (<= MSGR_OVERHEAD_MAX_PCT, 2%)
+# rides bench.py --smoke above with the other two recorder gates.
 if [ "$rc" -eq 0 ]; then
   timeout -k 10 420 env JAX_PLATFORMS=cpu python -m ceph_tpu.tools.cluster_bench \
     --scale 16 --seconds 2 --size 16384 || rc=$?
